@@ -1,0 +1,97 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle-parity
+capabilities (reference: /root/reference, PaddlePaddle ~v2.0/2.1-dev).
+
+Architecture (see SURVEY.md §7): the user-visible surface mirrors paddle 2.0
+(dygraph Tensor/Layer/optimizer, static Program/Executor, Fleet distributed
+strategies), while the execution substrate is JAX/XLA — ops are pure JAX
+functions that run eagerly with a vjp autograd tape, and compile into single
+fused XLA programs under paddle_tpu.jit / pjit / shard_map. Distribution is
+SPMD over jax.sharding.Mesh with XLA collectives on ICI/DCN instead of
+NCCL rings.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# Paddle's default index/integer dtype is int64 (reference:
+# framework.proto VarType INT64 used by lookup_table, arg_max, …). jax
+# truncates to 32-bit unless x64 is enabled; float defaults stay f32 via this
+# package's own dtype plumbing (core.dtypes.get_default_dtype).
+_jax.config.update("jax_enable_x64", True)
+
+# -- core dtypes (paddle.float32 etc.) --------------------------------------
+from .core.dtypes import (  # noqa: F401
+    bool_ as bool8, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128,
+    set_default_dtype, get_default_dtype, convert_dtype,
+)
+from .core.dtypes import bool_  # noqa: F401
+
+# -- places / devices -------------------------------------------------------
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XLAPlace, Place,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+    device_count,
+)
+
+# -- flags / errors ---------------------------------------------------------
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core import errors  # noqa: F401
+
+# -- tensor + autograd ------------------------------------------------------
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core.autograd import (  # noqa: F401
+    no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad,
+)
+from .core.random import seed, get_rng_state  # noqa: F401
+
+# -- ops --------------------------------------------------------------------
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+from .ops import sum, max, min, abs, all, any, round, pow, slice  # noqa: F401,A004
+
+# -- subsystem namespaces ---------------------------------------------------
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import models  # noqa: F401,E402
+from . import parallel  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from .hapi import Model, summary, flops  # noqa: F401,E402
+from .framework_io import save, load  # noqa: F401,E402
+
+from .nn.layer.base import ParamAttr  # noqa: F401,E402
+
+# dygraph-mode API parity helpers (reference: fluid/framework.py
+# in_dygraph_mode; this framework is dygraph-by-default like paddle 2.0)
+from .static.mode import (  # noqa: F401,E402
+    in_dynamic_mode, enable_static, disable_static,
+)
+
+
+def disable_signal_handler():
+    """Parity no-op (reference: pybind disable_signal_handler)."""
+
+
+def in_dygraph_mode():
+    return in_dynamic_mode()
+
+
+class DataParallel:  # real impl re-exported below once distributed loads
+    pass
+
+
+from .distributed.parallel import DataParallel  # noqa: F401,E402,F811
+
+__version__ = "0.1.0"
+version = __version__

@@ -1,0 +1,266 @@
+"""Eager autograd engine.
+
+TPU-native analogue of the reference's imperative runtime + BasicEngine
+(/root/reference/paddle/fluid/imperative/tracer.cc:132 TraceOp records
+GradOpNodes; basic_engine.cc:39/221/265 Init/PrepareDeps/Execute runs a
+dep-counted BFS over grad ops; gradient_accumulator.cc sums grads).
+
+Design differences, deliberately TPU-first:
+- Instead of per-op C++ grad kernels selected from a registry, each eager op
+  call captures a jax.vjp closure (XLA-differentiated); backward replays the
+  closures in reverse topological order. The same op functions are pure JAX,
+  so under `paddle_tpu.jit.to_static`/`jax.jit` NO tape is recorded — the
+  whole step traces into one XLA computation and jax.grad handles AD (this is
+  the performance path; the tape is the eager-semantics path).
+- Grad accumulation is functional (cotangent dict keyed by producer slot)
+  rather than mutation of a GradientAccumulator.
+"""
+from __future__ import annotations
+
+import itertools
+import weakref
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_seq_counter = itertools.count()
+
+
+class TapeNode:
+    """One recorded differentiable op (reference: GradOpNode, layer.h)."""
+
+    __slots__ = ("seq", "op_type", "vjp_fn", "inputs", "out_specs",
+                 "out_refs", "__weakref__")
+
+    def __init__(self, op_type: str, vjp_fn: Callable, inputs: List[Any],
+                 out_specs: List[Tuple[tuple, Any]]):
+        self.seq = next(_seq_counter)
+        self.op_type = op_type
+        self.vjp_fn: Optional[Callable] = vjp_fn
+        self.inputs = inputs            # Tensors (strong refs keep graph alive)
+        self.out_specs = out_specs      # [(shape, dtype)] per flat output
+        self.out_refs: List[Optional[weakref.ref]] = [None] * len(out_specs)
+
+    @property
+    def n_out(self) -> int:
+        return len(self.out_specs)
+
+    def release(self):
+        """Free vjp residuals after backward (retain_graph=False)."""
+        self.vjp_fn = None
+        self.inputs = []
+
+
+class _GradState:
+    enabled = True
+
+
+@contextmanager
+def no_grad():
+    """paddle.no_grad — disables tape recording."""
+    prev = _GradState.enabled
+    _GradState.enabled = False
+    try:
+        yield
+    finally:
+        _GradState.enabled = prev
+
+
+@contextmanager
+def enable_grad():
+    prev = _GradState.enabled
+    _GradState.enabled = True
+    try:
+        yield
+    finally:
+        _GradState.enabled = prev
+
+
+def set_grad_enabled(mode: bool):
+    @contextmanager
+    def _ctx():
+        prev = _GradState.enabled
+        _GradState.enabled = mode
+        try:
+            yield
+        finally:
+            _GradState.enabled = prev
+    return _ctx()
+
+
+def is_grad_enabled() -> bool:
+    return _GradState.enabled
+
+
+def _zero_cotangent(spec):
+    shape, dtype = spec
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _collect_nodes(root: TapeNode):
+    """Reachable subgraph, not crossing stop_gradient tensors."""
+    seen = set()
+    stack = [root]
+    nodes = []
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        nodes.append(n)
+        for inp in n.inputs:
+            pn = getattr(inp, "_node", None)
+            if pn is not None and not inp.stop_gradient and id(pn) not in seen:
+                stack.append(pn)
+    nodes.sort(key=lambda n: -n.seq)
+    return nodes
+
+
+def _run_engine(root_tensor, root_grad, retain_graph: bool,
+                sink: Optional[Dict[int, Any]] = None,
+                sink_ids: Optional[set] = None):
+    """Reverse-topological sweep (reference: BasicEngine::Execute
+    basic_engine.cc:265). `sink`/`sink_ids`: when set (paddle.grad path),
+    leaf cotangents are written there instead of .grad.
+    """
+    from .tensor import Tensor  # local import to break cycle
+
+    def apply_hooks(t: Tensor, cot):
+        """Hooks see/return Tensors (paddle parity: VarBase hooks)."""
+        for h in t._hooks:
+            out = h(Tensor(cot, stop_gradient=True))
+            if out is not None:
+                cot = out._value if isinstance(out, Tensor) else out
+        return cot
+
+    def deliver_leaf(t: Tensor, cot):
+        if _is_float0(cot) or t.stop_gradient:
+            return
+        cot = apply_hooks(t, cot)
+        if sink is not None:
+            if sink_ids is None or id(t) in sink_ids:
+                sink[id(t)] = cot if id(t) not in sink else sink[id(t)] + cot
+            return
+        t._accumulate_grad(cot)
+
+    node = root_tensor._node
+    if node is None:
+        deliver_leaf(root_tensor, root_grad)
+        return
+
+    cot: Dict[Tuple[int, int], Any] = {(id(node), root_tensor._out_idx): root_grad}
+    for n in _collect_nodes(node):
+        outs = [cot.pop((id(n), i), None) for i in range(n.n_out)]
+        if all(o is None for o in outs):
+            if not retain_graph:
+                n.release()
+            continue
+        if n.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time, but the "
+                "saved intermediate results have already been freed. Specify "
+                "retain_graph=True the first time you call backward().")
+        # apply registered tensor hooks of the produced tensors
+        for i, o in enumerate(outs):
+            if o is None:
+                continue
+            ref = n.out_refs[i]
+            t = ref() if ref is not None else None
+            if t is not None:
+                o = apply_hooks(t, o)
+                outs[i] = o
+                if sink is not None and sink_ids is not None and id(t) in sink_ids:
+                    sink[id(t)] = o if id(t) not in sink else sink[id(t)] + o
+        outs = [o if o is not None else _zero_cotangent(s)
+                for o, s in zip(outs, n.out_specs)]
+        in_cots = n.vjp_fn(tuple(outs) if n.n_out > 1 else outs[0])
+        inputs = n.inputs
+        if not retain_graph:
+            n.release()
+        for inp, ic in zip(inputs, in_cots):
+            if _is_float0(ic) or inp.stop_gradient:
+                continue
+            pn = inp._node
+            if pn is None:
+                deliver_leaf(inp, ic)
+            else:
+                key = (id(pn), inp._out_idx)
+                cot[key] = ic if key not in cot else cot[key] + ic
+                if sink is None and inp._retain_grads:
+                    inp._accumulate_grad(ic)
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False):
+    """Tensor.backward entry (reference: pybind/imperative.cc:871
+    VarBase._run_backward → BasicEngine)."""
+    from .tensor import Tensor
+    if grad_tensor is None:
+        root_grad = jnp.ones(tensor.shape, tensor._value.dtype)
+    else:
+        root_grad = grad_tensor._value if isinstance(grad_tensor, Tensor) \
+            else jnp.asarray(grad_tensor)
+    _run_engine(tensor, root_grad, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False, no_grad_vars=None):
+    """paddle.grad (reference: PartialGradEngine, partial_grad_engine.cc).
+
+    Returns grads of `outputs` w.r.t. `inputs` without touching .grad.
+    """
+    from .tensor import Tensor
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported yet")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = False
+    sink: Dict[int, Any] = {}
+    sink_ids = {id(t) for t in inputs}
+    # no_grad_vars: temporarily mark as stop_gradient so traversal and
+    # cotangent routing treat their subgraphs as constant
+    blocked = []
+    if no_grad_vars:
+        for v in (no_grad_vars if isinstance(no_grad_vars, (list, tuple))
+                  else [no_grad_vars]):
+            if not v.stop_gradient:
+                v.stop_gradient = True
+                blocked.append(v)
+    try:
+        for k, (out, g) in enumerate(zip(outputs, grad_outputs)):
+            if g is None:
+                g = jnp.ones(out.shape, out._value.dtype)
+            else:
+                g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+            last = (k == len(outputs) - 1)
+            _run_engine(out, g, retain_graph or not last,
+                        sink=sink, sink_ids=sink_ids)
+    finally:
+        for v in blocked:
+            v.stop_gradient = False
+    results = []
+    for t in inputs:
+        if id(t) in sink:
+            results.append(Tensor(sink[id(t)], stop_gradient=True))
+        elif allow_unused:
+            results.append(None)
+        else:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have been "
+                "used in the graph. Set allow_unused=True if this is desired.")
+    return results

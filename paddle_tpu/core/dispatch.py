@@ -1,0 +1,144 @@
+"""Op dispatch: the eager trace step.
+
+TPU-native analogue of the reference's Tracer::TraceOp
+(/root/reference/paddle/fluid/imperative/tracer.cc:132: create op → AMP cast →
+kernel dispatch → record GradOpNode) and of the generated `core.ops.*`
+fast-path functions (pybind/op_function_generator.cc).
+
+Every framework op is a *pure JAX function* wrapped by @op. Dispatch:
+1. unwraps Tensor leaves (pytree-general, so list-of-Tensor inputs work),
+2. applies dygraph AMP autocast if active (reference: amp_auto_cast.cc:27),
+3. if gradients are required, records a TapeNode carrying a jax.vjp closure,
+4. wraps outputs back into Tensors.
+
+Under jax tracing (to_static / jax.jit / shard_map) values are jax Tracers:
+the tape is bypassed and the op contributes straight to the traced jaxpr, so
+whole training steps compile into one fused XLA module — the analogue of the
+reference's ParallelExecutor graph mode, but via XLA instead of SSA graphs.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import TapeNode, _GradState
+from .tensor import Tensor
+from . import flags as _flags
+
+_OP_REGISTRY = {}
+
+# hooks installed by other subsystems (set lazily to avoid import cycles)
+_amp_cast_hook = None          # ops.amp installs: fn(op_type, tensors)->tensors
+_static_capture_hook = None    # static.program installs
+
+
+def register_amp_hook(fn):
+    global _amp_cast_hook
+    _amp_cast_hook = fn
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _leaf_is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def dispatch(op_type: str, fn: Callable, args, kwargs, differentiable=True):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_leaf_is_tensor)
+    tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+
+    if _amp_cast_hook is not None and tensor_pos:
+        casted = _amp_cast_hook(op_type, [leaves[i] for i in tensor_pos])
+        if casted is not None:
+            for i, t in zip(tensor_pos, casted):
+                leaves[i] = t
+
+    in_tensors = [leaves[i] for i in tensor_pos]
+    arrs = [t._value for t in in_tensors]
+
+    def pure(*arrs_):
+        ll = list(leaves)
+        for i, a in zip(tensor_pos, arrs_):
+            ll[i] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, ll)
+        return fn(*a2, **k2)
+
+    tracing = any(_is_tracer(a) for a in arrs)
+    need_grad = (differentiable and _GradState.enabled and not tracing
+                 and any(not t.stop_gradient for t in in_tensors))
+
+    if not need_grad:
+        out = pure(*arrs)
+        return _wrap_outputs(op_type, out, None, stop_gradient=True)
+
+    out, vjp_fn = jax.vjp(pure, *arrs)
+    flat_out, out_tree = jax.tree_util.tree_flatten(out)
+    node = TapeNode(
+        op_type,
+        _vjp_adapter(vjp_fn, out_tree, len(flat_out)),
+        in_tensors,
+        [(tuple(a.shape), a.dtype) for a in flat_out],
+    )
+    return _wrap_outputs(op_type, out, node, stop_gradient=False)
+
+
+def _vjp_adapter(vjp_fn, out_tree, n_out):
+    """Engine delivers flat cotangents; vjp expects the output pytree."""
+    def run(cots):
+        flat = [cots] if n_out == 1 else list(cots)
+        return vjp_fn(jax.tree_util.tree_unflatten(out_tree, flat))
+    return run
+
+
+def _check_finite(op_type, arrs):
+    for a in arrs:
+        if jnp.issubdtype(a.dtype, jnp.inexact) and not bool(jnp.isfinite(a).all()):
+            raise FloatingPointError(
+                f"Operator {op_type} output contains NaN/Inf "
+                "(FLAGS_check_nan_inf is set; reference hook operator.cc:1172)")
+
+
+def _wrap_outputs(op_type, out, node, stop_gradient):
+    flat, out_tree = jax.tree_util.tree_flatten(out)
+    if _flags.flag("check_nan_inf") and not any(_is_tracer(a) for a in flat):
+        _check_finite(op_type, flat)
+    wrapped = []
+    for i, a in enumerate(flat):
+        t = Tensor(a, stop_gradient=stop_gradient)
+        if node is not None:
+            t._node = node
+            t._out_idx = i
+            import weakref
+            node.out_refs[i] = weakref.ref(t)
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_tree, wrapped)
+
+
+def op(op_type: str, differentiable: bool = True):
+    """Declare a framework op (reference: REGISTER_OPERATOR
+    op_registry.h:256 — here registration is a decorator and the 'kernel' is
+    a pure JAX function lowered by XLA for whatever backend is active)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return dispatch(op_type, fn, args, kwargs, differentiable)
+        wrapper.op_type = op_type
+        wrapper.raw_fn = fn
+        _OP_REGISTRY[op_type] = wrapper
+        return wrapper
+    return deco
+
+
+def get_op(op_type: str):
+    return _OP_REGISTRY.get(op_type)
+
+
+def registered_ops():
+    return sorted(_OP_REGISTRY)

@@ -1,0 +1,108 @@
+"""Dtype system.
+
+TPU-native analogue of the reference's VarType::Type dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:106-141) and the
+proto_type<->numpy mapping in python/paddle/fluid/data_feeder.py. Instead of a
+protobuf enum dispatched through OpKernelType, dtypes here ARE jax/numpy
+dtypes — XLA is the only "kernel library", so the enum collapses onto
+jnp.dtype with paddle-style names preserved for API parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype singletons (paddle exposes these as paddle.float32 etc.)
+bool_ = jnp.dtype(jnp.bool_)
+uint8 = jnp.dtype(jnp.uint8)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype):
+    """Normalise any dtype spec (str / np dtype / jnp dtype / None) to jnp.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unsupported dtype string: {dtype!r}")
+    try:
+        return jnp.dtype(dtype)
+    except TypeError:
+        raise ValueError(f"Cannot convert {dtype!r} to a dtype")
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    if d == bool_:
+        return "bool"
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX
+
+
+# ---------------------------------------------------------------------------
+# Default dtype state (reference: python/paddle/framework/framework.py
+# set_default_dtype/get_default_dtype)
+# ---------------------------------------------------------------------------
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in _FLOATING:
+        raise TypeError(
+            "set_default_dtype only supports floating dtypes, got %s" % d)
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))

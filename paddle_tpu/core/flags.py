@@ -1,0 +1,91 @@
+"""Global flags registry.
+
+TPU-native analogue of the reference's gflags surface
+(/root/reference/paddle/fluid/platform/flags.cc:33-565, exposed to Python via
+pybind/global_value_getter_setter.cc and paddle.set_flags/get_flags). Flags are
+plain Python values seeded from FLAGS_* environment variables; a handful map
+straight onto XLA/JAX configuration.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+_PUBLIC: set = set()
+
+
+def define_flag(name: str, default, help_str: str = "", public: bool = True):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = value
+    if public:
+        _PUBLIC.add(name)
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown flag {k!r}")
+        _REGISTRY[key] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown flag {k!r}")
+        out[k] = _REGISTRY[key]
+    return out
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+# -- core flags (subset of reference's 32+, mapped to TPU-relevant knobs) ----
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf after every eager op "
+            "(reference: operator.cc:1172 hook).")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "GC knob; a no-op under XLA's buffer management, kept for parity.")
+define_flag("allocator_strategy", "auto_growth",
+            "Parity flag; allocation is delegated to PJRT.")
+define_flag("use_system_allocator", False, "Parity flag.")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "Maps onto XLA_PYTHON_CLIENT_MEM_FRACTION semantics.")
+define_flag("cudnn_deterministic", False,
+            "Maps onto XLA deterministic-ops preference.")
+define_flag("paddle_num_threads", 1, "Host-side intra-op threads.")
+define_flag("tpu_matmul_precision", "default",
+            "jax matmul precision: default|high|highest.")
+define_flag("benchmark", False, "Sync after each op for timing.")
+define_flag("check_finite", False, "Alias surface for AMP debugging.")
+define_flag("max_inplace_grad_add", 0, "Parity flag for grad accumulation.")
+define_flag("retain_grad_for_all_tensor", False,
+            "Keep .grad on non-leaf tensors during backward.")
+define_flag("call_stack_level", 1, "Error stack verbosity (enforce.h parity).")
+define_flag("sort_sum_gradient", False,
+            "Deterministic gradient accumulation order "
+            "(reference: imperative/flags gradient add order).")
+define_flag("use_mkldnn", False, "Parity flag; XLA:CPU is the CPU backend.")
+define_flag("conv_workspace_size_limit", 512, "Parity flag.")
+define_flag("cudnn_exhaustive_search", False, "Parity flag (autotune).")
+define_flag("sync_nccl_allreduce", True, "Parity flag; XLA orders collectives.")
+define_flag("fuse_parameter_memory_size", -1, "Parity flag; XLA fuses.")
+define_flag("init_allocated_mem", False, "Parity flag.")
+define_flag("enable_parallel_graph", False, "Parity flag.")

@@ -1,0 +1,51 @@
+"""Global RNG state.
+
+TPU-native analogue of the reference's Generator
+(/root/reference/paddle/fluid/framework/generator.cc — per-place mt19937 with
+global seed via paddle.seed). On TPU, randomness is counter-based: a root
+jax.random key derived from the seed, with a monotonically increasing
+fold_in counter per draw. This keeps the stateful paddle API (`paddle.seed`,
+implicit global generator) while staying reproducible and trace-safe: inside a
+jit trace the current counter value is burned into the compiled program, so a
+captured step function draws fresh randomness per call only if it threads keys
+explicitly (paddle_tpu.jit handles this for dropout via functional keys).
+"""
+from __future__ import annotations
+
+import itertools
+
+import jax
+
+
+class _RNGState:
+    seed = 0
+    counter = 0
+    root_key = jax.random.PRNGKey(0)
+
+
+def seed(s: int):
+    _RNGState.seed = int(s)
+    _RNGState.root_key = jax.random.PRNGKey(int(s))
+    _RNGState.counter = 0
+    return _RNGState
+
+
+def get_rng_state():
+    """Read-only snapshot (seed, draw counter) — does NOT advance the
+    stream."""
+    return (_RNGState.seed, _RNGState.counter)
+
+
+def set_rng_state(state):
+    seed(state[0])
+    _RNGState.counter = int(state[1])
+
+
+def next_key():
+    """Fresh PRNG key for one random draw."""
+    _RNGState.counter += 1
+    return jax.random.fold_in(_RNGState.root_key, _RNGState.counter)
+
+
+def default_seed() -> int:
+    return _RNGState.seed

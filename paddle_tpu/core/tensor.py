@@ -1,0 +1,329 @@
+"""Tensor: the user-facing eager tensor.
+
+TPU-native analogue of the reference's VarBase/VariableWrapper + Tensor
+(/root/reference/paddle/fluid/imperative/layer.h VarBase,
+framework/tensor.h:89 Tensor with Allocation+DDim+dtype and inplace version
+counter at tensor.h:77). Here a Tensor wraps a jax.Array (device memory is
+owned by PJRT — the whole memory/allocation layer C11 of the reference
+collapses into the XLA runtime) plus autograd metadata (producing TapeNode,
+.grad, stop_gradient) mirroring VarBase.
+
+Registered as a jax pytree so Tensors flow transparently through jax.jit /
+pjit / shard_map — that is what makes the dygraph API compile into single
+fused XLA programs instead of per-op dispatch (reference hot loop §3.2).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as _dt
+from . import place as _place
+from .autograd import backward as _backward
+
+_tensor_name_counter = [0]
+
+
+def _auto_name(prefix="generated_tensor"):
+    _tensor_name_counter[0] += 1
+    return f"{prefix}_{_tensor_name_counter[0]}"
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad", "_node", "_out_idx",
+                 "name", "persistable", "_hooks", "_retain_grads",
+                 "_inplace_version", "is_parameter", "__weakref__",
+                 "trainable", "optimize_attr", "regularizer", "do_model_average",
+                 "need_clip")
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = None,
+                 persistable: bool = False):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._node = None          # producing TapeNode (None => leaf)
+        self._out_idx = 0
+        self.name = name or _auto_name()
+        self.persistable = persistable
+        self._hooks = []
+        self._retain_grads = False
+        self._inplace_version = 0
+        self.is_parameter = False
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    def dim(self):
+        return self._value.ndim
+
+    def rank(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        if isinstance(self._value, jax.core.Tracer):
+            return _place._default_place()
+        try:
+            dev = list(self._value.devices())[0]
+            if dev.platform == "cpu":
+                return _place.CPUPlace()
+            return _place.TPUPlace(dev.id)
+        except Exception:
+            return _place._default_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    # -------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _backward(self, grad_tensor, retain_graph)
+
+    def _accumulate_grad(self, cot):
+        if self._grad is None:
+            self._grad = Tensor(cot, stop_gradient=True,
+                                name=self.name + "@GRAD")
+        else:
+            self._grad._value = self._grad._value + cot
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        self._inplace_version += 1
+        return self
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(inner):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        from ..ops import assign
+        return assign(self)
+
+    # --------------------------------------------------------------- convert
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype):
+        from ..ops import cast
+        return cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient, name=self.name)
+
+    def cuda(self, device_id=0, blocking=True):
+        return Tensor(jax.device_put(self._value,
+                                     _place.TPUPlace(device_id).get_device()),
+                      stop_gradient=self.stop_gradient, name=self.name)
+
+    def tpu(self, device_id=0):
+        return self.cuda(device_id)
+
+    def pin_memory(self):
+        return self.cpu()
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and not a.startswith(("cpu", "tpu", "gpu")):
+                a = _dt.convert_dtype(a)
+            if isinstance(a, str):
+                out = out.cpu() if a.startswith("cpu") else out.cuda()
+            elif isinstance(a, _place.Place):
+                out = out.cpu() if isinstance(a, _place.CPUPlace) else out.cuda(a.device_id)
+            else:
+                out = out.astype(a)
+        return out
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value
+        self._inplace_version += 1
+        return self
+
+    def _copy_to(self, place, blocking=True):
+        return self.cpu() if isinstance(place, _place.CPUPlace) else self.cuda()
+
+    # ----------------------------------------------------------------- repr
+    def __repr__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            return (f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, "
+                    f"traced=True)")
+        return (f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, "
+                f"place={self.place}, stop_gradient={self.stop_gradient},\n"
+                f"       {np.asarray(self._value)!r})")
+
+    __str__ = __repr__
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # Dunder arithmetic and the full method surface (matmul, sum, reshape,
+    # …) are attached by paddle_tpu.ops._attach_tensor_methods at import
+    # time — the analogue of the reference's generated core.ops fast-path +
+    # monkey-patched VarBase methods
+    # (python/paddle/fluid/dygraph/math_op_patch.py).
+
+
+# --------------------------------------------------------------------- pytree
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor(children[0], stop_gradient=aux[0], name=aux[1])
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+def alias_for_inplace(t: Tensor) -> Tensor:
+    """Snapshot a tensor's (value, producer) identity before an in-place
+    rebind. In-place ops compute functionally and re-point the original
+    Tensor at the new op's output; the op's recorded *input* must be this
+    alias, not the rebound original, or the autograd graph would contain a
+    self-cycle and drop gradients (the reference guards the analogous hazard
+    with inplace version counters, tensor.h:57-77)."""
+    a = Tensor(t._value, stop_gradient=t.stop_gradient, name=t.name)
+    a._node, a._out_idx = t._node, t._out_idx
+    return a
+
+
+def check_inplace_allowed(t: Tensor):
+    """Paddle parity: an in-place op on a *leaf* tensor that requires grad is
+    an error (reference: imperative checks 'Leaf Var that doesn't stop
+    gradient can't use inplace strategy') — otherwise the rebind would
+    silently orphan its gradient."""
+    from .autograd import _GradState
+    if _GradState.enabled and t._node is None and not t.stop_gradient:
+        raise RuntimeError(
+            f"Leaf Tensor {t.name} that requires grad is being used in an "
+            "in-place operation; this would silently detach it from "
+            "autograd. Wrap the update in paddle.no_grad() or use the "
+            "functional form.")
+
+
+def rebind_inplace(t: Tensor, out: Tensor) -> Tensor:
+    t._value, t._node, t._out_idx = out._value, out._node, out._out_idx
+    t._inplace_version += 1
+    return t
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py to_tensor)."""
+    dtype = _dt.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._value
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        t = Tensor(arr, stop_gradient=stop_gradient)
+        return t
+    if dtype is None:
+        a = np.asarray(data)
+        if a.dtype == np.float64:
+            dtype = _dt.get_default_dtype()
+        arr = jnp.asarray(a, dtype=dtype)
+    else:
+        arr = jnp.asarray(np.asarray(data), dtype=dtype)
+    if place is not None and not isinstance(place, _place.CPUPlace):
+        arr = jax.device_put(arr, place.get_device())
+    elif place is not None:
+        arr = jax.device_put(arr, jax.devices("cpu")[0])
+    return Tensor(arr, stop_gradient=stop_gradient)

@@ -1,0 +1,6 @@
+def Model(*a, **k):
+    raise NotImplementedError("hapi.Model: implemented later this round")
+def summary(*a, **k):
+    raise NotImplementedError
+def flops(*a, **k):
+    raise NotImplementedError

@@ -1,0 +1,299 @@
+"""Op corpus: the framework's operator library.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/ (~286k LoC of
+C++/CUDA kernels behind REGISTER_OPERATOR) plus the monkey-patched Tensor
+method surface (python/paddle/fluid/dygraph/math_op_patch.py and
+python/paddle/tensor/__init__.py's tensor_method_func list). Ops are pure JAX
+functions registered through core.dispatch.op; `_attach_tensor_methods` wires
+them onto Tensor, replacing the reference's generated `core.ops.*` fast path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op, get_op, registered_ops, dispatch
+from ..core.tensor import (Tensor, to_tensor, alias_for_inplace,
+                           rebind_inplace, check_inplace_allowed)
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+from . import creation, math, logic, manipulation, linalg, search
+
+# re-bind names that collide with builtins for explicit use
+from .math import sum, max, min, abs, all, any, round, pow  # noqa: F401,A004
+from .manipulation import slice  # noqa: F401,A004
+
+
+# --------------------------------------------------------------------------
+# Tensor indexing ops (reference: slice/strided_slice/set_value ops,
+# operators/set_value_op.cc — here jnp fancy indexing / .at updates)
+# --------------------------------------------------------------------------
+def _unwrap_index(item):
+    if isinstance(item, Tensor):
+        return item._value
+    if isinstance(item, tuple):
+        return tuple(_unwrap_index(i) for i in item)
+    if isinstance(item, list):
+        return jnp.asarray(np.asarray(item))
+    return item
+
+
+@op("getitem")
+def _getitem(x, idx_tensors, idx_spec):
+    # idx_tensors: tensor leaves pulled out so autograd tracks them
+    it = iter(idx_tensors)
+
+    def rebuild(spec):
+        if spec == "__tensor__":
+            return next(it)
+        if isinstance(spec, tuple):
+            return tuple(rebuild(s) for s in spec)
+        return spec
+    return x[rebuild(idx_spec)]
+
+
+def _tensor_getitem(self, item):
+    def to_spec(it):
+        if isinstance(it, Tensor):
+            return "__tensor__"
+        if isinstance(it, tuple):
+            return tuple(to_spec(i) for i in it)
+        if isinstance(it, list):
+            return "__tensor__"
+        if isinstance(it, (np.ndarray, jax.Array)):
+            return "__tensor__"
+        return it
+
+    def collect(it, out):
+        if isinstance(it, Tensor):
+            out.append(it)
+        elif isinstance(it, tuple):
+            for i in it:
+                collect(i, out)
+        elif isinstance(it, list):
+            out.append(to_tensor(np.asarray(it)))
+        elif isinstance(it, (np.ndarray, jax.Array)):
+            out.append(to_tensor(np.asarray(it)))
+    leaves = []
+    collect(item, leaves)
+    return _getitem(self, leaves, to_spec(item))
+
+
+@op("set_value")
+def _setitem_op(x, value, idx_tensors, idx_spec):
+    it = iter(idx_tensors)
+
+    def rebuild(spec):
+        if spec == "__tensor__":
+            return next(it)
+        if isinstance(spec, tuple):
+            return tuple(rebuild(s) for s in spec)
+        return spec
+    idx = rebuild(idx_spec)
+    sel_shape = jax.eval_shape(lambda a: a[idx], x).shape
+    while value.ndim > len(sel_shape) and value.shape[0] == 1:
+        value = jnp.squeeze(value, 0)
+    value = jnp.broadcast_to(value, sel_shape)
+    return x.at[idx].set(value)
+
+
+def _tensor_setitem(self, item, value):
+    def to_spec(it):
+        if isinstance(it, (Tensor, list, np.ndarray, jax.Array)):
+            return "__tensor__"
+        if isinstance(it, tuple):
+            return tuple(to_spec(i) for i in it)
+        return it
+
+    def collect(it, out):
+        if isinstance(it, Tensor):
+            out.append(it)
+        elif isinstance(it, tuple):
+            for i in it:
+                collect(i, out)
+        elif isinstance(it, (list, np.ndarray, jax.Array)):
+            out.append(to_tensor(np.asarray(it)))
+    leaves = []
+    collect(item, leaves)
+    if not isinstance(value, Tensor):
+        value = to_tensor(np.asarray(value, dtype=np.asarray(self._value).dtype)) \
+            if not isinstance(value, (int, float, bool)) else \
+            to_tensor(np.asarray(value))
+    value = value.astype(self.dtype)
+    check_inplace_allowed(self)
+    out = _setitem_op(alias_for_inplace(self), value, leaves, to_spec(item))
+    return rebind_inplace(self, out)
+
+
+# --------------------------------------------------------------------------
+# Method attachment
+# --------------------------------------------------------------------------
+def _binary_dunder(fn, reverse=False):
+    def method(self, other):
+        if isinstance(other, (list, tuple, np.ndarray, int, float, bool,
+                              complex, np.generic)):
+            other = to_tensor(np.asarray(other))
+        elif not isinstance(other, Tensor):
+            return NotImplemented
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+    return method
+
+
+def _attach_tensor_methods():
+    T = Tensor
+    T.__getitem__ = _tensor_getitem
+    T.__setitem__ = _tensor_setitem
+
+    T.__add__ = _binary_dunder(math.add)
+    T.__radd__ = _binary_dunder(math.add, True)
+    T.__sub__ = _binary_dunder(math.subtract)
+    T.__rsub__ = _binary_dunder(math.subtract, True)
+    T.__mul__ = _binary_dunder(math.multiply)
+    T.__rmul__ = _binary_dunder(math.multiply, True)
+    T.__truediv__ = _binary_dunder(math.divide)
+    T.__rtruediv__ = _binary_dunder(math.divide, True)
+    T.__floordiv__ = _binary_dunder(math.floor_divide)
+    T.__rfloordiv__ = _binary_dunder(math.floor_divide, True)
+    T.__mod__ = _binary_dunder(math.remainder)
+    T.__rmod__ = _binary_dunder(math.remainder, True)
+    T.__pow__ = _binary_dunder(math.pow_)
+    T.__rpow__ = _binary_dunder(math.pow_, True)
+    T.__matmul__ = _binary_dunder(linalg.matmul)
+    T.__rmatmul__ = _binary_dunder(linalg.matmul, True)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    T.__invert__ = lambda self: logic.logical_not(self) \
+        if self.dtype == jnp.bool_ else logic.bitwise_not(self)
+    T.__eq__ = _binary_dunder(logic.equal)
+    T.__ne__ = _binary_dunder(logic.not_equal)
+    T.__lt__ = _binary_dunder(logic.less_than)
+    T.__le__ = _binary_dunder(logic.less_equal)
+    T.__gt__ = _binary_dunder(logic.greater_than)
+    T.__ge__ = _binary_dunder(logic.greater_equal)
+    T.__and__ = _binary_dunder(lambda a, b: logic.logical_and(a, b)
+                               if a.dtype == jnp.bool_ else
+                               logic.bitwise_and(a, b))
+    T.__or__ = _binary_dunder(lambda a, b: logic.logical_or(a, b)
+                              if a.dtype == jnp.bool_ else
+                              logic.bitwise_or(a, b))
+    T.__xor__ = _binary_dunder(lambda a, b: logic.logical_xor(a, b)
+                               if a.dtype == jnp.bool_ else
+                               logic.bitwise_xor(a, b))
+
+    @property
+    def T_prop(self):
+        return manipulation.transpose(self)
+    T.T = T_prop
+
+    method_sources = {}
+    for mod in (creation, math, logic, manipulation, linalg, search):
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            f = getattr(mod, name)
+            if callable(f) and not isinstance(f, type):
+                method_sources.setdefault(name, f)
+
+    method_names = [
+        # math
+        "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+        "mod", "floor_mod", "pow", "maximum", "minimum", "fmax", "fmin",
+        "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p",
+        "sqrt", "rsqrt", "square", "sin", "cos", "tan", "asin", "acos",
+        "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "floor",
+        "ceil", "round", "trunc", "frac", "sign", "sgn", "reciprocal", "erf",
+        "erfinv", "lgamma", "digamma", "deg2rad", "rad2deg", "angle", "conj",
+        "real", "imag", "isnan", "isinf", "isfinite", "scale", "clip",
+        "lerp", "sum", "mean", "max", "min", "prod", "amax", "amin",
+        "nansum", "nanmean", "logsumexp", "all", "any", "count_nonzero",
+        "cumsum", "cumprod", "logcumsumexp", "trace", "diagonal", "cast",
+        "increment", "atan2", "heaviside", "kron", "inner", "outer",
+        "divide_no_nan", "hypot", "copysign",
+        # logic
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "logical_and", "logical_or", "logical_xor",
+        "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor",
+        "bitwise_not", "equal_all", "allclose", "isclose", "is_empty",
+        # manipulation
+        "reshape", "reshape_", "transpose", "t", "moveaxis", "concat",
+        "stack", "unstack", "split", "chunk", "tensor_split", "squeeze",
+        "squeeze_", "unsqueeze", "unsqueeze_", "flatten", "expand",
+        "broadcast_to", "expand_as", "tile", "repeat_interleave", "roll",
+        "flip", "rot90", "gather", "gather_nd", "scatter", "scatter_",
+        "scatter_nd_add", "index_select", "index_sample", "index_add",
+        "take_along_axis", "put_along_axis", "masked_select", "masked_fill",
+        "where", "nonzero", "pad", "slice", "strided_slice", "unique",
+        "unique_consecutive", "as_complex", "as_real", "numel", "crop",
+        # linalg
+        "matmul", "mm", "bmm", "dot", "mv", "addmm", "norm", "dist",
+        "cross", "cholesky", "cholesky_solve", "inverse", "pinv", "det",
+        "slogdet", "matrix_power", "solve", "triangular_solve", "multi_dot",
+        "histogram", "bincount", "tensordot",
+        # search/stat
+        "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+        "median", "nanmedian", "quantile", "nanquantile", "std", "var",
+        "searchsorted", "bucketize",
+        # creation-ish
+        "tril", "triu", "diag", "diagflat", "bernoulli", "multinomial",
+        "zeros_like", "ones_like",
+    ]
+    for name in method_names:
+        f = method_sources.get(name)
+        if f is None:
+            continue
+        if getattr(T, name, None) is None or name not in T.__dict__:
+            try:
+                setattr(T, name, f)
+            except AttributeError:
+                pass
+
+    # paddle-style in-place arithmetic variants
+    def _make_inplace(fname):
+        f = method_sources[fname]
+
+        def inplace(self, *a, **k):
+            check_inplace_allowed(self)
+            out = f(alias_for_inplace(self), *a, **k)
+            return rebind_inplace(self, out)
+        inplace.__name__ = fname + "_"
+        return inplace
+
+    for fname in ("add", "subtract", "multiply", "divide", "clip", "scale",
+                  "floor", "ceil", "exp", "sqrt", "reciprocal", "round",
+                  "remainder", "tanh", "cast"):
+        setattr(T, fname + "_", _make_inplace(fname))
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        self._inplace_version += 1
+        return self
+    T.fill_ = fill_
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        from ..core import random as _random
+        self._value = jax.random.uniform(
+            _random.next_key(), tuple(self._value.shape),
+            self._value.dtype, min, max)
+        self._inplace_version += 1
+        return self
+    T.uniform_ = uniform_
+
+    def normal_(self, mean=0.0, std=1.0):
+        from ..core import random as _random
+        self._value = mean + std * jax.random.normal(
+            _random.next_key(), tuple(self._value.shape), self._value.dtype)
+        self._inplace_version += 1
+        return self
+    T.normal_ = normal_
+
+
+_attach_tensor_methods()

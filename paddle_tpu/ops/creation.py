@@ -1,0 +1,281 @@
+"""Tensor creation ops.
+
+TPU-native analogue of the reference's creation op kernels
+(/root/reference/paddle/fluid/operators/fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, arange/linspace/eye ops, assign_op.cc) and the Python
+surface python/paddle/tensor/creation.py. Each op is a pure JAX function;
+random ops draw counter-based keys from core.random (reference analogue:
+framework/generator.cc global generator).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor
+from ..core import random as _random
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value if isinstance(s, Tensor) else s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    return d if d is not None else (default or get_default_dtype())
+
+
+@op("assign")
+def _assign(x):
+    return jnp.asarray(x)
+
+
+def assign(x, output=None):
+    x = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    out = _assign(x)
+    if output is not None:
+        output.set_value(out._value)
+        return output
+    return out
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = get_default_dtype()
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+fill_constant = full
+
+
+@op("zeros_like")
+def _zeros_like(x, dtype):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _zeros_like(x, convert_dtype(dtype))
+
+
+@op("ones_like")
+def _ones_like(x, dtype):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return _ones_like(x, convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.full(x.shape, fill_value, d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or get_default_dtype()
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    dtype = convert_dtype(dtype) or jnp.int64
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = num.item() if isinstance(num, Tensor) else num
+    return Tensor(jnp.linspace(start, stop, int(num),
+                               dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns,
+                          dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+@op("diag")
+def _diag(x, offset, padding_value):
+    if x.ndim == 1:
+        d = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=jnp.bool_), k=offset)
+            d = jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return d
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return _diag(x, offset, padding_value)
+
+
+@op("diagflat")
+def _diagflat(x, offset):
+    return jnp.diagflat(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return _diagflat(x, offset)
+
+
+@op("tril")
+def _tril(x, diagonal):
+    return jnp.tril(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal)
+
+
+@op("triu")
+def _triu(x, diagonal):
+    return jnp.triu(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(o) for o in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+# ------------------------------------------------------------------ random
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _dt(dtype)
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), d, min, max))
+
+
+uniform_random = uniform
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = np.broadcast_shapes(np.shape(m), np.shape(s))
+        return Tensor(m + s * jax.random.normal(_random.next_key(), sh,
+                                                get_default_dtype()))
+    return Tensor(mean + std * jax.random.normal(
+        _random.next_key(), _shape(shape or [1]), get_default_dtype()))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    return Tensor(mean + std * jax.random.normal(_random.next_key(),
+                                                 _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def randn(*shape, dtype=None, name=None):
+    if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+        shape = shape[0]
+    return standard_normal(shape, dtype)
+
+
+def rand(*shape, dtype=None, name=None):
+    if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+        shape = shape[0]
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.randint(_random.next_key(), _shape(shape),
+                                     low, high, dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype=None, name=None):
+    d = convert_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.permutation(_random.next_key(),
+                                         jnp.arange(n, dtype=d)))
+
+
+def bernoulli(x, name=None):
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_random.next_key(), p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(
+            _random.next_key(), logits, axis=-1,
+            shape=(num_samples,) + p.shape[:-1]) \
+            if p.ndim > 1 else jax.random.categorical(
+                _random.next_key(), logits, shape=(num_samples,))
+        if p.ndim > 1:
+            out = jnp.moveaxis(out, 0, -1)
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(_random.next_key(), p.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    lam = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_random.next_key(), lam).astype(lam.dtype))
+
+
+def rand_like(x, dtype=None, name=None):
+    return uniform(x.shape, dtype or x.dtype, 0.0, 1.0)
+
+
+def randn_like(x, dtype=None, name=None):
+    return standard_normal(x.shape, dtype or x.dtype)

@@ -1,0 +1,362 @@
+"""Linear algebra ops — the MXU path.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/matmul_v2_op.cc
+(+ math/blas.h cuBLAS wrappers), mv_op, dot_op, bmm_op, cholesky_op,
+inverse_op, svd_op, and python/paddle/tensor/linalg.py. matmul lowers to
+XLA dot_general → TPU MXU; precision is controlled by
+FLAGS_tpu_matmul_precision (default lets XLA pick bf16-accum-f32 on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+from ..core import flags as _flags
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _precision():
+    p = _flags.flag("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+@op("matmul_v2")
+def _matmul(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(_wrap(x), _wrap(y), transpose_x, transpose_y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+@op("bmm")
+def _bmm(x, y):
+    return jnp.einsum("bij,bjk->bik", x, y, precision=_precision())
+
+
+def bmm(x, y, name=None):
+    return _bmm(_wrap(x), _wrap(y))
+
+
+@op("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return _dot(_wrap(x), _wrap(y))
+
+
+@op("mv")
+def _mv(x, vec):
+    return jnp.matmul(x, vec, precision=_precision())
+
+
+def mv(x, vec, name=None):
+    return _mv(_wrap(x), _wrap(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _addmm(_wrap(input), _wrap(x), _wrap(y), beta, alpha)
+
+
+@op("addmm")
+def _addmm(inp, x, y, beta, alpha):
+    return beta * inp + alpha * jnp.matmul(x, y, precision=_precision())
+
+
+def einsum(equation, *operands):
+    ops_ = [_wrap(o) for o in operands]
+    return _einsum(equation, ops_)
+
+
+@op("einsum")
+def _einsum(equation, operands):
+    return jnp.einsum(equation, *operands, precision=_precision())
+
+
+@op("tensordot")
+def _tensordot(x, y, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return _tensordot(_wrap(x), _wrap(y), axes)
+
+
+@op("p_norm")
+def _p_norm(x, p, axis, keepdim):
+    if p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+@op("frobenius_norm")
+def _fro_norm(x, axis, keepdim):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _wrap(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+        if p in (None, "fro"):
+            return _fro_norm(x, axis, keepdim)
+        if p == np.inf or p == -np.inf:
+            return _p_norm(x, p, axis, keepdim)
+        if p == 1:
+            return _p_norm(x, 1, axis, keepdim)  # vector-style over both axes
+        if p == 2:
+            return _fro_norm(x, axis, keepdim)
+        return _p_norm(x, p, axis, keepdim)
+    if p is None or p == "fro":
+        return _fro_norm(x, None if axis is None else int(axis), keepdim)
+    return _p_norm(x, float(p) if p not in ("fro", "nuc") else p,
+                   None if axis is None else int(axis), keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(_wrap(x) - _wrap(y), p=p)
+
+
+@op("cross")
+def _cross(x, y, axis):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=None, name=None):
+    x, y = _wrap(x), _wrap(y)
+    if axis is None:  # paddle default: first axis of size 3
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), None)
+        if axis is None:
+            raise ValueError(
+                "paddle.cross: no dimension of size 3 found and no axis "
+                f"given (input shape {x.shape})")
+    return _cross(x, y, axis)
+
+
+@op("cholesky")
+def _cholesky(x, upper):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky(x, upper=False, name=None):
+    return _cholesky(_wrap(x), upper)
+
+
+@op("cholesky_solve")
+def _cholesky_solve(x, y, upper):
+    L = jnp.swapaxes(y, -1, -2).conj() if upper else y
+    return jax.scipy.linalg.cho_solve((L, True), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return _cholesky_solve(_wrap(x), _wrap(y), upper)
+
+
+@op("inverse")
+def _inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def inverse(x, name=None):
+    return _inverse(_wrap(x))
+
+
+@op("pinv")
+def _pinv(x, rcond):
+    return jnp.linalg.pinv(x, rcond=rcond)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _pinv(_wrap(x), rcond)
+
+
+@op("det")
+def _det(x):
+    return jnp.linalg.det(x)
+
+
+def det(x, name=None):
+    return _det(_wrap(x))
+
+
+@op("slogdet")
+def _slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def slogdet(x, name=None):
+    return _slogdet(_wrap(x))
+
+
+@op("matrix_rank", differentiable=False)
+def _matrix_rank(x, tol, hermitian):
+    return jnp.linalg.matrix_rank(x, tol)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    if isinstance(tol, Tensor):
+        tol = float(tol.item())
+    return _matrix_rank(_wrap(x), tol, hermitian)
+
+
+@op("matrix_power")
+def _matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return _matrix_power(_wrap(x), n)
+
+
+@op("svd", differentiable=False)
+def _svd(x, full_matrices):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = _svd(_wrap(x), full_matrices)
+    # paddle returns V transposed relative to numpy's vh
+    return u, s, Tensor(jnp.swapaxes(vh._value, -1, -2))
+
+
+@op("qr", differentiable=False)
+def _qr(x, mode):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def qr(x, mode="reduced", name=None):
+    return _qr(_wrap(x), mode)
+
+
+@op("eig", differentiable=False)
+def _eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eig(x, name=None):
+    return _eig(_wrap(x))
+
+
+@op("eigh", differentiable=False)
+def _eigh(x, UPLO):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigh(x, UPLO="L", name=None):
+    return _eigh(_wrap(x), UPLO)
+
+
+def eigvals(x, name=None):
+    return _eig(_wrap(x))[0]
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _eigh(_wrap(x), UPLO)[0]
+
+
+@op("solve")
+def _solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def solve(x, y, name=None):
+    return _solve(_wrap(x), _wrap(y))
+
+
+@op("triangular_solve")
+def _triangular_solve(x, y, upper, transpose, unitriangular):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return _triangular_solve(_wrap(x), _wrap(y), upper, transpose,
+                             unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(_wrap(x)._value, _wrap(y)._value,
+                                          rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+@op("multi_dot")
+def _multi_dot(xs):
+    return jnp.linalg.multi_dot(xs, precision=_precision())
+
+
+def multi_dot(x, name=None):
+    return _multi_dot([_wrap(v) for v in x])
+
+
+@op("histogram", differentiable=False)
+def _histogram(x, bins, min, max):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    return hist.astype(jnp.int64)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return _histogram(_wrap(input), bins, min, max)
+
+
+@op("bincount", differentiable=False)
+def _bincount(x, weights, minlength, length):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=length)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _wrap(x)
+    length = max(int(np.asarray(x._value).max(initial=-1)) + 1, minlength)
+    w = weights._value if isinstance(weights, Tensor) else weights
+    return _bincount(x, w, minlength, length)
+
+
+@op("corrcoef")
+def _corrcoef(x, rowvar):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _corrcoef(_wrap(x), rowvar)
+
+
+@op("cov")
+def _cov(x, rowvar, ddof, fweights, aweights):
+    return jnp.cov(x, rowvar=rowvar, ddof=ddof, fweights=fweights,
+                   aweights=aweights)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._value if isinstance(fweights, Tensor) else fweights
+    aw = aweights._value if isinstance(aweights, Tensor) else aweights
+    return _cov(_wrap(x), rowvar, 1 if ddof else 0, fw, aw)

@@ -1,0 +1,85 @@
+"""Comparison / logical / bitwise ops.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/controlflow/
+compare_op.cc, logical_op.cc, and bitwise kernels; Python surface
+python/paddle/tensor/logic.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _cmp(name, fn):
+    wrapped = op(name, differentiable=False)(fn)
+
+    def api(x, y, name=None):
+        return wrapped(_wrap(x), _wrap(y))
+    api.__name__ = name
+    return api
+
+
+equal = _cmp("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _cmp("not_equal", lambda x, y: jnp.not_equal(x, y))
+greater_than = _cmp("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _cmp("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+less_than = _cmp("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _cmp("less_equal", lambda x, y: jnp.less_equal(x, y))
+logical_and = _cmp("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _cmp("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _cmp("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+bitwise_and = _cmp("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _cmp("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _cmp("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+
+
+@op("logical_not", differentiable=False)
+def _logical_not(x):
+    return jnp.logical_not(x)
+
+
+@op("bitwise_not", differentiable=False)
+def _bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def logical_not(x, name=None):
+    return _logical_not(_wrap(x))
+
+
+def bitwise_not(x, name=None):
+    return _bitwise_not(_wrap(x))
+
+
+def equal_all(x, y, name=None):
+    x, y = _wrap(x), _wrap(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.array_equal(x._value, y._value))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = _wrap(x), _wrap(y)
+    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = _wrap(x), _wrap(y)
+    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,601 @@
+"""Shape / layout manipulation ops.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/ reshape_op.cc,
+transpose_op.cc, concat_op.cc, split_op.cc, stack_op.cc, squeeze/unsqueeze,
+flatten_op, expand_v2_op, tile_op, gather/gather_nd/scatter ops, slice_op,
+strided_slice_op, pad ops, flip/roll, unique_op; Python surface
+python/paddle/tensor/manipulation.py. All static-shape (XLA requirement):
+shape arguments must be Python ints at trace time.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import (Tensor, to_tensor, alias_for_inplace,
+                           rebind_inplace, check_inplace_allowed)
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+@op("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    shape = _static_shape(shape)
+    # paddle semantics: 0 means "copy this dim from input"
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return _reshape(_wrap(x), shape)
+
+
+def reshape_(x, shape, name=None):
+    check_inplace_allowed(x)
+    out = reshape(alias_for_inplace(x), shape)
+    return rebind_inplace(x, out)
+
+
+@op("transpose")
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm=None, name=None):
+    x = _wrap(x)
+    if perm is None:
+        perm = tuple(reversed(range(x.ndim)))
+    return _transpose(x, tuple(int(p) for p in perm))
+
+
+def t(x, name=None):
+    x = _wrap(x)
+    if x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+def moveaxis(x, source, destination, name=None):
+    return _moveaxis(_wrap(x), tuple(np.atleast_1d(source).tolist()),
+                     tuple(np.atleast_1d(destination).tolist()))
+
+
+@op("moveaxis")
+def _moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op("concat")
+def _concat(xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    xs = [_wrap(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(xs, axis)
+
+
+@op("stack")
+def _stack(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack([_wrap(v) for v in x], axis)
+
+
+@op("unstack")
+def _unstack(x, axis, num):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, num, axis=axis))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _wrap(x)
+    if num is None:
+        num = x.shape[axis]
+    return list(_unstack(x, axis, num))
+
+
+@op("split")
+def _split(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _wrap(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, (list, tuple)):
+        secs = list(num_or_sections)
+        total = x.shape[axis]
+        if any(s == -1 for s in secs):
+            known = sum(s for s in secs if s != -1)
+            secs = [total - known if s == -1 else s for s in secs]
+        return list(_split(x, tuple(secs), axis))
+    return list(_split(x, int(num_or_sections), axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _wrap(x)
+    arrs = jnp.array_split(x._value, num_or_indices, axis=axis) \
+        if isinstance(num_or_indices, int) else \
+        jnp.split(x._value, list(num_or_indices), axis=axis)
+    return [Tensor(a) for a in arrs]
+
+
+@op("squeeze")
+def _squeeze(x, axis):
+    return jnp.squeeze(x, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _wrap(x)
+    if axis is None:
+        return _squeeze(x, None)
+    if isinstance(axis, (int, np.integer)):
+        axis = [axis]
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    if not axis:
+        return _reshape(x, tuple(x.shape))
+    return _squeeze(x, axis)
+
+
+def squeeze_(x, axis=None, name=None):
+    check_inplace_allowed(x)
+    out = squeeze(alias_for_inplace(x), axis)
+    return rebind_inplace(x, out)
+
+
+@op("unsqueeze")
+def _unsqueeze(x, axis):
+    for a in axis:
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return _unsqueeze(_wrap(x), tuple(int(a) for a in axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    check_inplace_allowed(x)
+    out = unsqueeze(alias_for_inplace(x), axis)
+    return rebind_inplace(x, out)
+
+
+@op("flatten")
+def _flatten(x, start, stop):
+    shape = x.shape
+    new = shape[:start] + (int(np.prod(shape[start:stop + 1]) or 1),) \
+        + shape[stop + 1:]
+    return jnp.reshape(x, new)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _wrap(x)
+    nd = x.ndim
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    return _flatten(x, start, stop)
+
+
+@op("expand")
+def _expand(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    x = _wrap(x)
+    shape = _static_shape(shape)
+    # -1 means keep input dim
+    pad = len(shape) - x.ndim
+    shape = tuple(x.shape[i - pad] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return _expand(x, shape)
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[_wrap(v)._value for v in inputs])
+    return [Tensor(a) for a in arrs]
+
+
+@op("tile")
+def _tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(_wrap(x), _static_shape(repeat_times))
+
+
+@op("repeat_interleave")
+def _repeat_interleave(x, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats.numpy()
+        total = int(repeats.sum())
+        return Tensor(jnp.repeat(_wrap(x)._value, jnp.asarray(repeats),
+                                 axis=axis, total_repeat_length=total))
+    return _repeat_interleave(_wrap(x), int(repeats), axis)
+
+
+@op("roll")
+def _roll(x, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = tuple(shifts.tolist())
+    return _roll(_wrap(x), shifts, axis)
+
+
+@op("flip")
+def _flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _flip(_wrap(x), axis)
+
+
+reverse = flip
+
+
+@op("rot90")
+def _rot90(x, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(_wrap(x), k, tuple(axes))
+
+
+@op("gather")
+def _gather(x, index, axis):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _gather(_wrap(x), _wrap(index), axis)
+
+
+@op("gather_nd")
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(_wrap(x), _wrap(index))
+
+
+@op("scatter")
+def _scatter(x, index, updates, overwrite):
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle scatter(overwrite=False): zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(_wrap(x), _wrap(index), _wrap(updates), overwrite)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    check_inplace_allowed(x)
+    out = scatter(alias_for_inplace(x), index, updates, overwrite)
+    return rebind_inplace(x, out)
+
+
+@op("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(_wrap(x), _wrap(index), _wrap(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    x = Tensor(jnp.zeros(_static_shape(shape), _wrap(updates).dtype))
+    return scatter_nd_add(x, index, updates)
+
+
+@op("index_select")
+def _index_select(x, index, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(_wrap(x), _wrap(index), axis)
+
+
+@op("index_sample")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index, name=None):
+    return _index_sample(_wrap(x), _wrap(index))
+
+
+@op("index_add")
+def _index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].add(jnp.moveaxis(value, axis, 0))
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(_wrap(x), _wrap(index), axis, _wrap(value))
+
+
+@op("take_along_axis")
+def _take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return _take_along_axis(_wrap(arr), _wrap(indices), axis)
+
+
+@op("put_along_axis")
+def _put_along_axis(x, indices, values, axis, reduce):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis,
+                                  inplace=False)
+    dims = list(range(x.ndim))
+    idx = [jnp.broadcast_to(
+        jnp.arange(x.shape[d]).reshape([-1 if i == d else 1
+                                        for i in dims]), indices.shape)
+        for d in dims]
+    idx[axis] = indices
+    if reduce == "add":
+        return x.at[tuple(idx)].add(jnp.broadcast_to(values, indices.shape))
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[tuple(idx)].multiply(
+            jnp.broadcast_to(values, indices.shape))
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    return _put_along_axis(_wrap(arr), _wrap(indices), _wrap(values), axis,
+                           reduce)
+
+
+@op("masked_select")
+def _masked_select_sized(x, mask, size):
+    flat_x = x.reshape(-1)
+    flat_m = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    idx = jnp.nonzero(flat_m, size=size)[0]
+    return flat_x[idx]
+
+
+def masked_select(x, mask, name=None):
+    x, mask = _wrap(x), _wrap(mask)
+    # dynamic output size → host sync (documented XLA constraint; inside
+    # jit use masked_fill / where instead)
+    size = int(np.asarray(jnp.broadcast_to(mask._value, x._value.shape)).sum())
+    return _masked_select_sized(x, mask, size)
+
+
+@op("masked_fill")
+def _masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value._value
+    return _masked_fill(_wrap(x), _wrap(mask), value)
+
+
+@op("where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = _wrap(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, _wrap(x), _wrap(y))
+
+
+def nonzero(x, as_tuple=False):
+    x = _wrap(x)
+    # dynamic shape → host-side (outside jit only)
+    arrs = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(a)) for a in arrs)
+    return Tensor(jnp.asarray(np.stack(arrs, axis=1)))
+
+
+@op("pad_nd")
+def _pad_nd(x, pad_width, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode="constant", constant_values=value)
+    if mode == "replicate":
+        return jnp.pad(x, pad_width, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pad_width, mode="reflect")
+    if mode == "circular":
+        return jnp.pad(x, pad_width, mode="wrap")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):
+    """reference: operators/pad_op.cc, pad3d_op.cc.
+
+    `pad` is paddle convention: flat list [axN_lo, axN_hi, ...] applied to
+    the LAST len(pad)//2 axes (like torch) when len(pad) != 2*ndim, else
+    per-axis from axis 0.
+    """
+    x = _wrap(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd and data_format is None:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        k = len(pad) // 2
+        width = [(0, 0)] * (nd - k)
+        # paddle/torch order: last axis first in the flat list
+        tail = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)][::-1]
+        if data_format in ("NHWC", "NDHWC", "NLC"):
+            width = [(0, 0)] + tail + [(0, 0)] * (nd - k - 1)
+        else:
+            width += tail
+    return _pad_nd(x, tuple(width), mode, value)
+
+
+@op("slice")
+def _slice(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """reference: operators/slice_op.cc."""
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return _slice(_wrap(x), tuple(axes), tuple(starts), tuple(ends))
+
+
+@op("strided_slice")
+def _strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _strided_slice(_wrap(x), tuple(axes), tuple(int(s) for s in starts),
+                          tuple(int(e) for e in ends),
+                          tuple(int(s) for s in strides))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _wrap(x)
+    shape = _static_shape(shape)
+    offsets = [0] * x.ndim if offsets is None else \
+        [int(o) for o in (offsets.tolist() if isinstance(offsets, Tensor)
+                          else offsets)]
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    return slice(x, list(range(x.ndim)), offsets,
+                 [o + s for o, s in zip(offsets, shape)])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = _wrap(x)
+    res = np.unique(np.asarray(x._value), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = np.asarray(_wrap(x)._value)
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.ones(a.shape[0], bool)
+    keep[1:] = np.any(a[1:] != a[:-1],
+                      axis=tuple(range(1, a.ndim))) if a.ndim > 1 \
+        else a[1:] != a[:-1]
+    out = [Tensor(jnp.asarray(a[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, a.shape[0]))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@op("as_complex")
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_complex(x, name=None):
+    return _as_complex(_wrap(x))
+
+
+@op("as_real")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_real(x, name=None):
+    return _as_real(_wrap(x))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
+                              dtype=jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: operators/shard_index_op.cc (PS embedding sharding)."""
+    x = _wrap(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    v = x._value
+    in_shard = (v // shard_size) == shard_id
+    return Tensor(jnp.where(in_shard, v % shard_size, ignore_value))

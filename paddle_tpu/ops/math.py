@@ -1,0 +1,403 @@
+"""Elementwise + reduction math ops.
+
+TPU-native analogue of the reference op corpus:
+/root/reference/paddle/fluid/operators/elementwise/ (~8.7k LoC CUDA/C++),
+activation_op.cc, reduce_ops/ (~3.3k LoC), cum_op, clip_op, scale_op,
+sum_op (add_n), kron_op, etc. Each becomes a one-line pure JAX function;
+broadcasting, fusion and dtype promotion are XLA's job — the hand-written
+broadcast grad kernels of elementwise_op_function.h collapse into jax.vjp.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.dtypes import convert_dtype
+from ..core.tensor import (Tensor, to_tensor, alias_for_inplace,
+                           rebind_inplace, check_inplace_allowed)
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _binop(name, fn):
+    wrapped = op(name)(fn)
+
+    def api(x, y, name=None):
+        return wrapped(_wrap(x), _wrap(y))
+    api.__name__ = name
+    return api
+
+
+# -- elementwise binary ------------------------------------------------------
+add = _binop("elementwise_add", lambda x, y: jnp.add(x, y))
+subtract = _binop("elementwise_sub", lambda x, y: jnp.subtract(x, y))
+multiply = _binop("elementwise_mul", lambda x, y: jnp.multiply(x, y))
+divide = _binop("elementwise_div", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binop("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y))
+remainder = _binop("elementwise_mod", lambda x, y: jnp.remainder(x, y))
+mod = remainder
+floor_mod = remainder
+pow_ = _binop("elementwise_pow", lambda x, y: jnp.power(x, y))
+maximum = _binop("elementwise_max", lambda x, y: jnp.maximum(x, y))
+minimum = _binop("elementwise_min", lambda x, y: jnp.minimum(x, y))
+fmax = _binop("elementwise_fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _binop("elementwise_fmin", lambda x, y: jnp.fmin(x, y))
+atan2 = _binop("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _binop("hypot", lambda x, y: jnp.hypot(x, y))
+logaddexp = _binop("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+nextafter = _binop("nextafter", lambda x, y: jnp.nextafter(x, y))
+copysign = _binop("copysign", lambda x, y: jnp.copysign(x, y))
+heaviside = _binop("elementwise_heaviside", lambda x, y: jnp.heaviside(x, y))
+gcd = _binop("gcd", lambda x, y: jnp.gcd(x, y))
+lcm = _binop("lcm", lambda x, y: jnp.lcm(x, y))
+inner = _binop("inner", lambda x, y: jnp.inner(x, y))
+outer = _binop("outer", lambda x, y: jnp.outer(x, y))
+kron = _binop("kron", lambda x, y: jnp.kron(x, y))
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle api name
+    return pow_(x, y)
+
+
+def divide_no_nan(x, y, name=None):
+    x, y = _wrap(x), _wrap(y)
+    return _divide_no_nan(x, y)
+
+
+@op("divide_no_nan")
+def _divide_no_nan(x, y):
+    safe = jnp.where(y == 0, jnp.ones_like(y), y)
+    return jnp.where(y == 0, jnp.zeros_like(x * y), x / safe)
+
+
+# -- unary -------------------------------------------------------------------
+def _unop(name, fn):
+    wrapped = op(name)(fn)
+
+    def api(x, name=None):
+        return wrapped(_wrap(x))
+    api.__name__ = name
+    return api
+
+
+abs = _unop("abs", jnp.abs)  # noqa: A001
+neg = _unop("neg", jnp.negative)
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unop("square", jnp.square)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)  # noqa: A001
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sign = _unop("sign", jnp.sign)
+sgn = sign
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+i0 = _unop("i0", lambda x: jax.scipy.special.i0(x))
+i0e = _unop("i0e", lambda x: jax.scipy.special.i0e(x))
+i1 = _unop("i1", lambda x: jax.scipy.special.i1(x))
+i1e = _unop("i1e", lambda x: jax.scipy.special.i1e(x))
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conjugate)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+stanh = _unop("stanh", lambda x: 1.7159 * jnp.tanh(0.66667 * x))
+softsign = _unop("softsign", lambda x: x / (1 + jnp.abs(x)))
+rint = _unop("rint", jnp.rint)
+
+
+@op("isnan", differentiable=False)
+def _isnan(x):
+    return jnp.isnan(x)
+
+
+@op("isinf", differentiable=False)
+def _isinf(x):
+    return jnp.isinf(x)
+
+
+@op("isfinite", differentiable=False)
+def _isfinite(x):
+    return jnp.isfinite(x)
+
+
+def isnan(x, name=None):
+    return _isnan(_wrap(x))
+
+
+def isinf(x, name=None):
+    return _isinf(_wrap(x))
+
+
+def isfinite(x, name=None):
+    return _isfinite(_wrap(x))
+
+
+@op("scale")
+def _scale(x, scale, bias, bias_after_scale):
+    # reference: operators/scale_op.cc — out = scale*x + bias
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def scale(x, scale_=1.0, bias=0.0, bias_after_scale=True, act=None, name=None,
+          **kw):
+    if "scale" in kw:
+        scale_ = kw["scale"]
+    out = _scale(_wrap(x), scale_, bias, bias_after_scale)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+@op("increment")
+def _increment(x, value):
+    return x + value
+
+
+def increment(x, value=1.0, name=None):
+    check_inplace_allowed(x)
+    out = _increment(alias_for_inplace(x), value)
+    return rebind_inplace(x, out)
+
+
+@op("clip")
+def _clip(x, min, max):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return _clip(_wrap(x), mn, mx)
+
+
+@op("lerp")
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    w = weight if isinstance(weight, Tensor) else _wrap(weight)
+    return _lerp(_wrap(x), _wrap(y), w)
+
+
+@op("add_n")
+def _add_n(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    """reference: operators/sum_op.cc (paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(list(inputs))
+
+
+def sum_n(inputs):
+    return add_n(inputs)
+
+
+# -- reductions --------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduction(name, fn):
+    wrapped = op(name)(fn)
+
+    def api(x, axis=None, keepdim=False, name=None):
+        return wrapped(_wrap(x), _norm_axis(axis), keepdim)
+    api.__name__ = name
+    return api
+
+
+def _reduction_with_dtype(name, fn):
+    # paddle signature (python/paddle/tensor/math.py sum/prod):
+    # sum(x, axis=None, dtype=None, keepdim=False);
+    # prod(x, axis=None, keepdim=False, dtype=None). dtype casts the INPUT.
+    wrapped = op(name)(fn)
+
+    def sum_api(x, axis=None, dtype=None, keepdim=False, name=None):
+        x = _wrap(x)
+        if dtype is not None:
+            x = x.astype(convert_dtype(dtype))
+        return wrapped(x, _norm_axis(axis), keepdim)
+
+    def prod_api(x, axis=None, keepdim=False, dtype=None, name=None):
+        x = _wrap(x)
+        if dtype is not None:
+            x = x.astype(convert_dtype(dtype))
+        return wrapped(x, _norm_axis(axis), keepdim)
+    return sum_api, prod_api
+
+
+sum, _ = _reduction_with_dtype("reduce_sum", lambda x, axis, keepdim:  # noqa: A001
+                               jnp.sum(x, axis=axis, keepdims=keepdim))
+mean = _reduction("reduce_mean", lambda x, axis, keepdim:
+                  jnp.mean(x, axis=axis, keepdims=keepdim))
+max = _reduction("reduce_max", lambda x, axis, keepdim:  # noqa: A001
+                 jnp.max(x, axis=axis, keepdims=keepdim))
+min = _reduction("reduce_min", lambda x, axis, keepdim:  # noqa: A001
+                 jnp.min(x, axis=axis, keepdims=keepdim))
+_, prod = _reduction_with_dtype("reduce_prod", lambda x, axis, keepdim:
+                                jnp.prod(x, axis=axis, keepdims=keepdim))
+amax = _reduction("reduce_amax", lambda x, axis, keepdim:
+                  jnp.max(x, axis=axis, keepdims=keepdim))
+amin = _reduction("reduce_amin", lambda x, axis, keepdim:
+                  jnp.min(x, axis=axis, keepdims=keepdim))
+nansum = _reduction("reduce_nansum", lambda x, axis, keepdim:
+                    jnp.nansum(x, axis=axis, keepdims=keepdim))
+nanmean = _reduction("reduce_nanmean", lambda x, axis, keepdim:
+                     jnp.nanmean(x, axis=axis, keepdims=keepdim))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(_wrap(x), _norm_axis(axis), keepdim)
+
+
+@op("logsumexp")
+def _logsumexp(x, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+@op("all", differentiable=False)
+def _all(x, axis, keepdim):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+@op("any", differentiable=False)
+def _any(x, axis, keepdim):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _all(_wrap(x), _norm_axis(axis), keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _any(_wrap(x), _norm_axis(axis), keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = _wrap(x)
+    return sum((x != 0).astype(jnp.int64), axis=axis, keepdim=keepdim)
+
+
+# -- cumulative --------------------------------------------------------------
+@op("cumsum")
+def _cumsum(x, axis):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = _cumsum(_wrap(x), axis)
+    if dtype is not None:
+        out = out.astype(convert_dtype(dtype))
+    return out
+
+
+@op("cumprod")
+def _cumprod(x, dim):
+    return jnp.cumprod(x, axis=dim)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _cumprod(_wrap(x), dim)
+    if dtype is not None:
+        out = out.astype(convert_dtype(dtype))
+    return out
+
+
+@op("cummax", differentiable=False)
+def _cummax(x, axis):
+    return jax.lax.cummax(x, axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = _wrap(x)
+    if axis is None:
+        x, axis = x.reshape([-1]), 0
+    vals = _cummax(x, axis)
+    return vals
+
+
+@op("logcumsumexp")
+def _logcumsumexp(x, axis):
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = _wrap(x)
+    if axis is None:
+        x, axis = x.reshape([-1]), 0
+    return _logcumsumexp(x, axis)
+
+
+@op("trace")
+def _trace(x, offset, axis1, axis2):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace(_wrap(x), offset, axis1, axis2)
+
+
+@op("diagonal")
+def _diagonal(x, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal(_wrap(x), offset, axis1, axis2)
+
+
+@op("cast")
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    """reference: operators/cast_op.cc (grad casts back — jax.vjp handles)."""
+    return _cast(_wrap(x), convert_dtype(dtype))

@@ -1,0 +1,217 @@
+"""Search / sort / statistics ops.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/ arg_min_max_op,
+argsort_op.cc, top_k_v2_op, kthvalue, mode, median, index ops; Python surface
+python/paddle/tensor/search.py and stat.py. top_k lowers to jax.lax.top_k
+(XLA TopK — TPU-efficient); sorts lower to XLA variadic sort.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+@op("arg_max", differentiable=False)
+def _argmax(x, axis, keepdim):
+    if axis is None:
+        return jnp.argmax(x.reshape(-1))
+    out = jnp.argmax(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmax(_wrap(x), axis, keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@op("arg_min", differentiable=False)
+def _argmin(x, axis, keepdim):
+    if axis is None:
+        return jnp.argmin(x.reshape(-1))
+    out = jnp.argmin(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmin(_wrap(x), axis, keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@op("argsort", differentiable=False)
+def _argsort(x, axis, descending, stable):
+    idx = jnp.argsort(x, axis=axis, stable=stable,
+                      descending=descending)
+    return idx
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return _argsort(_wrap(x), axis, descending, stable).astype(jnp.int64)
+
+
+@op("sort")
+def _sort(x, axis, descending):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return _sort(_wrap(x), axis, descending)
+
+
+@op("top_k_v2")
+def _topk(x, k, axis, largest):
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    if axis is None:
+        axis = -1
+    vals, idx = _topk(_wrap(x), k, axis, largest)
+    return vals, Tensor(idx._value.astype(jnp.int64))
+
+
+@op("kthvalue")
+def _kthvalue(x, k, axis, keepdim):
+    sorted_vals = jnp.sort(x, axis=axis)
+    sorted_idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis)
+    idx = jnp.take(sorted_idx, k - 1, axis=axis)
+    if keepdim:
+        vals, idx = jnp.expand_dims(vals, axis), jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    vals, idx = _kthvalue(_wrap(x), k, axis, keepdim)
+    return vals, Tensor(idx._value.astype(jnp.int64))
+
+
+@op("mode")
+def _mode(x, axis, keepdim):
+    # sort, then longest run: run start positions via cummax (associative),
+    # run length = position - start + 1
+    moved = jnp.moveaxis(jnp.sort(x, axis=axis), axis, -1)
+    n = moved.shape[-1]
+    pos = jnp.arange(n)
+    change = jnp.concatenate(
+        [jnp.ones(moved.shape[:-1] + (1,), bool),
+         moved[..., 1:] != moved[..., :-1]], axis=-1)
+    start = jax.lax.cummax(jnp.where(change, pos, 0), axis=moved.ndim - 1)
+    run = pos - start + 1
+    best = jnp.argmax(run, axis=-1)
+    vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+    idx_sorted = jnp.moveaxis(jnp.argsort(x, axis=axis), axis, -1)
+    idx = jnp.take_along_axis(idx_sorted, best[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    vals, idx = _mode(_wrap(x), axis, keepdim)
+    return vals, Tensor(idx._value.astype(jnp.int64))
+
+
+@op("median")
+def _median(x, axis, keepdim):
+    if axis is None:
+        return jnp.median(x)
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _median(_wrap(x), axis, keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = _wrap(x)
+    if axis is None:
+        return Tensor(jnp.nanmedian(x._value))
+    return Tensor(jnp.nanmedian(x._value, axis=axis, keepdims=keepdim))
+
+
+@op("quantile")
+def _quantile(x, q, axis, keepdim, interpolation):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    if isinstance(q, Tensor):
+        q = q.tolist()
+    return _quantile(_wrap(x), q, axis, keepdim, interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = _wrap(x)
+    return Tensor(jnp.nanquantile(x._value, jnp.asarray(q), axis=axis,
+                                  keepdims=keepdim))
+
+
+@op("std")
+def _std(x, axis, unbiased, keepdim):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _std(_wrap(x), axis, unbiased, keepdim)
+
+
+@op("var")
+def _var(x, axis, unbiased, keepdim):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _var(_wrap(x), axis, unbiased, keepdim)
+
+
+@op("searchsorted", differentiable=False)
+def _searchsorted(sorted_sequence, values, right):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side)
+    fn = lambda s, v: jnp.searchsorted(s, v, side=side)
+    for _ in range(sorted_sequence.ndim - 1):
+        fn = jax.vmap(fn)
+    return fn(sorted_sequence, values)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    out = _searchsorted(_wrap(sorted_sequence), _wrap(values), right)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@op("bucketize", differentiable=False)
+def _bucketize(x, boundaries, right):
+    return jnp.searchsorted(boundaries, x,
+                            side="right" if right else "left")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    out = _bucketize(_wrap(x), _wrap(sorted_sequence), right)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)

@@ -1,0 +1,14 @@
+"""Execution-mode state (reference: fluid/framework.py in_dygraph_mode /
+paddle.enable_static). Dygraph is the default, as in paddle 2.0."""
+_static_mode = False
+
+def in_dynamic_mode():
+    return not _static_mode
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+def disable_static():
+    global _static_mode
+    _static_mode = False

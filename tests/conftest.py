@@ -1,0 +1,31 @@
+"""Test harness config.
+
+Tests run on an 8-device virtual CPU mesh (the reference's analogue:
+multi-process TestDistBase launching 2-rank jobs on one host,
+/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:660 —
+here XLA's host platform emulates the multi-chip topology in-process, so
+sharding/collective tests run anywhere).
+
+Must set platform config before any jax backend initialisation; the axon TPU
+plugin registers itself in sitecustomize, so selection (not registration) is
+overridden here.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

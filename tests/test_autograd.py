@@ -1,0 +1,173 @@
+"""Autograd engine tests.
+
+Modelled on the reference's imperative tests
+(/root/reference/python/paddle/fluid/tests/unittests/test_imperative_basic.py,
+test_imperative_auto_prune.py, test_inplace.py) — the numeric-vs-analytic
+check pattern of the OpTest harness (op_test.py:1329 check_grad) is applied
+via finite differences in test_ops.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_matmul_chain():
+    x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.random.randn(4, 5).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.matmul(x, w)
+    loss = (y * 2.0 + 1.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(
+        w.grad.numpy(), 2 * x.numpy().T @ np.ones((3, 5), np.float32),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        x.grad.numpy(), 2 * np.ones((3, 5), np.float32) @ w.numpy().T,
+        rtol=1e-5)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    (x * x).sum().backward()
+    (x * x).sum().backward()
+    assert abs(x.grad.numpy()[0] - 8.0) < 1e-6
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_prunes_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), y.numpy())
+    assert y.grad is None
+
+
+def test_detach_breaks_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = d * 3
+    assert z.stop_gradient
+
+
+def test_second_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    loss = (x * x).sum()
+    loss.backward()
+    with pytest.raises(RuntimeError):
+        loss.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    loss = (x * x).sum()
+    loss.backward(retain_graph=True)
+    loss.backward()
+    assert abs(x.grad.numpy()[0] - 12.0) < 1e-6
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, x)
+    assert abs(g.numpy()[0] - 12.0) < 1e-5
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_grad_unused_raises_and_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    u = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [u])
+    y = x * 2  # graph was consumed by the failed call (torch/paddle parity)
+    g = paddle.grad(y, [u], allow_unused=True)
+    assert g[0] is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert paddle.is_grad_enabled()
+
+
+def test_register_hook_scales_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 2)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    h.remove()
+
+
+def test_indexing_grad():
+    a = paddle.ones([4, 4])
+    a.stop_gradient = False
+    a[1:3, :2].sum().backward()
+    assert a.grad.numpy().sum() == 4
+    expected = np.zeros((4, 4), np.float32)
+    expected[1:3, :2] = 1
+    np.testing.assert_allclose(a.grad.numpy(), expected)
+
+
+def test_setitem_inplace_grad():
+    x = paddle.zeros([4])
+    x.stop_gradient = False
+    v = paddle.to_tensor([5.0], stop_gradient=False)
+    y = x * 2
+    y[1] = v * 3
+    y.sum().backward()
+    assert abs(v.grad.numpy()[0] - 3.0) < 1e-6
+    # overwritten slot contributes no grad to x
+    np.testing.assert_allclose(x.grad.numpy(), [2, 0, 2, 2])
+
+
+def test_inplace_add_participates_in_autograd():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.add_(paddle.to_tensor([10.0, 10.0]))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3),
+                         stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    (a.sum() * 1 + b.sum() * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 2, 0], [1, 2, 0]])
+
+
+def test_grad_through_concat_stack():
+    x = paddle.ones([2, 2])
+    x.stop_gradient = False
+    y = paddle.concat([x, x * 2], axis=0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 3.0))
+
+
+def test_hooks_on_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    mid = x * 2
+    seen = []
+    mid.register_hook(lambda g: seen.append(np.asarray(g)))
+    (mid * 3).backward()
+    assert seen and abs(seen[0][0] - 3.0) < 1e-6
+    assert abs(x.grad.numpy()[0] - 6.0) < 1e-6
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0])
+        with pytest.raises(FloatingPointError):
+            paddle.log(x - 2.0) * 0 + paddle.sqrt(x - 5.0)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})

@@ -1,0 +1,246 @@
+"""Op corpus tests — the OpTest harness analogue.
+
+The reference verifies ~700 ops through one declarative harness
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:232):
+check_output runs the op on every place, check_grad compares analytic
+gradients against finite differences (get_numeric_gradient:101). Here the
+same pattern: outputs vs numpy reference, analytic (tape) grads vs central
+finite differences in float64-free f32 with loose tolerance.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite differences of scalar fn at numpy array x."""
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        up = fn(x.copy().astype("float32"))
+        flat[i] = orig - eps
+        dn = fn(x.copy().astype("float32"))
+        flat[i] = orig
+        gf[i] = (up - dn) / (2 * eps)
+    return g
+
+
+def check_grad(op, x_np, atol=1e-2, rtol=1e-2, **kwargs):
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    out = op(x, **kwargs)
+    out.sum().backward()
+
+    def scalar_fn(a):
+        return float(op(paddle.to_tensor(a), **kwargs).sum().numpy())
+    ng = numeric_grad(scalar_fn, x_np.astype(np.float64))
+    np.testing.assert_allclose(x.grad.numpy(), ng, atol=atol, rtol=rtol)
+
+
+UNARY_CASES = [
+    (paddle.exp, lambda x: np.exp(x), (2, 3), (-1, 1)),
+    (paddle.log, np.log, (2, 3), (0.5, 2)),
+    (paddle.sqrt, np.sqrt, (2, 3), (0.5, 2)),
+    (paddle.tanh, np.tanh, (2, 3), (-2, 2)),
+    (paddle.sin, np.sin, (2, 3), (-2, 2)),
+    (paddle.cos, np.cos, (2, 3), (-2, 2)),
+    (paddle.square, np.square, (2, 3), (-2, 2)),
+    (paddle.abs, np.abs, (2, 3), (0.5, 2)),
+    (paddle.sigmoid if hasattr(paddle, "sigmoid") else paddle.tanh,
+     lambda x: 1 / (1 + np.exp(-x)) if hasattr(paddle, "sigmoid")
+     else np.tanh(x), (2, 3), (-2, 2)),
+    (paddle.rsqrt, lambda x: 1 / np.sqrt(x), (2, 3), (0.5, 2)),
+    (paddle.log1p, np.log1p, (2, 3), (0.1, 2)),
+    (paddle.erf, None, (2, 3), (-1, 1)),
+    (paddle.floor, np.floor, (2, 3), (-2, 2)),
+    (paddle.reciprocal, lambda x: 1 / x, (2, 3), (0.5, 2)),
+]
+
+
+@pytest.mark.parametrize("op,ref,shape,rng",
+                         UNARY_CASES,
+                         ids=[c[0].__name__ for c in UNARY_CASES])
+def test_unary_output(op, ref, shape, rng):
+    x = np.random.uniform(*rng, size=shape).astype("float32")
+    out = op(paddle.to_tensor(x)).numpy()
+    if ref is not None:
+        np.testing.assert_allclose(out, ref(x), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("op", [paddle.exp, paddle.tanh, paddle.sqrt,
+                                paddle.log, paddle.square],
+                         ids=lambda f: f.__name__)
+def test_unary_grad_vs_numeric(op):
+    x = np.random.uniform(0.5, 1.5, size=(2, 3)).astype("float32")
+    check_grad(op, x)
+
+
+def test_binary_broadcast_grads():
+    a_np = np.random.randn(3, 1, 4).astype("float32")
+    b_np = np.random.randn(2, 4).astype("float32")
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    (a * b).sum().backward()
+    np.testing.assert_allclose(
+        a.grad.numpy(),
+        np.broadcast_to(b_np, (3, 2, 4)).sum(1, keepdims=True),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        b.grad.numpy(),
+        np.broadcast_to(a_np, (3, 2, 4)).sum(0),
+        rtol=1e-5)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4, 5).astype("float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(), x.sum(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t, axis=[0, 2]).numpy(),
+                               x.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t, axis=-1, keepdim=True).numpy(),
+                               x.max(-1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(paddle.prod(t, axis=0).numpy(), x.prod(0),
+                               rtol=1e-4)
+    np.testing.assert_allclose(paddle.logsumexp(t, axis=1).numpy(),
+                               np.log(np.exp(x).sum(1)), rtol=1e-5)
+
+
+def test_matmul_variants():
+    a = np.random.randn(2, 3, 4).astype("float32")
+    b = np.random.randn(2, 4, 5).astype("float32")
+    np.testing.assert_allclose(
+        paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        a @ b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.swapaxes(1, 2)),
+                      transpose_y=True).numpy(),
+        a @ b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        a @ b, rtol=1e-4, atol=1e-5)
+    v = np.random.randn(4).astype("float32")
+    m = np.random.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(paddle.mv(paddle.to_tensor(m),
+                                         paddle.to_tensor(v)).numpy(),
+                               m @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_manipulation_roundtrips():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+    assert paddle.reshape(t, [0, -1]).shape == [2, 12]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1, 2).shape == [2, 12]
+    assert paddle.unsqueeze(t, [0, -1]).shape == [1, 2, 3, 4, 1]
+    assert paddle.squeeze(paddle.ones([1, 2, 1, 3]), axis=0).shape == [2, 1, 3]
+    parts = paddle.split(t, [1, 2], axis=1)
+    assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+    st = paddle.stack([t, t], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    assert paddle.tile(paddle.ones([2]), [3, 2]).shape == [3, 4]
+    assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+    np.testing.assert_allclose(paddle.flip(t, [0]).numpy(), x[::-1])
+    np.testing.assert_allclose(paddle.roll(t, 1, axis=0).numpy(),
+                               np.roll(x, 1, axis=0))
+
+
+def test_gather_scatter():
+    x = np.arange(12, dtype="float32").reshape(4, 3)
+    idx = np.array([0, 2])
+    np.testing.assert_allclose(
+        paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(),
+        x[idx])
+    up = np.ones((2, 3), np.float32)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         paddle.to_tensor(up))
+    exp = x.copy()
+    exp[idx] = up
+    np.testing.assert_allclose(out.numpy(), exp)
+    nd_idx = np.array([[0, 1], [2, 2]])
+    np.testing.assert_allclose(
+        paddle.gather_nd(paddle.to_tensor(x),
+                         paddle.to_tensor(nd_idx)).numpy(),
+        x[[0, 2], [1, 2]])
+
+
+def test_where_topk_sort():
+    x = np.random.randn(3, 5).astype("float32")
+    t = paddle.to_tensor(x)
+    vals, idx = paddle.topk(t, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), np.sort(x, 1)[:, ::-1][:, :2],
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(), np.sort(x, 1))
+    np.testing.assert_allclose(paddle.argsort(t, axis=1).numpy(),
+                               np.argsort(x, 1, kind="stable"))
+    cond = x > 0
+    np.testing.assert_allclose(
+        paddle.where(paddle.to_tensor(cond), t, t * 0).numpy(),
+        np.where(cond, x, 0))
+    assert paddle.argmax(t).numpy() == x.argmax()
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int64").dtype == paddle.int64
+    np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+    np.testing.assert_allclose(paddle.arange(1, 7, 2).numpy(), [1, 3, 5])
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+    u = paddle.uniform([100], min=0, max=1)
+    assert 0 <= float(u.numpy().min()) and float(u.numpy().max()) <= 1
+    r = paddle.randint(0, 10, [50])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    assert sorted(paddle.randperm(10).numpy().tolist()) == list(range(10))
+    np.testing.assert_allclose(paddle.tril(paddle.ones([3, 3])).numpy(),
+                               np.tril(np.ones((3, 3))))
+
+
+def test_linalg_extras():
+    a = np.random.randn(4, 4).astype("float32")
+    spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+    t = paddle.to_tensor(spd)
+    L = paddle.cholesky(t).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        paddle.inverse(t).numpy() @ spd, np.eye(4), atol=1e-3)
+    np.testing.assert_allclose(paddle.ops.linalg.det(t).numpy(),
+                               np.linalg.det(spd), rtol=1e-3)
+    n = paddle.ops.linalg.norm(paddle.to_tensor(a))
+    np.testing.assert_allclose(n.numpy(), np.linalg.norm(a), rtol=1e-5)
+    e = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(a))
+    np.testing.assert_allclose(e.numpy(), a @ a, rtol=1e-4, atol=1e-4)
+
+
+def test_logic_ops():
+    a = paddle.to_tensor([1, 2, 3])
+    b = paddle.to_tensor([1, 0, 3])
+    np.testing.assert_array_equal((a == b).numpy(), [True, False, True])
+    np.testing.assert_array_equal((a > b).numpy(), [False, True, False])
+    assert bool(paddle.allclose(paddle.ones([2]), paddle.ones([2])).numpy())
+    assert bool(paddle.ops.logic.equal_all(a, a).numpy())
+    assert not bool(paddle.ops.logic.equal_all(a, b).numpy())
+
+
+def test_cast_and_dtypes():
+    x = paddle.ones([2], dtype="float32")
+    assert x.astype("int64").dtype == paddle.int64
+    assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+    assert paddle.get_default_dtype() == paddle.float32
+
+
+def test_cumsum_clip_lerp():
+    x = np.random.randn(3, 4).astype("float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(),
+                               np.cumsum(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.clip(t, -0.5, 0.5).numpy(),
+                               np.clip(x, -0.5, 0.5))
+    np.testing.assert_allclose(
+        paddle.ops.math.lerp(paddle.zeros([3]), paddle.ones([3]), 0.3).numpy(),
+        np.full(3, 0.3), rtol=1e-6)
