@@ -1,1 +1,30 @@
+"""Automatic mixed precision.
 
+TPU-native analogue of /root/reference/paddle/fluid/imperative/amp_auto_cast.cc
+(AmpOperators allow/block lists :27-54, AutoCastInputs) +
+python/paddle/amp/auto_cast.py and grad_scaler.py (AmpScaler at
+fluid/dygraph/amp/loss_scaler.py:119 using check_finite_and_unscale +
+update_loss_scaling ops).
+
+TPU-first: the low-precision dtype is bfloat16 ('O1' casts matmul/conv inputs
+to bf16; 'O2' casts whole models). bf16 has fp32-range exponent, so loss
+scaling is a no-op numerically — GradScaler keeps the full paddle API and
+state machine (for float16 it scales for real), but with bf16 it simply
+passes through, which is the idiomatic TPU recipe.
+"""
+from .auto_cast import auto_cast, amp_guard, white_list, black_list  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate (O2: cast model params to low precision;
+    reference: pure-fp16 cast_model_to_fp16, fluid/contrib/mixed_precision/
+    fp16_utils.py:306)."""
+    if level == "O2" and models is not None:
+        items = models if isinstance(models, (list, tuple)) else [models]
+        for m in items:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

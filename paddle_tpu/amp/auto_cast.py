@@ -1,0 +1,97 @@
+"""Dygraph autocast context.
+
+Reference: imperative/amp_auto_cast.cc — AmpOperators holds
+allow/block/unsupported op lists; Tracer::TraceOp calls AutoCastInputs before
+kernel dispatch. Here the dispatch hook (core.dispatch.register_amp_hook)
+casts op inputs per the same three-way policy: white list → low precision,
+black list → float32, others → follow inputs (O1); O2 casts everything except
+the black list.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+# reference: fluid/contrib/mixed_precision/fp16_lists.py white/black lists
+WHITE_LIST = {
+    "conv2d", "matmul_v2", "bmm", "mv", "einsum", "mul", "linear",
+    "addmm",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "reduce_mean",
+    "reduce_sum", "cos_sim", "softmax_with_cross_entropy",
+    "softmax_with_cross_entropy_keepdim", "cross_entropy",
+    "cross_entropy_probs", "bce_loss", "bce_with_logits",
+    "sigmoid_cross_entropy_with_logits", "c_softmax_with_cross_entropy",
+    "layer_norm", "batch_norm_train", "batch_norm_infer", "p_norm",
+    "frobenius_norm", "softmax", "log_softmax", "logsumexp", "cumsum",
+    "nll_loss", "kl_div", "mse_loss", "l1_loss",
+}
+
+white_list = WHITE_LIST
+black_list = BLACK_LIST
+
+
+class _AmpState:
+    enabled = False
+    level = "O1"
+    dtype = jnp.bfloat16
+    custom_white = set()
+    custom_black = set()
+
+
+def _cast_tensors(tensors, dtype):
+    out = []
+    for t in tensors:
+        if jnp.issubdtype(t._value.dtype, jnp.floating) and \
+                t._value.dtype != dtype:
+            out.append(t.astype(dtype))
+        else:
+            out.append(t)
+    return out
+
+
+def _amp_hook(op_type, tensors):
+    if not _AmpState.enabled:
+        return None
+    white = (WHITE_LIST | _AmpState.custom_white) - _AmpState.custom_black
+    black = (BLACK_LIST | _AmpState.custom_black) - _AmpState.custom_white
+    if _AmpState.level == "O2":
+        if op_type in black:
+            return _cast_tensors(tensors, jnp.float32)
+        return _cast_tensors(tensors, _AmpState.dtype)
+    # O1
+    if op_type in white:
+        return _cast_tensors(tensors, _AmpState.dtype)
+    if op_type in black:
+        return _cast_tensors(tensors, jnp.float32)
+    return None  # follow input dtypes
+
+
+_dispatch.register_amp_hook(_amp_hook)
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast (reference: python/paddle/amp/auto_cast.py:20)."""
+    prev = (_AmpState.enabled, _AmpState.level, _AmpState.dtype,
+            _AmpState.custom_white, _AmpState.custom_black)
+    _AmpState.enabled = enable
+    _AmpState.level = level
+    _AmpState.dtype = convert_dtype(dtype)
+    _AmpState.custom_white = set(custom_white_list or ())
+    _AmpState.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_AmpState.enabled, _AmpState.level, _AmpState.dtype,
+         _AmpState.custom_white, _AmpState.custom_black) = prev
+
+
+amp_guard = auto_cast

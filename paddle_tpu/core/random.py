@@ -41,8 +41,35 @@ def set_rng_state(state):
     _RNGState.counter = int(state[1])
 
 
+class _TraceKey:
+    """Functional key threading for jitted steps: when a trace key is
+    installed (paddle_tpu.jit), random draws fold into IT instead of the
+    host counter's root key, so each compiled step invocation gets fresh
+    randomness (dropout masks differ across steps) while each call *site*
+    inside the trace stays distinct via the site counter."""
+    key = None
+    site_counter = 0
+
+
+from contextlib import contextmanager  # noqa: E402
+
+
+@contextmanager
+def trace_key_scope(key):
+    prev_key, prev_ctr = _TraceKey.key, _TraceKey.site_counter
+    _TraceKey.key = key
+    _TraceKey.site_counter = 0
+    try:
+        yield
+    finally:
+        _TraceKey.key, _TraceKey.site_counter = prev_key, prev_ctr
+
+
 def next_key():
     """Fresh PRNG key for one random draw."""
+    if _TraceKey.key is not None:
+        _TraceKey.site_counter += 1
+        return jax.random.fold_in(_TraceKey.key, _TraceKey.site_counter)
     _RNGState.counter += 1
     return jax.random.fold_in(_RNGState.root_key, _RNGState.counter)
 

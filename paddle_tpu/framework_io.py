@@ -1,4 +1,58 @@
-def save(obj, path, **k):
-    raise NotImplementedError("paddle.save placeholder")
-def load(path, **k):
-    raise NotImplementedError("paddle.load placeholder")
+"""paddle.save / paddle.load.
+
+TPU-native analogue of /root/reference/python/paddle/framework/io.py:201
+(pickle-based state_dict save with Tensors converted to ndarray) and
+fluid/dygraph/checkpoint.py. Uses numpy .npz-free pickle for exact parity
+with the reference's nested-dict format.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "value": obj.numpy(), "name": obj.name,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_serializable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["value"]
+            t = Tensor(obj["value"], stop_gradient=obj.get(
+                "stop_gradient", True), name=obj.get("name"))
+            return t
+        return {k: _from_serializable(v, return_numpy)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_serializable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_serializable(obj, return_numpy)

@@ -1,1 +1,22 @@
+"""paddle.io: Dataset / Sampler / DataLoader.
 
+TPU-native analogue of /root/reference/python/paddle/fluid/reader.py:149
+(DataLoader: multiprocess workers → shared-memory mmap_allocator →
+LoDTensorBlockingQueue) and fluid/dataloader/ (Dataset, BatchSampler,
+_DataLoaderIterMultiProcess at dataloader_iter.py:464).
+
+TPU-first differences: the device handoff is jax.device_put of whole
+batches (PJRT pins + transfers; no LoDTensor blocking queue needed), and
+multiprocess workers use a multiprocessing.Pool feeding an in-order prefetch
+queue — the double-buffering hides host→HBM latency behind TPU compute,
+which is the role the reference's shared-memory queue plays for CUDA.
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split, ConcatDataset,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

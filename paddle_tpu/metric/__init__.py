@@ -1,1 +1,3 @@
-
+"""paddle.metric (reference: python/paddle/metric/metrics.py — Metric base,
+Accuracy, Precision, Recall, Auc; paddle.metric.accuracy op wrapper)."""
+from .metrics import Metric, Accuracy, Precision, Recall, Auc, accuracy  # noqa: F401

@@ -1,1 +1,55 @@
+"""paddle.nn surface (reference: python/paddle/nn/__init__.py)."""
+from .layer.layers import Layer  # noqa: F401
+from .layer.base import ParamAttr  # noqa: F401
+from .layer.container import (  # noqa: F401
+    Sequential, LayerList, ParameterList, LayerDict,
+)
+from .layer.common import (  # noqa: F401
+    Linear, Identity, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Embedding, Flatten, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, CosineSimilarity, PairwiseDistance,
+    Bilinear, PixelShuffle,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, Tanhshrink, Silu, Swish, Mish, LogSigmoid,
+    Hardsigmoid, Hardswish, Softsign, GELU, LeakyReLU, ELU, CELU, SELU,
+    Hardtanh, Hardshrink, Softshrink, Softplus, ThresholdedReLU, PReLU,
+    RReLU, Softmax, LogSoftmax, Maxout, GLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, CTCLoss,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+    GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+    clip_grad_norm_,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
 
+# rnn/transformer build on the above
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from . import utils  # noqa: F401

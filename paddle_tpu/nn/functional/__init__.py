@@ -1,0 +1,22 @@
+"""paddle.nn.functional surface (reference:
+python/paddle/nn/functional/__init__.py)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+
+from . import activation, common, conv, pooling, norm, loss  # noqa: F401
+
+
+def _late_imports():
+    # attention functional lives in a module that imports layers; bind lazily
+    from .attention import scaled_dot_product_attention  # noqa: F401
+    globals()["scaled_dot_product_attention"] = scaled_dot_product_attention
+
+
+try:
+    from .attention import scaled_dot_product_attention  # noqa: F401
+except ImportError:
+    pass

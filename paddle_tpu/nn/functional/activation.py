@@ -1,0 +1,245 @@
+"""Activation functionals.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/activation_op.cc
+(+ .cu, .h — each activation is a CPU+CUDA kernel pair with a hand-written
+grad functor) and python/paddle/nn/functional/activation.py. Here each is a
+pure JAX function; XLA fuses them into adjacent matmuls so the reference's
+fuse_elewise_add_act / fuse_bn_act passes (framework/ir/) are not needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _unop(name, fn):
+    wrapped = op(name)(fn)
+
+    def api(x, name=None):
+        return wrapped(_wrap(x))
+    api.__name__ = name
+    return api
+
+
+relu = _unop("relu", lambda x: jnp.maximum(x, 0))
+relu6 = _unop("relu6", lambda x: jnp.clip(x, 0, 6))
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+silu = _unop("silu", jax.nn.silu)
+swish = silu
+tanh = _unop("tanh", jnp.tanh)
+tanhshrink = _unop("tanh_shrink", lambda x: x - jnp.tanh(x))
+mish = _unop("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+log_sigmoid = _unop("logsigmoid", jax.nn.log_sigmoid)
+hardsigmoid = _unop("hard_sigmoid",
+                    lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+hardswish = _unop("hard_swish",
+                  lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+
+
+@op("gelu")
+def _gelu(x, approximate):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(_wrap(x), bool(approximate))
+
+
+@op("leaky_relu")
+def _leaky_relu(x, negative_slope):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(_wrap(x), negative_slope)
+
+
+@op("elu")
+def _elu(x, alpha):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(_wrap(x), alpha)
+
+
+@op("celu")
+def _celu(x, alpha):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu(_wrap(x), alpha)
+
+
+@op("selu")
+def _selu(x, scale, alpha):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(_wrap(x), scale, alpha)
+
+
+@op("hard_tanh")
+def _hardtanh(x, min, max):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _hardtanh(_wrap(x), min, max)
+
+
+@op("hard_shrink")
+def _hardshrink(x, threshold):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(_wrap(x), threshold)
+
+
+@op("softshrink")
+def _softshrink(x, threshold):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(_wrap(x), threshold)
+
+
+@op("softplus")
+def _softplus(x, beta, threshold):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x,
+                     jnp.logaddexp(scaled, 0) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus(_wrap(x), beta, threshold)
+
+
+@op("softsign")
+def _softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+def softsign(x, name=None):
+    return _softsign(_wrap(x))
+
+
+@op("prelu")
+def _prelu(x, weight, data_format):
+    if weight.size == 1:
+        return jnp.where(x >= 0, x, weight.reshape(()) * x)
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = weight.shape[0]
+    return jnp.where(x >= 0, x, weight.reshape(shape) * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(_wrap(x), _wrap(weight), data_format)
+
+
+@op("rrelu")
+def _rrelu(x, slope):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    if training:
+        from ...core import random as _random
+        slope = jax.random.uniform(_random.next_key(), (), float, lower, upper)
+        return _rrelu(_wrap(x), slope)
+    return _rrelu(_wrap(x), (lower + upper) / 2.0)
+
+
+@op("thresholded_relu")
+def _thresholded_relu(x, threshold):
+    return jnp.where(x > threshold, x, 0)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _thresholded_relu(_wrap(x), threshold)
+
+
+@op("softmax")
+def _softmax(x, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _wrap(x)
+    if dtype is not None:
+        from ...core.dtypes import convert_dtype
+        x = x.astype(convert_dtype(dtype))
+    return _softmax(x, axis)
+
+
+@op("log_softmax")
+def _log_softmax(x, axis):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _wrap(x)
+    if dtype is not None:
+        from ...core.dtypes import convert_dtype
+        x = x.astype(convert_dtype(dtype))
+    return _log_softmax(x, axis)
+
+
+@op("gumbel_softmax")
+def _gumbel_softmax(x, gumbel, temperature, hard, axis):
+    y = jax.nn.softmax((x + gumbel) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        dims = list(range(y.ndim))
+        iota = jnp.arange(y.shape[axis]).reshape(
+            [-1 if i == axis else 1 for i in dims])
+        one_hot = jnp.where(iota == idx, 1.0, 0.0).astype(y.dtype)
+        # straight-through estimator
+        return one_hot + y - jax.lax.stop_gradient(y)
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as _random
+    x = _wrap(x)
+    g = jax.random.gumbel(_random.next_key(), tuple(x.shape),
+                          x._value.dtype if jnp.issubdtype(
+                              x._value.dtype, jnp.floating) else jnp.float32)
+    return _gumbel_softmax(x, g, temperature, hard, axis)
+
+
+@op("maxout")
+def _maxout(x, groups, axis):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout(_wrap(x), groups, axis)
+
+
+@op("glu")
+def _glu(x, axis):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(_wrap(x), axis)

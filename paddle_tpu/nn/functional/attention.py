@@ -1,0 +1,75 @@
+"""Attention functional — the TPU hot path.
+
+The reference snapshot has no fused attention op (only the ingredients under
+/root/reference/paddle/fluid/operators/fused/ — fused_attention appears in
+later Paddle versions); transformer attention is composed from matmul +
+softmax + dropout in python/paddle/nn/layer/transformer.py:372-436.
+
+Here attention is a first-class functional: composed-JAX reference path (XLA
+already fuses QK^T+softmax+PV well on TPU) with an optional pallas
+flash-attention kernel (paddle_tpu.ops.pallas) for long sequences, selected by
+`use_flash` or FLAGS. Causal masking uses an implicit mask — no O(T^2) mask
+materialisation.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor, to_tensor
+from ...core import flags as _flags
+
+_flags.define_flag("use_flash_attention", True,
+                   "Use the pallas flash-attention kernel when applicable.")
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+@op("scaled_dot_product_attention")
+def _sdpa(q, k, v, mask, causal, scale):
+    # q,k,v: [B, T, H, D] (paddle layout) -> compute in [B, H, T, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhtd,bhsd->bhts", qh, kh) * scale
+    if causal:
+        t, s = logits.shape[-2], logits.shape[-1]
+        idx_t = jnp.arange(t)[:, None]
+        idx_s = jnp.arange(s)[None, :]
+        logits = jnp.where(idx_t >= idx_s, logits,
+                           jnp.asarray(-1e30, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """q/k/v: [batch, seq, num_heads, head_dim] (paddle layout)."""
+    q, k, v = _wrap(query), _wrap(key), _wrap(value)
+    head_dim = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / float(np.sqrt(head_dim))
+    use_flash = (_flags.flag("use_flash_attention") and attn_mask is None
+                 and dropout_p == 0.0)
+    if use_flash:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=is_causal, scale=sc)
+        except Exception:
+            pass  # fall back to composed path (e.g. odd shapes, CPU quirks)
+    m = None if attn_mask is None else _wrap(attn_mask)
+    out = _sdpa(q, k, v, m, is_causal, sc)
+    if dropout_p > 0.0 and training:
+        from .common import dropout
+        out = dropout(out, dropout_p, training=training)
+    return out

@@ -1,0 +1,273 @@
+"""Common NN functionals: linear, dropout, embedding, one_hot, interpolate…
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/ matmul_v2 +
+elementwise_add (linear is a fused pattern there; python surface
+python/paddle/nn/functional/common.py:477 dispatches core.ops.matmul_v2),
+dropout_op.cc, lookup_table_v2_op.cc (embedding), one_hot_v2_op, interpolate
+ops, unfold_op, label_smooth_op.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor, to_tensor
+from ...core.dtypes import convert_dtype
+from ...core import random as _random
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+@op("linear")
+def _linear(x, weight, bias):
+    # weight layout is [in, out] (paddle convention, transposed vs torch)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    return _linear(_wrap(x), _wrap(weight),
+                   None if bias is None else _wrap(bias))
+
+
+@op("dropout")
+def _dropout(x, mask, p, mode):
+    if mode == "upscale_in_train":
+        return x * mask / (1.0 - p)
+    return x * mask  # 'downscale_in_infer' train path
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = _wrap(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x.scale(1.0 - p)
+        return x
+    if p == 1.0:
+        return x * to_tensor(0.0)
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, tuple(shape))
+    mask = Tensor(keep.astype(x._value.dtype))
+    return _dropout(x, mask, p, mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _wrap(x)
+    x = _wrap(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p,
+                                tuple(x.shape))
+    a = (1.0 / (1.0 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    mask = Tensor(keep.astype(x._value.dtype))
+    return (x * mask + to_tensor(alpha_p) * (to_tensor(1.0) - mask)) \
+        .scale(a) + to_tensor(b)
+
+
+@op("lookup_table_v2")
+def _embedding(weight, ids, padding_idx):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: operators/lookup_table_v2_op.cc. `sparse` (SelectedRows
+    grads) is accepted for parity; on TPU dense scatter-add grads via XLA
+    are used either way."""
+    if padding_idx is not None and padding_idx < 0:
+        padding_idx = weight.shape[0] + padding_idx
+    return _embedding(_wrap(weight), _wrap(x), padding_idx)
+
+
+@op("one_hot_v2", differentiable=False)
+def _one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def one_hot(x, num_classes, name=None):
+    if isinstance(num_classes, Tensor):
+        num_classes = int(num_classes.item())
+    return _one_hot(_wrap(x), num_classes)
+
+
+@op("label_smooth")
+def _label_smooth(label, epsilon, prior):
+    k = label.shape[-1]
+    if prior is None:
+        return (1 - epsilon) * label + epsilon / k
+    return (1 - epsilon) * label + epsilon * prior
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    prior = prior_dist._value if isinstance(prior_dist, Tensor) else prior_dist
+    return _label_smooth(_wrap(label), epsilon, prior)
+
+
+# ---------------------------------------------------------------- interpolate
+def _interp_size(x, size, scale_factor, spatial):
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        return [int(s.item() if isinstance(s, Tensor) else s) for s in size]
+    if isinstance(scale_factor, (int, float)):
+        scale_factor = [scale_factor] * spatial
+    return [int(d * s) for d, s in zip(x.shape[2:], scale_factor)]
+
+
+@op("interpolate")
+def _interpolate(x, out_size, mode, align_corners, data_format):
+    chan_first = data_format in ("NCHW", "NCDHW", "NCW")
+    if chan_first:
+        perm = (0,) + tuple(range(2, x.ndim)) + (1,)
+        x = jnp.transpose(x, perm)
+    spatial_in = x.shape[1:-1]
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "trilinear": "linear", "linear": "linear",
+              "bicubic": "cubic", "area": "linear"}[mode]
+    if align_corners and method != "nearest":
+        # jax.image doesn't support align_corners; emulate with explicit
+        # coordinate map via map_coordinates
+        coords = []
+        for i, (oin, oout) in enumerate(zip(spatial_in, out_size)):
+            if oout == 1:
+                c = jnp.zeros((oout,))
+            else:
+                c = jnp.linspace(0, oin - 1, oout)
+            coords.append(c)
+        mesh = jnp.meshgrid(*coords, indexing="ij")
+        order = 1 if method == "linear" else 0
+
+        def sample_one(img):  # img: spatial + C at end? map per-channel
+            return jax.vmap(lambda ch: jax.scipy.ndimage.map_coordinates(
+                ch, mesh, order=order, mode="nearest"), in_axes=-1,
+                out_axes=-1)(img)
+        out = jax.vmap(sample_one)(x)
+    else:
+        out_shape = (x.shape[0],) + tuple(out_size) + (x.shape[-1],)
+        out = jax.image.resize(x, out_shape, method=method)
+    if chan_first:
+        inv = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        out = jnp.transpose(out, inv)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """reference: operators/interpolate_v2_op.cc."""
+    x = _wrap(x)
+    out_size = _interp_size(x, size, scale_factor, x.ndim - 2)
+    return _interpolate(x, tuple(out_size), mode, align_corners, data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+@op("unfold")
+def _unfold(x, kernel, strides, paddings, dilations):
+    n, c = x.shape[0], x.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=kernel, window_strides=strides,
+        padding=[(paddings[0], paddings[2] if len(paddings) > 2 else paddings[0]),
+                 (paddings[1], paddings[3] if len(paddings) > 2 else paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, H', W'] -> [N, C*kh*kw, L]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    ks, st, dl = _pair(kernel_sizes), _pair(strides), _pair(dilations)
+    pd = [paddings] * 2 if isinstance(paddings, int) else list(paddings)
+    return _unfold(_wrap(x), tuple(ks), tuple(st), tuple(pd), tuple(dl))
+
+
+@op("pixel_shuffle")
+def _pixel_shuffle(x, factor, data_format):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        oc = c // (factor * factor)
+        x = x.reshape(n, oc, factor, factor, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, oc, h * factor, w * factor)
+    n, h, w, c = x.shape
+    oc = c // (factor * factor)
+    x = x.reshape(n, h, w, factor, factor, oc)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * factor, w * factor, oc)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(_wrap(x), upscale_factor, data_format)
+
+
+@op("cosine_similarity")
+def _cosine_similarity(x1, x2, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cosine_similarity(_wrap(x1), _wrap(x2), axis, eps)
+
+
+@op("normalize_l2")
+def _normalize(x, p, axis, epsilon):
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                              keepdims=True), 1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(_wrap(x), p, axis, epsilon)
+
+
+@op("bilinear")
+def _bilinear(x1, x2, weight, bias):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return _bilinear(_wrap(x1), _wrap(x2), _wrap(weight),
+                     None if bias is None else _wrap(bias))

@@ -1,0 +1,192 @@
+"""Convolution functionals.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/conv_op.cc,
+conv_cudnn_op.cu (cuDNN algo search), conv_transpose_op.cc, and
+python/paddle/nn/functional/conv.py. All variants lower to ONE primitive —
+jax.lax.conv_general_dilated — which XLA maps onto the TPU MXU with its own
+tiling/layout search, replacing the reference's cudnn workspace/algo logic.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _tuple_n(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _norm_padding(padding, n, strides=None):
+    """Returns list of (lo, hi) per spatial dim, or the string 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # nested [[lo,hi],...]
+    return [tuple(p) for p in padding]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last \
+            else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last \
+        else ("NCDHW", "OIDHW", "NCDHW")
+
+
+@op("conv2d")
+def _conv(x, weight, bias, strides, padding, dilations, groups, n,
+          channel_last):
+    dn = _dim_numbers(n, channel_last)
+    # paddle weight layout is [out_c, in_c/groups, *k] = OIHW; transpose for
+    # channel-last rhs spec
+    if channel_last:
+        if n == 1:
+            weight = jnp.transpose(weight, (2, 1, 0))
+        elif n == 2:
+            weight = jnp.transpose(weight, (2, 3, 1, 0))
+        else:
+            weight = jnp.transpose(weight, (2, 3, 4, 1, 0))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    strides = _tuple_n(stride, n)
+    dilations = _tuple_n(dilation, n)
+    pad = _norm_padding(padding, n)
+    return _conv(_wrap(x), _wrap(weight),
+                 None if bias is None else _wrap(bias),
+                 strides, pad, dilations, groups, n, channel_last)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format)
+
+
+@op("conv2d_transpose")
+def _conv_transpose(x, weight, bias, strides, padding, output_padding,
+                    dilations, groups, n, channel_last):
+    dn = _dim_numbers(n, channel_last)
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    # conv_transpose in jax wants IO spec matching dn's rhs: use transpose of
+    # the forward conv via gradient trick: lax.conv_transpose handles it.
+    spatial = weight.shape[2:]
+    if channel_last:
+        perm = tuple(range(2, 2 + n)) + (0, 1)
+        w = jnp.transpose(weight, perm)  # k..., I, O
+        rhs_spec = dn[1]
+    else:
+        w = weight  # I O k...
+        rhs_spec = ("IOW", "IOHW", "IODHW")[n - 1]
+        dn = (dn[0], rhs_spec, dn[2])
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(d * (k - 1) - lo, d * (k - 1) - hi + op_)
+               for (lo, hi), k, d, op_ in zip(
+                   padding, spatial, dilations, output_padding)]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * n, padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=1) if groups == 1 else \
+        _grouped_transpose(x, w, strides, pad, dilations, dn, groups, n,
+                           channel_last)
+    # flip kernel spatially: conv_transpose = conv with flipped kernel
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def _grouped_transpose(x, w, strides, pad, dilations, dn, groups, n,
+                       channel_last):
+    c_axis = x.ndim - 1 if channel_last else 1
+    xg = jnp.split(x, groups, axis=c_axis)
+    wg = jnp.split(w, groups, axis=(n if channel_last else 0))
+    outs = [jax.lax.conv_general_dilated(
+        xi, wi, window_strides=(1,) * n, padding=pad, lhs_dilation=strides,
+        rhs_dilation=dilations, dimension_numbers=dn, feature_group_count=1)
+        for xi, wi in zip(xg, wg)]
+    return jnp.concatenate(outs, axis=c_axis)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       groups, dilation, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    strides = _tuple_n(stride, n)
+    dilations = _tuple_n(dilation, n)
+    out_pad = _tuple_n(output_padding, n)
+    pad = _norm_padding(padding, n)
+    x, weight = _wrap(x), _wrap(weight)
+    # transposed conv = lhs-dilated conv with spatially flipped kernel
+    from ...ops.manipulation import flip as _flip_op
+    wf = _flip_op(weight, list(range(2, 2 + n)))
+    return _conv_transpose(x, wf, None if bias is None else _wrap(bias),
+                           strides, pad, out_pad, dilations, groups, n,
+                           channel_last)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 1, df)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 2,
+                              data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 3,
+                              data_format)

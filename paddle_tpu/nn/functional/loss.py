@@ -1,0 +1,387 @@
+"""Loss functionals.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/
+softmax_with_cross_entropy_op.cc (fused stable softmax+CE, the workhorse),
+cross_entropy_op.cc, bce_loss_op, sigmoid_cross_entropy_with_logits_op,
+smooth_l1_loss_op, kldiv_loss_op, margin_rank_loss_op, hinge_loss_op,
+nll_loss_op, mse ops; python/paddle/nn/functional/loss.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+@op("softmax_with_cross_entropy")
+def _softmax_ce(logits, label, soft_label, ignore_index, axis, weight,
+                reduction):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        per = -jnp.sum(label * logp, axis=axis)
+        if weight is not None:
+            per = per * jnp.sum(label * weight, axis=axis)
+        return _reduce(per, reduction)
+    lab = label
+    if lab.ndim == logits.ndim:  # [..., 1] hard label
+        lab = jnp.squeeze(lab, axis=axis)
+    nll = -jnp.take_along_axis(
+        logp, jnp.expand_dims(lab, axis).astype(jnp.int32), axis=axis)
+    nll = jnp.squeeze(nll, axis=axis)
+    valid = (lab != ignore_index)
+    nll = jnp.where(valid, nll, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, lab.astype(jnp.int32))
+        w = jnp.where(valid, w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(nll * w, reduction)
+    if reduction == "mean":
+        cnt = jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+        return jnp.sum(nll) / cnt
+    return _reduce(nll, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    """reference: softmax_with_cross_entropy_op.cc + paddle.nn.functional
+    cross_entropy (python/paddle/nn/functional/loss.py)."""
+    input, label = _wrap(input), _wrap(label)
+    if not use_softmax:
+        # input already holds probabilities: take log and do plain NLL
+        from ...ops import math as m
+        logp = m.log(m.maximum(input, to_tensor(1e-30)))
+        return _softmax_ce_no_softmax(logp, label, soft_label, ignore_index,
+                                      axis,
+                                      None if weight is None else _wrap(weight),
+                                      reduction)
+    return _softmax_ce(input, label, soft_label, ignore_index, axis,
+                       None if weight is None else _wrap(weight), reduction)
+
+
+@op("cross_entropy_probs")
+def _softmax_ce_no_softmax(logp, label, soft_label, ignore_index, axis,
+                           weight, reduction):
+    if soft_label:
+        per = -jnp.sum(label * logp, axis=axis)
+        return _reduce(per, reduction)
+    lab = label
+    if lab.ndim == logp.ndim:
+        lab = jnp.squeeze(lab, axis=axis)
+    nll = -jnp.take_along_axis(
+        logp, jnp.expand_dims(lab, axis).astype(jnp.int32), axis=axis)
+    nll = jnp.squeeze(nll, axis=axis)
+    valid = lab != ignore_index
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        cnt = jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+        return jnp.sum(nll) / cnt
+    return _reduce(nll, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = _softmax_ce_keep(logits if isinstance(logits, Tensor)
+                            else _wrap(logits), _wrap(label), soft_label,
+                            ignore_index, axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+@op("softmax_with_cross_entropy_keepdim")
+def _softmax_ce_keep(logits, label, soft_label, ignore_index, axis):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    lab = label
+    squeeze = False
+    if lab.ndim == logits.ndim:
+        lab = jnp.squeeze(lab, axis=axis)
+        squeeze = True
+    nll = -jnp.take_along_axis(
+        logp, jnp.expand_dims(lab, axis).astype(jnp.int32), axis=axis)
+    valid = jnp.expand_dims(lab != ignore_index, axis)
+    nll = jnp.where(valid, nll, 0.0)
+    return nll  # keepdim like reference op output [N, 1]
+
+
+@op("nll_loss")
+def _nll_loss(x, label, weight, ignore_index, reduction):
+    # x: log-probabilities [N, C, ...]
+    lab = jnp.expand_dims(label, 1).astype(jnp.int32)
+    nll = -jnp.take_along_axis(x, lab, axis=1)
+    nll = jnp.squeeze(nll, 1)
+    valid = label != ignore_index
+    nll = jnp.where(valid, nll, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, label.astype(jnp.int32))
+        w = jnp.where(valid, w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(nll * w, reduction)
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(
+            jnp.sum(valid.astype(nll.dtype)), 1.0)
+    return _reduce(nll, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll_loss(_wrap(input), _wrap(label),
+                     None if weight is None else _wrap(weight),
+                     ignore_index, reduction)
+
+
+@op("mse_loss")
+def _mse(x, y, reduction):
+    return _reduce(jnp.square(x - y), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse(_wrap(input), _wrap(label), reduction)
+
+
+@op("l1_loss")
+def _l1(x, y, reduction):
+    return _reduce(jnp.abs(x - y), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1(_wrap(input), _wrap(label), reduction)
+
+
+@op("smooth_l1_loss")
+def _smooth_l1(x, y, delta, reduction):
+    d = x - y
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(_wrap(input), _wrap(label), delta, reduction)
+
+
+@op("huber_loss")
+def _huber(x, y, delta, reduction):
+    d = x - y
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+@op("bce_loss")
+def _bce(x, label, weight, reduction):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(x, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - x, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    return _bce(_wrap(input), _wrap(label),
+                None if weight is None else _wrap(weight), reduction)
+
+
+@op("bce_with_logits")
+def _bce_logits(logit, label, weight, pos_weight, reduction):
+    # stable: max(x,0) - x*z + log(1+exp(-|x|))
+    neg_abs = -jnp.abs(logit)
+    base = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        base = base * log_w
+    if weight is not None:
+        base = base * weight
+    return _reduce(base, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return _bce_logits(_wrap(logit), _wrap(label),
+                       None if weight is None else _wrap(weight),
+                       None if pos_weight is None else _wrap(pos_weight),
+                       reduction)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False, name=None):
+    x, label = _wrap(x), _wrap(label)
+    return _sigmoid_ce(x, label, ignore_index, normalize)
+
+
+@op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(x, label, ignore_index, normalize):
+    neg_abs = -jnp.abs(x)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(neg_abs))
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return loss
+
+
+@op("kl_div")
+def _kl_div(x, target, reduction):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return _kl_div(_wrap(input), _wrap(label), reduction)
+
+
+@op("margin_ranking_loss")
+def _margin_ranking(x, y, label, margin, reduction):
+    return _reduce(jnp.maximum(0.0, -label * (x - y) + margin), reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _margin_ranking(_wrap(input), _wrap(other), _wrap(label), margin,
+                           reduction)
+
+
+@op("hinge_embedding_loss")
+def _hinge_embedding(x, label, margin, reduction):
+    loss = jnp.where(label == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return _hinge_embedding(_wrap(input), _wrap(label), margin, reduction)
+
+
+@op("cosine_embedding_loss")
+def _cosine_embedding(x1, x2, label, margin, reduction):
+    cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    return _cosine_embedding(_wrap(input1), _wrap(input2), _wrap(label),
+                             margin, reduction)
+
+
+@op("triplet_margin_loss")
+def _triplet(anchor, pos, neg, margin, p, eps, swap, reduction):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + eps, p), -1),
+                         1.0 / p)
+    d_pos = dist(anchor, pos)
+    d_neg = dist(anchor, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(pos, neg))
+    return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    return _triplet(_wrap(input), _wrap(positive), _wrap(negative), margin,
+                    p, epsilon, swap, reduction)
+
+
+def square_error_cost(input, label):
+    """reference: operators/squared_l2_distance / square_error_cost
+    (python/paddle/fluid/layers/loss.py)."""
+    from ...ops import math as m
+    d = _wrap(input) - _wrap(label)
+    return d * d
+
+
+@op("log_loss")
+def _log_loss(input, label, epsilon):
+    return -label * jnp.log(input + epsilon) \
+        - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss(_wrap(input), _wrap(label), epsilon)
+
+
+@op("ctc_loss")
+def _ctc(log_probs, labels, input_lengths, label_lengths, blank):
+    # log_probs: [T, B, C] log-softmax already applied
+    # standard CTC forward (alpha recursion) in log space via lax.scan
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    # extended label seq: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = -1e30
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, blank])
+    first_lab = jnp.take_along_axis(log_probs[0], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(first_lab)
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, logp_t):
+        a_shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        new = merged + emit
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    last = alphas[t_idx, jnp.arange(B)]  # [B, S]
+    s_last = 2 * label_lengths  # blank after last label
+    ll_blank = jnp.take_along_axis(last, s_last[:, None], axis=1)[:, 0]
+    ll_lab = jnp.take_along_axis(
+        last, jnp.maximum(s_last - 1, 0)[:, None], axis=1)[:, 0]
+    return -jnp.logaddexp(ll_blank, ll_lab)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """reference: operators/warpctc_op.cc (warp-ctc library there; native
+    log-space alpha recursion via lax.scan here)."""
+    from .activation import log_softmax
+    lp = log_softmax(_wrap(log_probs), axis=-1)
+    out = _ctc(lp, _wrap(labels), _wrap(input_lengths),
+               _wrap(label_lengths), blank)
+    if reduction == "mean":
+        from ...ops import math as m
+        return m.mean(out / _wrap(label_lengths).astype(out.dtype))
+    if reduction == "sum":
+        from ...ops import math as m
+        return m.sum(out)
+    return out

@@ -1,0 +1,180 @@
+"""Normalization functionals.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/batch_norm_op.cc
+(+ .cu cudnnBatchNorm), layer_norm_op.cc (hand-tuned CUDA welford kernels),
+instance_norm_op.cc, group_norm_op.cc, norm_op.cc;
+python/paddle/nn/functional/norm.py. Pure-JAX reductions — XLA fuses the
+normalize+scale+shift into neighbours, replacing the reference's
+fuse_bn_act/fused_bn_add_act passes.
+
+Running-stat updates are returned functionally AND applied in-place on the
+passed stat tensors when executing eagerly (paddle mutates them in place).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+@op("batch_norm_infer")
+def _bn_infer(x, mean, var, weight, bias, eps, c_axis):
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("batch_norm_train")
+def _bn_train(x, weight, bias, eps, c_axis):
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """reference: operators/batch_norm_op.cc (momentum semantics:
+    running = momentum*running + (1-momentum)*batch, batch_norm_op.cc
+    attr 'momentum' default 0.9)."""
+    x = _wrap(x)
+    c_axis = x.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else 1
+    if x.ndim == 2:
+        c_axis = 1
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+    if use_stats:
+        return _bn_infer(x, _wrap(running_mean), _wrap(running_var),
+                         None if weight is None else _wrap(weight),
+                         None if bias is None else _wrap(bias),
+                         epsilon, c_axis)
+    out, mean, var = _bn_train(x, None if weight is None else _wrap(weight),
+                               None if bias is None else _wrap(bias),
+                               epsilon, c_axis)
+    # update running stats in place. Under a jit trace the assigned values
+    # are tracers; paddle_tpu.jit reads the buffers back after tracing and
+    # returns them as extra outputs, making the update functional.
+    if running_mean is not None:
+        n = int(np.prod([x.shape[i] for i in range(x.ndim) if i != c_axis]))
+        unbiased = var._value * (n / max(n - 1, 1))
+        running_mean._value = (momentum * running_mean._value
+                               + (1 - momentum) * mean._value)
+        running_var._value = (momentum * running_var._value
+                              + (1 - momentum) * unbiased)
+    return out
+
+
+@op("layer_norm")
+def _layer_norm(x, weight, bias, eps, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    """reference: operators/layer_norm_op.cc (begin_norm_axis semantics)."""
+    x = _wrap(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = x.ndim - len(list(normalized_shape))
+    return _layer_norm(x, None if weight is None else _wrap(weight),
+                       None if bias is None else _wrap(bias), epsilon, begin)
+
+
+@op("instance_norm")
+def _instance_norm(x, weight, bias, eps):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    return _instance_norm(_wrap(x),
+                          None if weight is None else _wrap(weight),
+                          None if bias is None else _wrap(bias), eps)
+
+
+@op("group_norm")
+def _group_norm(x, weight, bias, groups, eps, channel_last):
+    if channel_last:
+        x_cf = jnp.moveaxis(x, -1, 1)
+    else:
+        x_cf = x
+    n, c = x_cf.shape[0], x_cf.shape[1]
+    g = x_cf.reshape((n, groups, c // groups) + x_cf.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(x_cf.shape)
+    shape = [1, c] + [1] * (x_cf.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    return _group_norm(_wrap(x), None if weight is None else _wrap(weight),
+                       None if bias is None else _wrap(bias), num_groups,
+                       epsilon, channel_last)
+
+
+@op("local_response_norm")
+def _lrn(x, size, alpha, beta, k):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    padded = jnp.pad(sq, pads)
+    window = (1, size) + (1,) * (x.ndim - 2)
+    summed = jax.lax.reduce_window(padded, 0.0, jax.lax.add, window,
+                                   (1,) * x.ndim, [(0, 0)] * x.ndim)
+    return x / jnp.power(k + alpha * summed, beta)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _lrn(_wrap(x), size, alpha, beta, k)

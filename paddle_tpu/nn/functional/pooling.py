@@ -1,0 +1,220 @@
+"""Pooling functionals.
+
+TPU-native analogue of /root/reference/paddle/fluid/operators/pool_op.cc
+(+ pool_cudnn_op, math/pooling.{cc,cu} — hand-written maxPool/avgPool
+forward/backward kernels) and python/paddle/nn/functional/pooling.py. All
+pooling lowers to jax.lax.reduce_window; XLA generates the backward
+(select-and-scatter) — the reference's MaxPoolGrad/AvgPoolGrad functors
+collapse into jax.vjp.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _tuple_n(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _pool_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+@op("pool_max")
+def _max_pool(x, kernel, strides, padding, n, channel_last, ceil_mode):
+    window = _window(kernel, n, x.ndim, channel_last)
+    stride = _window(strides, n, x.ndim, channel_last)
+    pads = _full_padding(padding, n, x.ndim, channel_last, x.shape, window,
+                         stride, ceil_mode)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, init, jax.lax.max, window, stride, pads)
+
+
+@op("pool_avg")
+def _avg_pool(x, kernel, strides, padding, n, channel_last, exclusive,
+              ceil_mode):
+    window = _window(kernel, n, x.ndim, channel_last)
+    stride = _window(strides, n, x.ndim, channel_last)
+    pads = _full_padding(padding, n, x.ndim, channel_last, x.shape, window,
+                         stride, ceil_mode)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride, pads)
+    if exclusive and any(lo or hi for lo, hi in pads):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       stride, pads)
+        return summed / counts
+    return summed / float(np.prod(kernel))
+
+
+def _window(kernel, n, ndim, channel_last):
+    if channel_last:
+        return (1,) + tuple(kernel) + (1,)
+    return (1, 1) + tuple(kernel)
+
+
+def _full_padding(padding, n, ndim, channel_last, shape, window, stride,
+                  ceil_mode):
+    if isinstance(padding, str):
+        if padding == "VALID":
+            pads = [(0, 0)] * n
+        else:  # SAME
+            spatial = shape[1:-1] if channel_last else shape[2:]
+            k = window[1:-1] if channel_last else window[2:]
+            s = stride[1:-1] if channel_last else stride[2:]
+            pads = []
+            for d, kk, ss in zip(spatial, k, s):
+                out = -(-d // ss)
+                total = max(0, (out - 1) * ss + kk - d)
+                pads.append((total // 2, total - total // 2))
+    else:
+        pads = list(padding)
+    if ceil_mode:
+        spatial = shape[1:-1] if channel_last else shape[2:]
+        k = window[1:-1] if channel_last else window[2:]
+        s = stride[1:-1] if channel_last else stride[2:]
+        new = []
+        for (lo, hi), d, kk, ss in zip(pads, spatial, k, s):
+            eff = d + lo + hi - kk
+            rem = eff % ss
+            extra = (ss - rem) % ss if rem else 0
+            new.append((lo, hi + extra))
+        pads = new
+    if channel_last:
+        return [(0, 0)] + pads + [(0, 0)]
+    return [(0, 0), (0, 0)] + pads
+
+
+def _pool_api(x, kernel_size, stride, padding, n, data_format, mode,
+              exclusive=True, ceil_mode=False):
+    x = _wrap(x)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    k = _tuple_n(kernel_size, n)
+    s = _tuple_n(stride if stride is not None else kernel_size, n)
+    pad = _pool_padding(padding, n)
+    if mode == "max":
+        return _max_pool(x, k, s, pad, n, channel_last, ceil_mode)
+    return _avg_pool(x, k, s, pad, n, channel_last, exclusive, ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    out = _pool_api(x, kernel_size, stride, padding, 1, df, "max",
+                    ceil_mode=ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool_api(x, kernel_size, stride, padding, 2, data_format, "max",
+                     ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_api(x, kernel_size, stride, padding, 3, data_format, "max",
+                     ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool_api(x, kernel_size, stride, padding, 1, df, "avg",
+                     exclusive, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_api(x, kernel_size, stride, padding, 2, data_format, "avg",
+                     exclusive, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_api(x, kernel_size, stride, padding, 3, data_format, "avg",
+                     exclusive, ceil_mode)
+
+
+@op("adaptive_pool")
+def _adaptive_pool(x, out_sizes, n, channel_last, mode):
+    spatial_axes = list(range(1, 1 + n)) if channel_last \
+        else list(range(2, 2 + n))
+    out = x
+    for ax, osz in zip(spatial_axes, out_sizes):
+        isz = out.shape[ax]
+        if isz % osz == 0:
+            k = isz // osz
+            new_shape = (out.shape[:ax] + (osz, k) + out.shape[ax + 1:])
+            r = out.reshape(new_shape)
+            out = jnp.max(r, axis=ax + 1) if mode == "max" \
+                else jnp.mean(r, axis=ax + 1)
+        else:
+            # general adaptive: per-output-bin variable windows
+            starts = (np.arange(osz) * isz) // osz
+            ends = -(-((np.arange(osz) + 1) * isz) // osz)
+            slices = []
+            for st, en in zip(starts, ends):
+                sl = jax.lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                red = jnp.max(sl, axis=ax, keepdims=True) if mode == "max" \
+                    else jnp.mean(sl, axis=ax, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+    return out
+
+
+def _adaptive_api(x, output_size, n, data_format, mode):
+    x = _wrap(x)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * n
+    output_size = tuple(
+        x.shape[(1 + i if channel_last else 2 + i)] if o is None else int(o)
+        for i, o in enumerate(output_size))
+    return _adaptive_pool(x, output_size, n, channel_last, mode)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_api(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_api(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_api(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_api(x, output_size, 1, "NCW", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_api(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_api(x, output_size, 3, "NCDHW", "max")

@@ -1,0 +1,206 @@
+"""Parameter initializers.
+
+TPU-native analogue of /root/reference/python/paddle/fluid/initializer.py
+(ConstantInitializer, UniformInitializer, NormalInitializer,
+TruncatedNormalInitializer, XavierInitializer, MSRAInitializer (=Kaiming),
+BilinearInitializer, NumpyArrayInitializer) and paddle.nn.initializer.
+Each initializer returns a concrete jax array drawn from the global
+counter-based RNG (core.random).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.dtypes import convert_dtype, get_default_dtype
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        convert_dtype(dtype) or get_default_dtype())
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(_random.next_key(), tuple(shape), d,
+                                  self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        return self.mean + self.std * jax.random.normal(
+            _random.next_key(), tuple(shape), d)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        r = jax.random.truncated_normal(_random.next_key(), -2.0, 2.0,
+                                        tuple(shape), d)
+        return self.mean + self.std * r
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_random.next_key(), tuple(shape), d,
+                                  -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(_random.next_key(), tuple(shape), d)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        limit = math.sqrt(6.0 / fi)
+        return jax.random.uniform(_random.next_key(), tuple(shape), d,
+                                  -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        std = math.sqrt(2.0 / fi)
+        return std * jax.random.normal(_random.next_key(), tuple(shape), d)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        from ..core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=d)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(tuple(shape))
+        return arr
+
+
+class Bilinear(Initializer):
+    """For conv-transpose upsampling kernels (reference:
+    fluid/initializer.py BilinearInitializer)."""
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        weight = np.zeros(tuple(shape), dtype=np.float32)
+        shape = tuple(shape)
+        f = math.ceil(shape[3] / 2)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            w = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[i // (shape[3] * shape[2] * shape[1]),
+                   (i // (shape[3] * shape[2])) % shape[1], y, x] = w
+        return jnp.asarray(weight, dtype=d)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        w = np.zeros(tuple(shape), np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(oc // self.groups, ic)):
+                idx = (g * (oc // self.groups) + i, i) + tuple(centers)
+                w[idx] = 1.0
+        return jnp.asarray(w, dtype=d)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        d = convert_dtype(dtype) or get_default_dtype()
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        mat = jax.random.normal(_random.next_key(),
+                                (max(rows, cols), min(rows, cols)), d)
+        q, r = jnp.linalg.qr(mat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(tuple(shape))
+
+
+# legacy fluid aliases (reference: fluid/initializer.py)
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign

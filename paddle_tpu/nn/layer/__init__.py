@@ -1,0 +1,2 @@
+from . import layers, base, common, conv, norm, pooling, activation  # noqa
+from . import loss, container, rnn, transformer  # noqa

@@ -1,0 +1,379 @@
+"""Layer: the dygraph module base class.
+
+TPU-native analogue of /root/reference/python/paddle/fluid/dygraph/layers.py
+(class Layer: parameters/buffers/sublayers registries, forward hooks,
+state_dict at layers.py, __call__ at :885) backed by the C++ VarBase runtime
+(imperative/layer.h). Parameters are Tensors with stop_gradient=False;
+`state_dict` / `set_state_dict` give paddle.save/load compatibility.
+
+`parameters_dict()` + `load_flat_params()` additionally expose the layer's
+parameters as a flat pytree so a whole Layer drops into jax.jit / pjit /
+shard_map functional train steps (the TPU performance path).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dtypes import get_default_dtype, convert_dtype
+from ...core import random as _random
+from .base import ParamAttr
+
+_layer_name_counters: Dict[str, int] = collections.defaultdict(int)
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, idx):
+        self._hooks = hooks
+        self._idx = idx
+
+    def remove(self):
+        self._hooks.pop(self._idx, None)
+
+
+class Layer:
+    def __init__(self, name_scope: str = None, dtype=None):
+        cls = self.__class__.__name__.lower()
+        _layer_name_counters[cls] += 1
+        self._full_name = name_scope or f"{cls}_{_layer_name_counters[cls] - 1}"
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self.training = True
+        self._parameters: "collections.OrderedDict[str, Tensor]" = \
+            collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = \
+            collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, Tensor]" = \
+            collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_counter = 0
+
+    # ------------------------------------------------------------- creation
+    def create_parameter(self, shape, attr=None, dtype=None,
+                         is_bias=False, default_initializer=None):
+        """reference: fluid/dygraph/layers.py create_parameter +
+        fluid/layer_helper_base.py (initializer selection: bias→Constant,
+        weight→default or attr.initializer)."""
+        from .. import initializer as I
+        dtype = convert_dtype(dtype) or self._dtype
+        attr = attr if isinstance(attr, ParamAttr) else \
+            (ParamAttr(name=attr) if isinstance(attr, str) else
+             (attr or ParamAttr()))
+        init = attr.initializer or default_initializer or \
+            (I.Constant(0.0) if is_bias else I.XavierNormal())
+        value = init(shape, dtype)
+        p = Tensor(value, stop_gradient=not attr.trainable, persistable=True,
+                   name=attr.name)
+        p.is_parameter = True
+        p.trainable = attr.trainable
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.do_model_average = attr.do_model_average
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        dtype = convert_dtype(dtype) or self._dtype
+        return Tensor(jnp.zeros([], dtype), persistable=bool(persistable),
+                      name=name)
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, "_dummy", None)  # keep slots-free semantics
+        return tensor
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    # ------------------------------------------------------------ attribute
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Tensor) and getattr(value, "is_parameter", False):
+            if params is None:
+                raise RuntimeError(
+                    "super().__init__() must be called before assigning "
+                    "parameters")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError(
+                    "super().__init__() must be called before assigning "
+                    "sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            if value is not None and not isinstance(value, Tensor):
+                value = Tensor(value)
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) \
+            + list(self._sub_layers) + list(self._buffers)
+
+    # ------------------------------------------------------------ iteration
+    def parameters(self, include_sublayers=True) -> List[Tensor]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True,
+                         include_self=True
+                         ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def _traverse(self, prefix="", include_sublayers=True):
+        yield prefix, self
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from sub._traverse(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = []
+        for name, l in self._traverse("", True):
+            if not include_self and l is self:
+                continue
+            out.append(l)
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, l in self._traverse(prefix, True):
+            if not include_self and l is self:
+                continue
+            yield name, l
+
+    # ---------------------------------------------------------------- modes
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ---------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        idx = self._hook_counter
+        self._hook_counter += 1
+        self._forward_pre_hooks[idx] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, idx)
+
+    def register_forward_post_hook(self, hook):
+        idx = self._hook_counter
+        self._hook_counter += 1
+        self._forward_post_hooks[idx] = hook
+        return HookRemoveHelper(self._forward_post_hooks, idx)
+
+    # ---------------------------------------------------------------- call
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, out)
+            if res is not None:
+                out = res
+        return out
+
+    # ------------------------------------------------------------ state i/o
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None \
+            else collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for lname, layer in self._traverse(
+                structured_name_prefix.rstrip("."), include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[f"{lname}.{bname}" if lname else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            tgt = own[k]
+            arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+            if tuple(arr.shape) != tuple(tgt.shape):
+                raise ValueError(
+                    f"state_dict shape mismatch for {k}: "
+                    f"{arr.shape} vs {tuple(tgt.shape)}")
+            tgt._value = jnp.asarray(arr, dtype=tgt._value.dtype)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # --------------------------------------------------------- dtype/device
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dtype = convert_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._value = p._value.astype(dtype)
+            for b in self.buffers():
+                if b is not None and jnp.issubdtype(b._value.dtype,
+                                                    jnp.floating):
+                    b._value = b._value.astype(dtype)
+            for l in self.sublayers(include_self=True):
+                l._dtype = dtype
+        if device is not None:
+            import jax
+            from ...core.place import CPUPlace, Place, set_device
+            if isinstance(device, str):
+                dev = CPUPlace().get_device() if device.startswith("cpu") \
+                    else None
+            elif isinstance(device, Place):
+                dev = device.get_device()
+            else:
+                dev = None
+            if dev is not None:
+                for t in list(self.parameters()) + list(self.buffers()):
+                    if t is not None:
+                        t._value = jax.device_put(t._value, dev)
+        return self
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    # ------------------------------------------------- functional interface
+    def parameters_dict(self):
+        """Flat name→jax.Array pytree of trainable state (for jit/pjit)."""
+        return {k: p._value for k, p in self.named_parameters()}
+
+    def buffers_dict(self):
+        return {k: (b._value if b is not None else None)
+                for k, b in self.named_buffers()}
+
+    def load_flat_params(self, flat):
+        """Write a name→array pytree back into the live parameters."""
+        named = dict(self.named_parameters())
+        for k, v in flat.items():
+            named[k]._value = v
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            mod_str = repr(sub)
+            mod_str = "\n".join("  " + l for l in mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str.strip()}" if "\n" not in mod_str
+                         else f"({name}): {mod_str.lstrip()}")
+        main = self.__class__.__name__
+        if extra and not lines:
+            return f"{main}({extra})"
+        if not lines:
+            return f"{main}()"
+        body = "\n".join("  " + l for l in lines)
+        return f"{main}(\n{body}\n)"
+
+    def extra_repr(self):
+        return ""

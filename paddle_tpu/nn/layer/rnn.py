@@ -1,0 +1,367 @@
+"""Recurrent layers.
+
+TPU-native analogue of /root/reference/python/paddle/nn/layer/rnn.py
+(SimpleRNNCell/LSTMCell/GRUCell + RNN/BiRNN wrappers over rnn_op) and
+/root/reference/paddle/fluid/operators/rnn_op.h (cuDNN RNN descriptors).
+
+TPU-first design: the time loop is jax.lax.scan — ONE compiled step body
+iterated by XLA (no Python loop, no cuDNN descriptor plumbing), so the whole
+sequence unrolls into an efficient while-loop on device and fuses with the
+surrounding graph. The scan runs over arrays, is wrapped as a single dispatch
+op, and therefore both records one tape node eagerly and traces cleanly
+under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .base import ParamAttr
+from .container import LayerList
+from .. import initializer as I
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+
+def _cell_step_simple(x_t, h, wi, wh, bi, bh, activation):
+    z = x_t @ wi.T + h @ wh.T
+    if bi is not None:
+        z = z + bi + bh
+    return jnp.tanh(z) if activation == "tanh" else jnp.maximum(z, 0)
+
+
+def _cell_step_lstm(x_t, h, c, wi, wh, bi, bh):
+    z = x_t @ wi.T + h @ wh.T
+    if bi is not None:
+        z = z + bi + bh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _cell_step_gru(x_t, h, wi, wh, bi, bh):
+    zi = x_t @ wi.T
+    zh = h @ wh.T
+    if bi is not None:
+        zi = zi + bi
+        zh = zh + bh
+    ri, zi_, ni = jnp.split(zi, 3, axis=-1)
+    rh, zh_, nh = jnp.split(zh, 3, axis=-1)
+    r = jax.nn.sigmoid(ri + rh)
+    z = jax.nn.sigmoid(zi_ + zh_)
+    n = jnp.tanh(ni + r * nh)
+    return (1 - z) * n + z * h
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(
+                shape[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                         jnp.float32)) for s in shape)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value,
+                               jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter([hidden_size], bias_ih_attr, is_bias=True,
+                                  default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter([hidden_size], bias_hh_attr, is_bias=True,
+                                  default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = _simple_cell_op(inputs, states, self.weight_ih, self.weight_hh,
+                            self.bias_ih, self.bias_hh, self.activation)
+        return h, h
+
+
+@op("simple_rnn_cell")
+def _simple_cell_op(x, h, wi, wh, bi, bh, activation):
+    return _cell_step_simple(x, h, wi, wh, bi, bh, activation)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                  is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                  is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h, c = states
+        h2, c2 = _lstm_cell_op(inputs, h, c, self.weight_ih, self.weight_hh,
+                               self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+@op("lstm_cell")
+def _lstm_cell_op(x, h, c, wi, wh, bi, bh):
+    return _cell_step_lstm(x, h, c, wi, wh, bi, bh)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                  is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                  is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = _gru_cell_op(inputs, states, self.weight_ih, self.weight_hh,
+                         self.bias_ih, self.bias_hh)
+        return h, h
+
+
+@op("gru_cell")
+def _gru_cell_op(x, h, wi, wh, bi, bh):
+    return _cell_step_gru(x, h, wi, wh, bi, bh)
+
+
+# -------------------------------------------------------------- scan drivers
+@op("rnn_scan_simple")
+def _scan_simple(x, h0, wi, wh, bi, bh, activation, reverse):
+    # x: [B, T, I] time-major scan
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def step(h, x_t):
+        h2 = _cell_step_simple(x_t, h, wi, wh, bi, bh, activation)
+        return h2, h2
+    hT, ys = jax.lax.scan(step, h0, xs, reverse=reverse)
+    return jnp.swapaxes(ys, 0, 1), hT
+
+
+@op("rnn_scan_lstm")
+def _scan_lstm(x, h0, c0, wi, wh, bi, bh, reverse):
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, x_t):
+        h, c = carry
+        h2, c2 = _cell_step_lstm(x_t, h, c, wi, wh, bi, bh)
+        return (h2, c2), h2
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+@op("rnn_scan_gru")
+def _scan_gru(x, h0, wi, wh, bi, bh, reverse):
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def step(h, x_t):
+        h2 = _cell_step_gru(x_t, h, wi, wh, bi, bh)
+        return h2, h2
+    hT, ys = jax.lax.scan(step, h0, xs, reverse=reverse)
+    return jnp.swapaxes(ys, 0, 1), hT
+
+
+class RNN(Layer):
+    """Generic cell driver (reference: nn/layer/rnn.py RNN — Python while
+    loop there; lax.scan here)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        x = inputs
+        if self.time_major:
+            from ...ops import manipulation as M
+            x = M.transpose(x, [1, 0, 2])
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(
+                x, getattr(self.cell, "state_shape", (self.cell.hidden_size,)))
+        if isinstance(self.cell, LSTMCell):
+            h0, c0 = initial_states
+            ys, hT, cT = _scan_lstm(x, h0, c0, self.cell.weight_ih,
+                                    self.cell.weight_hh, self.cell.bias_ih,
+                                    self.cell.bias_hh, self.is_reverse)
+            final = (hT, cT)
+        elif isinstance(self.cell, GRUCell):
+            ys, hT = _scan_gru(x, initial_states, self.cell.weight_ih,
+                               self.cell.weight_hh, self.cell.bias_ih,
+                               self.cell.bias_hh, self.is_reverse)
+            final = hT
+        else:
+            ys, hT = _scan_simple(x, initial_states, self.cell.weight_ih,
+                                  self.cell.weight_hh, self.cell.bias_ih,
+                                  self.cell.bias_hh, self.cell.activation,
+                                  self.is_reverse)
+            final = hT
+        if self.time_major:
+            from ...ops import manipulation as M
+            ys = M.transpose(ys, [1, 0, 2])
+        return ys, final
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw = s_bw = None
+        if initial_states is not None:
+            s_fw, s_bw = initial_states
+        y_fw, f_fw = self.rnn_fw(inputs, s_fw)
+        y_bw, f_bw = self.rnn_bw(inputs, s_bw)
+        from ...ops import manipulation as M
+        return M.concat([y_fw, y_bw], axis=-1), (f_fw, f_bw)
+
+
+class _MultiLayerRNN(Layer):
+    CELL = None
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 **cell_kwargs):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self._layers = LayerList()
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * num_dir
+            if self.bidirectional:
+                cfw = self.CELL(in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                                weight_hh_attr=weight_hh_attr,
+                                bias_ih_attr=bias_ih_attr,
+                                bias_hh_attr=bias_hh_attr, **cell_kwargs)
+                cbw = self.CELL(in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                                weight_hh_attr=weight_hh_attr,
+                                bias_ih_attr=bias_ih_attr,
+                                bias_hh_attr=bias_hh_attr, **cell_kwargs)
+                self._layers.append(BiRNN(cfw, cbw, time_major))
+            else:
+                cell = self.CELL(in_sz, hidden_size,
+                                 weight_ih_attr=weight_ih_attr,
+                                 weight_hh_attr=weight_hh_attr,
+                                 bias_ih_attr=bias_ih_attr,
+                                 bias_hh_attr=bias_hh_attr, **cell_kwargs)
+                self._layers.append(
+                    RNN(cell, direction == "backward", time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        from .. import functional as F
+        x = inputs
+        finals = []
+        for i, rnn in enumerate(self._layers):
+            x, final = rnn(x)
+            finals.append(final)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        # stack finals: [num_layers*num_dir, B, H]
+        if isinstance(self, LSTM):
+            if self.bidirectional:
+                hs = [f[d][0] for f in finals for d in (0, 1)]
+                cs = [f[d][1] for f in finals for d in (0, 1)]
+            else:
+                hs = [f[0] for f in finals]
+                cs = [f[1] for f in finals]
+            return x, (M.stack(hs, 0), M.stack(cs, 0))
+        if self.bidirectional:
+            hs = [f[d] for f in finals for d in (0, 1)]
+        else:
+            hs = finals
+        return x, M.stack(hs, 0)
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell

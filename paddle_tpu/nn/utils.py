@@ -1,0 +1,84 @@
+"""nn.utils (reference: python/paddle/nn/utils/weight_norm_hook.py,
+spectral_norm_hook.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Decompose weight into direction v and magnitude g; recompute on every
+    forward via a pre-hook (reference: weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    g0 = _norm_except(w._value, dim)
+    v0 = w._value / jnp.maximum(g0, 1e-12)
+    g = layer.create_parameter(list(g0.shape),
+                               default_initializer=lambda s, d: g0)
+    v = layer.create_parameter(list(v0.shape),
+                               default_initializer=lambda s, d: v0)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from .. import ops  # noqa
+        norm_v = _norm_except(v._value, dim)
+        new_w = v * Tensor(1.0 / jnp.maximum(norm_v, 1e-12)) * g
+        object.__setattr__(lyr, name, new_w)
+        return None
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    norm_v = _norm_except(v._value, 0)
+    w = layer.create_parameter(
+        list(v.shape), default_initializer=lambda s, d:
+        v._value / jnp.maximum(norm_v, 1e-12) * g._value)
+    layer.add_parameter(name, w)
+    if hasattr(layer, "_wn_hook"):
+        layer._wn_hook.remove()
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from .layer.norm import SpectralNorm
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    sn = SpectralNorm(list(w.shape), axis=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(name + "_orig", orig)
+    layer.add_sublayer(name + "_sn", sn)
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name, sn(orig))
+        return None
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from ..ops import manipulation as M
+    return M.concat([M.reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p.set_value(vec[offset:offset + n].reshape(p.shape))
+        offset += n

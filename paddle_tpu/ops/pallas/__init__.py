@@ -1,0 +1,4 @@
+"""Pallas TPU kernels — hand-written kernels for the ops XLA doesn't fuse
+optimally (reference analogue: the hand-tuned CUDA kernels under
+/root/reference/paddle/fluid/operators/fused/ and operators/math/, which on
+TPU become pallas Mosaic kernels; see /opt/skills/guides/pallas_guide.md)."""

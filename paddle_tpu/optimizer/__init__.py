@@ -1,1 +1,7 @@
-
+"""paddle.optimizer surface (reference: python/paddle/optimizer/__init__.py)."""
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adamax, Adadelta, Adagrad, RMSProp, Lamb,
+    Lars, LarsMomentum,
+)
+from . import lr  # noqa: F401

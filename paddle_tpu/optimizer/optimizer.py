@@ -1,0 +1,195 @@
+"""Optimizer base.
+
+TPU-native analogue of /root/reference/python/paddle/optimizer/optimizer.py
+(Optimizer base: step/minimize/_apply_optimize, accumulator management
+mirroring fluid's _add_accumulator) and the C++ optimizer op corpus
+(/root/reference/paddle/fluid/operators/optimizers/ — sgd_op, adam_op, …).
+
+Design: every optimizer implements ONE pure function
+`_update(param, grad, state, lr) -> (new_param, new_state)` over jax arrays.
+The eager `step()` walks parameters applying it (one small XLA program per
+unique shape, cached by jax); the same function is reused by
+paddle_tpu.jit's functional train steps and by the sharded pjit path, where
+XLA partitions the update across the mesh (the reference needs dedicated
+fused/sharded optimizer passes for this — C18 fuse_optimizer_ops_pass).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._parameter_list = list(parameters) if parameters is not None \
+            else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self.regularization = weight_decay
+        if isinstance(weight_decay, float):
+            from ..regularizer import L2Decay
+            self.regularization = L2Decay(weight_decay)
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._global_step = 0
+        # name of the parameter currently being updated (for policies that
+        # exempt by name, e.g. AdamW's apply_decay_param_fun)
+        self._current_param_name = None
+
+    # ------------------------------------------------------------------ lr
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "set_lr is not allowed when the learning rate is an "
+                "LRScheduler; call scheduler.step() instead (paddle parity)")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # ------------------------------------------------------------- core api
+    def _state_for(self, p: Tensor) -> Dict[str, jax.Array]:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p._value)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _init_state(self, param) -> Dict[str, jax.Array]:
+        return {}
+
+    def _update(self, param, grad, state, lr):
+        raise NotImplementedError
+
+    def _param_lr(self, p):
+        return getattr(p, "optimize_attr", None) or {"learning_rate": 1.0}
+
+    def step(self):
+        with no_grad():
+            params_grads = [(p, p.grad) for p in self._parameter_list
+                            if p.grad is not None
+                            and getattr(p, "trainable", True)]
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            lr = self.get_lr()
+            for p, g in params_grads:
+                garr = g._value
+                if self.regularization is not None and \
+                        getattr(p, "regularizer", None) is None:
+                    garr = self.regularization.apply(p._value, garr)
+                elif getattr(p, "regularizer", None) is not None:
+                    garr = p.regularizer.apply(p._value, garr)
+                state = self._state_for(p)
+                p_lr = lr * self._param_lr(p).get("learning_rate", 1.0)
+                self._current_param_name = p.name
+                new_p, new_state = self._update(p._value, garr, state, p_lr)
+                p._value = new_p
+                self._accumulators[id(p)] = new_state
+            self._global_step += 1
+
+    def clear_grad(self, set_to_zero=False):
+        for p in (self._parameter_list or []):
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    def backward(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None, callbacks=None):
+        loss.backward()
+        return [(p, p.grad) for p in (self._parameter_list or [])]
+
+    def apply_gradients(self, params_grads):
+        with no_grad():
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            lr = self.get_lr()
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                state = self._state_for(p)
+                new_p, new_state = self._update(p._value, g._value, state, lr)
+                p._value = new_p
+                self._accumulators[id(p)] = new_state
+            self._global_step += 1
+
+    # ------------------------------------------------------------ state i/o
+    def state_dict(self):
+        out = {}
+        if self._parameter_list:
+            for p in self._parameter_list:
+                st = self._accumulators.get(id(p))
+                if st:
+                    for k, v in st.items():
+                        out[f"{p.name}_{k}"] = Tensor(v)
+        out["global_step"] = self._global_step
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        if self._parameter_list:
+            for p in self._parameter_list:
+                st = self._init_state(p._value)
+                found = False
+                for k in st:
+                    key = f"{p.name}_{k}"
+                    if key in state_dict:
+                        v = state_dict[key]
+                        st[k] = v._value if isinstance(v, Tensor) \
+                            else jnp.asarray(v)
+                        found = True
+                if found:
+                    self._accumulators[id(p)] = st
+
+    set_dict = set_state_dict
+
+    # ---------------------------------------------- functional (jit) bridge
+    def init_opt_state(self, flat_params: Dict[str, jax.Array]):
+        """Build a pure pytree of optimizer state for functional steps."""
+        return {k: self._init_state(v) for k, v in flat_params.items()}
+
+    def apply_updates(self, flat_params, flat_grads, opt_state, lr=None):
+        """Pure functional update over name→array pytrees (used inside
+        jit/pjit train steps; the sharding of params induces the sharding of
+        the update — ZeRO falls out of GSPMD annotations)."""
+        lr = self.get_lr() if lr is None else lr
+        new_p, new_s = {}, {}
+        for k, p in flat_params.items():
+            g = flat_grads.get(k)
+            if g is None:
+                new_p[k], new_s[k] = p, opt_state[k]
+                continue
+            if self.regularization is not None:
+                g = self.regularization.apply(p, g)
+            # cast lr to the param dtype so bf16/f16 params stay low
+            # precision (a strongly-typed f32 lr array would promote the
+            # whole update to f32)
+            lr_k = lr.astype(p.dtype) if hasattr(lr, "astype") and \
+                hasattr(p, "dtype") and p.dtype != getattr(lr, "dtype", None) \
+                else lr
+            self._current_param_name = k
+            new_p[k], new_s[k] = self._update(p, g, opt_state[k], lr_k)
+        return new_p, new_s

@@ -1,0 +1,262 @@
+"""Concrete optimizers.
+
+TPU-native analogues of /root/reference/paddle/fluid/operators/optimizers/:
+sgd_op.cc, momentum_op.cc/.h (use_nesterov branch), adam_op.h (beta pow
+accumulators), adamw (AdamW decoupled decay in python/paddle/optimizer/adamw),
+adamax_op.h, adadelta_op.h, adagrad_op.h, rmsprop_op.cc (centered branch),
+lamb_op.h (trust ratio), lars_momentum_op.cc.
+Each is a pure jax update usable eagerly and inside jit/pjit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update(self, p, g, state, lr):
+        return p - lr * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rescale_grad = rescale_grad
+
+    def _init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype) * self._rescale_grad
+        v = self._momentum * state["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, param):
+        return {
+            "moment1": jnp.zeros_like(param),
+            "moment2": jnp.zeros_like(param),
+            "beta1_pow": jnp.ones([], param.dtype),
+            "beta2_pow": jnp.ones([], param.dtype),
+        }
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        # reference adam_op.h: lr_t = lr * sqrt(1-b2^t)/(1-b1^t)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_p = p - lr_t * m / (jnp.sqrt(v) + self._epsilon)
+        return new_p, {"moment1": m, "moment2": v,
+                       "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py —
+    param is scaled by (1 - lr*coeff) before the adam update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode)
+        self._coeff = weight_decay if isinstance(weight_decay, float) \
+            else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, p, g, state, lr):
+        decay = True
+        if self._apply_decay_param_fun is not None and \
+                self._current_param_name is not None:
+            decay = self._apply_decay_param_fun(self._current_param_name)
+        if decay and self._coeff:
+            p = p * (1.0 - lr * self._coeff)
+        return super()._update(p, g, state, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, param):
+        return {"moment": jnp.zeros_like(param),
+                "inf_norm": jnp.zeros_like(param),
+                "beta1_pow": jnp.ones([], param.dtype)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"] * self._beta1
+        new_p = p - (lr / (1 - b1p)) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _init_state(self, param):
+        return {"avg_squared_grad": jnp.zeros_like(param),
+                "avg_squared_update": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype)
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        update = -jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps) * g
+        asu = rho * state["avg_squared_update"] + (1 - rho) * update * update
+        return p + lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _init_state(self, param):
+        return {"moment": jnp.full_like(param, self._init_value)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype)
+        m = state["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, param):
+        st = {"mean_square": jnp.zeros_like(param),
+              "momentum": jnp.zeros_like(param)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(param)
+        return st
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype)
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        return p - mom, new_state
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.h — adam moments + per-layer
+    trust ratio ||w|| / ||r + lambda*w||."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, param):
+        return {"moment1": jnp.zeros_like(param),
+                "moment2": jnp.zeros_like(param),
+                "beta1_pow": jnp.ones([], param.dtype),
+                "beta2_pow": jnp.ones([], param.dtype)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) \
+            + self._lamb_weight_decay * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v,
+                                    "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Lars(Optimizer):
+    """reference: operators/optimizers/lars_momentum_op.cc."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._lars_epsilon = epsilon
+
+    def _init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr):
+        g = g.astype(p.dtype)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm /
+            (g_norm + self._lars_weight_decay * p_norm + self._lars_epsilon),
+            lr)
+        v = self._momentum * state["velocity"] + local_lr * (
+            g + self._lars_weight_decay * p)
+        return p - v, {"velocity": v}
+
+
+LarsMomentum = Lars

@@ -1,0 +1,36 @@
+"""Regularizers (reference: python/paddle/fluid/regularizer.py — L1/L2Decay
+appended as ops into the backward program; here applied to grad arrays)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def apply(self, param, grad):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def apply(self, param, grad):
+        return grad + self._coeff * param
+
+    def __str__(self):
+        return f"L2Decay, coeff={self._coeff}"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def apply(self, param, grad):
+        return grad + self._coeff * jnp.sign(param)
+
+    def __str__(self):
+        return f"L1Decay, coeff={self._coeff}"
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

@@ -1,1 +1,2 @@
-
+"""paddle.text (reference: python/paddle/text/datasets/)."""
+from .datasets import Imdb, UCIHousing  # noqa: F401

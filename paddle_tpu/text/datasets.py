@@ -1,0 +1,75 @@
+"""Text datasets (reference: python/paddle/text/datasets/imdb.py,
+uci_housing.py). Local-file loading with synthetic fallback (zero egress)."""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.mode = mode
+        if data_file and os.path.exists(data_file):
+            self._load_real(data_file, mode, cutoff)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n, vocab = 512, 5000
+            self.docs = [rng.randint(2, vocab, rng.randint(20, 100))
+                         for _ in range(n)]
+            self.labels = rng.randint(0, 2, n).astype(np.int64)
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def _load_real(self, data_file, mode, cutoff):
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq = {}
+        docs, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "latin-1").lower().split()
+                docs.append(text)
+                labels.append(1 if m.group(1) == "pos" else 0)
+                for w in text:
+                    freq[w] = freq.get(w, 0) + 1
+        words = sorted(freq, key=lambda w: -freq[w])[:cutoff]
+        self.word_idx = {w: i + 2 for i, w in enumerate(words)}
+        self.docs = [np.asarray([self.word_idx.get(w, 1) for w in d],
+                                np.int64) for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.RandomState(0)
+            X = rng.randn(506, self.FEATURES).astype(np.float32)
+            w = rng.randn(self.FEATURES).astype(np.float32)
+            y = X @ w + rng.randn(506).astype(np.float32) * 0.1
+            raw = np.concatenate([X, y[:, None]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)

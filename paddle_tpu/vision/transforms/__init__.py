@@ -1,0 +1,11 @@
+"""paddle.vision.transforms (reference:
+python/paddle/vision/transforms/transforms.py + functional.py). Numpy-based
+(CHW float32 output convention); Compose/ToTensor/Normalize/Resize/crops/
+flips cover the model-zoo pipelines."""
+from .transforms import (  # noqa: F401
+    Compose, ToTensor, Normalize, Resize, RandomResizedCrop, CenterCrop,
+    RandomHorizontalFlip, RandomVerticalFlip, RandomCrop, Pad, Transpose,
+    BrightnessTransform, ContrastTransform, SaturationTransform, ColorJitter,
+    RandomRotation, Grayscale,
+)
+from . import functional  # noqa: F401

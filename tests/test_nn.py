@@ -1,0 +1,305 @@
+"""nn layer/functional tests (reference test analogues:
+python/paddle/fluid/tests/unittests/test_layers.py, test_conv2d_op.py,
+test_batch_norm_op.py, test_transformer_api.py, test_rnn_*.py — here
+checked against torch CPU as the numeric oracle, the same role the
+reference's numpy reference implementations play in OpTest)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+
+def test_linear_matches_torch():
+    x = np.random.randn(4, 6).astype("float32")
+    w = np.random.randn(6, 3).astype("float32")
+    b = np.random.randn(3).astype("float32")
+    out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b)).numpy()
+    ref = tF.linear(torch.tensor(x), torch.tensor(w.T),
+                    torch.tensor(b)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 3),
+])
+def test_conv2d_matches_torch(stride, padding, dilation, groups):
+    cin, cout = 6, 9
+    x = np.random.randn(2, cin, 10, 10).astype("float32")
+    w = np.random.randn(cout, cin // groups, 3, 3).astype("float32")
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), None,
+                   stride=stride, padding=padding, dilation=dilation,
+                   groups=groups).numpy()
+    ref = tF.conv2d(torch.tensor(x), torch.tensor(w), None, stride=stride,
+                    padding=padding, dilation=dilation,
+                    groups=groups).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grad_matches_torch():
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    w = np.random.randn(4, 3, 3, 3).astype("float32")
+    px = paddle.to_tensor(x, stop_gradient=False)
+    pw = paddle.to_tensor(w, stop_gradient=False)
+    F.conv2d(px, pw, padding=1).sum().backward()
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(w, requires_grad=True)
+    tF.conv2d(tx, tw, padding=1).sum().backward()
+    np.testing.assert_allclose(px.grad.numpy(), tx.grad.numpy(), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(pw.grad.numpy(), tw.grad.numpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_conv_transpose_matches_torch():
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    w = np.random.randn(3, 5, 4, 4).astype("float32")
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=2, padding=1).numpy()
+    ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                              padding=1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_pooling_matches_torch():
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    np.testing.assert_allclose(
+        F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy(),
+        tF.max_pool2d(torch.tensor(x), 2, 2).numpy())
+    np.testing.assert_allclose(
+        F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1).numpy(),
+        tF.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                      count_include_pad=False).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy(),
+        tF.adaptive_avg_pool2d(torch.tensor(x), 3).numpy(), rtol=1e-4,
+        atol=1e-5)
+
+
+def test_norms_match_torch():
+    x = np.random.randn(4, 6, 5, 5).astype("float32")
+    g = np.random.rand(6).astype("float32") + 0.5
+    b = np.random.randn(6).astype("float32")
+    out = F.group_norm(paddle.to_tensor(x), 3, 1e-5, paddle.to_tensor(g),
+                       paddle.to_tensor(b)).numpy()
+    ref = tF.group_norm(torch.tensor(x), 3, torch.tensor(g),
+                        torch.tensor(b)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+    out = F.instance_norm(paddle.to_tensor(x), weight=paddle.to_tensor(g),
+                          bias=paddle.to_tensor(b)).numpy()
+    ref = tF.instance_norm(torch.tensor(x), weight=torch.tensor(g),
+                           bias=torch.tensor(b)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_batch_norm_train_and_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    x = np.random.randn(8, 3, 4, 4).astype("float32")
+    tb = torch.nn.BatchNorm2d(3, momentum=0.1)
+    out = bn(paddle.to_tensor(x)).numpy()
+    ref = tb(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(bn._mean.numpy(), tb.running_mean.numpy(),
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(bn._variance.numpy(),
+                               tb.running_var.numpy(), rtol=1e-3, atol=1e-4)
+    bn.eval()
+    tb.eval()
+    out = bn(paddle.to_tensor(x)).numpy()
+    ref = tb(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_losses_match_torch():
+    logits = np.random.randn(8, 5).astype("float32")
+    labels = np.random.randint(0, 5, 8)
+    np.testing.assert_allclose(
+        F.cross_entropy(paddle.to_tensor(logits),
+                        paddle.to_tensor(labels)).numpy(),
+        tF.cross_entropy(torch.tensor(logits),
+                         torch.tensor(labels)).numpy(), rtol=1e-5)
+    x = np.random.rand(6).astype("float32")
+    y = (np.random.rand(6) > 0.5).astype("float32")
+    np.testing.assert_allclose(
+        F.binary_cross_entropy(paddle.to_tensor(x),
+                               paddle.to_tensor(y)).numpy(),
+        tF.binary_cross_entropy(torch.tensor(x), torch.tensor(y)).numpy(),
+        rtol=1e-4)
+    lx = np.random.randn(6).astype("float32")
+    np.testing.assert_allclose(
+        F.binary_cross_entropy_with_logits(paddle.to_tensor(lx),
+                                           paddle.to_tensor(y)).numpy(),
+        tF.binary_cross_entropy_with_logits(torch.tensor(lx),
+                                            torch.tensor(y)).numpy(),
+        rtol=1e-5)
+    a = np.random.randn(4, 7).astype("float32")
+    b = np.random.randn(4, 7).astype("float32")
+    np.testing.assert_allclose(
+        F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        tF.smooth_l1_loss(torch.tensor(a), torch.tensor(b)).numpy(),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        F.kl_div(paddle.to_tensor(a), paddle.to_tensor(np.abs(b))).numpy(),
+        tF.kl_div(torch.tensor(a), torch.tensor(np.abs(b))).numpy(),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_weight():
+    logits = np.random.randn(6, 4).astype("float32")
+    labels = np.array([0, 1, -100, 3, -100, 2])
+    w = np.random.rand(4).astype("float32") + 0.5
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          weight=paddle.to_tensor(w)).numpy()
+    ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                           weight=torch.tensor(w)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_activations_match_torch():
+    x = np.random.randn(4, 8).astype("float32")
+    cases = [
+        (F.gelu, lambda t: tF.gelu(t)),
+        (lambda v: F.gelu(v, approximate=True),
+         lambda t: tF.gelu(t, approximate="tanh")),
+        (F.silu, tF.silu),
+        (F.softplus, tF.softplus),
+        (F.elu, tF.elu),
+        (F.selu, tF.selu),
+        (F.hardswish, tF.hardswish),
+        (F.mish, tF.mish),
+        (lambda v: F.leaky_relu(v, 0.1),
+         lambda t: tF.leaky_relu(t, 0.1)),
+        (lambda v: F.log_softmax(v, -1),
+         lambda t: tF.log_softmax(t, -1)),
+    ]
+    for mine, ref in cases:
+        np.testing.assert_allclose(
+            mine(paddle.to_tensor(x)).numpy(),
+            ref(torch.tensor(x)).numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_semantics():
+    x = paddle.ones([1000])
+    out = F.dropout(x, 0.5, training=True)
+    kept = float((out.numpy() != 0).mean())
+    assert 0.35 < kept < 0.65
+    np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0)
+    out_eval = F.dropout(x, 0.5, training=False)
+    np.testing.assert_allclose(out_eval.numpy(), x.numpy())
+
+
+def test_embedding_grad_and_padding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 0, 2]]))
+    out = emb(ids)
+    assert float(np.abs(out.numpy()[0, 1]).sum()) == 0.0
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert g[1].sum() != 0 and g[3].sum() == 0
+
+
+def test_sdpa_matches_torch():
+    q = np.random.randn(2, 8, 2, 16).astype("float32")
+    k = np.random.randn(2, 8, 2, 16).astype("float32")
+    v = np.random.randn(2, 8, 2, 16).astype("float32")
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True).numpy()
+    tq, tk, tv = (torch.tensor(a).permute(0, 2, 1, 3) for a in (q, k, v))
+    ref = tF.scaled_dot_product_attention(
+        tq, tk, tv, is_causal=True).permute(0, 2, 1, 3).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_encoder_decoder():
+    model = nn.Transformer(d_model=32, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=64)
+    src = paddle.randn([2, 6, 32])
+    tgt = paddle.randn([2, 5, 32])
+    out = model(src, tgt)
+    assert out.shape == [2, 5, 32]
+    out.mean().backward()
+    assert all(p.grad is not None for p in model.parameters())
+
+
+def test_rnn_shapes_and_grads():
+    for cls, states in [(nn.SimpleRNN, 1), (nn.GRU, 1), (nn.LSTM, 2)]:
+        m = cls(5, 7, num_layers=2)
+        x = paddle.randn([3, 6, 5])
+        y, final = m(x)
+        assert y.shape == [3, 6, 7]
+        y.sum().backward()
+        assert all(p.grad is not None for p in m.parameters())
+
+
+def test_lstm_cell_matches_torch():
+    cell = nn.LSTMCell(4, 6)
+    tcell = torch.nn.LSTMCell(4, 6)
+    # copy weights
+    cell.weight_ih.set_value(tcell.weight_ih.detach().numpy())
+    cell.weight_hh.set_value(tcell.weight_hh.detach().numpy())
+    cell.bias_ih.set_value(tcell.bias_ih.detach().numpy())
+    cell.bias_hh.set_value(tcell.bias_hh.detach().numpy())
+    x = np.random.randn(2, 4).astype("float32")
+    h0 = np.random.randn(2, 6).astype("float32")
+    c0 = np.random.randn(2, 6).astype("float32")
+    _, (h, c) = cell(paddle.to_tensor(x),
+                     (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    th, tc = tcell(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_layer_hooks_and_apply():
+    m = nn.Linear(3, 3)
+    calls = []
+    h = m.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    m(paddle.ones([2, 3]))
+    assert calls
+    h.remove()
+    m(paddle.ones([2, 3]))
+    assert len(calls) == 1
+    m.eval()
+    assert not m.training
+    m.train()
+    assert m.training
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4), nn.Linear(4, 2))
+    m2 = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4), nn.Linear(4, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([3, 4])
+    m1.eval()
+    m2.eval()
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_clip_grad_by_global_norm():
+    m = nn.Linear(3, 3)
+    (m(paddle.ones([2, 3])) * 100).sum().backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    pg = clip([(p, p.grad) for p in m.parameters()])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in pg))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_weight_norm():
+    from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+    m = nn.Linear(4, 5)
+    w0 = m.weight.numpy().copy()
+    weight_norm(m, "weight")
+    x = paddle.randn([2, 4])
+    y1 = m(x).numpy()
+    assert "weight_g" in dict(m.named_parameters())
+    remove_weight_norm(m)
+    y2 = m(x).numpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-5)

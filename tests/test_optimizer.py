@@ -1,0 +1,193 @@
+"""Optimizer + LR scheduler + AMP tests (reference analogues:
+unittests/test_adam_op.py, test_momentum_op.py, test_imperative_optimizer.py,
+test_lr_scheduler.py, test_imperative_auto_mixed_precision.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+torch = pytest.importorskip("torch")
+
+
+def _compare_with_torch(make_mine, make_torch, steps=15, rtol=1e-4,
+                        atol=1e-5):
+    w0 = np.random.randn(5, 3).astype("float32")
+    X = np.random.randn(16, 5).astype("float32")
+    Y = np.random.randn(16, 3).astype("float32")
+    p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    p.trainable = True
+    o = make_mine([p])
+    tp = torch.tensor(w0.copy(), requires_grad=True)
+    to = make_torch([tp])
+    for _ in range(steps):
+        ((paddle.to_tensor(X) @ p - paddle.to_tensor(Y)) ** 2).mean() \
+            .backward()
+        o.step()
+        o.clear_grad()
+        to.zero_grad()
+        ((torch.tensor(X) @ tp - torch.tensor(Y)) ** 2).mean().backward()
+        to.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=rtol,
+                               atol=atol)
+
+
+def test_sgd():
+    _compare_with_torch(lambda ps: opt.SGD(0.05, parameters=ps),
+                        lambda ps: torch.optim.SGD(ps, lr=0.05))
+
+
+def test_momentum_nesterov():
+    _compare_with_torch(
+        lambda ps: opt.Momentum(0.02, 0.9, parameters=ps,
+                                use_nesterov=True),
+        lambda ps: torch.optim.SGD(ps, lr=0.02, momentum=0.9,
+                                   nesterov=True), rtol=1e-3, atol=1e-4)
+
+
+def test_adam():
+    _compare_with_torch(lambda ps: opt.Adam(0.01, parameters=ps),
+                        lambda ps: torch.optim.Adam(ps, lr=0.01))
+
+
+def test_adamw():
+    _compare_with_torch(
+        lambda ps: opt.AdamW(0.01, parameters=ps, weight_decay=0.1),
+        lambda ps: torch.optim.AdamW(ps, lr=0.01, weight_decay=0.1),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_rmsprop():
+    _compare_with_torch(
+        lambda ps: opt.RMSProp(0.01, rho=0.9, epsilon=1e-8, parameters=ps),
+        lambda ps: torch.optim.RMSprop(ps, lr=0.01, alpha=0.9, eps=1e-8),
+        rtol=2e-3, atol=1e-3)
+
+
+def test_adagrad():
+    _compare_with_torch(
+        lambda ps: opt.Adagrad(0.05, epsilon=1e-10, parameters=ps),
+        lambda ps: torch.optim.Adagrad(ps, lr=0.05), rtol=2e-3, atol=1e-4)
+
+
+def test_weight_decay_l2():
+    w0 = np.ones((3,), np.float32)
+    p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    p.trainable = True
+    o = opt.SGD(0.1, parameters=[p], weight_decay=0.5)
+    (p * 0.0).sum().backward()  # zero data grad; decay only
+    o.step()
+    np.testing.assert_allclose(p.numpy(), 1 - 0.1 * 0.5, rtol=1e-6)
+
+
+def test_lamb_trust_ratio_moves():
+    p = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    p.trainable = True
+    o = opt.Lamb(0.01, parameters=[p])
+    (p ** 2).sum().backward()
+    o.step()
+    assert not np.allclose(p.numpy(), 1.0)
+
+
+def test_lr_schedulers():
+    s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    seen = []
+    for _ in range(5):
+        seen.append(round(s(), 5))
+        s.step()
+    assert seen == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    s = opt.lr.MultiStepDecay(1.0, milestones=[2, 4], gamma=0.1)
+    vals = []
+    for _ in range(5):
+        vals.append(round(s(), 6))
+        s.step()
+    assert vals == [1.0, 1.0, 0.1, 0.1, 0.01]
+
+    s = opt.lr.PolynomialDecay(1.0, decay_steps=4, end_lr=0.0, power=1.0)
+    vals = []
+    for _ in range(5):
+        vals.append(round(s(), 4))
+        s.step()
+    assert vals == [1.0, 0.75, 0.5, 0.25, 0.0]
+
+    s = opt.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+    for loss in [1.0, 1.0, 1.0, 1.0]:
+        s.step(loss)
+    assert s() == pytest.approx(0.05)
+
+
+def test_scheduler_drives_optimizer():
+    sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    p.trainable = True
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.01)
+    with pytest.raises(RuntimeError):
+        o.set_lr(0.5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = paddle.to_tensor(np.random.randn(3).astype("f4"),
+                         stop_gradient=False)
+    p.trainable = True
+    o = opt.Adam(0.01, parameters=[p])
+    (p ** 2).sum().backward()
+    o.step()
+    sd = o.state_dict()
+    o2 = opt.Adam(0.01, parameters=[p])
+    o2.set_state_dict(sd)
+    st1 = o._accumulators[id(p)]
+    st2 = o2._accumulators[id(p)]
+    for k in st1:
+        np.testing.assert_allclose(np.asarray(st1[k]), np.asarray(st2[k]))
+
+
+def test_grad_scaler_skips_on_inf():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    p.trainable = True
+    o = opt.SGD(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0,
+                                   decr_every_n_nan_or_inf=1)
+    loss = (p * float("inf")).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(o)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+    assert scaler.get_init_loss_scaling() == pytest.approx(1.0)
+
+
+def test_auto_cast_bf16():
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        c = paddle.matmul(a, b)
+        assert c.dtype == paddle.bfloat16
+        # black-listed op stays f32
+        s = paddle.nn.functional.softmax(c.astype("float32"))
+        assert s.dtype == paddle.float32
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == paddle.float32
+
+
+def test_train_step_jit_lenet_smoke():
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(1)
+    model = LeNet()
+    optim = opt.Adam(0.002, parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, optim)
+    x = paddle.randn([16, 1, 28, 28])
+    y = paddle.to_tensor(np.random.randint(0, 10, 16))
+    l0 = float(step(x, y).numpy())
+    for _ in range(10):
+        l = float(step(x, y).numpy())
+    assert l < l0
