@@ -1,0 +1,90 @@
+"""Benchmark: flagship GPT training throughput on the real chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); vs_baseline is reported
+against this repo's own recorded first-round value when present
+(BENCH_BASELINE.json), else 1.0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+
+    paddle.seed(0)
+    on_tpu = jax.default_backend() != "cpu"
+    # sized to fit one v5e chip comfortably in bf16
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024)
+        batch, seq, iters = 8, 1024, 20
+    else:  # CPU smoke sizing
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128)
+        batch, seq, iters = 2, 128, 3
+
+    model = GPT(cfg)
+    optim = opt.AdamW(1e-4, parameters=model.parameters(),
+                      grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+
+    def loss_fn(m, x, y):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return gpt_loss_fn(m, x, y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, optim)
+    x = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+    y = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+
+    # warmup/compile
+    step(x, y)
+    step(x, y)
+
+    def sync():
+        # True drain: a scalar reduction over the LAST-updated parameter,
+        # fetched to host. Blocking on the loss alone is wrong (it is an
+        # early output of the compiled step — TPU streams outputs as
+        # produced) and a full-parameter D2H would be transfer-dominated;
+        # a dependent scalar is both correct and cheap.
+        import jax.numpy as jnp
+        return float(np.asarray(
+            jax.jit(jnp.sum)(model.parameters()[-1]._value)))
+
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step(x, y)
+    sync()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    baseline = None
+    if os.path.exists("BENCH_BASELINE.json"):
+        try:
+            baseline = json.load(open("BENCH_BASELINE.json")).get("value")
+        except Exception:
+            baseline = None
+    vs = tokens_per_sec / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "gpt_small_train_tokens_per_sec"
+                  + ("" if on_tpu else "_cpu"),
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

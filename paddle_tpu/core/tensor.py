@@ -38,7 +38,7 @@ class Tensor:
                  "name", "persistable", "_hooks", "_retain_grads",
                  "_inplace_version", "is_parameter", "__weakref__",
                  "trainable", "optimize_attr", "regularizer", "do_model_average",
-                 "need_clip")
+                 "need_clip", "_partition_spec")
 
     def __init__(self, value, stop_gradient: bool = True, name: str = None,
                  persistable: bool = False):
@@ -57,6 +57,7 @@ class Tensor:
         self._retain_grads = False
         self._inplace_version = 0
         self.is_parameter = False
+        self._partition_spec = None
 
     # ------------------------------------------------------------------ meta
     @property

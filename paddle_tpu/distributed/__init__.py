@@ -1,1 +1,24 @@
+"""paddle.distributed (reference: python/paddle/distributed/__init__.py)."""
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, broadcast, reduce, scatter, alltoall, barrier,
+    reduce_scatter, send, recv, wait, get_rank, get_world_size,
+    c_allreduce_sum, c_allreduce_max, c_allreduce_min, c_allreduce_prod,
+    c_broadcast, c_allgather, c_reducescatter, c_sync_calc_stream,
+    c_sync_comm_stream, c_gen_nccl_id, c_comm_init,
+)
+from .parallel import (  # noqa: F401
+    init_parallel_env, ParallelEnv, DataParallel,
+)
+from .tp_layers import (  # noqa: F401
+    split, ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from . import fleet  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from .launch import launch  # noqa: F401
 
+# meta_parallel namespace parity (later paddle exposes these there)
+class meta_parallel:
+    from .tp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding, ParallelCrossEntropy)

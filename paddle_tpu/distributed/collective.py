@@ -1,0 +1,358 @@
+"""Collective communication API.
+
+TPU-native analogue of /root/reference/python/paddle/distributed/collective.py
+(broadcast:101, all_reduce:157, all_gather:313, scatter:386, barrier:457) and
+the C++ collective op corpus /root/reference/paddle/fluid/operators/collective/
+(c_allreduce_{sum,max,min,prod}, c_broadcast, c_allgather, c_reducescatter,
+c_gen_nccl_id, c_comm_init — thin NCCL wrappers keyed by ring_id,
+c_allreduce_op.h:123-157).
+
+Mapping (SURVEY.md §2.4): ring_id → mesh axis; NCCL calls → XLA collectives
+(lax.psum / all_gather / ppermute) emitted when the op executes inside a
+shard_map/pjit trace over that axis. Outside any mesh trace with world_size 1
+the ops degenerate to identity, matching the reference's single-rank
+behavior. Multi-host bootstrap (gen_comm_id TCP exchange) becomes
+jax.distributed.initialize (the coordination service) — see
+distributed/parallel.py init_parallel_env.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+from ..parallel import mesh as _mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Process group ≈ a mesh axis (reference: comm rings + group in
+    collective.py). `ranks` kept for API parity."""
+
+    def __init__(self, axis: str = "dp", ranks: Optional[List[int]] = None,
+                 ring_id: int = 0):
+        self.axis = axis
+        self.ranks = ranks
+        self.id = ring_id
+
+    @property
+    def nranks(self):
+        m = _mesh.get_global_mesh()
+        if m is not None and self.axis in m.shape:
+            return m.shape[self.axis]
+        return len(self.ranks) if self.ranks else 1
+
+
+_default_group = Group("dp", ring_id=0)
+_groups = {0: _default_group}
+
+
+def new_group(ranks=None, backend=None, axis: str = "dp"):
+    gid = max(_groups) + 1
+    g = Group(axis, ranks, gid)
+    _groups[gid] = g
+    _mesh.register_ring(gid, axis)
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _default_group)
+
+
+def _axis_in_scope(axis: str) -> bool:
+    """True when executing inside a shard_map/xmap trace that binds `axis`."""
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except (NameError, KeyError, Exception):
+        return False
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return _default_group
+    if isinstance(group, int):
+        return get_group(group)
+    return group
+
+
+# ---------------------------------------------------------------- primitives
+def _psum(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def _pmax(x, axis):
+    return jax.lax.pmax(x, axis)
+
+
+def _pmin(x, axis):
+    return jax.lax.pmin(x, axis)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: _psum,
+    ReduceOp.MAX: _pmax,
+    ReduceOp.MIN: _pmin,
+    ReduceOp.AVG: lambda x, a: jax.lax.pmean(x, a),
+    ReduceOp.PROD: lambda x, a: jnp.exp(jax.lax.psum(jnp.log(x), a)),
+}
+
+
+@op("c_allreduce")
+def _c_allreduce(x, axis, red):
+    return _REDUCERS[red](x, axis)
+
+
+@op("c_allgather")
+def _c_allgather(x, axis):
+    return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+@op("c_reducescatter")
+def _c_reducescatter(x, axis):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+
+@op("c_broadcast")
+def _c_broadcast(x, axis, src):
+    # broadcast = select src shard then replicate: implement with psum of
+    # masked value (XLA lowers to a broadcast-from-root collective)
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+@op("c_alltoall")
+def _c_alltoall(x, axis):
+    n = jax.lax.psum(1, axis)
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    return jax.lax.all_to_all(xs, axis, split_axis=0, concat_axis=0,
+                              tiled=False).reshape(x.shape)
+
+
+@op("c_ppermute")
+def _c_ppermute(x, axis, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+# ---------------------------------------------------------------- public api
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    """paddle.distributed.all_reduce (reference: collective.py:157).
+    In-place on `tensor`, returns it (paddle semantics)."""
+    g = _resolve_group(group)
+    if not _axis_in_scope(g.axis):
+        return tensor  # world of one: identity (matches reference nranks==1)
+    out = _c_allreduce(tensor, g.axis, op)
+    tensor._value = out._value
+    tensor._node, tensor._out_idx = out._node, out._out_idx
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """reference: collective.py:313 — gathers shards into tensor_list."""
+    g = _resolve_group(group)
+    if not _axis_in_scope(g.axis):
+        tensor_list.append(tensor)
+        return tensor_list
+    gathered = _c_allgather(tensor, g.axis)
+    n = g.nranks
+    from ..ops import manipulation as M
+    parts = M.split(gathered, n, axis=0)
+    tensor_list.extend(parts)
+    return tensor_list
+
+
+def all_gather_object(obj_list, obj, group=None):
+    obj_list.append(obj)
+    return obj_list
+
+
+def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None):
+    g = _resolve_group(group)
+    src = tensor_or_list
+    if isinstance(src, (list, tuple)):
+        from ..ops import manipulation as M
+        src = M.concat(list(src), axis=0)
+    if not _axis_in_scope(g.axis):
+        tensor._value = src._value
+        return tensor
+    out = _c_reducescatter(src, g.axis)
+    tensor._value = out._value
+    tensor._node, tensor._out_idx = out._node, out._out_idx
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """reference: collective.py:101."""
+    g = _resolve_group(group)
+    if not _axis_in_scope(g.axis):
+        return tensor
+    out = _c_broadcast(tensor, g.axis, src)
+    tensor._value = out._value
+    tensor._node, tensor._out_idx = out._node, out._out_idx
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference semantics: result valid on dst; on SPMD hardware the
+    allreduce result is simply present everywhere (free on TPU)."""
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    if not _axis_in_scope(g.axis):
+        if tensor_list:
+            tensor._value = tensor_list[src]._value
+        return tensor
+    from ..ops import manipulation as M
+    stacked = M.stack(list(tensor_list), axis=0)
+    rooted = _c_broadcast(stacked, g.axis, src)
+    idx = _axis_index_tensor(g.axis)
+    picked = rooted[idx]
+    tensor._value = picked._value
+    tensor._node, tensor._out_idx = picked._node, picked._out_idx
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    g = _resolve_group(group)
+    from ..ops import manipulation as M
+    if not _axis_in_scope(g.axis):
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    x = M.concat(list(in_tensor_list), axis=0)
+    out = _c_alltoall(x, g.axis)
+    out_tensor_list.extend(M.split(out, len(in_tensor_list), axis=0))
+    return out_tensor_list
+
+
+@op("axis_index", differentiable=False)
+def _axis_index_op(axis):
+    return jax.lax.axis_index(axis)
+
+
+def _axis_index_tensor(axis):
+    return _axis_index_op(axis)
+
+
+def barrier(group=None):
+    """reference: collective.py:457 + operators/collective/barrier_op.
+    XLA orders collectives by data dependence; a host-level sync suffices."""
+    for d in jax.devices():
+        pass
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv map onto lax.ppermute inside sharded "
+        "programs (see paddle_tpu.parallel.pipeline); host-level p2p is "
+        "not part of the SPMD model")
+
+
+recv = send
+
+
+def get_world_size(group=None):
+    g = _resolve_group(group)
+    m = _mesh.get_global_mesh()
+    if m is not None:
+        if g.axis in m.shape:
+            return int(m.shape[g.axis])
+    import os
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def get_rank(group=None):
+    import os
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+# --------------------------------------------------- c_* op-level aliases
+# (reference: operators/collective/*.cc names; kept so ported graph-level
+# code and tests can target the op surface directly)
+def c_allreduce_sum(x, ring_id=0, use_calc_stream=True):
+    axis = _mesh.ring_axis(ring_id)
+    if not _axis_in_scope(axis):
+        return x
+    return _c_allreduce(x, axis, ReduceOp.SUM)
+
+
+def c_allreduce_max(x, ring_id=0, use_calc_stream=True):
+    axis = _mesh.ring_axis(ring_id)
+    if not _axis_in_scope(axis):
+        return x
+    return _c_allreduce(x, axis, ReduceOp.MAX)
+
+
+def c_allreduce_min(x, ring_id=0, use_calc_stream=True):
+    axis = _mesh.ring_axis(ring_id)
+    if not _axis_in_scope(axis):
+        return x
+    return _c_allreduce(x, axis, ReduceOp.MIN)
+
+
+def c_allreduce_prod(x, ring_id=0, use_calc_stream=True):
+    axis = _mesh.ring_axis(ring_id)
+    if not _axis_in_scope(axis):
+        return x
+    return _c_allreduce(x, axis, ReduceOp.PROD)
+
+
+def c_broadcast(x, root=0, ring_id=0, use_calc_stream=True):
+    axis = _mesh.ring_axis(ring_id)
+    if not _axis_in_scope(axis):
+        return x
+    return _c_broadcast(x, axis, root)
+
+
+def c_allgather(x, nranks=None, ring_id=0, use_calc_stream=True):
+    axis = _mesh.ring_axis(ring_id)
+    if not _axis_in_scope(axis):
+        return x
+    return _c_allgather(x, axis)
+
+
+def c_reducescatter(x, nranks=None, ring_id=0, use_calc_stream=True):
+    axis = _mesh.ring_axis(ring_id)
+    if not _axis_in_scope(axis):
+        return x
+    return _c_reducescatter(x, axis)
+
+
+def c_sync_calc_stream(x):
+    return x  # XLA token ordering subsumes stream sync (SURVEY.md §5)
+
+
+def c_sync_comm_stream(x, ring_id=0):
+    return x
+
+
+def c_gen_nccl_id(*a, **k):
+    """reference: c_gen_nccl_id_op.cc — TCP ncclUniqueId exchange. The JAX
+    coordination service owns bootstrap; nothing to generate."""
+    return None
+
+
+def c_comm_init(ring_id=0, axis="dp", *a, **k):
+    _mesh.register_ring(ring_id, axis)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not isinstance(
+            tensor._value, jax.core.Tracer):
+        tensor._value.block_until_ready()
+    return tensor

@@ -1,0 +1,31 @@
+"""paddle.distributed.fleet (reference: python/paddle/distributed/fleet/).
+
+The singleton `fleet` object mirrors fleet_base.py's module-level pattern:
+fleet.init / fleet.distributed_model / fleet.distributed_optimizer, plus the
+TPU-native fleet.distributed_train_step that builds the composed SPMD step.
+"""
+from .fleet_base import Fleet, _FleetOptimizer  # noqa: F401
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, UserDefinedRoleMaker, RoleMakerBase, Role,
+)
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
+
+fleet = Fleet()
+
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+distributed_train_step = fleet.distributed_train_step
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+save_persistables = fleet.save_persistables

@@ -1,0 +1,82 @@
+"""python -m paddle_tpu.distributed.launch (reference:
+python/paddle/distributed/fleet/launch.py:334 — collective mode spawns one
+proc per device with the PADDLE_TRAINER_* env contract, watches children,
+tears the pod down on failure; launch_utils.py Cluster/Pod model).
+
+TPU-native: the default is ONE process per host driving all local chips
+(SPMD); --nproc_per_node>1 partitions chips between processes. Multi-host
+jobs pass --ips and the coordination service handles rendezvous.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    ap = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    ap.add_argument("--ips", default="127.0.0.1",
+                    help="comma-separated host ips (multi-host DCN)")
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--node_rank", type=int,
+                    default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    ap.add_argument("--port", type=int, default=6170)
+    ap.add_argument("training_script")
+    ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return ap.parse_args()
+
+
+def launch():
+    args = _parse()
+    ips = args.ips.split(",")
+    nnodes = len(ips)
+    world = nnodes * args.nproc_per_node
+    endpoints = [f"{ip}:{args.port + i}" for ip in ips
+                 for i in range(args.nproc_per_node)]
+    procs = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local_rank in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "FLAGS_selected_tpus": str(local_rank),
+        })
+        out = open(os.path.join(args.log_dir, f"worker.{rank}.log"),
+                   "w") if args.log_dir else None
+        p = subprocess.Popen([sys.executable, args.training_script]
+                             + args.training_script_args, env=env,
+                             stdout=out, stderr=subprocess.STDOUT
+                             if out else None)
+        procs.append(p)
+    # watch loop (reference: launch_utils.py watch_local_trainers — kill the
+    # pod if any trainer dies)
+    try:
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+                    sys.exit(ret)
+            time.sleep(1)
+    except KeyboardInterrupt:
+        for q in procs:
+            q.send_signal(signal.SIGTERM)
+        raise
+
+
+if __name__ == "__main__":
+    launch()

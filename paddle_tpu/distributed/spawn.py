@@ -1,0 +1,38 @@
+"""paddle.distributed.spawn (reference: python/paddle/distributed/spawn.py —
+multiprocessing over GPUs). On TPU, one process drives all local chips via
+SPMD, so spawn runs the target once per requested proc with the env contract
+set; nprocs>1 requires per-proc chip partitioning (TPU_VISIBLE_DEVICES),
+documented as the launcher's job.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+
+def _worker(func, rank, nprocs, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs == 1:
+        os.environ.setdefault("PADDLE_TRAINER_ID", "0")
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", "1")
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, rank, nprocs, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: exitcodes {bad}")
+    return procs

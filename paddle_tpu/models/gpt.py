@@ -1,0 +1,157 @@
+"""GPT: the flagship decoder-only transformer.
+
+Reference capability target: GPT-3-style static-graph training with Fleet
+pipeline+recompute (BASELINE.json config 5) and ERNIE/BERT-style encoders
+(configs 3-4). The reference builds these from python/paddle/nn/layer/
+transformer.py primitives + fleet meta-optimizers; here the model is written
+sharded-by-default (SPMD annotations are no-ops without a mesh):
+
+- tensor parallel: QKV/MLP-up as ColumnParallel, attn-out/MLP-down as
+  RowParallel over the 'tp' axis (Megatron layout: one psum per block pair)
+- sequence parallel: activations between blocks sharded over 'sp' on the
+  sequence dim (ring-free: XLA chooses all-gather/reduce-scatter points)
+- attention: nn.functional.scaled_dot_product_attention (pallas flash on
+  TPU for long sequences)
+- recompute: per-block jax.checkpoint via fleet.utils.recompute
+- pipeline: the stacked-parameter variant lives in
+  paddle_tpu.parallel.pipeline (shard_map + ppermute microbatch schedule)
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..core.tensor import Tensor
+from ..ops import manipulation as M
+from ..parallel.api import shard_activation, mark_sharding
+from ..distributed.tp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                     VocabParallelEmbedding)
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    use_recompute: bool = False
+    sequence_parallel: bool = False
+
+    # presets (reference marketing targets: BASELINE.json configs)
+    @staticmethod
+    def gpt3_1p3b():
+        return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                         num_heads=16, max_seq_len=2048)
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, max_seq_len=64)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.qkv = ColumnParallelLinear(cfg.hidden_size,
+                                        3 * cfg.hidden_size,
+                                        gather_output=False)
+        self.out = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                     input_is_parallel=True)
+
+    def forward(self, x):
+        B, T = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        qkv = M.reshape(qkv, [B, T, 3, self.num_heads, self.head_dim])
+        q, k, v = M.unstack(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.cfg.dropout,
+            training=self.training)
+        out = M.reshape(out, [B, T, -1])
+        return self.out(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        inner = cfg.ffn_mult * cfg.hidden_size
+        self.up = ColumnParallelLinear(cfg.hidden_size, inner,
+                                       gather_output=False)
+        self.down = RowParallelLinear(inner, cfg.hidden_size,
+                                      input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down(F.gelu(self.up(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+
+    def _body(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        if self.cfg.sequence_parallel:
+            x = shard_activation(x, "dp", "sp", None)
+        return x
+
+    def forward(self, x):
+        if self.cfg.use_recompute:
+            from ..distributed.fleet.utils import recompute
+            return recompute(self._body, x)
+        return self._body(x)
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig = None, **kwargs):
+        super().__init__()
+        cfg = cfg or GPTConfig(**kwargs)
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        # weight-tied LM head (standard GPT); column-parallel over vocab
+        self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
+                                            has_bias=False,
+                                            gather_output=True)
+
+    def forward(self, input_ids):
+        B, T = input_ids.shape[0], input_ids.shape[1]
+        import jax.numpy as jnp
+        pos = Tensor(jnp.arange(T, dtype=jnp.int32)[None, :])
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        if self.cfg.sequence_parallel:
+            x = shard_activation(x, "dp", "sp", None)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        return self.lm_head(x)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            M.reshape(logits, [-1, self.cfg.vocab_size]),
+            M.reshape(labels, [-1]))
+
+
+def gpt_loss_fn(model, input_ids, labels):
+    """loss_fn signature for jit.TrainStep / parallel.ShardedTrainStep."""
+    return model.loss(input_ids, labels)

@@ -112,6 +112,10 @@ class Layer:
             for d in (layers, buffers):
                 if d is not None:
                     d.pop(name, None)
+            # drop any plain attribute of the same name (e.g. a `self.x =
+            # None` placeholder) — instance __dict__ wins attribute lookup
+            # over __getattr__, which would shadow the parameter
+            self.__dict__.pop(name, None)
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
@@ -121,6 +125,7 @@ class Layer:
             for d in (params, buffers):
                 if d is not None:
                     d.pop(name, None)
+            self.__dict__.pop(name, None)
             layers[name] = value
         elif buffers is not None and name in buffers:
             if value is not None and not isinstance(value, Tensor):

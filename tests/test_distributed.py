@@ -1,0 +1,259 @@
+"""Distributed stack tests on the 8-device virtual CPU mesh.
+
+Reference test analogues: unittests/test_dist_base.py:660 (asserts 2-rank
+distributed losses ≈ single-rank losses — here the same assertion between
+sharded-mesh and single-device runs), fleet meta-optimizer tests
+(test_fleet_sharding_meta_optimizer.py — compile-time assertions, here
+sharding-spec assertions), collective_*.py (op semantics inside shard_map),
+pipeline_mnist.py (pp parity).
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+from paddle_tpu.parallel import (build_mesh, set_global_mesh,
+                                 ShardedTrainStep, ShardingStage)
+from paddle_tpu.parallel import mesh as mesh_mod
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    set_global_mesh(None)
+
+
+def _tiny_cfg(**kw):
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, max_seq_len=16, **kw)
+
+
+def _data(batch=8):
+    rng = np.random.RandomState(0)
+    return (paddle.to_tensor(rng.randint(0, 64, (batch, 16))),
+            paddle.to_tensor(rng.randint(0, 64, (batch, 16))))
+
+
+def _run(mesh_kw, stage=0, steps=5, cfg_kw=None, batch=8):
+    paddle.seed(0)
+    mesh = build_mesh(**mesh_kw)
+    set_global_mesh(mesh)
+    model = GPT(_tiny_cfg(**(cfg_kw or {})))
+    optim = opt.Adam(1e-3, parameters=model.parameters())
+    step = ShardedTrainStep(model, gpt_loss_fn, optim, mesh=mesh,
+                            sharding_stage=stage)
+    x, y = _data(batch)
+    return [float(step(x, y).numpy()) for _ in range(steps)]
+
+
+def _single(steps=5, cfg_kw=None, batch=8):
+    return _run(dict(dp=1, pp=1, tp=1, sp=1, sharding=1,
+                     devices=jax.devices()[:1]), 0, steps, cfg_kw, batch)
+
+
+def test_dp_matches_single_device():
+    base = _single()
+    dp = _run(dict(dp=8, pp=1, tp=1, sp=1, sharding=1))
+    np.testing.assert_allclose(base, dp, rtol=2e-3, atol=2e-3)
+
+
+def test_tp_matches_single_device():
+    base = _single()
+    tp = _run(dict(dp=1, pp=1, tp=8, sp=1, sharding=1))
+    np.testing.assert_allclose(base, tp, rtol=2e-3, atol=2e-3)
+
+
+def test_zero_stages_match_single_device():
+    base = _single()
+    for stage in (ShardingStage.OPTIMIZER, ShardingStage.GRADIENT,
+                  ShardingStage.PARAMETER):
+        z = _run(dict(dp=1, pp=1, tp=1, sp=1, sharding=8), stage)
+        np.testing.assert_allclose(base, z, rtol=2e-3, atol=2e-3,
+                                   err_msg=f"stage {stage}")
+
+
+def test_hybrid_dp_tp_sharding():
+    base = _single()
+    hy = _run(dict(dp=2, pp=1, tp=2, sp=1, sharding=2),
+              ShardingStage.GRADIENT)
+    np.testing.assert_allclose(base, hy, rtol=2e-3, atol=2e-3)
+
+
+def test_sequence_parallel():
+    base = _single(cfg_kw=dict(sequence_parallel=True))
+    sp = _run(dict(dp=2, pp=1, tp=2, sp=2, sharding=1),
+              cfg_kw=dict(sequence_parallel=True))
+    np.testing.assert_allclose(base, sp, rtol=2e-3, atol=2e-3)
+
+
+def test_recompute_matches():
+    base = _single()
+    rc = _run(dict(dp=2, pp=1, tp=2, sp=1, sharding=2),
+              cfg_kw=dict(use_recompute=True))
+    np.testing.assert_allclose(base, rc, rtol=2e-3, atol=2e-3)
+
+
+def test_pipeline_parity():
+    from paddle_tpu.parallel.pipeline import (PipelinedGPT,
+                                              pipelined_gpt_loss_fn)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                    num_heads=2, max_seq_len=16)
+
+    def run_pp(mesh_kw):
+        paddle.seed(0)
+        mesh = build_mesh(**mesh_kw)
+        set_global_mesh(mesh)
+        model = PipelinedGPT(cfg, mesh)
+        optim = opt.Adam(1e-3, parameters=model.parameters())
+        step = ShardedTrainStep(model, pipelined_gpt_loss_fn, optim,
+                                mesh=mesh)
+        x, y = _data(8)
+        return [float(step(x, y).numpy()) for _ in range(5)]
+
+    base = run_pp(dict(dp=1, pp=1, tp=1, sp=1, sharding=1,
+                       devices=jax.devices()[:1]))
+    pp = run_pp(dict(dp=2, pp=4, tp=1, sp=1, sharding=1))
+    np.testing.assert_allclose(base, pp, rtol=3e-3, atol=3e-3)
+
+
+def test_gradient_merge_matches_big_batch():
+    paddle.seed(0)
+    mesh = build_mesh(dp=1, pp=1, tp=1, sp=1, sharding=1,
+                      devices=jax.devices()[:1])
+    set_global_mesh(mesh)
+    model = GPT(_tiny_cfg())
+    optim = opt.SGD(0.1, parameters=model.parameters())
+    step = ShardedTrainStep(model, gpt_loss_fn, optim, mesh=mesh,
+                            grad_accum_steps=2)
+    x, y = _data(8)
+    xa, xb = x[:4], x[4:]
+    ya, yb = y[:4], y[4:]
+    step(xa, ya)
+    w_before = model.parameters()[0].numpy().copy()
+    # not applied yet after first micro-step? applied at 2nd
+    step(xb, yb)
+    w_after = model.parameters()[0].numpy()
+    assert not np.allclose(w_before, w_after)
+
+    # compare against single big-batch step
+    paddle.seed(0)
+    model2 = GPT(_tiny_cfg())
+    optim2 = opt.SGD(0.1, parameters=model2.parameters())
+    step2 = ShardedTrainStep(model2, gpt_loss_fn, optim2, mesh=mesh)
+    step2(x, y)
+    np.testing.assert_allclose(
+        model.parameters()[0].numpy(), model2.parameters()[0].numpy(),
+        rtol=2e-3, atol=2e-4)
+
+
+def test_collectives_inside_shard_map():
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu.distributed as dist
+    mesh = build_mesh(dp=8, pp=1, tp=1, sp=1, sharding=1)
+    set_global_mesh(mesh)
+
+    def body(x):
+        t = paddle.Tensor(x)
+        dist.all_reduce(t)
+        return t._value
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                  axis_names={"dp"}, check_vma=False)
+    x = jnp.arange(8.0)
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.full(8, x.sum()))
+
+    def bcast(x):
+        t = paddle.Tensor(x)
+        dist.broadcast(t, src=3)
+        return t._value
+    f2 = shard_map(bcast, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                   axis_names={"dp"}, check_vma=False)
+    np.testing.assert_allclose(np.asarray(f2(x)), np.full(8, 3.0))
+
+
+def test_collectives_identity_outside_mesh():
+    import paddle_tpu.distributed as dist
+    t = paddle.to_tensor([1.0, 2.0])
+    out = dist.all_reduce(t)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+    gathered = []
+    dist.all_gather(gathered, t)
+    assert len(gathered) == 1
+
+
+def test_fleet_end_to_end():
+    import paddle_tpu.distributed.fleet as fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.sharding = True
+    strategy.sharding_configs = {"sharding_degree": 2, "sharding_stage": 2}
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    assert fleet.worker_num() >= 1
+    paddle.seed(0)
+    model = GPT(_tiny_cfg())
+    optim = opt.Adam(1e-3, parameters=model.parameters())
+    step = fleet.distributed_train_step(model, gpt_loss_fn, optim)
+    x, y = _data(8)
+    losses = [float(step(x, y).numpy()) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_lamb_substitution():
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.optimizer import Lamb
+    strategy = fleet.DistributedStrategy()
+    strategy.lamb = True
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    p.trainable = True
+    inner = opt.Adam(0.01, parameters=[p])
+    fleet.init(is_collective=True, strategy=strategy)
+    wrapped = fleet.distributed_optimizer(inner, strategy)
+    assert isinstance(wrapped._inner, Lamb)
+
+
+def test_distributed_batch_sampler_shards():
+    from paddle_tpu.io import DistributedBatchSampler, TensorDataset
+    ds = TensorDataset([paddle.arange(20).reshape([20, 1])])
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert not set(i0) & set(i1)
+    assert len(i0) == len(i1) == 10
+
+
+def test_tp_layer_specs():
+    from paddle_tpu.distributed import (ColumnParallelLinear,
+                                        RowParallelLinear,
+                                        VocabParallelEmbedding)
+    from paddle_tpu.parallel.api import param_spec
+    col = ColumnParallelLinear(8, 16)
+    row = RowParallelLinear(16, 8)
+    emb = VocabParallelEmbedding(32, 8)
+    assert param_spec(col.weight) == (None, "tp")
+    assert param_spec(row.weight) == ("tp", None)
+    assert param_spec(emb.weight) == ("tp", None)
+    # runs unsharded too
+    x = paddle.randn([2, 8])
+    assert row(col(x)).shape == [2, 8]
+
+
+def test_graft_entry_dryrun():
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 2
+    __graft_entry__.dryrun_multichip(8)
