@@ -250,8 +250,20 @@ def _axis_index_tensor(axis):
 
 def barrier(group=None):
     """reference: collective.py:457 + operators/collective/barrier_op.
-    XLA orders collectives by data dependence; a host-level sync suffices."""
-    for d in jax.devices():
+
+    Within one process XLA orders collectives by data dependence, so the
+    only real synchronisation needed is across *processes*: when the JAX
+    coordination service is up, a tiny psum over all devices forces every
+    process to reach this point before any proceeds (the collective cannot
+    complete until each participant has enqueued it).  Single-process:
+    flush outstanding work on the default device.
+    """
+    import jax.experimental.multihost_utils as mhu
+    try:
+        if jax.process_count() > 1:
+            mhu.sync_global_devices("paddle_tpu.barrier")
+            return
+    except Exception:
         pass
     (jnp.zeros(()) + 0).block_until_ready()
 
@@ -267,18 +279,41 @@ recv = send
 
 
 def get_world_size(group=None):
+    """Host-level world size (reference: parallel.py get_world_size).
+
+    Note: inside an SPMD/shard_map trace this is a *host* quantity; per-axis
+    position within the trace is `axis_index(group)` / lax.axis_index.
+    """
     g = _resolve_group(group)
     m = _mesh.get_global_mesh()
     if m is not None:
         if g.axis in m.shape:
             return int(m.shape[g.axis])
     import os
-    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
 
 
 def get_rank(group=None):
+    """Host-level rank (process index). See get_world_size note."""
     import os
-    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if "PADDLE_TRAINER_ID" in os.environ:
+        return int(os.environ["PADDLE_TRAINER_ID"])
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def axis_index(group=None):
+    """Traced position along the group's mesh axis — valid inside an
+    SPMD/shard_map region (this, not get_rank, is the in-trace rank)."""
+    g = _resolve_group(group)
+    return _axis_index_tensor(g.axis)
 
 
 # --------------------------------------------------- c_* op-level aliases

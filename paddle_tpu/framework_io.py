@@ -1,9 +1,12 @@
 """paddle.save / paddle.load.
 
 TPU-native analogue of /root/reference/python/paddle/framework/io.py:201
-(pickle-based state_dict save with Tensors converted to ndarray) and
-fluid/dygraph/checkpoint.py. Uses numpy .npz-free pickle for exact parity
-with the reference's nested-dict format.
+(pickle-based state_dict save with Tensors converted to plain ndarrays —
+_build_saved_state_dict / _unpack_saved_dict) and
+fluid/dygraph/checkpoint.py. Tensors are pickled as bare numpy arrays in
+the same nested-dict structure, so checkpoints are interchangeable with
+reference-format state_dict pickles; load() rebuilds Tensors from ndarray
+leaves unless return_numpy=True.
 """
 from __future__ import annotations
 
@@ -17,8 +20,7 @@ from .core.tensor import Tensor
 
 def _to_serializable(obj):
     if isinstance(obj, Tensor):
-        return {"__tensor__": True, "value": obj.numpy(), "name": obj.name,
-                "stop_gradient": obj.stop_gradient}
+        return obj.numpy()
     if isinstance(obj, dict):
         return {k: _to_serializable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -28,13 +30,15 @@ def _to_serializable(obj):
 
 
 def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else Tensor(obj, stop_gradient=True)
     if isinstance(obj, dict):
-        if obj.get("__tensor__"):
+        if obj.get("__tensor__"):  # legacy pre-r2 checkpoint format
             if return_numpy:
                 return obj["value"]
-            t = Tensor(obj["value"], stop_gradient=obj.get(
-                "stop_gradient", True), name=obj.get("name"))
-            return t
+            return Tensor(obj["value"],
+                          stop_gradient=obj.get("stop_gradient", True),
+                          name=obj.get("name"))
         return {k: _from_serializable(v, return_numpy)
                 for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):

@@ -30,7 +30,7 @@ def _wrap(x):
 
 
 @op("scaled_dot_product_attention")
-def _sdpa(q, k, v, mask, causal, scale):
+def _sdpa(q, k, v, mask, causal, scale, drop_mask, dropout_p):
     # q,k,v: [B, T, H, D] (paddle layout) -> compute in [B, H, T, D]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
@@ -48,6 +48,11 @@ def _sdpa(q, k, v, mask, causal, scale):
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits, axis=-1)
+    if drop_mask is not None:
+        # paddle/torch semantics: dropout on the softmax weights, upscaled.
+        # At p>=1 the mask is all zeros and the output is zeros (denominator
+        # pinned to avoid 0/0 -> NaN).
+        probs = probs * drop_mask / max(1.0 - dropout_p, 1e-12)
     out = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
     return jnp.swapaxes(out, 1, 2)
 
@@ -59,8 +64,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     q, k, v = _wrap(query), _wrap(key), _wrap(value)
     head_dim = q.shape[-1]
     sc = scale if scale is not None else 1.0 / float(np.sqrt(head_dim))
+    dropout_active = dropout_p > 0.0 and training
     use_flash = (_flags.flag("use_flash_attention") and attn_mask is None
-                 and dropout_p == 0.0)
+                 and not dropout_active)
     if use_flash:
         try:
             from ...ops.pallas.flash_attention import flash_attention
@@ -68,8 +74,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         except Exception:
             pass  # fall back to composed path (e.g. odd shapes, CPU quirks)
     m = None if attn_mask is None else _wrap(attn_mask)
-    out = _sdpa(q, k, v, m, is_causal, sc)
-    if dropout_p > 0.0 and training:
-        from .common import dropout
-        out = dropout(out, dropout_p, training=training)
-    return out
+    drop_mask = None
+    if dropout_active:
+        from ...core import random as _random
+        b, t, h = q.shape[0], q.shape[1], q.shape[2]
+        s = k.shape[1]
+        keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout_p,
+                                    (b, h, t, s))
+        drop_mask = Tensor(keep.astype(q._value.dtype))
+    return _sdpa(q, k, v, m, is_causal, sc, drop_mask, float(dropout_p))

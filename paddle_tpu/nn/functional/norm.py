@@ -76,12 +76,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     # are tracers; paddle_tpu.jit reads the buffers back after tracing and
     # returns them as extra outputs, making the update functional.
     if running_mean is not None:
-        n = int(np.prod([x.shape[i] for i in range(x.ndim) if i != c_axis]))
-        unbiased = var._value * (n / max(n - 1, 1))
+        # Reference uses the *biased* batch variance for the running-stat EMA
+        # (batch_norm_op.cc:398 saved_variance /= N*sample_size, no Bessel
+        # correction) — feed `var` straight in.
         running_mean._value = (momentum * running_mean._value
                                + (1 - momentum) * mean._value)
         running_var._value = (momentum * running_var._value
-                              + (1 - momentum) * unbiased)
+                              + (1 - momentum) * var._value)
     return out
 
 
