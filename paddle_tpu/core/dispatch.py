@@ -70,6 +70,12 @@ def dispatch(op_type: str, fn: Callable, args, kwargs, differentiable=True):
         a2, k2 = jax.tree_util.tree_unflatten(treedef, ll)
         return fn(*a2, **k2)
 
+    if _static_capture_hook is not None:
+        captured = _static_capture_hook(op_type, pure, in_tensors,
+                                        differentiable)
+        if captured is not None:
+            return captured
+
     tracing = any(_is_tracer(a) for a in arrs)
     need_grad = (differentiable and _GradState.enabled and not tracing
                  and any(not t.stop_gradient for t in in_tensors))

@@ -79,10 +79,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # Reference uses the *biased* batch variance for the running-stat EMA
         # (batch_norm_op.cc:398 saved_variance /= N*sample_size, no Bessel
         # correction) — feed `var` straight in.
-        running_mean._value = (momentum * running_mean._value
-                               + (1 - momentum) * mean._value)
-        running_var._value = (momentum * running_var._value
-                              + (1 - momentum) * var._value)
+        from ...static.program import Variable as _SVar
+        if isinstance(running_mean, _SVar):
+            # static graph: stat update is an op writing the persistable
+            from ...static.nn import static_assign
+            new_rm = running_mean * momentum + mean * (1.0 - momentum)
+            new_rv = running_var * momentum + var * (1.0 - momentum)
+            static_assign(running_mean, new_rm)
+            static_assign(running_var, new_rv)
+        else:
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * mean._value)
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * var._value)
     return out
 
 

@@ -66,6 +66,16 @@ class Layer:
              (attr or ParamAttr()))
         init = attr.initializer or default_initializer or \
             (I.Constant(0.0) if is_bias else I.XavierNormal())
+        from ...static import mode as _smode
+        if _smode._static_mode:
+            # static graph: parameter Variable in the main program + init
+            # op in startup (reference: layer_helper_base.py path)
+            from ...static.program import create_parameter as _static_param
+            return _static_param(
+                shape, dtype, name=attr.name, initializer=init,
+                trainable=attr.trainable, regularizer=attr.regularizer,
+                learning_rate=attr.learning_rate, need_clip=attr.need_clip,
+                do_model_average=attr.do_model_average)
         value = init(shape, dtype)
         p = Tensor(value, stop_gradient=not attr.trainable, persistable=True,
                    name=attr.name)
@@ -83,6 +93,17 @@ class Layer:
                       name=name)
 
     def register_buffer(self, name, tensor, persistable=True):
+        from ...static import mode as _smode
+        if _smode._static_mode and tensor is not None and persistable:
+            # static graph: buffers (BN running stats, …) live in the scope
+            # as persistable vars initialized by the startup program
+            from ...static.program import Variable as _SVar
+            if not isinstance(tensor, _SVar):
+                from ...static.nn import persistable_buffer
+                val = tensor._value if isinstance(tensor, Tensor) \
+                    else jnp.asarray(tensor)
+                tensor = persistable_buffer(
+                    val, prefix=f"{self._full_name}.{name}")
         if tensor is not None and not isinstance(tensor, Tensor):
             tensor = Tensor(tensor)
         self._buffers[name] = tensor

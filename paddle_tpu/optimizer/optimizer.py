@@ -109,9 +109,85 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable as _SVar
+        if isinstance(loss, _SVar):
+            return self._static_minimize(loss, startup_program, parameters,
+                                         no_grad_set)
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    def _static_minimize(self, loss, startup_program=None, parameters=None,
+                         no_grad_set=None):
+        """Static-graph minimize (reference: optimizer.py minimize →
+        append_backward + _create_optimization_pass appending per-param
+        update ops; accumulator vars initialized in startup,
+        fluid/optimizer.py _add_accumulator). The update rule is the same
+        pure `_update` the eager path uses — captured as ops over the
+        param/grad/accumulator persistables, with the learning rate as a
+        runtime scalar so scheduler steps never recompile."""
+        from ..static import backward as _B
+        from ..static.program import (OpDesc, default_startup_program)
+        prog = loss.block.program
+        blk = prog.global_block
+        startup = startup_program or default_startup_program()
+        params_grads = _B.append_backward(loss, parameters, no_grad_set)
+
+        if self._grad_clip is not None:
+            gnames = [g.name for _, g in params_grads]
+            clip = self._grad_clip
+
+            def clip_fn(*gs):
+                return tuple(clip.clip_arrays(list(gs)))
+
+            blk.append_op(OpDesc("op", "optimize.clip", clip_fn, gnames,
+                                 gnames))
+
+        lr_name = prog.add_runtime_scalar(
+            "learning_rate", lambda: np.float32(self.get_lr()))
+
+        update_ops = []
+        for p, g in params_grads:
+            aval = jax.ShapeDtypeStruct(tuple(p._value.shape),
+                                        p._value.dtype)
+            tmpl = jax.eval_shape(self._init_state, aval)
+            skeys = sorted(tmpl)
+            snames = [f"{p.name}_{k}_acc" for k in skeys]
+            shape_t = tuple(p._value.shape)
+            dtype_t = p._value.dtype
+            for k, sn in zip(skeys, snames):
+                sv = blk.create_var(name=sn, shape=tmpl[k].shape,
+                                    dtype=tmpl[k].dtype, persistable=True)
+                startup.global_block.create_var(
+                    name=sn, shape=tmpl[k].shape, dtype=tmpl[k].dtype,
+                    persistable=True)
+
+                def init_fn(_self=self, _k=k, _shape=shape_t,
+                            _dtype=dtype_t):
+                    return _self._init_state(
+                        jnp.zeros(_shape, _dtype))[_k]
+
+                startup.global_block.append_op(
+                    OpDesc("init", "fill_accumulator", init_fn, [], [sn]))
+
+            reg = getattr(p, "regularizer", None) or self.regularization
+            mult = self._param_lr(p).get("learning_rate", 1.0)
+
+            def upd(pv, gv, lr, *svals, _self=self, _skeys=tuple(skeys),
+                    _reg=reg, _mult=mult, _pname=p.name):
+                if _reg is not None:
+                    gv = _reg.apply(pv, gv)
+                _self._current_param_name = _pname
+                new_p, new_s = _self._update(
+                    pv, gv, dict(zip(_skeys, svals)),
+                    (lr * _mult).astype(pv.dtype))
+                return (new_p,) + tuple(new_s[k] for k in _skeys)
+
+            od = blk.append_op(OpDesc(
+                "op", "optimize.update", upd,
+                [p.name, g.name, lr_name] + snames, [p.name] + snames))
+            update_ops.append(od)
+        return update_ops, params_grads
 
     def backward(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None, callbacks=None):

@@ -1,1 +1,58 @@
+"""paddle.static — the static-graph pillar.
 
+TPU-native analogue of /root/reference/python/paddle/static/__init__.py:
+Program/Block/Variable IR (framework.py), Executor (executor.py:475),
+append_backward (backward.py:1337), program/scope management. See
+static/program.py for the XLA-first redesign (programs of pure closures
+compiled as one jitted module).
+"""
+from .mode import (  # noqa: F401
+    in_dynamic_mode, in_static_mode, enable_static, disable_static,
+)
+from .program import (  # noqa: F401
+    Program, Block, Variable, OpDesc, program_guard,
+    default_main_program, default_startup_program, data, create_parameter,
+)
+from .executor import Executor, Scope, global_scope  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from . import nn  # noqa: F401
+from .nn import create_global_var  # noqa: F401
+from .io import save, load, save_inference_model, load_inference_model  # noqa: F401
+
+try:  # InputSpec lives in paddle.static in the reference
+    from ..jit import InputSpec  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+
+class CompiledProgram:
+    """reference: compiler.py CompiledProgram — graph-optimization wrapper.
+    XLA owns fusion/placement here, so this is a transparent handle the
+    Executor unwraps."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class ExecutionStrategy:
+    num_threads = 1
+    num_iteration_per_drop_scope = 100
+
+
+class BuildStrategy:
+    """reference: ParallelExecutor BuildStrategy knobs — XLA subsumes the
+    fusion/memory-reuse passes these toggled."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    reduce_strategy = ReduceStrategy.AllReduce
+    fuse_all_optimizer_ops = True
+    fuse_elewise_add_act_ops = True
+    enable_inplace = True

@@ -1,0 +1,78 @@
+"""append_backward / gradients for static programs.
+
+TPU-native analogue of /root/reference/python/paddle/fluid/backward.py
+(append_backward:1337 — walks the forward op list emitting grad ops via
+each op's GradOpMaker, inserting sum ops for fan-in). Re-design: the
+recorded ops are pure JAX closures, so the chain rule belongs to jax.grad.
+append_backward snapshots the forward op list and appends ONE backward
+OpDesc; at Executor time jax.grad differentiates the re-interpreted
+forward and XLA CSEs it against the original forward — numerically
+identical to per-op transposition, with XLA owning scheduling/fusion of
+the grad graph (what the reference's graph passes hand-tune).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .program import OpDesc, Variable, default_main_program
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Returns [(param_var, grad_var)] like the reference (backward.py:1337).
+    Grad vars are named '<param>@GRAD'."""
+    if not isinstance(loss, Variable):
+        raise TypeError("append_backward expects a static Variable loss; "
+                        "got a dygraph Tensor (call loss.backward() there)")
+    prog = loss.block.program
+    blk = prog.global_block
+    if parameter_list:
+        params = []
+        for p in parameter_list:
+            if isinstance(p, str):
+                params.append(blk.var(p))
+            else:
+                params.append(p)
+    else:
+        params = [p for p in prog.all_parameters()
+                  if getattr(p, "trainable", True)]
+    if no_grad_set:
+        drop = {n if isinstance(n, str) else n.name for n in no_grad_set}
+        params = [p for p in params if p.name not in drop]
+    if not params:
+        raise ValueError("append_backward found no trainable parameters")
+
+    fwd_ops = list(blk.ops)  # snapshot: grads of the program-so-far
+    pnames = [p.name for p in params]
+    grad_vars = []
+    for p in params:
+        g = blk.create_var(name=p.name + "@GRAD", shape=p.shape,
+                           dtype=p._value.dtype, stop_gradient=True)
+        grad_vars.append(g)
+    blk.append_op(OpDesc("backward", "backward", None, [loss.name] + pnames,
+                         [g.name for g in grad_vars],
+                         payload=(fwd_ops, loss.name, pnames)))
+    return list(zip(params, grad_vars))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: backward.py gradients (grads of targets w.r.t. arbitrary
+    inputs, not just parameters)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("gradients: exactly one scalar target")
+    loss = targets[0]
+    prog = loss.block.program
+    blk = prog.global_block
+    fwd_ops = list(blk.ops)
+    inames = [v.name for v in inputs]
+    grad_vars = []
+    for v in inputs:
+        g = blk.create_var(name=v.name + "@GRAD", shape=v.shape,
+                           dtype=v._value.dtype, stop_gradient=True)
+        grad_vars.append(g)
+    blk.append_op(OpDesc("backward", "backward", None, [loss.name] + inames,
+                         [g.name for g in grad_vars],
+                         payload=(fwd_ops, loss.name, inames)))
+    return grad_vars

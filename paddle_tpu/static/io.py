@@ -1,0 +1,146 @@
+"""paddle.static.save / load / save_inference_model.
+
+TPU-native analogue of /root/reference/python/paddle/fluid/io.py
+(save_vars/save_params, save_inference_model:1152, load_inference_model)
+and python/paddle/framework/io.py static paths. Parameters and other
+persistables are pickled as plain name→ndarray dicts (.pdparams /
+.pdopt split like the reference); the inference artifact additionally
+exports the pruned program as StableHLO via jax.export so it can be served
+without Python graph rebuild.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .program import Program, Variable, default_main_program
+from .executor import global_scope, _interpret, _analyze_program
+
+
+def _persistables(program: Program):
+    return [v for v in program.global_block.vars.values() if v.persistable]
+
+
+def save(program: Program, model_path: str, protocol: int = 4):
+    """reference: paddle.static.save — params to .pdparams, the rest of the
+    persistables (optimizer accumulators, stat buffers) to .pdopt."""
+    scope = global_scope()
+    params, others = {}, {}
+    for v in _persistables(program):
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        (params if v.is_parameter else others)[v.name] = np.asarray(val)
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=protocol)
+    with open(model_path + ".pdopt", "wb") as f:
+        pickle.dump(others, f, protocol=protocol)
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    """reference: paddle.static.load."""
+    scope = global_scope()
+    want = {v.name for v in (var_list or _persistables(program))}
+    for suffix in (".pdparams", ".pdopt"):
+        path = model_path + suffix
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        for name, arr in blob.items():
+            if name in want:
+                scope.set(name, jnp.asarray(arr))
+
+
+def save_inference_model(path_prefix: str, feed_vars: List[Variable],
+                         fetch_vars, executor=None, program=None):
+    """reference: fluid/io.py save_inference_model:1152 — prunes the
+    program to the fetch targets and serializes it. Here the pruned
+    program is captured as a jax.export StableHLO artifact (the TPU-native
+    serialized-graph format) plus the persistable values it closes over."""
+    program = program or default_main_program()
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feed_names = [v.name for v in feed_vars]
+    fetch_names = [v.name for v in fetch_vars]
+    scope = global_scope()
+    reads, writes, feeds_needed = _analyze_program(program)
+    consts = dict(program._consts)
+    state = {}
+    for n in reads:
+        val = scope.find_var(n)
+        if val is None:
+            raise RuntimeError(f"save_inference_model: persistable {n} not "
+                               "initialized (run startup + training first)")
+        state[n] = val
+    rt = {k: jnp.asarray(fn()) for k, fn in program._runtime_scalars.items()}
+    ops = []
+    for od in program.ops:  # strip the training tail like clone(for_test)
+        if od.kind == "backward" or od.op_type.startswith("optimize"):
+            break
+        ops.append(od)
+
+    def infer_fn(*feed_arrays):
+        env = dict(consts)
+        env.update(state)
+        env.update(rt)
+        env.update(zip(feed_names, feed_arrays))
+        _interpret(ops, env, dict(env))
+        return tuple(env[n] for n in fetch_names)
+
+    from jax import export as jexport
+
+    def _args(symbolic):
+        out = []
+        for i, v in enumerate(feed_vars):
+            if symbolic and any(d == -1 for d in v.shape):
+                spec = ",".join(f"b{i}_{j}" if d == -1 else str(d)
+                                for j, d in enumerate(v.shape))
+                shape = jexport.symbolic_shape(spec)
+            else:
+                shape = tuple(1 if d == -1 else d for d in v.shape)
+            out.append(jax.ShapeDtypeStruct(shape, v._value.dtype))
+        return out
+
+    try:  # dynamic batch via symbolic dims; fall back to concrete shapes
+        exported = jexport.export(jax.jit(infer_fn))(*_args(True))
+    except Exception:
+        exported = jexport.export(jax.jit(infer_fn))(*_args(False))
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"feed_names": feed_names, "fetch_names": fetch_names},
+                    f)
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    """Returns (program_like, feed_names, fetch_names) where program_like
+    is a callable running the deserialized StableHLO artifact."""
+    from jax import export as jexport
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+
+    class _InferenceProgram:
+        def __init__(self, exported, meta):
+            self._exported = exported
+            self.feed_names = meta["feed_names"]
+            self.fetch_names = meta["fetch_names"]
+
+        def __call__(self, *arrays):
+            return self._exported.call(*[jnp.asarray(a) for a in arrays])
+
+    prog = _InferenceProgram(exported, meta)
+    return prog, prog.feed_names, prog.fetch_names

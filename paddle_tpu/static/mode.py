@@ -1,13 +1,27 @@
 """Execution-mode state (reference: fluid/framework.py in_dygraph_mode /
-paddle.enable_static). Dygraph is the default, as in paddle 2.0."""
+paddle.enable_static). Dygraph is the default, as in paddle 2.0.
+
+enable_static() installs the op-capture hook into core.dispatch: from then
+on, ops whose inputs include static Variables append OpDescs to the
+default Program instead of executing (see static/program.py)."""
+
 _static_mode = False
+
 
 def in_dynamic_mode():
     return not _static_mode
 
+
+def in_static_mode():
+    return _static_mode
+
+
 def enable_static():
     global _static_mode
+    from .program import install_capture_hook
+    install_capture_hook()
     _static_mode = True
+
 
 def disable_static():
     global _static_mode

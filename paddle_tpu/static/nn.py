@@ -1,0 +1,331 @@
+"""paddle.static.nn — static-graph layer functions.
+
+TPU-native analogue of /root/reference/python/paddle/static/nn/__init__.py
+(fc, conv2d, batch_norm, embedding, …) which route through
+fluid/layers/nn.py appending ops + parameters to the default program. Here
+the dygraph functional corpus already captures into the Program through
+the dispatch hook, so these helpers only add the parameter-creation
+convention (create_parameter into startup) on top of paddle.nn.functional.
+
+Control flow (cond / while_loop / case / switch_case) maps the reference's
+sub-block ops (operators/controlflow/conditional_block_op.cc, while_op.cc)
+onto lax.cond / lax.while_loop via nested capture: each branch body is
+captured into a sub-Program whose interpreter becomes a lax branch —
+compiler-friendly control flow instead of interpreter re-entry.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes as _dt
+from ..core.tensor import Tensor
+from . import program as _prog
+from .program import (OpDesc, Program, Variable, create_parameter,
+                      default_main_program, program_guard)
+
+
+def _flatten_to_2d(x, num_flatten_dims):
+    from ..ops import manipulation as M
+    if x.ndim == 2 and num_flatten_dims == 1:
+        return x
+    lead = int(np.prod([d for d in x.shape[:num_flatten_dims]]))
+    tail = int(np.prod([d for d in x.shape[num_flatten_dims:]]))
+    return M.reshape(x, [lead if lead > 0 else -1, tail])
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: python/paddle/static/nn/common.py fc → fluid layers fc."""
+    from ..nn.layer.base import ParamAttr
+    from ..nn import initializer as I
+    from ..ops import linalg as L
+    in_dim = int(np.prod([d for d in x.shape[num_flatten_dims:]]))
+    wa = weight_attr if isinstance(weight_attr, ParamAttr) else ParamAttr()
+    w = create_parameter([in_dim, size], x._value.dtype,
+                         name=wa.name, initializer=wa.initializer,
+                         trainable=wa.trainable)
+    x2 = _flatten_to_2d(x, num_flatten_dims)
+    out = L.matmul(x2, w)
+    if bias_attr is not False:
+        ba = bias_attr if isinstance(bias_attr, ParamAttr) else ParamAttr()
+        b = create_parameter([size], x._value.dtype, name=ba.name,
+                             initializer=ba.initializer or I.Constant(0.0),
+                             trainable=ba.trainable)
+        out = out + b
+    if activation:
+        from ..nn import functional as F
+        out = getattr(F, activation)(out)
+    if num_flatten_dims != 1 or x.ndim != 2:
+        from ..ops import manipulation as M
+        out = M.reshape(out, [d for d in x.shape[:num_flatten_dims]] + [size])
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """reference: static/nn embedding → lookup_table_v2."""
+    from ..nn.layer.base import ParamAttr
+    from ..nn import initializer as I
+    from ..nn import functional as F
+    pa = param_attr if isinstance(param_attr, ParamAttr) else ParamAttr()
+    w = create_parameter(list(size), dtype, name=pa.name,
+                         initializer=pa.initializer or I.XavierNormal(),
+                         trainable=pa.trainable)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    """reference: fluid/layers/nn.py conv2d."""
+    from ..nn.layer.base import ParamAttr
+    from ..nn import initializer as I
+    from ..nn import functional as F
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    c_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    pa = param_attr if isinstance(param_attr, ParamAttr) else ParamAttr()
+    w = create_parameter(
+        [num_filters, c_in // groups] + list(filter_size),
+        input._value.dtype, name=pa.name,
+        initializer=pa.initializer or I.KaimingNormal(),
+        trainable=pa.trainable)
+    b = None
+    if bias_attr is not False:
+        ba = bias_attr if isinstance(bias_attr, ParamAttr) else ParamAttr()
+        b = create_parameter([num_filters], input._value.dtype, name=ba.name,
+                             initializer=ba.initializer or I.Constant(0.0),
+                             trainable=ba.trainable)
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None):
+    """reference: fluid/layers/nn.py batch_norm (stat vars are persistable
+    and updated by ops in the program)."""
+    from ..nn import initializer as I
+    from ..nn import functional as F
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = input._value.dtype
+    scale = create_parameter([c], dtype, initializer=I.Constant(1.0))
+    bias = create_parameter([c], dtype, initializer=I.Constant(0.0))
+    mean = persistable_buffer(np.zeros([c], np.dtype(dtype).name), "bn_mean")
+    var = persistable_buffer(np.ones([c], np.dtype(dtype).name), "bn_var")
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None):
+    from ..nn import functional as F
+    return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def persistable_buffer(value, prefix="buffer", name=None):
+    """Create a persistable non-parameter var initialized to `value` in the
+    startup program (the static home of running stats and counters)."""
+    main = default_main_program()
+    from .program import default_startup_program
+    startup = default_startup_program()
+    value = jnp.asarray(value)
+    name = name or main.unique_name(prefix)
+    v = main.global_block.create_var(name=name, shape=value.shape,
+                                     dtype=value.dtype, persistable=True)
+    startup.global_block.create_var(name=name, shape=value.shape,
+                                    dtype=value.dtype, persistable=True)
+    startup.global_block.append_op(
+        OpDesc("init", "fill_buffer", lambda v=value: v, [], [name]))
+    return v
+
+
+def static_assign(target: Variable, value):
+    """Append an op that rebinds `target`'s name to `value` (the static
+    analogue of in-place buffer update; reference: assign op +
+    program-ordered writes)."""
+    blk = target.block
+    blk.append_op(OpDesc("op", "assign_out", lambda v: v, [value.name],
+                         [target.name]))
+    return target
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: fluid/layers/tensor.py create_global_var."""
+    arr = np.full(tuple(shape), value, dtype=_dt.convert_dtype(dtype))
+    return persistable_buffer(arr, name=name or None, prefix="global_var")
+
+
+# ------------------------------------------------------------- control flow
+def _capture_subprogram(fn, arg_vars):
+    """Trace `fn` over fresh Variables into a sub-Program; returns
+    (sub_program, out_vars, out_tree). Nested capture is the analogue of
+    the reference's sub-block construction (conditional_block_op.cc)."""
+    sub = Program()
+    # the sub program shares the outer symbol table through captured
+    # closure values: ops record input *names*; inner ops referencing outer
+    # vars resolve at interpret time because the interpreter env is seeded
+    # with every outer value (see cond below)
+    with program_guard(sub):
+        blk = sub.global_block
+        inner_args = []
+        for v in arg_vars:
+            nv = blk.create_var(name=v.name, shape=v.shape,
+                                dtype=v._value.dtype)
+            inner_args.append(nv)
+        out = fn(*inner_args) if inner_args else fn()
+    flat, tree = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    return sub, flat, tree
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference: fluid/layers/control_flow.py cond →
+    conditional_block_op.cc. Lowers to lax.cond: both branches are captured
+    sub-programs interpreted inside the lax branches, so the compiled
+    module contains real XLA conditionals (no host round-trip)."""
+    from .executor import _interpret
+    prog = default_main_program()
+    blk = prog.current_block()
+
+    true_sub, t_out, t_tree = _capture_subprogram(true_fn, [])
+    false_sub, f_out, f_tree = _capture_subprogram(false_fn, [])
+    if len(t_out) != len(f_out):
+        raise ValueError("cond: true_fn and false_fn must return the same "
+                         "structure (reference cond requirement)")
+
+    # free variables of each sub-program = inputs read but never produced
+    def free_vars(sub):
+        produced = set(sub._consts)
+        free = []
+        for od in sub.global_block.ops:
+            for n in od.input_names:
+                if n not in produced and n not in free:
+                    free.append(n)
+            produced.update(od.output_names)
+        return free
+
+    t_free, f_free = free_vars(true_sub), free_vars(false_sub)
+    free = list(dict.fromkeys(t_free + f_free))
+    t_consts, f_consts = dict(true_sub._consts), dict(false_sub._consts)
+    t_ops = list(true_sub.global_block.ops)
+    f_ops = list(false_sub.global_block.ops)
+    t_names = [v.name for v in t_out]
+    f_names = [v.name for v in f_out]
+
+    def run_branch(ops, consts, out_names, freevals):
+        env = dict(consts)
+        env.update(zip(free, freevals))
+        _interpret(ops, env, dict(env))
+        return tuple(env[n] for n in out_names)
+
+    def cond_fn(predv, *freevals):
+        return jax.lax.cond(
+            jnp.reshape(predv, ()).astype(bool),
+            lambda ops=t_ops: run_branch(t_ops, t_consts, t_names, freevals),
+            lambda ops=f_ops: run_branch(f_ops, f_consts, f_names, freevals))
+
+    out_shapes = [jax.ShapeDtypeStruct(tuple(v._value.shape),
+                                       v._value.dtype) for v in t_out]
+    out_vars = [blk.create_var(name=prog.unique_name("cond.out"),
+                               shape=s.shape, dtype=s.dtype)
+                for s in out_shapes]
+    blk.append_op(OpDesc("op", "conditional_block", cond_fn,
+                         [pred.name] + free, [v.name for v in out_vars]))
+    res = jax.tree_util.tree_unflatten(t_tree, out_vars)
+    return res
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """reference: fluid/layers/control_flow.py while_loop → while_op.cc.
+    Lowers to lax.while_loop over the captured cond/body sub-programs."""
+    from .executor import _interpret
+    prog = default_main_program()
+    blk = prog.current_block()
+    loop_vars = list(loop_vars)
+
+    c_sub, c_out, _ = _capture_subprogram(lambda *a: cond_fn(*a), loop_vars)
+    b_sub, b_out, b_tree = _capture_subprogram(
+        lambda *a: body_fn(*a), loop_vars)
+    if len(b_out) != len(loop_vars):
+        raise ValueError("while_loop body must return the same number of "
+                         "vars as loop_vars")
+
+    lnames = [v.name for v in loop_vars]
+
+    def free_of(sub):
+        produced = set(sub._consts) | set(lnames)
+        free = []
+        for od in sub.global_block.ops:
+            for n in od.input_names:
+                if n not in produced and n not in free:
+                    free.append(n)
+            produced.update(od.output_names)
+        return free
+
+    free = list(dict.fromkeys(free_of(c_sub) + free_of(b_sub)))
+    c_ops, c_consts = list(c_sub.global_block.ops), dict(c_sub._consts)
+    b_ops, b_consts = list(b_sub.global_block.ops), dict(b_sub._consts)
+    c_name = c_out[0].name
+    b_names = [v.name for v in b_out]
+
+    def while_fn(*args):
+        lvals = args[:len(lnames)]
+        freevals = args[len(lnames):]
+
+        def cond_body(carry):
+            env = dict(c_consts)
+            env.update(zip(free, freevals))
+            env.update(zip(lnames, carry))
+            _interpret(c_ops, env, dict(env))
+            return jnp.reshape(env[c_name], ()).astype(bool)
+
+        def body_body(carry):
+            env = dict(b_consts)
+            env.update(zip(free, freevals))
+            env.update(zip(lnames, carry))
+            _interpret(b_ops, env, dict(env))
+            return tuple(env[n] for n in b_names)
+
+        return jax.lax.while_loop(cond_body, body_body, tuple(lvals))
+
+    out_vars = [blk.create_var(name=prog.unique_name("while.out"),
+                               shape=v._value.shape, dtype=v._value.dtype)
+                for v in loop_vars]
+    blk.append_op(OpDesc("op", "while", while_fn, lnames + free,
+                         [v.name for v in out_vars]))
+    return out_vars
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: control_flow.py case — chained conds."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: control_flow.py switch_case."""
+    pairs = []
+    from ..ops import logic as Lg
+    for idx, fn in (branch_fns.items() if isinstance(branch_fns, dict)
+                    else enumerate(branch_fns)):
+        pairs.append((branch_index == idx, fn))
+    return case(pairs, default=default)

@@ -1,0 +1,382 @@
+"""Static graph IR: Program / Block / Variable and the op-capture hook.
+
+TPU-native analogue of the reference's ProgramDesc stack
+(/root/reference/python/paddle/fluid/framework.py — class Variable:938,
+Block:2096, Program:3900, program_guard:5560; C++ ProgramDesc
+paddle/fluid/framework/program_desc.h). The reference captures ops into a
+protobuf ProgramDesc interpreted by an SSA executor; here a Program records
+*pure JAX closures* (one per framework op, exactly the closures the eager
+dispatcher would have executed) plus the variable names wiring them. The
+Executor then interprets the op list inside one `jax.jit`, so a whole
+Program compiles to a single fused XLA module — the static-graph pillar
+re-based on XLA tracing instead of an SSA graph IR.
+
+Capture piggybacks on core.dispatch: when static mode is enabled and an op
+sees a `Variable` input, the dispatch hook appends an OpDesc to the current
+block and returns output Variables whose shapes/dtypes come from
+jax.eval_shape (the analogue of the reference's InferShape/InferVarType
+pass, operator.cc RuntimeInferShapeContext).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from . import mode as _mode
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (reference: framework.py Variable:938).
+
+    `_value` holds a jax.ShapeDtypeStruct — shape/dtype metadata flow
+    through the whole Tensor method surface, while any attempt to read a
+    concrete value (numpy()/item()) fails, matching static-graph semantics.
+    Dims declared as None/-1 are stored in `.shape` and replaced by 1 for
+    shape inference (ops must treat the batch dim symbolically, which all
+    jnp-level op bodies do).
+    """
+
+    def __init__(self, shape, dtype, name: str, block: "Block",
+                 persistable: bool = False, stop_gradient: bool = True,
+                 is_data: bool = False):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        declared = [(-1 if d is None or (isinstance(d, int) and d < 0) else
+                     int(d)) for d in shape]
+        placeholder = tuple(1 if d == -1 else d for d in declared)
+        self._value = jax.ShapeDtypeStruct(placeholder, jnp.dtype(dtype))
+        self._declared_shape = declared
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = persistable
+        self._hooks = []
+        self._retain_grads = False
+        self._inplace_version = 0
+        self.is_parameter = False
+        self._partition_spec = None
+        self.block = block
+        self.is_data = is_data
+        self.trainable = not stop_gradient
+
+    @property
+    def shape(self):
+        return list(self._declared_shape)
+
+    @property
+    def ndim(self):
+        return len(self._declared_shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value at graph-build time; "
+            "fetch it through Executor.run (reference: static Variables are "
+            "symbolic, framework.py:938)")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={_dt.dtype_name(self._value.dtype)}, "
+                f"persistable={self.persistable})")
+
+    __str__ = __repr__
+
+
+class OpDesc:
+    """One recorded op (reference: framework.py Operator / C++ OpDesc).
+
+    kind:
+      'op'       — fn is a pure positional closure over input arrays
+      'init'     — nullary fn producing a persistable's startup value
+      'backward' — payload = (fwd_ops, loss_name, param_names); the
+                   Executor differentiates the recorded forward with
+                   jax.grad (the analogue of append_backward's per-op grad
+                   composition, reference backward.py:1337 — here JAX owns
+                   the chain rule and XLA CSEs the recomputed forward)
+    """
+
+    __slots__ = ("kind", "op_type", "fn", "input_names", "output_names",
+                 "payload")
+
+    def __init__(self, kind, op_type, fn, input_names, output_names,
+                 payload=None):
+        self.kind = kind
+        self.op_type = op_type
+        self.fn = fn
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+        self.payload = payload
+
+    @property
+    def type(self):
+        return self.op_type
+
+    def __repr__(self):
+        return (f"{{{self.op_type}: ({', '.join(self.input_names)}) -> "
+                f"({', '.join(self.output_names)})}}")
+
+
+class Block:
+    """Op/var container (reference: framework.py Block:2096). The flagship
+    path uses a single block per program; sub-blocks for control flow are
+    modelled as nested captured programs (see static.nn.cond)."""
+
+    def __init__(self, program: "Program", idx: int = 0):
+        self.program = program
+        self.idx = idx
+        self.ops: List[OpDesc] = []
+        self.vars: Dict[str, Variable] = collections.OrderedDict()
+
+    def create_var(self, name=None, shape=(), dtype="float32",
+                   persistable=False, stop_gradient=True, is_data=False):
+        name = name or self.program.unique_name("tmp")
+        v = Variable(shape, dtype, name, self, persistable=persistable,
+                     stop_gradient=stop_gradient, is_data=is_data)
+        self.vars[name] = v
+        return v
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise ValueError(f"Variable {name} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def append_op(self, od: OpDesc):
+        self.ops.append(od)
+        self.program._version += 1
+        return od
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if v.is_parameter]
+
+
+class Program:
+    """An op list + symbol table, compiled as one XLA module by the
+    Executor (reference: framework.py Program:3900 / ProgramDesc)."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self._version = 0
+        self._name_counter = collections.defaultdict(int)
+        self._consts: Dict[str, jax.Array] = {}
+        # runtime scalars: evaluated on the host at every Executor.run and
+        # fed as inputs (e.g. scheduler-driven learning rates) so changing
+        # them never recompiles
+        self._runtime_scalars: Dict[str, Callable[[], np.ndarray]] = {}
+        self.random_seed = 0
+
+    # ------------------------------------------------------------ structure
+    @property
+    def global_block(self):
+        return self.blocks[0]
+
+    def block(self, idx=0):
+        return self.blocks[idx]
+
+    def current_block(self):
+        return self.blocks[-1]
+
+    @property
+    def ops(self):
+        return self.global_block.ops
+
+    def unique_name(self, prefix="tmp"):
+        self._name_counter[prefix] += 1
+        return f"{prefix}_{self._name_counter[prefix]}"
+
+    def all_parameters(self):
+        return self.global_block.all_parameters()
+
+    def list_vars(self):
+        return list(self.global_block.vars.values())
+
+    def add_const(self, value) -> str:
+        name = self.unique_name("const")
+        self._consts[name] = value
+        return name
+
+    def add_runtime_scalar(self, prefix: str, fn: Callable) -> str:
+        name = self.unique_name(prefix)
+        self._runtime_scalars[name] = fn
+        return name
+
+    # ------------------------------------------------------------- clone
+    def clone(self, for_test: bool = False) -> "Program":
+        """reference: Program.clone (framework.py:4400). for_test=True
+        keeps only ops up to (excluding) the first backward/optimizer op —
+        the static analogue of stripping the training tail. Note: ops
+        captured with training-time behavior (dropout masks, BN batch
+        stats) keep it; build the eval program under a separate
+        program_guard for exact eval semantics."""
+        p = Program()
+        p._name_counter = collections.Counter(self._name_counter)
+        p._consts = dict(self._consts)
+        p._runtime_scalars = dict(self._runtime_scalars)
+        blk = p.global_block
+        ops = self.global_block.ops
+        if for_test:
+            cut = len(ops)
+            for i, od in enumerate(ops):
+                if od.kind == "backward" or od.op_type.startswith("optimize"):
+                    cut = i
+                    break
+            ops = ops[:cut]
+        blk.ops = list(ops)
+        for name, v in self.global_block.vars.items():
+            nv = Variable(v.shape, v._value.dtype, name, blk,
+                          persistable=v.persistable,
+                          stop_gradient=v.stop_gradient, is_data=v.is_data)
+            nv.is_parameter = v.is_parameter
+            nv.trainable = getattr(v, "trainable", True)
+            blk.vars[name] = nv
+        return p
+
+    def __repr__(self):
+        lines = [f"Program(ops={len(self.ops)})"]
+        for od in self.ops:
+            lines.append("  " + repr(od))
+        return "\n".join(lines)
+
+    __str__ = __repr__
+
+
+# ------------------------------------------------------------------ defaults
+_default_main_program = Program()
+_default_startup_program = Program()
+_program_stack: List[tuple] = []
+
+
+def default_main_program() -> Program:
+    return _default_main_program
+
+
+def default_startup_program() -> Program:
+    return _default_startup_program
+
+
+def set_default_programs(main, startup):
+    global _default_main_program, _default_startup_program
+    _default_main_program = main
+    _default_startup_program = startup
+
+
+class program_guard:
+    """reference: framework.py program_guard:5560."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _default_main_program, _default_startup_program
+        _program_stack.append((_default_main_program,
+                               _default_startup_program))
+        _default_main_program = self.main
+        if self.startup is not None:
+            _default_startup_program = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        global _default_main_program, _default_startup_program
+        _default_main_program, _default_startup_program = _program_stack.pop()
+        return False
+
+
+# ------------------------------------------------------------------- capture
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference: python/paddle/static/input.py data)."""
+    blk = default_main_program().current_block()
+    if blk.has_var(name):
+        return blk.var(name)
+    return blk.create_var(name=name, shape=shape, dtype=dtype,
+                          persistable=False, stop_gradient=True,
+                          is_data=True)
+
+
+def _capture_hook(op_type, pure, in_tensors, differentiable=True):
+    """Installed into core.dispatch as the static capture hook. Returns
+    output Variables when capturing, or None to fall through to eager
+    execution (static mode off, or no Variable inputs → constant fold)."""
+    if not _mode._static_mode:
+        return None
+    if not any(isinstance(t, Variable) for t in in_tensors):
+        return None
+    prog = default_main_program()
+    blk = prog.current_block()
+    in_names, avals = [], []
+    for t in in_tensors:
+        if isinstance(t, Variable):
+            in_names.append(t.name)
+            avals.append(t._value)
+        else:
+            # concrete tensor mixed into the graph: bake as a constant
+            # (reference: literals become persistable vars filled by
+            # fill_constant in the startup program)
+            cname = prog.add_const(t._value)
+            in_names.append(cname)
+            avals.append(jax.ShapeDtypeStruct(t._value.shape,
+                                              t._value.dtype))
+    out_shapes = jax.eval_shape(pure, *avals)
+    flat, tree = jax.tree_util.tree_flatten(out_shapes)
+    stop = (not differentiable) or all(t.stop_gradient for t in in_tensors)
+    out_vars = []
+    for s in flat:
+        v = blk.create_var(name=prog.unique_name(f"{op_type}.out"),
+                           shape=s.shape, dtype=s.dtype,
+                           stop_gradient=stop)
+        out_vars.append(v)
+    blk.append_op(OpDesc("op", op_type, pure, in_names,
+                         [v.name for v in out_vars]))
+    return jax.tree_util.tree_unflatten(tree, out_vars)
+
+
+def create_parameter(shape, dtype, name=None, initializer=None,
+                     trainable=True, regularizer=None, learning_rate=1.0,
+                     need_clip=True, do_model_average=None):
+    """Create a parameter Variable in the default main program with its
+    init op in the startup program (reference: layer_helper_base.py
+    create_parameter + initializer ops appended to startup,
+    fluid/initializer.py)."""
+    from ..nn import initializer as I
+    main = default_main_program()
+    startup = default_startup_program()
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    name = name or main.unique_name("param")
+    blk = main.global_block
+    v = blk.create_var(name=name, shape=shape, dtype=dtype, persistable=True,
+                       stop_gradient=not trainable)
+    v.is_parameter = True
+    v.trainable = trainable
+    v.optimize_attr = {"learning_rate": learning_rate}
+    v.regularizer = regularizer
+    v.need_clip = need_clip
+    v.do_model_average = do_model_average
+    init = initializer or I.XavierNormal()
+    shape_t, dtype_t = tuple(shape), dtype
+
+    def init_fn(init=init, shape=shape_t, dtype=dtype_t):
+        val = init(shape, dtype)
+        return val._value if isinstance(val, Tensor) else jnp.asarray(val)
+
+    startup.global_block.append_op(
+        OpDesc("init", "fill_parameter", init_fn, [], [name]))
+    # mirror the var into the startup program's symbol table so
+    # Executor.run(startup) knows it writes a persistable
+    sv = startup.global_block.create_var(
+        name=name, shape=shape, dtype=dtype, persistable=True)
+    sv.is_parameter = True
+    return v
+
+
+def install_capture_hook():
+    from ..core import dispatch as _dispatch
+    _dispatch._static_capture_hook = _capture_hook
