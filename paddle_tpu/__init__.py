@@ -68,6 +68,7 @@ from . import hapi  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from .hapi import Model, summary, flops  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
 from .framework_io import save, load  # noqa: F401,E402
 
 from .nn.layer.base import ParamAttr  # noqa: F401,E402

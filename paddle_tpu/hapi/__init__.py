@@ -1,6 +1,12 @@
-def Model(*a, **k):
-    raise NotImplementedError("hapi.Model: implemented later this round")
-def summary(*a, **k):
-    raise NotImplementedError
-def flops(*a, **k):
-    raise NotImplementedError
+"""paddle hapi — the high-level Model.fit API.
+
+TPU-native analogue of /root/reference/python/paddle/hapi/ (model.py
+Model:810, callbacks.py, model_summary.py, dynamic_flops.py). See
+hapi/model.py for the compiled-by-default redesign.
+"""
+from .model import Model  # noqa: F401
+from .model_summary import summary, flops  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+)

@@ -1,0 +1,348 @@
+"""hapi Model: the high-level train/eval/predict API.
+
+TPU-native analogue of /root/reference/python/paddle/hapi/model.py
+(class Model:810 — fit:1299, evaluate:1489, predict:1570, prepare:1244,
+train_batch:903, save:1028, load:1083) with the DynamicGraphAdapter
+(model.py:598) replaced by compiled-by-default execution: train_batch runs
+a jit.TrainStep (forward+backward+optimizer as ONE XLA module) and
+eval/predict batches run a jitted functional forward. The reference runs
+eager per-op dispatch in dygraph; on TPU the compiled step is the whole
+point, so hapi users get it for free.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as _random
+from ..nn.layer.layers import Layer
+from ..io.dataloader import DataLoader
+from ..metric.metrics import Metric
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_arrays(batch):
+    out = []
+    for b in _to_list(batch):
+        if isinstance(b, Tensor):
+            out.append(b._value)
+        else:
+            out.append(jnp.asarray(np.asarray(b)))
+    return out
+
+
+class Model:
+    """reference: hapi/model.py Model:810."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_fn = None
+        self._predict_fn = None
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """reference: model.py prepare:1244."""
+        self._optimizer = optimizer
+        if loss is not None and not isinstance(loss, Layer) \
+                and not callable(loss):
+            raise TypeError("loss must be a Layer or a callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle.metric.Metric, "
+                                f"got {type(m)}")
+        self._train_step = None
+        self._eval_fn = None
+        self._predict_fn = None
+        return self
+
+    def _split_batch(self, batch):
+        """A DataLoader batch is [inputs..., labels...]; the split point is
+        len(self._inputs) when declared, else all-but-last as inputs
+        (reference: model.py same heuristic for None inputs)."""
+        batch = _to_list(batch)
+        if self._inputs:
+            n = len(self._inputs)
+        elif self._loss is not None:
+            n = max(1, len(batch) - max(1, len(self._labels) or 1))
+        else:
+            n = len(batch)
+        return batch[:n], batch[n:]
+
+    def _loss_value(self, outputs, labels):
+        outs = _to_list(outputs)
+        loss = self._loss(*(outs + labels)) if self._loss else outs[0]
+        return loss
+
+    # ------------------------------------------------------ batch-level API
+    def train_batch(self, inputs, labels=None):
+        """reference: model.py train_batch:903 — here one fused XLA step."""
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer, loss) before "
+                               "training (reference model.py:1244)")
+        from ..jit import TrainStep
+        self.network.train()
+        if self._train_step is None:
+            def loss_fn(model, *args):
+                n_in = len(_to_list(inputs))
+                outs = model(*args[:n_in])
+                loss = self._loss_value(outs, list(args[n_in:]))
+                return (loss,) + tuple(_to_list(outs))
+
+            self._train_step = TrainStep(self.network, loss_fn,
+                                         self._optimizer,
+                                         return_outputs=True)
+        args = _as_arrays(_to_list(inputs) + _to_list(labels))
+        loss, out = self._train_step(*args)
+        outputs = list(out)[1:]
+        metrics = self._update_metrics(outputs, _to_list(labels))
+        return ([float(loss.numpy())], metrics) if self._metrics \
+            else [float(loss.numpy())]
+
+    def _build_eval_fn(self):
+        from ..jit import _FunctionalizedLayer
+        inner = _FunctionalizedLayer(lambda *a: self.network(*a),
+                                     self.network)
+
+        def f(params, buffers, key, *args):
+            out, _ = inner.pure_call(params, buffers, key, args, {})
+            return out
+
+        jitted = jax.jit(f)
+
+        def run(*args):
+            params = {k: p._value for k, p in
+                      self.network.named_parameters()}
+            buffers = {k: b._value for k, b in self.network.named_buffers()
+                       if b is not None}
+            return jitted(params, buffers, _random.next_key(), *args)
+        return run
+
+    def eval_batch(self, inputs, labels=None):
+        """reference: model.py eval_batch:944."""
+        self.network.eval()
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_fn()
+        out = self._eval_fn(*_as_arrays(inputs))
+        outputs = [Tensor(o) for o in _to_list(out)]
+        labels = _to_list(labels)
+        losses = []
+        if self._loss is not None and labels:
+            lv = self._loss_value(outputs, [
+                l if isinstance(l, Tensor) else Tensor(jnp.asarray(
+                    np.asarray(l))) for l in labels])
+            losses = [float(lv.numpy())]
+        metrics = self._update_metrics(outputs, labels)
+        if self._metrics:
+            return (losses, metrics) if losses else metrics
+        return losses
+
+    def predict_batch(self, inputs):
+        """reference: model.py predict_batch:985."""
+        self.network.eval()
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_fn()
+        out = self._eval_fn(*_as_arrays(inputs))
+        return [np.asarray(o) for o in _to_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        results = []
+        labels = [l if isinstance(l, Tensor) else
+                  Tensor(jnp.asarray(np.asarray(l))) for l in labels]
+        for m in self._metrics:
+            inp = m.compute(*( _to_list(outputs) + labels))
+            r = m.update(*[np.asarray(i.numpy() if isinstance(i, Tensor)
+                                      else i) for i in _to_list(inp)])
+            results.append(r)
+        return results[0] if len(results) == 1 else results
+
+    # ------------------------------------------------------------ loop API
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        """reference: model.py fit:1299."""
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        self._save_dir = save_dir
+        steps = len(train_loader) if hasattr(train_loader, "__len__") \
+            else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train")
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                logs.update({"eval_" + k if not k.startswith("eval_")
+                             else k: v for k, v in eval_logs.items()})
+        cbks.on_train_end(logs if epochs else {})
+        return self
+
+    def _metrics_name(self):
+        return ["loss"] + [m.name() for m in self._metrics]
+
+    def _run_one_epoch(self, loader, cbks, mode):
+        logs = {}
+        for m in self._metrics:
+            m.reset()
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            cbks.on_train_batch_begin(step)
+            res = self.train_batch(inputs, labels)
+            if self._metrics:
+                losses, _ = res
+            else:
+                losses = res
+            logs = {"loss": losses}
+            for m in self._metrics:
+                r = m.accumulate()
+                name = m.name()
+                if isinstance(name, (list, tuple)):
+                    logs.update(dict(zip(name, _to_list(r))))
+                else:
+                    logs[name] = r
+            cbks.on_train_batch_end(step, logs)
+            if self.stop_training:
+                break
+        return logs
+
+    def _run_eval(self, loader, cbks):
+        cbks.on_eval_begin({"steps": len(loader)
+                            if hasattr(loader, "__len__") else None})
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            cbks.on_eval_batch_begin(step)
+            res = self.eval_batch(inputs, labels)
+            if self._loss is not None and self._metrics:
+                bl, _ = res
+                losses.extend(_to_list(bl))
+            elif self._loss is not None:
+                losses.extend(_to_list(res))
+            logs = {}
+            if losses:
+                logs["loss"] = [float(np.mean(losses))]
+            for m in self._metrics:
+                r = m.accumulate()
+                name = m.name()
+                if isinstance(name, (list, tuple)):
+                    logs.update(dict(zip(name, _to_list(r))))
+                else:
+                    logs[name] = r
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        """reference: model.py evaluate:1489 — returns the metric dict."""
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        """reference: model.py predict:1570."""
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=[])
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            inputs, _ = self._split_batch(batch)
+            cbks.on_predict_batch_begin(step)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end()
+        # transpose: list-per-batch -> list-per-output
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    # ------------------------------------------------------------ state i/o
+    def save(self, path, training=True):
+        """reference: model.py save:1028 — <path>.pdparams (+ .pdopt)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework_io import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        """reference: model.py load:1083."""
+        from ..framework_io import load as _load
+        state = _load(path + ".pdparams")
+        if skip_mismatch:
+            own = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in own and tuple(np.asarray(v.numpy()
+                        if isinstance(v, Tensor) else v).shape)
+                     == tuple(own[k].shape)}
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+        self._train_step = None
+        self._eval_fn = None
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        """reference: model.py summary:1669 → hapi/model_summary.py."""
+        from .model_summary import summary as _summary
+        if input_size is None and self._inputs:
+            input_size = [tuple(s.shape) for s in self._inputs]
+        return _summary(self.network, input_size, dtypes=dtype)

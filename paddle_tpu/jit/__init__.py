@@ -281,10 +281,11 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True):
+                 donate: bool = True, return_outputs: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.return_outputs = return_outputs
         self._opt_state = None
         inner = _FunctionalizedLayer(
             lambda *args: loss_fn(model, *args), model)
@@ -308,6 +309,8 @@ class TrainStep:
                 grads = dict(zip(names, clipped))
             new_params, new_opt = optimizer.apply_updates(
                 params, grads, opt_state, lr)
+            if return_outputs:
+                return loss, new_params, new_buffers, new_opt, out
             return loss, new_params, new_buffers, new_opt
 
         donate_argnums = (0, 3) if donate else ()
@@ -334,8 +337,12 @@ class TrainStep:
                     for a in args]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.next_key()
-        loss, new_params, new_buffers, self._opt_state = self._step(
-            params, frozen, buffers, self._opt_state, lr, key, *arr_args)
+        res = self._step(params, frozen, buffers, self._opt_state, lr, key,
+                         *arr_args)
+        if self.return_outputs:
+            loss, new_params, new_buffers, self._opt_state, out = res
+        else:
+            loss, new_params, new_buffers, self._opt_state = res
         named_p = dict(self.model.named_parameters())
         for k, v in new_params.items():
             named_p[k]._value = v
@@ -343,4 +350,6 @@ class TrainStep:
         for k, v in new_buffers.items():
             named_b[k]._value = v
         self.optimizer._global_step += 1
+        if self.return_outputs:
+            return Tensor(loss), jax.tree_util.tree_map(Tensor, out)
         return Tensor(loss)
