@@ -18,13 +18,22 @@ from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 
 def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
-    """paddle.amp.decorate (O2: cast model params to low precision;
-    reference: pure-fp16 cast_model_to_fp16, fluid/contrib/mixed_precision/
-    fp16_utils.py:306)."""
+    """paddle.amp.decorate (O2: cast model params to low precision, keep
+    fp32 master weights in the optimizer; reference: pure-fp16
+    cast_model_to_fp16 fluid/contrib/mixed_precision/fp16_utils.py:306 +
+    optimizer _multi_precision master copies)."""
     if level == "O2" and models is not None:
         items = models if isinstance(models, (list, tuple)) else [models]
         for m in items:
             m.to(dtype=dtype)
+    if optimizers is not None:
+        opts = optimizers if isinstance(optimizers, (list, tuple)) \
+            else [optimizers]
+        for o in opts:
+            # default: master weights on for O2 (paddle default True)
+            o._multi_precision = (master_weight
+                                  if master_weight is not None
+                                  else level == "O2")
     if optimizers is None:
         return models
     return models, optimizers

@@ -42,6 +42,11 @@ class Optimizer:
         # name of the parameter currently being updated (for policies that
         # exempt by name, e.g. AdamW's apply_decay_param_fun)
         self._current_param_name = None
+        # multi_precision / master weights (reference: fluid/optimizer.py
+        # _multi_precision + _master_weights dict; amp O2 keeps an fp32
+        # master copy of each low-precision param and updates that): set by
+        # paddle.amp.decorate(master_weight=True) or directly.
+        self._multi_precision = False
 
     # ------------------------------------------------------------------ lr
     def get_lr(self) -> float:
@@ -62,12 +67,35 @@ class Optimizer:
                                                  LRScheduler) else None
 
     # ------------------------------------------------------------- core api
+    def _lowp(self, arr) -> bool:
+        return self._multi_precision and arr.dtype in (jnp.bfloat16,
+                                                       jnp.float16)
+
     def _state_for(self, p: Tensor) -> Dict[str, jax.Array]:
         st = self._accumulators.get(id(p))
         if st is None:
-            st = self._init_state(p._value)
+            if self._lowp(p._value):
+                st = self._init_state(p._value.astype(jnp.float32))
+                st["master"] = p._value.astype(jnp.float32)
+            else:
+                st = self._init_state(p._value)
             self._accumulators[id(p)] = st
         return st
+
+    def _apply_one(self, parr, garr, state, lr):
+        """One param update honoring master weights: low-precision params
+        update their fp32 master and re-cast (reference:
+        fluid/optimizer.py _append_optimize_op multi_precision path)."""
+        if "master" in state:
+            inner = {k: v for k, v in state.items() if k != "master"}
+            new_master, new_inner = self._update(
+                state["master"], garr.astype(jnp.float32), inner,
+                jnp.asarray(lr, jnp.float32) if not hasattr(lr, "dtype")
+                else lr.astype(jnp.float32))
+            new_inner = dict(new_inner)
+            new_inner["master"] = new_master
+            return new_master.astype(parr.dtype), new_inner
+        return self._update(parr, garr, state, lr)
 
     def _init_state(self, param) -> Dict[str, jax.Array]:
         return {}
@@ -96,7 +124,8 @@ class Optimizer:
                 state = self._state_for(p)
                 p_lr = lr * self._param_lr(p).get("learning_rate", 1.0)
                 self._current_param_name = p.name
-                new_p, new_state = self._update(p._value, garr, state, p_lr)
+                new_p, new_state = self._apply_one(p._value, garr, state,
+                                                   p_lr)
                 p._value = new_p
                 self._accumulators[id(p)] = new_state
             self._global_step += 1
@@ -203,7 +232,8 @@ class Optimizer:
                 if g is None:
                     continue
                 state = self._state_for(p)
-                new_p, new_state = self._update(p._value, g._value, state, lr)
+                new_p, new_state = self._apply_one(p._value, g._value,
+                                                   state, lr)
                 p._value = new_p
                 self._accumulators[id(p)] = new_state
             self._global_step += 1
@@ -245,7 +275,15 @@ class Optimizer:
     # ---------------------------------------------- functional (jit) bridge
     def init_opt_state(self, flat_params: Dict[str, jax.Array]):
         """Build a pure pytree of optimizer state for functional steps."""
-        return {k: self._init_state(v) for k, v in flat_params.items()}
+        out = {}
+        for k, v in flat_params.items():
+            if self._lowp(v):
+                st = self._init_state(v.astype(jnp.float32))
+                st["master"] = v.astype(jnp.float32)
+            else:
+                st = self._init_state(v)
+            out[k] = st
+        return out
 
     def apply_updates(self, flat_params, flat_grads, opt_state, lr=None):
         """Pure functional update over name→array pytrees (used inside
@@ -263,9 +301,14 @@ class Optimizer:
             # cast lr to the param dtype so bf16/f16 params stay low
             # precision (a strongly-typed f32 lr array would promote the
             # whole update to f32)
-            lr_k = lr.astype(p.dtype) if hasattr(lr, "astype") and \
-                hasattr(p, "dtype") and p.dtype != getattr(lr, "dtype", None) \
-                else lr
+            lr_k = lr
+            if "master" not in opt_state[k] and hasattr(lr, "astype") and \
+                    hasattr(p, "dtype") and p.dtype != getattr(lr, "dtype",
+                                                               None):
+                # cast lr to the param dtype so bf16/f16 params stay low
+                # precision (a strongly-typed f32 lr array would promote
+                # the whole update to f32)
+                lr_k = lr.astype(p.dtype)
             self._current_param_name = k
-            new_p[k], new_s[k] = self._update(p, g, opt_state[k], lr_k)
+            new_p[k], new_s[k] = self._apply_one(p, g, opt_state[k], lr_k)
         return new_p, new_s

@@ -191,3 +191,47 @@ def test_train_step_jit_lenet_smoke():
     for _ in range(10):
         l = float(step(x, y).numpy())
     assert l < l0
+
+
+def test_master_weights_multi_precision():
+    """amp.decorate O2: bf16 params update through fp32 masters (reference:
+    fluid/optimizer.py _multi_precision master weights)."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 4)
+    optim = opt.Adam(learning_rate=1e-4, parameters=lin.parameters())
+    lin, optim = paddle.amp.decorate(lin, optim, level="O2",
+                                     dtype="bfloat16")
+    assert lin.weight.dtype == paddle.bfloat16
+    assert optim._multi_precision
+    x = paddle.randn([8, 4]).astype("bfloat16")
+    # tiny updates that would vanish in bf16 (eps ~ 2^-8 relative) must
+    # accumulate in the fp32 master
+    for _ in range(100):
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+    import jax.numpy as jnp
+    st = optim._accumulators[id(lin.weight)]
+    assert "master" in st and st["master"].dtype == jnp.float32
+    # master moved away from the bf16 quantization grid
+    assert not np.allclose(np.asarray(st["master"]),
+                           lin.weight.numpy(), atol=0)
+
+
+def test_master_weights_functional_apply_updates():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 4)
+    optim = opt.Adam(learning_rate=1e-3, parameters=lin.parameters())
+    optim._multi_precision = True
+    import jax.numpy as jnp2
+    params = {k: p._value.astype(jnp2.bfloat16)
+              for k, p in lin.named_parameters()}
+    state = optim.init_opt_state(params)
+    for st in state.values():
+        assert "master" in st
+    grads = {k: jnp2.ones_like(v) for k, v in params.items()}
+    new_p, new_s = optim.apply_updates(params, grads, state, 1e-3)
+    for k in params:
+        assert new_p[k].dtype == jnp2.bfloat16
+        assert new_s[k]["master"].dtype == jnp2.float32
