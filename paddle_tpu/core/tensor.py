@@ -318,7 +318,10 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         return t
     if dtype is None:
         a = np.asarray(data)
-        if a.dtype == np.float64:
+        # Paddle parity (python/paddle/tensor/creation.py to_tensor): an
+        # explicit float64 ndarray keeps float64; Python floats/lists (which
+        # numpy defaults to f64) take the framework default dtype.
+        if a.dtype == np.float64 and not isinstance(data, np.ndarray):
             dtype = _dt.get_default_dtype()
         arr = jnp.asarray(a, dtype=dtype)
     else:

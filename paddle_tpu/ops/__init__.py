@@ -78,9 +78,9 @@ def _tensor_getitem(self, item):
             for i in it:
                 collect(i, out)
         elif isinstance(it, list):
-            out.append(to_tensor(np.asarray(it)))
+            out.append(to_tensor(it))
         elif isinstance(it, (np.ndarray, jax.Array)):
-            out.append(to_tensor(np.asarray(it)))
+            out.append(to_tensor(it))
     leaves = []
     collect(item, leaves)
     return _getitem(self, leaves, to_spec(item))
@@ -119,7 +119,7 @@ def _tensor_setitem(self, item, value):
             for i in it:
                 collect(i, out)
         elif isinstance(it, (list, np.ndarray, jax.Array)):
-            out.append(to_tensor(np.asarray(it)))
+            out.append(to_tensor(it))
     leaves = []
     collect(item, leaves)
     if not isinstance(value, Tensor):
@@ -139,7 +139,7 @@ def _binary_dunder(fn, reverse=False):
     def method(self, other):
         if isinstance(other, (list, tuple, np.ndarray, int, float, bool,
                               complex, np.generic)):
-            other = to_tensor(np.asarray(other))
+            other = to_tensor(other)
         elif not isinstance(other, Tensor):
             return NotImplemented
         if reverse:

@@ -38,7 +38,7 @@ def _assign(x):
 
 
 def assign(x, output=None):
-    x = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    x = x if isinstance(x, Tensor) else to_tensor(x)
     out = _assign(x)
     if output is not None:
         output.set_value(out._value)

@@ -18,7 +18,7 @@ from ..core import flags as _flags
 
 
 def _wrap(x):
-    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    return x if isinstance(x, Tensor) else to_tensor(x)
 
 
 def _precision():

@@ -19,7 +19,7 @@ from ..core.tensor import (Tensor, to_tensor, alias_for_inplace,
 
 
 def _wrap(x):
-    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    return x if isinstance(x, Tensor) else to_tensor(x)
 
 
 def _static_shape(shape):

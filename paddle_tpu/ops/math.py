@@ -20,7 +20,7 @@ from ..core.tensor import (Tensor, to_tensor, alias_for_inplace,
 
 
 def _wrap(x):
-    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    return x if isinstance(x, Tensor) else to_tensor(x)
 
 
 def _binop(name, fn):
@@ -129,7 +129,13 @@ angle = _unop("angle", jnp.angle)
 conj = _unop("conj", jnp.conjugate)
 real = _unop("real", jnp.real)
 imag = _unop("imag", jnp.imag)
-stanh = _unop("stanh", lambda x: 1.7159 * jnp.tanh(0.66667 * x))
+_stanh = op("stanh")(lambda x, a, b: b * jnp.tanh(a * x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """reference: activation_op.cc STanh, defaults scale_a=0.67."""
+    return _stanh(_wrap(x), scale_a, scale_b)
+
 softsign = _unop("softsign", lambda x: x / (1 + jnp.abs(x)))
 rint = _unop("rint", jnp.rint)
 

@@ -43,13 +43,23 @@ def _supported(q_shape):
     return t % 128 == 0 and d % 8 == 0 and d >= 32
 
 
+def _largest_block(t):
+    # largest power-of-two block ≤512 that divides the sequence (the kernel
+    # requires seq % block == 0; _supported guarantees t % 128 == 0)
+    for b in (512, 256, 128):
+        if t % b == 0:
+            return b
+    return 128
+
+
 def _block_sizes(t, s):
     """Tuned for v5e: 512-wide q/k blocks keep the MXU fed at head_dim
     64-128 (measured 3× over the kernel defaults at T=2048, bench r2);
-    clamp to the sequence for short inputs."""
+    shorter/odd sequences (768, 1152, ...) drop to the largest dividing
+    power-of-two block."""
     _, BlockSizes = _kernel()
-    bq = min(512, t)
-    bk = min(512, s)
+    bq = _largest_block(t)
+    bk = _largest_block(s)
     return BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
@@ -58,8 +68,13 @@ def _block_sizes(t, s):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _fa_core(qh, kh, vh, causal, scale):
-    out, _ = _fa_fwd(qh, kh, vh, causal, scale)
-    return out
+    # primal (no-grad forward): skip the l/m softmax residuals entirely —
+    # the custom_vjp fwd below only runs under differentiation
+    import jax.experimental.pallas.ops.tpu.flash_attention as m
+    with jax.enable_x64(False):
+        return m._flash_attention(
+            qh, kh, vh, None, None, False, causal, scale,
+            _block_sizes(qh.shape[2], kh.shape[2]), False)
 
 
 def _fa_fwd(qh, kh, vh, causal, scale):

@@ -71,14 +71,20 @@ class Optimizer:
         return self._multi_precision and arr.dtype in (jnp.bfloat16,
                                                        jnp.float16)
 
+    def _fresh_state(self, arr) -> Dict[str, jax.Array]:
+        """Init accumulators for one param; low-precision params also get an
+        fp32 'master' copy (reference: fluid/optimizer.py
+        _create_master_weight multi_precision path)."""
+        if self._lowp(arr):
+            st = self._init_state(arr.astype(jnp.float32))
+            st["master"] = arr.astype(jnp.float32)
+            return st
+        return self._init_state(arr)
+
     def _state_for(self, p: Tensor) -> Dict[str, jax.Array]:
         st = self._accumulators.get(id(p))
         if st is None:
-            if self._lowp(p._value):
-                st = self._init_state(p._value.astype(jnp.float32))
-                st["master"] = p._value.astype(jnp.float32)
-            else:
-                st = self._init_state(p._value)
+            st = self._fresh_state(p._value)
             self._accumulators[id(p)] = st
         return st
 
@@ -258,7 +264,7 @@ class Optimizer:
             self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
         if self._parameter_list:
             for p in self._parameter_list:
-                st = self._init_state(p._value)
+                st = self._fresh_state(p._value)
                 found = False
                 for k in st:
                     key = f"{p.name}_{k}"
@@ -275,15 +281,7 @@ class Optimizer:
     # ---------------------------------------------- functional (jit) bridge
     def init_opt_state(self, flat_params: Dict[str, jax.Array]):
         """Build a pure pytree of optimizer state for functional steps."""
-        out = {}
-        for k, v in flat_params.items():
-            if self._lowp(v):
-                st = self._init_state(v.astype(jnp.float32))
-                st["master"] = v.astype(jnp.float32)
-            else:
-                st = self._init_state(v)
-            out[k] = st
-        return out
+        return {k: self._fresh_state(v) for k, v in flat_params.items()}
 
     def apply_updates(self, flat_params, flat_grads, opt_state, lr=None):
         """Pure functional update over name→array pytrees (used inside
@@ -305,9 +303,6 @@ class Optimizer:
             if "master" not in opt_state[k] and hasattr(lr, "astype") and \
                     hasattr(p, "dtype") and p.dtype != getattr(lr, "dtype",
                                                                None):
-                # cast lr to the param dtype so bf16/f16 params stay low
-                # precision (a strongly-typed f32 lr array would promote
-                # the whole update to f32)
                 lr_k = lr.astype(p.dtype)
             self._current_param_name = k
             new_p[k], new_s[k] = self._apply_one(p, g, opt_state[k], lr_k)

@@ -1,0 +1,9 @@
+"""Testing utilities shipped with the framework.
+
+TPU-native analogue of the reference's declarative op-test harness
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:232), which is
+how the reference verifies its ~700-op corpus: check_output runs each op on
+every registered place, check_grad compares analytic gradients against
+numeric finite differences (get_numeric_gradient:101).
+"""
+from .op_test import OpTestCase, run_case, numeric_grad  # noqa: F401

@@ -1,0 +1,532 @@
+"""Mass declarative op suite driven by the paddle_tpu.testing harness.
+
+Mirrors the reference's single-harness op verification culture
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:232 drives
+~916 declarative test classes): each entry below is one op case — forward vs
+a numpy/torch oracle, eager tape grads vs float64 central finite differences.
+
+The closing audit test asserts every registered op is exercised here or is on
+the explicit exemption list (ops exercised by other test files — the
+reference's white_list/ pattern).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.testing import OpTestCase, run_case
+
+rng = np.random.RandomState(7)
+
+
+def r(*shape, lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, size=shape).astype("float32")
+
+
+def rpos(*shape, lo=0.3, hi=2.0):
+    return rng.uniform(lo, hi, size=shape).astype("float32")
+
+
+def t_ref(fn):
+    """Build a numpy oracle from a torch function."""
+    def oracle(*args, **kw):
+        targs = []
+        for a in args:
+            if isinstance(a, np.ndarray):
+                if np.issubdtype(a.dtype, np.floating):
+                    a = torch.tensor(a.astype(np.float64))
+                else:
+                    a = torch.tensor(a)
+            targs.append(a)
+        out = fn(*targs, **kw)
+        if isinstance(out, (tuple, list)):
+            return [o.numpy() if torch.is_tensor(o) else o for o in out]
+        return out.numpy()
+    return oracle
+
+
+C = OpTestCase
+
+# -- unary elementwise -------------------------------------------------------
+UNARY = [
+    C(paddle.abs, (r(2, 3),), ref=np.abs, grad=(0,), op_types=["abs"]),
+    C(paddle.acos, (r(2, 3, lo=-.9, hi=.9),), ref=np.arccos, grad=(0,), op_types=["acos"]),
+    C(paddle.acosh, (rpos(2, 3, lo=1.2, hi=3),), ref=np.arccosh, grad=(0,), op_types=["acosh"]),
+    C(paddle.asin, (r(2, 3, lo=-.9, hi=.9),), ref=np.arcsin, grad=(0,), op_types=["asin"]),
+    C(paddle.asinh, (r(2, 3),), ref=np.arcsinh, grad=(0,), op_types=["asinh"]),
+    C(paddle.atan, (r(2, 3),), ref=np.arctan, grad=(0,), op_types=["atan"]),
+    C(paddle.atanh, (r(2, 3, lo=-.9, hi=.9),), ref=np.arctanh, grad=(0,), op_types=["atanh"]),
+    C(paddle.ceil, (r(2, 3),), ref=np.ceil, op_types=["ceil"]),
+    C(paddle.cos, (r(2, 3),), ref=np.cos, grad=(0,), op_types=["cos"]),
+    C(paddle.cosh, (r(2, 3),), ref=np.cosh, grad=(0,), op_types=["cosh"]),
+    C(paddle.digamma, (rpos(2, 3),), ref=t_ref(torch.digamma), grad=(0,), op_types=["digamma"]),
+    C(paddle.erf, (r(2, 3),), ref=t_ref(torch.erf), grad=(0,), op_types=["erf"]),
+    C(paddle.erfinv, (r(2, 3, lo=-.8, hi=.8),), ref=t_ref(torch.erfinv), grad=(0,), op_types=["erfinv"]),
+    C(paddle.exp, (r(2, 3),), ref=np.exp, grad=(0,), op_types=["exp"]),
+    C(paddle.expm1, (r(2, 3),), ref=np.expm1, grad=(0,), op_types=["expm1"]),
+    C(paddle.floor, (r(2, 3),), ref=np.floor, op_types=["floor"]),
+    C(paddle.frac, (r(2, 3),), ref=t_ref(torch.frac), op_types=["frac"]),
+    C(paddle.i0, (r(2, 3),), ref=t_ref(torch.i0), op_types=["i0"]),
+    C(paddle.i0e, (r(2, 3),), ref=t_ref(torch.special.i0e), op_types=["i0e"]),
+    C(paddle.i1, (r(2, 3),), ref=t_ref(torch.special.i1), op_types=["i1"]),
+    C(paddle.i1e, (r(2, 3),), ref=t_ref(torch.special.i1e), op_types=["i1e"]),
+    C(paddle.lgamma, (rpos(2, 3),), ref=t_ref(torch.lgamma), grad=(0,), op_types=["lgamma"]),
+    C(paddle.log, (rpos(2, 3),), ref=np.log, grad=(0,), op_types=["log"]),
+    C(paddle.log10, (rpos(2, 3),), ref=np.log10, grad=(0,), op_types=["log10"]),
+    C(paddle.log1p, (rpos(2, 3),), ref=np.log1p, grad=(0,), op_types=["log1p"]),
+    C(paddle.log2, (rpos(2, 3),), ref=np.log2, grad=(0,), op_types=["log2"]),
+    C(paddle.neg, (r(2, 3),), ref=lambda x: -x, grad=(0,), op_types=["neg"]),
+    C(paddle.reciprocal, (rpos(2, 3),), ref=lambda x: 1 / x, grad=(0,), op_types=["reciprocal"]),
+    C(paddle.rint, (r(2, 3),), ref=np.rint, op_types=["rint"]),
+    C(paddle.round, (r(2, 3),), ref=np.rint, op_types=["round"]),
+    C(paddle.rsqrt, (rpos(2, 3),), ref=lambda x: 1 / np.sqrt(x), grad=(0,), op_types=["rsqrt"]),
+    C(F.sigmoid, (r(2, 3),), ref=t_ref(torch.sigmoid), grad=(0,), op_types=["sigmoid"]),
+    C(paddle.sign, (r(2, 3),), ref=np.sign, op_types=["sign"]),
+    C(paddle.sin, (r(2, 3),), ref=np.sin, grad=(0,), op_types=["sin"]),
+    C(paddle.sinh, (r(2, 3),), ref=np.sinh, grad=(0,), op_types=["sinh"]),
+    C(paddle.sqrt, (rpos(2, 3),), ref=np.sqrt, grad=(0,), op_types=["sqrt"]),
+    C(paddle.square, (r(2, 3),), ref=np.square, grad=(0,), op_types=["square"]),
+    C(paddle.tan, (r(2, 3, lo=-1, hi=1),), ref=np.tan, grad=(0,), op_types=["tan"]),
+    C(paddle.tanh, (r(2, 3),), ref=np.tanh, grad=(0,), op_types=["tanh"]),
+    C(paddle.trunc, (r(2, 3),), ref=np.trunc, op_types=["trunc"]),
+    C(paddle.deg2rad, (r(2, 3, lo=-180, hi=180),), ref=np.deg2rad, grad=(0,), op_types=["deg2rad"]),
+    C(paddle.rad2deg, (r(2, 3),), ref=np.rad2deg, grad=(0,), op_types=["rad2deg"]),
+    C(paddle.angle, (r(2, 3),), ref=t_ref(torch.angle), op_types=["angle"]),
+    C(paddle.conj, (r(2, 3),), ref=np.conj, op_types=["conj"]),
+]
+
+# -- binary elementwise ------------------------------------------------------
+BINARY = [
+    C(paddle.add, (r(2, 3), r(2, 3)), ref=np.add, grad=(0, 1), op_types=["elementwise_add"]),
+    C(paddle.subtract, (r(2, 3), r(3)), ref=np.subtract, grad=(0, 1), op_types=["elementwise_sub"]),
+    C(paddle.multiply, (r(2, 3), r(2, 1)), ref=np.multiply, grad=(0, 1), op_types=["elementwise_mul"]),
+    C(paddle.divide, (r(2, 3), rpos(2, 3)), ref=np.true_divide, grad=(0, 1), op_types=["elementwise_div"]),
+    C(paddle.floor_divide, (rpos(2, 3, hi=9), rpos(2, 3)), ref=np.floor_divide, op_types=["elementwise_floordiv"]),
+    C(paddle.remainder, (rpos(2, 3, hi=9), rpos(2, 3)), ref=np.remainder, op_types=["elementwise_mod"]),
+    C(paddle.pow, (rpos(2, 3), 2.0), ref=lambda x, y: np.power(x, y), grad=(0,), op_types=["elementwise_pow"]),
+    C(paddle.maximum, (r(2, 3), r(2, 3)), ref=np.maximum, grad=(0, 1), op_types=["elementwise_max"]),
+    C(paddle.minimum, (r(2, 3), r(2, 3)), ref=np.minimum, grad=(0, 1), op_types=["elementwise_min"]),
+    C(paddle.fmax, (r(2, 3), r(2, 3)), ref=np.fmax, op_types=["elementwise_fmax"]),
+    C(paddle.fmin, (r(2, 3), r(2, 3)), ref=np.fmin, op_types=["elementwise_fmin"]),
+    C(paddle.atan2, (r(2, 3), rpos(2, 3)), ref=np.arctan2, grad=(0, 1), op_types=["atan2"]),
+    C(paddle.hypot, (r(2, 3), r(2, 3)), ref=np.hypot, op_types=["hypot"]),
+    C(paddle.logaddexp, (r(2, 3), r(2, 3)), ref=np.logaddexp, grad=(0, 1), op_types=["logaddexp"]),
+    C(paddle.nextafter, (r(2, 3), r(2, 3)), ref=np.nextafter, op_types=["nextafter"], atol=0, rtol=1e-6),
+    C(paddle.copysign, (r(2, 3), r(2, 3)), ref=np.copysign, op_types=["copysign"]),
+    C(paddle.heaviside, (r(2, 3), r(2, 3)), ref=np.heaviside, op_types=["elementwise_heaviside"]),
+    C(paddle.gcd, (np.array([12, 20, 7]), np.array([8, 5, 14])), ref=np.gcd, op_types=["gcd"]),
+    C(paddle.lcm, (np.array([4, 6, 7]), np.array([6, 8, 14])), ref=np.lcm, op_types=["lcm"]),
+    C(paddle.inner, (r(2, 4), r(3, 4)), ref=np.inner, grad=(0, 1), op_types=["inner"]),
+    C(paddle.outer, (r(3), r(4)), ref=np.outer, grad=(0, 1), op_types=["outer"]),
+    C(paddle.kron, (r(2, 2), r(2, 3)), ref=np.kron, grad=(0, 1), op_types=["kron"]),
+    C(paddle.divide_no_nan, (r(2, 3), np.array([[1., 0., 2.], [0., 1., 1.]], np.float32)),
+      ref=lambda x, y: np.where(y == 0, 0.0, x / np.where(y == 0, 1, y)),
+      op_types=["divide_no_nan"]),
+]
+
+# -- reductions / cumulative -------------------------------------------------
+REDUCE = [
+    C(paddle.sum, (r(2, 3, 4),), {"axis": 1}, ref=lambda x, axis: x.sum(axis),
+      grad=(0,), op_types=["reduce_sum"]),
+    C(paddle.mean, (r(2, 3, 4),), {"axis": [0, 2]}, ref=lambda x, axis: x.mean((0, 2)),
+      grad=(0,), op_types=["reduce_mean"]),
+    C(paddle.max, (r(2, 5),), {"axis": 1}, ref=lambda x, axis: x.max(axis),
+      grad=(0,), op_types=["reduce_max"]),
+    C(paddle.min, (r(2, 5),), {"axis": -1, "keepdim": True},
+      ref=lambda x, axis, keepdim: x.min(axis, keepdims=True), grad=(0,), op_types=["reduce_min"]),
+    C(paddle.prod, (rpos(2, 3),), {"axis": 0}, ref=lambda x, axis: x.prod(0),
+      grad=(0,), op_types=["reduce_prod"]),
+    C(paddle.amax, (r(2, 5),), {"axis": 1}, ref=lambda x, axis: x.max(1), op_types=["reduce_amax"]),
+    C(paddle.amin, (r(2, 5),), {"axis": 1}, ref=lambda x, axis: x.min(1), op_types=["reduce_amin"]),
+    C(paddle.nansum, (np.array([[1., np.nan, 2.], [3., 4., np.nan]], np.float32),),
+      ref=np.nansum, op_types=["reduce_nansum"]),
+    C(paddle.nanmean, (np.array([[1., np.nan, 2.], [3., 4., np.nan]], np.float32),),
+      ref=np.nanmean, op_types=["reduce_nanmean"]),
+    C(paddle.all, (np.array([[True, False], [True, True]]),), {"axis": 1},
+      ref=lambda x, axis: x.all(1), op_types=["all"]),
+    C(paddle.any, (np.array([[True, False], [False, False]]),), {"axis": 1},
+      ref=lambda x, axis: x.any(1), op_types=["any"]),
+    C(paddle.logsumexp, (r(3, 4),), {"axis": 1},
+      ref=lambda x, axis: np.log(np.exp(x).sum(1)), grad=(0,), op_types=["logsumexp"]),
+    C(paddle.count_nonzero, (np.array([[0., 1.], [2., 0.]], np.float32),),
+      ref=lambda x: np.count_nonzero(x), op_types=[]),
+    C(paddle.std, (r(3, 4),), {"axis": 1},
+      ref=lambda x, axis: x.astype(np.float64).std(1, ddof=1), grad=(0,), op_types=["std"]),
+    C(paddle.var, (r(3, 4),), {"axis": 1},
+      ref=lambda x, axis: x.astype(np.float64).var(1, ddof=1), grad=(0,), op_types=["var"]),
+    C(paddle.median, (r(3, 5),), {"axis": 1},
+      ref=lambda x, axis: np.median(x, 1), op_types=["median"]),
+    C(paddle.quantile, (r(3, 5),), {"q": 0.5, "axis": 1},
+      ref=lambda x, q, axis: np.quantile(x.astype(np.float64), q, axis=1), op_types=["quantile"]),
+    C(paddle.cumsum, (r(3, 4),), {"axis": 1}, ref=lambda x, axis: np.cumsum(x, 1),
+      grad=(0,), op_types=["cumsum"]),
+    C(paddle.cumprod, (rpos(3, 4),), {"dim": 1}, ref=lambda x, dim: np.cumprod(x, 1),
+      grad=(0,), op_types=["cumprod"]),
+    C(paddle.cummax, (r(3, 4),), {"axis": 1},
+      ref=lambda x, axis: [np.maximum.accumulate(x, 1), None], op_types=["cummax"]),
+    C(paddle.logcumsumexp, (r(3, 4),), {"axis": 1},
+      ref=lambda x, axis: np.log(np.cumsum(np.exp(x.astype(np.float64)), 1)),
+      op_types=["logcumsumexp"]),
+]
+
+# -- linalg ------------------------------------------------------------------
+def _spd(n):
+    a = rng.randn(n, n).astype("float32")
+    return a @ a.T + n * np.eye(n, dtype="float32")
+
+
+LINALG = [
+    C(paddle.matmul, (r(2, 3, 4), r(2, 4, 5)), ref=np.matmul, grad=(0, 1),
+      op_types=["matmul_v2"]),
+    C(paddle.bmm, (r(2, 3, 4), r(2, 4, 5)), ref=np.matmul, op_types=["bmm"]),
+    C(paddle.mv, (r(3, 4), r(4)), ref=np.matmul, grad=(0, 1), op_types=["mv"]),
+    C(paddle.dot, (r(4), r(4)), ref=np.dot, grad=(0, 1), op_types=["dot"]),
+    C(paddle.addmm, (r(2, 3), r(2, 4), r(4, 3)),
+      ref=lambda i, x, y: i + x @ y, grad=(0, 1, 2), op_types=["addmm"]),
+    C(paddle.linalg.multi_dot, ([r(2, 3), r(3, 4), r(4, 2)],),
+      ref=lambda xs: xs[0] @ xs[1] @ xs[2], op_types=["multi_dot"]),
+    C(paddle.tensordot, (r(2, 3, 4), r(4, 3, 2)), {"axes": 1},
+      ref=lambda x, y, axes: np.tensordot(x, y, 1), op_types=["tensordot"]),
+    C(paddle.einsum, ("ij,jk->ik", r(2, 3), r(3, 4)),
+      ref=lambda s, a, b: np.einsum(s, a, b), op_types=["einsum"]),
+    C(paddle.trace, (r(4, 4),), ref=np.trace, grad=(0,), op_types=["trace"]),
+    C(paddle.diagonal, (r(3, 4),), ref=lambda x: np.diagonal(x), op_types=["diagonal"]),
+    C(paddle.det, (_spd(3),), ref=np.linalg.det, rtol=1e-3, op_types=["det"]),
+    C(paddle.linalg.slogdet, (_spd(3),),
+      ref=lambda x: np.array(np.linalg.slogdet(x.astype(np.float64))),
+      rtol=1e-3, op_types=["slogdet"]),
+    C(paddle.inverse, (_spd(3),), ref=np.linalg.inv, rtol=1e-3, op_types=["inverse"]),
+    C(paddle.cholesky, (_spd(3),), ref=np.linalg.cholesky, rtol=1e-3, op_types=["cholesky"]),
+    C(paddle.linalg.solve, (_spd(3), r(3, 2)),
+      ref=lambda a, b: np.linalg.solve(a.astype(np.float64), b), rtol=1e-3,
+      op_types=["solve"]),
+    C(paddle.linalg.triangular_solve,
+      (np.tril(_spd(3)), r(3, 2)), {"upper": False},
+      ref=lambda a, b, upper: np.linalg.solve(a.astype(np.float64), b),
+      rtol=1e-3, op_types=["triangular_solve"]),
+    C(paddle.linalg.cholesky_solve, (r(3, 1), np.linalg.cholesky(_spd(3)).astype("float32")),
+      {"upper": False}, op_types=["cholesky_solve"]),
+    C(paddle.linalg.matrix_power, (_spd(3), 2),
+      ref=lambda x, n: np.linalg.matrix_power(x.astype(np.float64), n),
+      rtol=1e-3, op_types=["matrix_power"]),
+    C(paddle.linalg.pinv, (r(4, 3),),
+      ref=lambda x: np.linalg.pinv(x.astype(np.float64)), rtol=1e-2, atol=1e-4,
+      op_types=["pinv"]),
+    C(paddle.linalg.matrix_rank, (_spd(3),), ref=lambda x: 3, op_types=["matrix_rank"]),
+    C(paddle.linalg.qr, (r(4, 3),), op_types=["qr"]),
+    C(paddle.linalg.svd, (r(4, 3),), op_types=["svd"]),
+    C(paddle.linalg.eigh, (_spd(3),), op_types=["eigh"]),
+    C(paddle.linalg.eig, (_spd(3),), op_types=["eig"]),
+    C(paddle.linalg.norm, (r(3, 4),), ref=lambda x: np.linalg.norm(x),
+      op_types=["frobenius_norm", "p_norm"]),
+    C(paddle.cross, (r(3, 3), r(3, 3)), {"axis": 1},
+      ref=lambda x, y, axis: np.cross(x, y, axis=1), grad=(0, 1), op_types=["cross"]),
+    C(paddle.linalg.cov, (r(3, 6),), ref=lambda x: np.cov(x.astype(np.float64)),
+      rtol=1e-3, op_types=["cov"]),
+    C(paddle.corrcoef, (r(3, 6),), ref=lambda x: np.corrcoef(x.astype(np.float64)),
+      rtol=1e-3, op_types=["corrcoef"]),
+]
+
+# -- manipulation ------------------------------------------------------------
+x234 = np.arange(24, dtype="float32").reshape(2, 3, 4)
+
+MANIP = [
+    C(paddle.reshape, (x234, [4, 6]), ref=lambda x, s: x.reshape(4, 6),
+      grad=(0,), op_types=["reshape"]),
+    C(paddle.transpose, (x234, [2, 0, 1]),
+      ref=lambda x, p: x.transpose(2, 0, 1), grad=(0,), op_types=["transpose"]),
+    C(paddle.flatten, (x234,), {"start_axis": 1, "stop_axis": 2},
+      ref=lambda x, start_axis, stop_axis: x.reshape(2, 12), op_types=["flatten"]),
+    C(paddle.squeeze, (np.ones((1, 2, 1, 3), np.float32),), {"axis": 0},
+      ref=lambda x, axis: x.squeeze(0), op_types=["squeeze"]),
+    C(paddle.unsqueeze, (x234, [0, -1]),
+      ref=lambda x, ax: x[None, ..., None], op_types=["unsqueeze"]),
+    C(paddle.concat, ([r(2, 3), r(2, 3)],), {"axis": 1},
+      ref=lambda xs, axis: np.concatenate(xs, 1), op_types=["concat"]),
+    C(paddle.stack, ([r(2, 3), r(2, 3)],), {"axis": 0},
+      ref=lambda xs, axis: np.stack(xs, 0), op_types=["stack"]),
+    C(paddle.manipulation.unstack, (r(3, 2),), {"axis": 0},
+      ref=lambda x, axis: list(x), op_types=["unstack"]),
+    C(paddle.split, (x234, [1, 2]), {"axis": 1},
+      ref=lambda x, s, axis: [x[:, :1], x[:, 1:]], op_types=["split"]),
+    C(paddle.tile, (r(2, 3), [2, 1]), ref=lambda x, reps: np.tile(x, (2, 1)),
+      grad=(0,), op_types=["tile"]),
+    C(paddle.expand, (r(1, 3), [4, 3]),
+      ref=lambda x, s: np.broadcast_to(x, (4, 3)), grad=(0,), op_types=["expand"]),
+    C(paddle.flip, (x234, [0, 2]), ref=lambda x, ax: x[::-1, :, ::-1],
+      op_types=["flip"]),
+    C(paddle.roll, (x234, 2), {"axis": 1}, ref=lambda x, s, axis: np.roll(x, 2, 1),
+      op_types=["roll"]),
+    C(paddle.manipulation.rot90, (r(3, 3),), ref=lambda x: np.rot90(x),
+      op_types=["rot90"]),
+    C(paddle.moveaxis, (x234, 0, 2), ref=lambda x, a, b: np.moveaxis(x, 0, 2),
+      op_types=["moveaxis"]),
+    C(paddle.repeat_interleave, (r(2, 3), 2), {"axis": 1},
+      ref=lambda x, n, axis: np.repeat(x, 2, 1), op_types=["repeat_interleave"]),
+    C(paddle.gather, (r(4, 3), np.array([0, 2])),
+      ref=lambda x, i: x[i], grad=(0,), op_types=["gather"]),
+    C(paddle.gather_nd, (r(4, 3), np.array([[0, 1], [2, 2]])),
+      ref=lambda x, i: x[[0, 2], [1, 2]], grad=(0,), op_types=["gather_nd"]),
+    C(paddle.scatter, (r(4, 3), np.array([0, 2]), np.ones((2, 3), np.float32)),
+      ref=lambda x, i, u: np.concatenate([u[:1], x[1:2], u[1:], x[3:]]),
+      grad=(0, 2), op_types=["scatter"]),
+    C(paddle.scatter_nd_add,
+      (np.zeros((4,), np.float32), np.array([[1], [2], [1]]),
+       np.array([1., 2., 3.], np.float32)),
+      ref=lambda x, i, u: np.array([0., 4., 2., 0.], np.float32),
+      op_types=["scatter_nd_add"]),
+    C(paddle.index_select, (r(4, 3), np.array([0, 2])), {"axis": 0},
+      ref=lambda x, i, axis: x[[0, 2]], grad=(0,), op_types=["index_select"]),
+    C(paddle.index_add, (r(4, 3), np.array([0, 2]), 0, np.ones((2, 3), np.float32)),
+      op_types=["index_add"]),
+    C(paddle.index_sample, (r(3, 5), np.array([[0, 1], [2, 3], [4, 0]])),
+      ref=lambda x, i: np.take_along_axis(x, i, 1), op_types=["index_sample"]),
+    C(paddle.manipulation.put_along_axis,
+      (r(3, 5), np.array([[0], [1], [2]]), np.zeros((3, 1), np.float32), 1),
+      op_types=["put_along_axis"]),
+    C(paddle.manipulation.take_along_axis, (r(3, 5), np.array([[0], [1], [2]]), 1),
+      ref=lambda x, i, axis: np.take_along_axis(x, i, 1), grad=(0,),
+      op_types=["take_along_axis"]),
+    C(paddle.masked_select, (r(2, 3), np.array([[True, False, True],
+                                                [False, True, False]])),
+      ref=lambda x, m: x[m], op_types=["masked_select"]),
+    C(paddle.manipulation.masked_fill,
+      (r(2, 3), np.array([[True, False, True], [False, True, False]]), 0.0),
+      ref=lambda x, m, v: np.where(m, 0.0, x), grad=(0,), op_types=["masked_fill"]),
+    C(paddle.where, (np.array([[True, False], [False, True]]), r(2, 2), r(2, 2)),
+      ref=np.where, grad=(1, 2), op_types=["where"]),
+    C(paddle.diag, (r(4),), ref=np.diag, op_types=["diag"]),
+    C(paddle.diagflat, (r(2, 2),), ref=lambda x: np.diagflat(x), op_types=["diagflat"]),
+    C(paddle.tril, (r(3, 3),), ref=np.tril, grad=(0,), op_types=["tril"]),
+    C(paddle.triu, (r(3, 3),), ref=np.triu, op_types=["triu"]),
+    C(F.one_hot, (np.array([0, 2, 1]), 4),
+      ref=lambda x, n: np.eye(4, dtype="float32")[x], op_types=["one_hot_v2"]),
+    C(paddle.as_complex, (r(2, 3, 2),),
+      ref=lambda x: x[..., 0] + 1j * x[..., 1], op_types=["as_complex"]),
+    C(paddle.as_real, (r(2, 3).astype(np.complex64),),
+      ref=lambda x: np.stack([x.real, x.imag], -1), op_types=["as_real"]),
+    C(paddle.real, ((r(2, 2) + 1j * r(2, 2)).astype(np.complex64),),
+      ref=np.real, op_types=["real"]),
+    C(paddle.imag, ((r(2, 2) + 1j * r(2, 2)).astype(np.complex64),),
+      ref=np.imag, op_types=["imag"]),
+    C(paddle.ones_like, (r(2, 3),), ref=np.ones_like, op_types=["ones_like"]),
+    C(paddle.zeros_like, (r(2, 3),), ref=np.zeros_like, op_types=["zeros_like"]),
+    C(paddle.assign, (r(2, 3),), ref=lambda x: x, op_types=["assign"]),
+    C(paddle.cast, (r(2, 3), "int32"),
+      ref=lambda x, d: x.astype(np.int32), op_types=["cast"]),
+]
+
+# -- search / sort -----------------------------------------------------------
+SEARCH = [
+    C(paddle.argmax, (r(3, 5),), {"axis": 1}, ref=lambda x, axis: x.argmax(1),
+      op_types=["arg_max"]),
+    C(paddle.argmin, (r(3, 5),), {"axis": 1}, ref=lambda x, axis: x.argmin(1),
+      op_types=["arg_min"]),
+    C(paddle.argsort, (r(3, 5),), {"axis": 1},
+      ref=lambda x, axis: np.argsort(x, 1, kind="stable"), op_types=["argsort"]),
+    C(paddle.sort, (r(3, 5),), {"axis": 1}, ref=lambda x, axis: np.sort(x, 1),
+      grad=(0,), op_types=["sort"]),
+    C(paddle.topk, (r(3, 5), 2), {"axis": 1},
+      ref=lambda x, k, axis: [np.sort(x, 1)[:, ::-1][:, :2], None],
+      grad=(0,), op_types=["top_k_v2"]),
+    C(paddle.kthvalue, (r(3, 5), 2), {"axis": 1},
+      ref=lambda x, k, axis: [np.sort(x, 1)[:, 1], None], op_types=["kthvalue"]),
+    C(paddle.mode, (np.array([[1., 1., 2.], [3., 3., 3.]], np.float32),),
+      ref=lambda x: [np.array([1., 3.], np.float32), None], op_types=["mode"]),
+    C(paddle.searchsorted, (np.array([1., 3., 5., 7.], np.float32),
+                            np.array([2., 6.], np.float32)),
+      ref=lambda s, v: np.searchsorted(s, v), op_types=["searchsorted"]),
+    C(paddle.bucketize, (np.array([2., 6.], np.float32),
+                         np.array([1., 3., 5., 7.], np.float32)),
+      ref=lambda v, s: np.searchsorted(s, v), op_types=["bucketize"]),
+    C(paddle.histogram, (r(20),), {"bins": 5, "min": -2, "max": 2},
+      ref=lambda x, bins, min, max: np.histogram(x, 5, (-2, 2))[0],
+      op_types=["histogram"]),
+    C(paddle.bincount, (np.array([0, 1, 1, 3]),),
+      ref=lambda x: np.bincount(x), op_types=["bincount"]),
+]
+
+# -- logic / comparison ------------------------------------------------------
+LOGIC = [
+    C(paddle.equal, (np.array([1, 2]), np.array([1, 3])),
+      ref=np.equal, op_types=["equal"]),
+    C(paddle.not_equal, (np.array([1, 2]), np.array([1, 3])),
+      ref=np.not_equal, op_types=["not_equal"]),
+    C(paddle.greater_than, (r(2, 2), r(2, 2)), ref=np.greater,
+      op_types=["greater_than"]),
+    C(paddle.greater_equal, (r(2, 2), r(2, 2)), ref=np.greater_equal,
+      op_types=["greater_equal"]),
+    C(paddle.less_than, (r(2, 2), r(2, 2)), ref=np.less, op_types=["less_than"]),
+    C(paddle.less_equal, (r(2, 2), r(2, 2)), ref=np.less_equal,
+      op_types=["less_equal"]),
+    C(paddle.logical_and, (np.array([True, False]), np.array([True, True])),
+      ref=np.logical_and, op_types=["logical_and"]),
+    C(paddle.logical_or, (np.array([True, False]), np.array([False, False])),
+      ref=np.logical_or, op_types=["logical_or"]),
+    C(paddle.logical_xor, (np.array([True, False]), np.array([True, True])),
+      ref=np.logical_xor, op_types=["logical_xor"]),
+    C(paddle.logical_not, (np.array([True, False]),), ref=np.logical_not,
+      op_types=["logical_not"]),
+    C(paddle.bitwise_and, (np.array([5, 3]), np.array([3, 1])),
+      ref=np.bitwise_and, op_types=["bitwise_and"]),
+    C(paddle.bitwise_or, (np.array([5, 3]), np.array([3, 1])),
+      ref=np.bitwise_or, op_types=["bitwise_or"]),
+    C(paddle.bitwise_xor, (np.array([5, 3]), np.array([3, 1])),
+      ref=np.bitwise_xor, op_types=["bitwise_xor"]),
+    C(paddle.bitwise_not, (np.array([5, 3]),), ref=np.bitwise_not,
+      op_types=["bitwise_not"]),
+    C(paddle.isnan, (np.array([1., np.nan], np.float32),), ref=np.isnan,
+      op_types=["isnan"]),
+    C(paddle.isinf, (np.array([1., np.inf], np.float32),), ref=np.isinf,
+      op_types=["isinf"]),
+    C(paddle.isfinite, (np.array([1., np.inf], np.float32),), ref=np.isfinite,
+      op_types=["isfinite"]),
+]
+
+# -- activations -------------------------------------------------------------
+ACT = [
+    C(F.relu, (r(2, 3),), ref=lambda x: np.maximum(x, 0), grad=(0,), op_types=["relu"]),
+    C(F.relu6, (r(2, 3, lo=-1, hi=8),), ref=lambda x: np.clip(x, 0, 6), op_types=["relu6"]),
+    C(F.elu, (r(2, 3),), ref=t_ref(tF.elu), grad=(0,), op_types=["elu"]),
+    C(F.selu, (r(2, 3),), ref=t_ref(tF.selu), op_types=["selu"]),
+    C(F.celu, (r(2, 3),), ref=t_ref(tF.celu), op_types=["celu"]),
+    C(F.gelu, (r(2, 3),), ref=t_ref(tF.gelu), grad=(0,), op_types=["gelu"]),
+    C(F.silu, (r(2, 3),), ref=t_ref(tF.silu), grad=(0,), op_types=["silu"]),
+    C(F.mish, (r(2, 3),), ref=t_ref(tF.mish), op_types=["mish"]),
+    C(F.softplus, (r(2, 3),), ref=t_ref(tF.softplus), grad=(0,), op_types=["softplus"]),
+    C(F.softshrink, (r(2, 3),), ref=t_ref(tF.softshrink), op_types=["softshrink"]),
+    C(F.softsign, (r(2, 3),), ref=t_ref(tF.softsign), op_types=["softsign"]),
+    C(F.hardtanh, (r(2, 3),), ref=t_ref(tF.hardtanh), op_types=["hard_tanh"]),
+    C(F.hardshrink, (r(2, 3),), ref=t_ref(tF.hardshrink), op_types=["hard_shrink"]),
+    C(F.hardsigmoid, (r(2, 3, lo=-6, hi=6),), op_types=["hard_sigmoid"]),
+    C(F.hardswish, (r(2, 3, lo=-6, hi=6),), ref=t_ref(tF.hardswish),
+      op_types=["hard_swish"]),
+    C(F.leaky_relu, (r(2, 3),), {"negative_slope": 0.1},
+      ref=lambda x, negative_slope: np.where(x > 0, x, 0.1 * x), grad=(0,),
+      op_types=["leaky_relu"]),
+    C(F.prelu, (r(2, 3), np.array([0.25], np.float32)),
+      ref=lambda x, w: np.where(x > 0, x, 0.25 * x), op_types=["prelu"]),
+    C(F.log_sigmoid, (r(2, 3),), ref=t_ref(tF.logsigmoid), grad=(0,),
+      op_types=["logsigmoid"]),
+    C(F.log_softmax, (r(2, 5),), {"axis": -1}, ref=t_ref(lambda x, axis: tF.log_softmax(x, -1)),
+      grad=(0,), op_types=["log_softmax"]),
+    C(F.softmax, (r(2, 5),), {"axis": -1}, ref=t_ref(lambda x, axis: tF.softmax(x, -1)),
+      grad=(0,), op_types=["softmax"]),
+    C(F.tanhshrink, (r(2, 3),), ref=t_ref(tF.tanhshrink), op_types=["tanh_shrink"]),
+    C(F.thresholded_relu, (r(2, 3),),
+      ref=lambda x: np.where(x > 1.0, x, 0.0), op_types=["thresholded_relu"]),
+    C(F.swish, (r(2, 3),), ref=t_ref(tF.silu), op_types=[]),
+    C(paddle.stanh, (r(2, 3),),
+      ref=lambda x: 1.7159 * np.tanh(0.67 * x), op_types=["stanh"]),
+    C(F.maxout, (r(2, 4, 3, 3), 2), op_types=["maxout"]),
+    C(F.glu, (r(2, 4),), ref=t_ref(lambda x: tF.glu(x, -1)), op_types=["glu"]),
+    C(F.gumbel_softmax, (r(2, 5),), op_types=["gumbel_softmax"]),
+]
+
+# -- losses / misc nn --------------------------------------------------------
+_logits = r(4, 5)
+_labels = np.array([1, 0, 4, 2])
+
+LOSS = [
+    C(F.mse_loss, (r(3, 4), r(3, 4)), ref=t_ref(tF.mse_loss), grad=(0,),
+      op_types=["mse_loss"]),
+    C(F.l1_loss, (r(3, 4), r(3, 4)), ref=t_ref(tF.l1_loss), op_types=["l1_loss"]),
+    C(F.binary_cross_entropy, (rpos(3, 4, lo=0.1, hi=0.9), rpos(3, 4, lo=0.1, hi=0.9)),
+      ref=t_ref(tF.binary_cross_entropy), grad=(0,), op_types=["bce_loss"]),
+    C(F.binary_cross_entropy_with_logits, (r(3, 4), rpos(3, 4, lo=0, hi=1)),
+      ref=t_ref(tF.binary_cross_entropy_with_logits), grad=(0,),
+      op_types=["bce_with_logits"]),
+    C(F.cross_entropy, (_logits, _labels),
+      ref=t_ref(lambda x, y: tF.cross_entropy(x, torch.tensor(np.asarray(y)))),
+      grad=(0,), op_types=["softmax_with_cross_entropy",
+                           "softmax_with_cross_entropy_keepdim"]),
+    C(F.nll_loss, (np.log(tF.softmax(torch.tensor(_logits), -1).numpy() + 1e-9), _labels),
+      ref=t_ref(lambda x, y: tF.nll_loss(x, torch.tensor(np.asarray(y)))),
+      op_types=["nll_loss"]),
+    C(F.kl_div, (np.log(rpos(3, 4, lo=.1, hi=.9)), rpos(3, 4, lo=.1, hi=.9)),
+      ref=t_ref(lambda x, y: tF.kl_div(x, y)), op_types=["kl_div"]),
+    C(F.smooth_l1_loss, (r(3, 4), r(3, 4)), ref=t_ref(tF.smooth_l1_loss),
+      op_types=["smooth_l1_loss", "huber_loss"]),
+    C(F.margin_ranking_loss, (r(3), r(3), np.sign(r(3)).astype("float32")),
+      ref=t_ref(tF.margin_ranking_loss), op_types=["margin_ranking_loss"]),
+    C(F.hinge_embedding_loss, (r(3, 4), np.sign(r(3, 4)).astype("float32")),
+      ref=t_ref(tF.hinge_embedding_loss), op_types=["hinge_embedding_loss"]),
+    C(F.cosine_embedding_loss, (r(3, 4), r(3, 4), np.sign(r(3)).astype("float32")),
+      ref=t_ref(tF.cosine_embedding_loss), op_types=["cosine_embedding_loss"]),
+    C(F.triplet_margin_loss, (r(3, 4), r(3, 4), r(3, 4)),
+      ref=t_ref(tF.triplet_margin_loss), op_types=["triplet_margin_loss"]),
+    C(F.log_loss, (rpos(3, 1, lo=.1, hi=.9), rpos(3, 1, lo=0, hi=1)),
+      op_types=["log_loss"]),
+    C(F.label_smooth, (np.eye(4, dtype="float32"),),
+      ref=lambda x: x * 0.9 + 0.1 / 4, op_types=["label_smooth"]),
+    C(F.sigmoid_cross_entropy_with_logits, (r(3, 4), rpos(3, 4, lo=0, hi=1)),
+      ref=t_ref(lambda x, y: tF.binary_cross_entropy_with_logits(
+          x, y, reduction="none")), op_types=["sigmoid_cross_entropy_with_logits"]),
+    C(F.square_error_cost, (r(3), r(3)), ref=lambda x, y: (x - y) ** 2, op_types=[]),
+    C(F.cosine_similarity, (r(3, 4), r(3, 4)),
+      ref=t_ref(lambda a, b: tF.cosine_similarity(a, b)),
+      op_types=["cosine_similarity"]),
+    C(F.normalize, (r(3, 4),), ref=t_ref(lambda x: tF.normalize(x)),
+      op_types=["normalize_l2"]),
+    C(F.linear, (r(3, 4), r(4, 5), r(5)),
+      ref=lambda x, w, b: x @ w + b, grad=(0, 1, 2), op_types=["linear"]),
+    C(F.bilinear, (r(3, 4), r(3, 5), r(2, 4, 5)),
+      ref=t_ref(lambda a, b, w: tF.bilinear(a, b, w)), op_types=["bilinear"]),
+    C(F.embedding, (np.array([0, 2, 1]), r(5, 4)),
+      ref=lambda i, w: w[i], op_types=["lookup_table_v2"]),
+    C(F.layer_norm, (r(3, 4), [4], r(4), r(4)),
+      ref=t_ref(lambda x, s, w, b: tF.layer_norm(x, [4], w, b)),
+      grad=(0,), op_types=["layer_norm"]),
+    C(F.label_smooth, (np.eye(4, dtype="float32"),), op_types=["label_smooth"]),
+    C(paddle.dist, (r(3, 4), r(3, 4)),
+      ref=lambda x, y: np.linalg.norm((x - y).ravel()), op_types=[]),
+]
+
+ALL_CASES = UNARY + BINARY + REDUCE + LINALG + MANIP + SEARCH + LOGIC + ACT + LOSS
+
+# traced/eager parity (the TPU performance path) for the core families;
+# random ops (gumbel_softmax) draw different keys eager vs traced
+for _c in UNARY + BINARY + REDUCE + ACT:
+    if _c.name not in ("gumbel_softmax", "rrelu", "dropout"):
+        _c.check_jit = True
+
+
+@pytest.mark.parametrize(
+    "case", ALL_CASES,
+    ids=[f"{i}:{c.name}" for i, c in enumerate(ALL_CASES)])
+def test_op_case(case):
+    run_case(case)
+
+
+# Ops verified by other test files or not meaningfully coverable by the
+# value-oracle harness (random, distributed, compound-model, infra ops).
+# Mirrors the reference's white_list/ exemption pattern.
+EXEMPT = {
+    # random ops: distribution checked in test_ops.py::test_creation_ops
+    "dropout", "rrelu", "gumbel_softmax",
+    # conv/pool/rnn/attention: exercised in test_nn.py against torch
+    "conv2d", "conv2d_transpose", "pool_avg", "pool_max", "adaptive_pool",
+    "unfold", "interpolate", "pixel_shuffle", "local_response_norm",
+    "rnn_scan_gru", "rnn_scan_lstm", "rnn_scan_simple", "gru_cell",
+    "lstm_cell", "simple_rnn_cell", "scaled_dot_product_attention",
+    "flash_attention",  # registered lazily by ops.pallas; engaged in test_nn
+    "batch_norm_train", "batch_norm_infer", "group_norm", "instance_norm",
+    "ctc_loss", "cross_entropy_probs",
+    # distributed/SPMD ops: test_distributed.py
+    "c_allgather", "c_allreduce", "c_alltoall", "c_broadcast", "c_ppermute",
+    "c_reducescatter", "axis_index", "shard_constraint",
+    # in-place/indexing infra: test_autograd.py / test_ops.py
+    "set_value", "getitem", "slice", "strided_slice", "increment", "scale",
+    "clip", "lerp", "add_n", "pad_nd",
+}
+
+
+def test_every_registered_op_is_covered():
+    from paddle_tpu.core.dispatch import registered_ops
+    covered = set(EXEMPT)
+    for c in ALL_CASES:
+        covered.update(c.op_types)
+    missing = [o for o in registered_ops() if o not in covered]
+    assert not missing, f"ops with no harness coverage: {missing}"

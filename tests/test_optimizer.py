@@ -235,3 +235,41 @@ def test_master_weights_functional_apply_updates():
     for k in params:
         assert new_p[k].dtype == jnp2.bfloat16
         assert new_s[k]["master"].dtype == jnp2.float32
+
+
+def test_master_weights_survive_state_dict_roundtrip():
+    """O2 resume: the fp32 master accumulator must round-trip through
+    state_dict/set_state_dict (reference: fluid/optimizer.py
+    _create_master_weight + load semantics)."""
+    import jax.numpy as jnp2
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 4)
+    for p in lin.parameters():
+        p._value = p._value.astype(jnp2.bfloat16)
+    optim = opt.Adam(learning_rate=1e-2, parameters=lin.parameters())
+    optim._multi_precision = True
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    lin(x.astype("bfloat16")).sum().backward()
+    optim.step()
+    optim.clear_grad()
+    sd = optim.state_dict()
+    assert any(k.endswith("_master") for k in sd)
+
+    lin2 = paddle.nn.Linear(4, 4)
+    for p, q in zip(lin2.parameters(), lin.parameters()):
+        p._value = q._value
+        p.name = q.name  # state-dict keys are accumulator-name based
+    optim2 = opt.Adam(learning_rate=1e-2, parameters=lin2.parameters())
+    optim2._multi_precision = True
+    optim2.set_state_dict(sd)
+    for p in lin2.parameters():
+        st = optim2._accumulators[id(p)]
+        assert "master" in st and st["master"].dtype == jnp2.float32
+    # numerics: one more identical step matches the uninterrupted optimizer
+    lin(x.astype("bfloat16")).sum().backward()
+    lin2(x.astype("bfloat16")).sum().backward()
+    optim.step()
+    optim2.step()
+    for p, q in zip(lin.parameters(), lin2.parameters()):
+        np.testing.assert_array_equal(np.asarray(p._value, dtype=np.float32),
+                                      np.asarray(q._value, dtype=np.float32))
