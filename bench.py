@@ -110,13 +110,20 @@ def bench_gpt(on_tpu: bool):
     return tokens_per_sec, mfu
 
 
+_JIT_SUM = None
+
+
 def _drain(model):
     """True drain: block on a scalar reduction of the LAST-updated
     parameter. Blocking on the loss alone is wrong — it is an early output
-    of the compiled step and TPU streams outputs as produced."""
-    import jax
-    import jax.numpy as jnp
-    return float(np.asarray(jax.jit(jnp.sum)(model.parameters()[-1]._value)))
+    of the compiled step and TPU streams outputs as produced. The jitted
+    sum is cached so the closing drain doesn't time a recompile."""
+    global _JIT_SUM
+    if _JIT_SUM is None:
+        import jax
+        import jax.numpy as jnp
+        _JIT_SUM = jax.jit(jnp.sum)
+    return float(np.asarray(_JIT_SUM(model.parameters()[-1]._value)))
 
 
 def bench_lenet():

@@ -32,15 +32,20 @@ _seq_counter = itertools.count()
 class TapeNode:
     """One recorded differentiable op (reference: GradOpNode, layer.h)."""
 
-    __slots__ = ("seq", "op_type", "vjp_fn", "inputs", "out_specs",
-                 "out_refs", "__weakref__")
+    __slots__ = ("seq", "op_type", "vjp_fn", "fwd_fn", "inputs", "in_arrays",
+                 "out_specs", "out_refs", "__weakref__")
 
     def __init__(self, op_type: str, vjp_fn: Callable, inputs: List[Any],
-                 out_specs: List[Tuple[tuple, Any]]):
+                 out_specs: List[Tuple[tuple, Any]],
+                 fwd_fn: Optional[Callable] = None, in_arrays=None):
         self.seq = next(_seq_counter)
         self.op_type = op_type
         self.vjp_fn: Optional[Callable] = vjp_fn
+        self.fwd_fn = fwd_fn            # pure fn of input arrays (replay/AD²)
         self.inputs = inputs            # Tensors (strong refs keep graph alive)
+        self.in_arrays = in_arrays      # forward-time input values (replay
+        # must not see later in-place mutations of leaf tensors; these are
+        # the same arrays the vjp residuals retain, so no extra memory)
         self.out_specs = out_specs      # [(shape, dtype)] per flat output
         self.out_refs: List[Optional[weakref.ref]] = [None] * len(out_specs)
 
@@ -51,11 +56,17 @@ class TapeNode:
     def release(self):
         """Free vjp residuals after backward (retain_graph=False)."""
         self.vjp_fn = None
+        self.fwd_fn = None
         self.inputs = []
+        self.in_arrays = None
 
 
 class _GradState:
     enabled = True
+    # When True, the tape records even under a jax trace (normally bypassed
+    # for the one-fused-XLA-module perf path). Set by enable_grad(): inside
+    # jit this is the explicit opt-in for paddle.grad/double-grad regions.
+    force_tape = False
 
 
 @contextmanager
@@ -72,11 +83,14 @@ def no_grad():
 @contextmanager
 def enable_grad():
     prev = _GradState.enabled
+    prev_force = _GradState.force_tape
     _GradState.enabled = True
+    _GradState.force_tape = True
     try:
         yield
     finally:
         _GradState.enabled = prev
+        _GradState.force_tape = prev_force
 
 
 def set_grad_enabled(mode: bool):
@@ -211,6 +225,150 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
     _run_engine(tensor, root_grad, retain_graph)
 
 
+def _tensor_key(t):
+    """Identity of a value in the replay env: producer slot for op outputs,
+    object id for leaves."""
+    if t._node is not None:
+        return (id(t._node), t._out_idx)
+    return ("leaf", id(t))
+
+
+def _collect_forward(outputs, blocked_ids):
+    """Forward subgraph reaching `outputs` in execution (seq) order."""
+    seen, nodes, stack = set(), [], []
+    for t in outputs:
+        if t._node is not None and id(t) not in blocked_ids:
+            stack.append(t._node)
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        nodes.append(n)
+        for inp in n.inputs:
+            pn = inp._node
+            if pn is not None and not inp.stop_gradient \
+                    and id(inp) not in blocked_ids and id(pn) not in seen:
+                stack.append(pn)
+    nodes.sort(key=lambda n: n.seq)
+    return nodes
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused,
+                       no_grad_vars):
+    """Higher-order paddle.grad: replay the recorded forward as one pure JAX
+    function of the leaf inputs, then dispatch its vjp as a single
+    'partial_grad' op — which itself lands on the tape, so the returned
+    grads are differentiable to any order (reference:
+    imperative/partial_grad_engine.cc create_graph path; here AD composes
+    for free because every replayed op is pure JAX)."""
+    from .tensor import Tensor
+    from . import dispatch as _dispatch
+
+    blocked_ids = {id(v) for v in (no_grad_vars or [])}
+    nodes = _collect_forward(outputs, blocked_ids)
+    for n in nodes:
+        if n.fwd_fn is None:
+            raise RuntimeError(
+                "create_graph=True requires the forward graph to be alive; "
+                "it was already freed by a previous backward() without "
+                "retain_graph=True.")
+
+    # forward-time snapshot of every node input (in-place updates of leaves
+    # between forward and grad must not leak into the replay — eager parity:
+    # the vjp residuals were captured at forward time too)
+    recorded: Dict[int, Any] = {}
+    used_keys = set()
+    for n in nodes:
+        for t, a in zip(n.inputs, n.in_arrays or []):
+            recorded.setdefault(id(t), a)
+            used_keys.add(_tensor_key(t))
+    used_keys.update(_tensor_key(t) for t in outputs)
+
+    def _rec_value(t):
+        return recorded.get(id(t), t._value)
+
+    # eager parity: a stop_gradient input is "not used in the graph"
+    unused = [t.stop_gradient or _tensor_key(t) not in used_keys
+              for t in inputs]
+    if any(unused) and not allow_unused:
+        raise RuntimeError(
+            "One of the differentiated tensors appears to not have been "
+            "used in the graph. Set allow_unused=True if this is desired.")
+
+    seeds = []
+    for out, g in zip(outputs, grad_outputs):
+        if g is None:
+            seeds.append(jnp.ones(out.shape, out._value.dtype))
+        else:
+            seeds.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
+    seeds = tuple(seeds)
+
+    # The dispatched op must stay connected to EVERY differentiable leaf in
+    # the subgraph (not just the requested inputs), so that backward through
+    # the returned grads reaches e.g. model weights (gradient penalties).
+    # Deduplicate by value identity: a tensor requested twice gets the same
+    # gradient at both positions.
+    all_args, arg_keys, pos_of = [], [], {}
+    for t in inputs:
+        k = _tensor_key(t)
+        if k not in pos_of:
+            pos_of[k] = len(all_args)
+            all_args.append(t)
+            arg_keys.append(k)
+    for n in nodes:
+        for t in n.inputs:
+            k = _tensor_key(t)
+            if t._node is None and not t.stop_gradient \
+                    and id(t) not in blocked_ids and k not in pos_of:
+                pos_of[k] = len(all_args)
+                all_args.append(t)
+                arg_keys.append(k)
+
+    def replay(*in_arrs):
+        override = dict(zip(arg_keys, in_arrs))
+        env = dict(override)
+        for n in nodes:
+            vals = []
+            for t in n.inputs:
+                # blocked (no_grad_vars) tensors are constants; stop_gradient
+                # frontiers are constants automatically (their producers were
+                # never collected, so env has no entry)
+                if id(t) in blocked_ids:
+                    vals.append(_rec_value(t))
+                else:
+                    vals.append(env.get(_tensor_key(t), _rec_value(t)))
+            outs = n.fwd_fn(*vals)
+            flat, _ = jax.tree_util.tree_flatten(outs)
+            for i, o in enumerate(flat):
+                k = (id(n), i)
+                if k not in override:  # requested intermediates stay pinned
+                    env[k] = o
+        return tuple(env.get(_tensor_key(t), _rec_value(t)) for t in outputs)
+
+    def grad_fn(*in_arrs):
+        _, vjp = jax.vjp(replay, *in_arrs)
+        return vjp(seeds)
+
+    # Evaluate at the forward-time point: temporarily pin each arg tensor's
+    # value to its recorded array so the dispatched vjp (and any further
+    # differentiation of it) is taken where the graph was actually built.
+    saved = [(t, t._value) for t in all_args if id(t) in recorded]
+    try:
+        for t, _ in saved:
+            t._value = recorded[id(t)]
+        grads = _dispatch.dispatch("partial_grad", grad_fn,
+                                   tuple(all_args), {})
+    finally:
+        for t, v in saved:
+            t._value = v
+    results = []
+    for t, is_unused in zip(inputs, unused):
+        results.append(None if is_unused
+                       else grads[pos_of[_tensor_key(t)]])
+    return results
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph: bool = False, only_inputs: bool = True,
          allow_unused: bool = False, no_grad_vars=None):
@@ -219,11 +377,18 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     Returns grads of `outputs` w.r.t. `inputs` without touching .grad.
     """
     from .tensor import Tensor
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported yet")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if create_graph:
+        if grad_outputs is None:
+            grad_outputs = [None] * len(outputs)
+        elif not isinstance(grad_outputs, (list, tuple)):
+            grad_outputs = [grad_outputs]
+        if no_grad_vars is not None and not isinstance(no_grad_vars,
+                                                       (list, tuple)):
+            no_grad_vars = [no_grad_vars]
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused, no_grad_vars)
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     elif not isinstance(grad_outputs, (list, tuple)):

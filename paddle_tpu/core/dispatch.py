@@ -77,7 +77,8 @@ def dispatch(op_type: str, fn: Callable, args, kwargs, differentiable=True):
             return captured
 
     tracing = any(_is_tracer(a) for a in arrs)
-    need_grad = (differentiable and _GradState.enabled and not tracing
+    need_grad = (differentiable and _GradState.enabled
+                 and (not tracing or _GradState.force_tape)
                  and any(not t.stop_gradient for t in in_tensors))
 
     if not need_grad:
@@ -91,6 +92,8 @@ def dispatch(op_type: str, fn: Callable, args, kwargs, differentiable=True):
         _vjp_adapter(vjp_fn, out_tree, len(flat_out)),
         in_tensors,
         [(tuple(a.shape), a.dtype) for a in flat_out],
+        fwd_fn=pure,
+        in_arrays=arrs,
     )
     return _wrap_outputs(op_type, out, node, stop_gradient=False)
 

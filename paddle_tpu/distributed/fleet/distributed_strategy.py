@@ -67,6 +67,7 @@ class DistributedStrategy:
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1, "begin_step": 1}
         self.dgc = False
         self.dgc_configs = {"rampup_begin_step": 0}
         self.fp16_allreduce = False

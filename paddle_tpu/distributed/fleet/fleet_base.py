@@ -125,9 +125,46 @@ class Fleet:
         reference's meta-optimizer pipeline ultimately produces)."""
         strategy = strategy or self._user_defined_strategy
         optimizer = optimizer or self._origin_optimizer
+        _check_unsupported(strategy)
         opt = _apply_optimizer_strategies(optimizer, strategy)
         inner_loss_fn = _apply_loss_strategies(loss_fn, strategy)
         real_model = model._layers if hasattr(model, "_layers") else model
+        if strategy.localsgd or strategy.adaptive_localsgd:
+            from .comm_opt import AdaptiveLocalSGDStep, LocalSGDStep
+            if strategy.fp16_allreduce:
+                raise NotImplementedError(
+                    "localsgd + fp16_allreduce cannot compose: LocalSGD "
+                    "does not allreduce gradients at all (it syncs params "
+                    "every k steps); pick one.")
+            if self._hybrid_mesh is not None and any(
+                    self._hybrid_mesh.shape.get(ax, 1) > 1
+                    for ax in ("tp", "pp", "sp", "sharding")):
+                raise NotImplementedError(
+                    "localsgd runs per-rank parameter copies over a pure "
+                    "dp mesh; combine it with tp/pp/sp/sharding degrees "
+                    "is not supported (reference localsgd_optimizer is "
+                    "DP-only too).")
+            cfg = strategy.localsgd_configs
+            if strategy.adaptive_localsgd:
+                acfg = strategy.adaptive_localsgd_configs
+                return AdaptiveLocalSGDStep(
+                    real_model, inner_loss_fn, opt,
+                    init_k_steps=int(acfg.get("init_k_steps", 1)),
+                    begin_step=int(acfg.get("begin_step", 1)))
+            return LocalSGDStep(real_model, inner_loss_fn, opt,
+                                k_steps=int(cfg.get("k_steps", 1)),
+                                begin_step=int(cfg.get("begin_step", 1)))
+        if strategy.fp16_allreduce:
+            from .comm_opt import Fp16AllReduceStep
+            if self._hybrid_mesh is not None and any(
+                    self._hybrid_mesh.shape.get(ax, 1) > 1
+                    for ax in ("tp", "pp", "sp", "sharding")):
+                raise NotImplementedError(
+                    "fp16_allreduce's manual reduced-precision grad sync "
+                    "runs over a pure dp mesh; with tp/pp/sp/sharding "
+                    "degrees use ShardedTrainStep (XLA picks collective "
+                    "precision) instead.")
+            return Fp16AllReduceStep(real_model, inner_loss_fn, opt)
         step = ShardedTrainStep(
             real_model, inner_loss_fn, opt,
             mesh=self._hybrid_mesh,
@@ -203,6 +240,18 @@ class _FleetOptimizer:
 
     def clear_grad(self):
         self._inner.clear_grad()
+
+
+def _check_unsupported(strategy: DistributedStrategy):
+    """Strategy flags must work or fail loudly — silent no-ops corrupt
+    experiments (reference flags: distributed_strategy.proto)."""
+    if strategy.dgc:
+        raise NotImplementedError(
+            "DistributedStrategy.dgc (deep gradient compression, reference "
+            "operators/optimizers/dgc_momentum_op) is not supported on the "
+            "TPU backend: ICI bandwidth makes top-k grad sparsification a "
+            "pessimization, and XLA collectives operate on dense buffers. "
+            "Use fp16_allreduce (bf16 comm) or localsgd instead.")
 
 
 def _apply_optimizer_strategies(optimizer, strategy: DistributedStrategy):

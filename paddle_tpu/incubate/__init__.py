@@ -1,1 +1,5 @@
-
+"""paddle.incubate (reference: python/paddle/fluid/incubate/ +
+paddle.incubate 2.x): experimental features that graduated into the core
+packages here — re-exported for API parity."""
+from . import checkpoint  # noqa: F401
+from . import optimizer  # noqa: F401

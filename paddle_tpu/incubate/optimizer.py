@@ -1,0 +1,4 @@
+"""paddle.incubate.optimizer (reference: incubate LookAhead/ModelAverage)."""
+from ..optimizer.wrappers import (  # noqa: F401
+    LookaheadOptimizer as LookAhead, ModelAverage, ExponentialMovingAverage,
+)

@@ -328,6 +328,7 @@ class TrainStep:
         return params, frozen
 
     def __call__(self, *args):
+        from ..profiler import RecordEvent
         params, frozen = self._split_params()
         buffers = {k: b._value for k, b in self.model.named_buffers()
                    if b is not None}
@@ -337,8 +338,9 @@ class TrainStep:
                     for a in args]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.next_key()
-        res = self._step(params, frozen, buffers, self._opt_state, lr, key,
-                         *arr_args)
+        with RecordEvent("TrainStep"):
+            res = self._step(params, frozen, buffers, self._opt_state, lr,
+                             key, *arr_args)
         if self.return_outputs:
             loss, new_params, new_buffers, self._opt_state, out = res
         else:

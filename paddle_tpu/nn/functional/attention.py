@@ -26,7 +26,7 @@ _flags.define_flag("use_flash_attention", True,
 
 
 def _wrap(x):
-    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    return x if isinstance(x, Tensor) else to_tensor(x)
 
 
 @op("scaled_dot_product_attention")

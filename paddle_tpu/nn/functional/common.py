@@ -19,7 +19,7 @@ from ...core import random as _random
 
 
 def _wrap(x):
-    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    return x if isinstance(x, Tensor) else to_tensor(x)
 
 
 @op("linear")

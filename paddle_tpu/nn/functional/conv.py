@@ -17,7 +17,7 @@ from ...core.tensor import Tensor, to_tensor
 
 
 def _wrap(x):
-    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    return x if isinstance(x, Tensor) else to_tensor(x)
 
 
 def _tuple_n(v, n):

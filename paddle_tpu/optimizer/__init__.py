@@ -5,3 +5,6 @@ from .optimizers import (  # noqa: F401
     Lars, LarsMomentum,
 )
 from . import lr  # noqa: F401
+from .wrappers import (  # noqa: F401
+    ExponentialMovingAverage, ModelAverage, LookaheadOptimizer, Lookahead,
+)

@@ -1,0 +1,200 @@
+"""Wrapper optimizers: EMA, ModelAverage, Lookahead.
+
+Reference: fluid/optimizer.py — ExponentialMovingAverage (:3466),
+ModelAverage (:3157), LookaheadOptimizer (:5230). All three maintain shadow
+parameter state alongside training and can temporarily swap it in for
+evaluation (apply/restore).
+
+TPU-native: shadow state is a plain name→array pytree updated with pure jnp
+expressions; apply/restore swap Tensor._value (zero-copy on device).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+__all__ = ["ExponentialMovingAverage", "ModelAverage", "LookaheadOptimizer",
+           "Lookahead"]
+
+
+def _named_params(parameters) -> Dict[str, Tensor]:
+    return {p.name: p for p in parameters}
+
+
+class ExponentialMovingAverage:
+    """shadow = decay * shadow + (1 - decay) * param, with optional
+    Adam-style bias correction through `thres_steps`-free default
+    (reference: fluid/optimizer.py:3466)."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None, name=None,
+                 parameters: Optional[List[Tensor]] = None):
+        if parameters is None:
+            raise ValueError("parameters is required (pass "
+                             "model.parameters())")
+        self._decay = float(decay)
+        self._params = _named_params(parameters)
+        self._shadow = {k: p._value.astype(jnp.float32)
+                        for k, p in self._params.items()}
+        self._backup: Optional[Dict[str, jax.Array]] = None
+        self._step = 0
+
+    def update(self):
+        """Call after each optimizer.step()."""
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step)) \
+            if self._decay >= 1.0 else self._decay
+        for k, p in self._params.items():
+            self._shadow[k] = (d * self._shadow[k]
+                               + (1.0 - d) * p._value.astype(jnp.float32))
+
+    @contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap EMA weights in (evaluation); restore on exit."""
+        with no_grad():
+            self._backup = {k: p._value for k, p in self._params.items()}
+            for k, p in self._params.items():
+                p._value = self._shadow[k].astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for k, p in self._params.items():
+                p._value = self._backup[k]
+            self._backup = None
+
+    def state_dict(self):
+        return {f"{k}_ema": Tensor(v) for k, v in self._shadow.items()} | {
+            "ema_step": self._step}
+
+    def set_state_dict(self, state):
+        self._step = int(state.get("ema_step", 0))
+        for k in self._shadow:
+            v = state.get(f"{k}_ema")
+            if v is not None:
+                self._shadow[k] = v._value if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+
+
+class ModelAverage:
+    """Sliding-window parameter average with the reference's exact sum_1/
+    sum_2/sum_3 rotation (reference: fluid/optimizer.py:3157 backed by
+    operators/average_accumulates_op.h: on window trigger sum_3 = sum_1 +
+    sum_2, counters rotate into old_num_accumulates; applied average =
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates))."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters: Optional[List[Tensor]] = None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000000, name=None):
+        if parameters is None:
+            raise ValueError("parameters is required")
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._params = _named_params(parameters)
+        zeros = lambda: {k: jnp.zeros_like(p._value, dtype=jnp.float32)  # noqa: E731
+                         for k, p in self._params.items()}
+        self._sum1 = zeros()
+        self._sum2 = zeros()
+        self._sum3 = zeros()
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._backup = None
+
+    def update(self):
+        """Accumulate current params (call each step after optimizer)."""
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for k, p in self._params.items():
+            self._sum1[k] = self._sum1[k] + p._value.astype(jnp.float32)
+        if (self._num_accumulates >= self._min_w
+                and self._num_accumulates >= min(
+                    self._max_w, self._num_updates * self._rate)):
+            for k in self._params:
+                self._sum3[k] = self._sum1[k] + self._sum2[k]
+                self._sum1[k] = jnp.zeros_like(self._sum1[k])
+                self._sum2[k] = jnp.zeros_like(self._sum2[k])
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+
+    @contextmanager
+    def apply(self, executor=None, need_restore=True):
+        with no_grad():
+            self._backup = {k: p._value for k, p in self._params.items()}
+            n = max(self._num_accumulates + self._old_num_accumulates, 1)
+            for k, p in self._params.items():
+                avg = (self._sum1[k] + self._sum2[k] + self._sum3[k]) / n
+                p._value = avg.astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for k, p in self._params.items():
+                p._value = self._backup[k]
+            self._backup = None
+
+    # paddle 2.x incubate.ModelAverage exposes step/minimize no-ops
+    def step(self):
+        self.update()
+
+
+class LookaheadOptimizer:
+    """k fast steps, then slow += alpha * (fast - slow); fast = slow
+    (reference: fluid/optimizer.py:5230, Zhang et al. 2019)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be within [0, 1]")
+        if k <= 0:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._steps = 0
+        params = inner_optimizer._parameter_list or []
+        self._params = _named_params(params)
+        self._slow = {kk: p._value.astype(jnp.float32)
+                      for kk, p in self._params.items()}
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            with no_grad():
+                for kk, p in self._params.items():
+                    slow = (self._slow[kk]
+                            + self.alpha * (p._value.astype(jnp.float32)
+                                            - self._slow[kk]))
+                    self._slow[kk] = slow
+                    p._value = slow.astype(p._value.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params.values()]
+
+
+Lookahead = LookaheadOptimizer

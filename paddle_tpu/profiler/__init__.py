@@ -1,1 +1,303 @@
+"""Profiler: host event recording + XLA device tracing + chrome timeline.
 
+TPU-native analogue of the reference profiler stack:
+- RecordEvent RAII markers: platform/profiler.h:127 (placed in Tracer at
+  tracer.cc:135 and op Run) → here a context manager/decorator that records
+  host wall-time events AND emits a jax.profiler.TraceAnnotation so the same
+  name shows up inside XLA's device trace.
+- EnableProfiler/DisableProfiler + aggregated tables:
+  platform/profiler.h:210-213, python wrappers fluid/profiler.py
+  (start_profiler/stop_profiler/profiler context).
+- Device side: CUPTI DeviceTracer (platform/device_tracer.cc) → here
+  jax.profiler.start_trace/stop_trace producing a TensorBoard/perfetto
+  trace directory.
+- tools/timeline.py chrome-trace generation → export_chrome_tracing().
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+__all__ = [
+    "RecordEvent", "record_event", "start_profiler", "stop_profiler",
+    "reset_profiler", "profiler", "is_profiler_enabled",
+    "start_trace", "stop_trace", "export_chrome_tracing", "summary",
+    "Profiler", "ProfilerTarget", "ProfilerState",
+]
+
+
+class _Event:
+    __slots__ = ("name", "start", "end", "tid", "depth")
+
+    def __init__(self, name, start, end, tid, depth):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.depth = depth
+
+
+class _ProfState:
+    enabled = False
+    events: List[_Event] = []
+    t0 = 0.0
+    lock = threading.Lock()
+    tls = threading.local()
+    trace_dir: Optional[str] = None
+    op_hook_installed = False
+
+
+def is_profiler_enabled() -> bool:
+    return _ProfState.enabled
+
+
+class RecordEvent:
+    """Scoped event marker (reference: platform/profiler.h:127 RecordEvent).
+
+    Usable as context manager or decorator. Host side: wall-time event in
+    the global table. Device side: a jax.profiler.TraceAnnotation so the
+    scope appears in XLA traces viewed in TensorBoard/perfetto.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def begin(self):
+        if _ProfState.enabled:
+            self._t0 = time.perf_counter()
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+            depth = getattr(_ProfState.tls, "depth", 0)
+            _ProfState.tls.depth = depth + 1
+
+    def end(self):
+        if self._t0 is not None:
+            t1 = time.perf_counter()
+            _ProfState.tls.depth -= 1
+            with _ProfState.lock:
+                _ProfState.events.append(_Event(
+                    self.name, self._t0, t1,
+                    threading.get_ident(), _ProfState.tls.depth))
+            if self._ann is not None:
+                self._ann.__exit__(None, None, None)
+                self._ann = None
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapper
+
+
+@contextmanager
+def record_event(name: str):
+    with RecordEvent(name):
+        yield
+
+
+def _install_op_hook():
+    """Record every dispatched op while profiling (reference: RecordEvent
+    placed in Tracer::TraceOp, imperative/tracer.cc:135)."""
+    if _ProfState.op_hook_installed:
+        return
+    from ..core import dispatch as _d
+    orig = _d.dispatch
+
+    def profiled_dispatch(op_type, fn, args, kwargs, differentiable=True):
+        if not _ProfState.enabled:
+            return orig(op_type, fn, args, kwargs, differentiable)
+        with RecordEvent(op_type):
+            return orig(op_type, fn, args, kwargs, differentiable)
+
+    _d.dispatch = profiled_dispatch
+    _ProfState.op_hook_installed = True
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default"):
+    """reference: fluid/profiler.py start_profiler → EnableProfiler.
+    state: 'CPU' (host events only), 'GPU'/'All' (device scopes appear via
+    TraceAnnotation when an XLA trace is active — see start_trace)."""
+    if _ProfState.enabled:
+        return
+    _install_op_hook()
+    _ProfState.events = []
+    _ProfState.t0 = time.perf_counter()
+    _ProfState.enabled = True
+
+
+def reset_profiler():
+    """reference: fluid/profiler.py reset_profiler."""
+    with _ProfState.lock:
+        _ProfState.events = []
+        _ProfState.t0 = time.perf_counter()
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None):
+    """reference: fluid/profiler.py stop_profiler → DisableProfiler; prints
+    the aggregate table (platform/profiler.cc PrintProfiler analogue) and
+    optionally writes the raw events (chrome-trace JSON, loadable by
+    chrome://tracing — the tools/timeline.py role)."""
+    if not _ProfState.enabled:
+        return
+    _ProfState.enabled = False
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    print(summary(sorted_key=sorted_key or "total"))
+
+
+def summary(sorted_key: str = "total") -> str:
+    """Aggregate event table: calls/total/avg/min/max ms per event name."""
+    agg: Dict[str, List[float]] = {}
+    with _ProfState.lock:
+        events = list(_ProfState.events)
+    for e in events:
+        d = (e.end - e.start) * 1e3
+        s = agg.setdefault(e.name, [0, 0.0, float("inf"), 0.0])
+        s[0] += 1
+        s[1] += d
+        s[2] = min(s[2], d)
+        s[3] = max(s[3], d)
+    keymap = {
+        "calls": lambda kv: -kv[1][0],
+        "total": lambda kv: -kv[1][1],
+        "min": lambda kv: kv[1][2],
+        "max": lambda kv: -kv[1][3],
+        "ave": lambda kv: -(kv[1][1] / kv[1][0]),
+    }
+    rows = sorted(agg.items(), key=keymap.get(sorted_key, keymap["total"]))
+    lines = ["-" * 78,
+             f"{'Event':<30}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+             f"{'Min(ms)':>9}{'Max(ms)':>9}",
+             "-" * 78]
+    for name, (n, tot, mn, mx) in rows:
+        lines.append(f"{name[:29]:<30}{n:>8}{tot:>12.3f}{tot / n:>10.3f}"
+                     f"{mn:>9.3f}{mx:>9.3f}")
+    lines.append("-" * 78)
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(path: str):
+    """Write recorded host events as a chrome://tracing JSON file
+    (reference: tools/timeline.py Timeline generation)."""
+    with _ProfState.lock:
+        events = list(_ProfState.events)
+    trace = {"traceEvents": [
+        {"name": e.name, "ph": "X", "cat": "op",
+         "ts": (e.start - _ProfState.t0) * 1e6,
+         "dur": (e.end - e.start) * 1e6,
+         "pid": os.getpid(), "tid": e.tid}
+        for e in events
+    ]}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+@contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: Optional[str] = None, tracer_option="Default"):
+    """reference: fluid/profiler.py profiler context manager."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# ---------------------------------------------------------------- XLA trace
+def start_trace(log_dir: str):
+    """Start an XLA/TPU device trace (CUPTI DeviceTracer analogue —
+    jax.profiler.start_trace; view in TensorBoard or perfetto)."""
+    import jax
+    _ProfState.trace_dir = log_dir
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    import jax
+    jax.profiler.stop_trace()
+    d = _ProfState.trace_dir
+    _ProfState.trace_dir = None
+    return d
+
+
+# ----------------------------------------------------- paddle.profiler 2.x
+class ProfilerTarget:
+    CPU = "CPU"
+    GPU = "GPU"
+    CUSTOM_DEVICE = "CUSTOM_DEVICE"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class Profiler:
+    """Object-style profiler over the same machinery (host events +
+    optional XLA trace directory)."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, trace_dir=None):
+        self._targets = targets or [ProfilerTarget.CPU]
+        self._on_trace_ready = on_trace_ready
+        self._trace_dir = trace_dir
+        self._timer_only = timer_only
+        self._step = 0
+
+    def start(self):
+        start_profiler()
+        if self._trace_dir and not self._timer_only:
+            start_trace(self._trace_dir)
+
+    def stop(self):
+        if self._trace_dir and not self._timer_only:
+            stop_trace()
+        _ProfState.enabled = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self._step += 1
+
+    def step_info(self, unit=None):
+        return f"step {self._step}"
+
+    def summary(self, sorted_by="total", **kw):
+        return summary(sorted_key=sorted_by)
+
+    def export(self, path, format="json"):
+        return export_chrome_tracing(path)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

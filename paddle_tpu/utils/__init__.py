@@ -1,1 +1,122 @@
+"""paddle.utils equivalents (reference: python/paddle/utils/ — deprecated
+decorator, lazy import, install check, unique_name, download)."""
+from __future__ import annotations
 
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "try_import", "run_check", "unique_name",
+           "download", "flops"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """reference: python/paddle/utils/deprecated.py — warn once per site."""
+    def deco(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+    return deco
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """reference: python/paddle/utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Required optional dependency '{module_name}' is "
+                       f"not installed; this environment is sealed (no pip "
+                       f"installs), so the feature needing it is unavailable.")
+
+
+def run_check():
+    """reference: python/paddle/utils/install_check.py run_check — verify
+    the framework can execute a compute on the available backend(s)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    dev = jax.devices()[0]
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = paddle.matmul(a, a).numpy()
+    assert float(out.sum()) == 8.0
+    print(f"paddle_tpu is installed successfully! backend="
+          f"{jax.default_backend()} device={dev.device_kind}")
+    return True
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._ids = {}
+
+    def __call__(self, prefix: str) -> str:
+        i = self._ids.get(prefix, 0)
+        self._ids[prefix] = i + 1
+        return f"{prefix}_{i}"
+
+
+class unique_name:
+    """reference: fluid/unique_name.py — process-wide name uniquifier with
+    a `guard` that scopes the counters (so a model rebuilt inside a fresh
+    guard gets the same auto-generated parameter names — the checkpoint-
+    resume contract across processes)."""
+    _generator = _UniqueNameGenerator()
+
+    @staticmethod
+    def generate(prefix: str) -> str:
+        return unique_name._generator(prefix)
+
+    @staticmethod
+    def switch(new_generator=None):
+        old = unique_name._generator
+        unique_name._generator = new_generator or _UniqueNameGenerator()
+        return old
+
+    @staticmethod
+    def guard(new_generator=None):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _guard():
+            from ..nn.layer import layers as _layers
+            from ..core import tensor as _tensor
+            old_gen = unique_name.switch(new_generator)
+            old_layer = dict(_layers._layer_name_counters)
+            old_tensor = _tensor._tensor_name_counter[0]
+            _layers._layer_name_counters.clear()
+            _tensor._tensor_name_counter[0] = 0
+            try:
+                yield
+            finally:
+                unique_name._generator = old_gen
+                _layers._layer_name_counters.clear()
+                _layers._layer_name_counters.update(old_layer)
+                _tensor._tensor_name_counter[0] = old_tensor
+        return _guard()
+
+
+def download(url, path=None, md5sum=None):
+    """reference: python/paddle/utils/download.py get_path_from_url. This
+    environment has no network egress; datasets fall back to synthetic data
+    (see paddle_tpu.vision.datasets), so downloading is unsupported."""
+    raise RuntimeError(
+        "paddle_tpu.utils.download: no network egress in this environment; "
+        "use local files or the synthetic dataset fallbacks.")
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """reference: paddle.flops → hapi.model_summary; re-export."""
+    from ..hapi.model_summary import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)

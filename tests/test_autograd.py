@@ -171,3 +171,129 @@ def test_check_nan_inf_flag():
             paddle.log(x - 2.0) * 0 + paddle.sqrt(x - 5.0)
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---------------------------------------------------------------- double grad
+def test_double_grad_basic():
+    """d/dx (dy/dx) for y = x^3: first grad 3x^2, second 6x."""
+    x = paddle.to_tensor(np.array([2.0, -1.5], np.float32),
+                         stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    assert not gx.stop_gradient
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([4.0, 2.25]),
+                               rtol=1e-5)
+    (ggx,) = paddle.grad(gx.sum(), [x])
+    np.testing.assert_allclose(ggx.numpy(), 6 * np.array([2.0, -1.5]),
+                               rtol=1e-5)
+
+
+def test_triple_grad():
+    x = paddle.to_tensor(np.array([1.3], np.float32), stop_gradient=False)
+    y = x ** 4
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g1, [x], create_graph=True)
+    (g3,) = paddle.grad(g2, [x])
+    np.testing.assert_allclose(g3.numpy(), 24 * np.array([1.3]), rtol=1e-5)
+
+
+def test_double_grad_matches_torch():
+    import torch
+    xn = np.random.randn(3, 4).astype("float32")
+    wn = np.random.randn(4, 2).astype("float32")
+
+    x = paddle.to_tensor(xn, stop_gradient=False)
+    w = paddle.to_tensor(wn, stop_gradient=False)
+    out = paddle.tanh(paddle.matmul(x, w)).sum()
+    (gx,) = paddle.grad(out, [x], create_graph=True)
+    penalty = (gx ** 2).sum()
+    penalty.backward()
+    got = w.grad.numpy()
+
+    xt = torch.tensor(xn, requires_grad=True)
+    wt = torch.tensor(wn, requires_grad=True)
+    outt = torch.tanh(xt @ wt).sum()
+    (gxt,) = torch.autograd.grad(outt, [xt], create_graph=True)
+    pent = (gxt ** 2).sum()
+    pent.backward()
+    np.testing.assert_allclose(got, wt.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_penalty_wgan_gp_style():
+    """WGAN-GP: penalty on the critic's input-gradient norm trains."""
+    paddle.seed(0)
+    critic = paddle.nn.Linear(5, 1)
+    xs = paddle.to_tensor(np.random.randn(8, 5).astype("float32"),
+                          stop_gradient=False)
+    score = critic(xs).sum()
+    (gx,) = paddle.grad(score, [xs], create_graph=True)
+    gp = ((paddle.sqrt((gx ** 2).sum(axis=1) + 1e-12) - 1.0) ** 2).mean()
+    gp.backward()
+    gnorm = np.linalg.norm(critic.weight.grad.numpy())
+    assert gnorm > 0  # penalty reaches the critic weights
+
+
+def test_double_grad_allow_unused_and_no_grad_vars():
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    z = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x * 3.0).sum()
+    gx, gz = paddle.grad(y, [x, z], create_graph=True, allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+    with pytest.raises(RuntimeError):
+        paddle.grad((x * 2).sum(), [z], create_graph=True)
+
+
+def test_double_grad_under_jit():
+    """Gradient penalty compiled into one XLA module: paddle.enable_grad()
+    inside a traced function opts the tape back in, so paddle.grad
+    (create_graph=True) composes under paddle.jit.to_static."""
+    paddle.seed(0)
+    critic = paddle.nn.Linear(5, 1)
+
+    def gp_fn(x):
+        x.stop_gradient = False
+        with paddle.enable_grad():
+            score = critic(x).sum()
+            (gx,) = paddle.grad(score, [x], create_graph=True)
+            gp = ((((gx ** 2).sum(axis=1)) ** 0.5 - 1.0) ** 2).mean()
+            (gw,) = paddle.grad(gp, [critic.weight])
+        return gp, gw
+
+    xn = np.random.randn(8, 5).astype("float32")
+    eager_gp, eager_gw = gp_fn(paddle.to_tensor(xn))
+    jit_fn = paddle.jit.to_static(gp_fn)
+    jit_gp, jit_gw = jit_fn(paddle.to_tensor(xn))
+    np.testing.assert_allclose(jit_gp.numpy(), eager_gp.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(jit_gw.numpy(), eager_gw.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_double_grad_uses_forward_time_values():
+    """In-place leaf updates between forward and grad must not change the
+    higher-order result (eager parity: vjp residuals are forward-time)."""
+    w = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = (w * x).sum()
+    with paddle.no_grad():
+        w.set_value(paddle.to_tensor(np.array([100.0], np.float32)))
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])  # not 100
+
+
+def test_double_grad_duplicate_inputs():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x ** 2).sum()
+    g1, g2 = paddle.grad(y, [x, x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [4.0])
+    np.testing.assert_allclose(g2.numpy(), [4.0])
+
+
+def test_double_grad_stop_gradient_input_raises():
+    s = paddle.to_tensor(np.array([1.0], np.float32))  # stop_gradient=True
+    w = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    z = (s * w).sum()
+    with pytest.raises(RuntimeError):
+        paddle.grad(z, [s], create_graph=True)
+    (gs,) = paddle.grad(z, [s], create_graph=True, allow_unused=True)
+    assert gs is None

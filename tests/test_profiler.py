@@ -1,0 +1,78 @@
+"""Profiler tests (reference: fluid/tests/unittests/test_profiler.py —
+profile a train loop, assert the aggregate table and timeline output)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+
+
+def test_record_event_and_summary(capsys):
+    prof.start_profiler("CPU")
+    with prof.RecordEvent("outer"):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        for _ in range(3):
+            x = paddle.matmul(x, x) * 0.1
+    prof.stop_profiler(sorted_key="calls")
+    out = capsys.readouterr().out
+    assert "outer" in out
+    assert "matmul_v2" in out          # per-op dispatch hook engaged
+    assert "elementwise_mul" in out
+    assert not prof.is_profiler_enabled()
+
+
+def test_chrome_trace_export(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    with prof.profiler(state="CPU", profile_path=path):
+        a = paddle.ones([8, 8])
+        (a @ a).sum()
+    trace = json.load(open(path))
+    events = trace["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    names = {e["name"] for e in events}
+    assert "matmul_v2" in names or "reduce_sum" in names
+
+
+def test_profiler_object_and_decorator(tmp_path):
+    @prof.RecordEvent("decorated_fn")
+    def work():
+        return paddle.ones([2]).sum()
+
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU])
+    with p:
+        work()
+        p.step()
+    table = p.summary()
+    assert "decorated_fn" in table
+    out = p.export(str(tmp_path / "t.json"))
+    assert os.path.exists(out)
+
+
+def test_profiler_off_is_zero_overhead_path():
+    # RecordEvent must be a no-op when profiling is disabled
+    ev = prof.RecordEvent("noop")
+    with ev:
+        pass
+    assert not prof._ProfState.enabled
+    before = len(prof._ProfState.events)
+    with prof.RecordEvent("noop2"):
+        pass
+    assert len(prof._ProfState.events) == before
+
+
+def test_train_step_event_recorded(capsys):
+    paddle.seed(0)
+    model = paddle.nn.Linear(4, 2)
+    optim = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda m, x, y: ((m(x) - y) ** 2).mean(), optim)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    y = paddle.to_tensor(np.random.randn(8, 2).astype("float32"))
+    step(x, y)  # compile outside the profile window
+    prof.start_profiler()
+    step(x, y)
+    prof.stop_profiler()
+    out = capsys.readouterr().out
+    assert "TrainStep" in out

@@ -1,0 +1,182 @@
+"""Strategy-matrix tests: LocalSGD, fp16 allreduce, wrapper optimizers, dgc.
+
+Reference test style: fleet meta-optimizer tests assert on the rewritten
+program (test_fleet_localsgd_meta_optimizer.py); here the strategies are
+executable on the 8-device CPU mesh, so we assert numerics instead.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import fleet as fleet_mod
+
+
+def _toy(seed=0):
+    paddle.seed(seed)
+    model = paddle.nn.Linear(4, 2)
+    X = np.random.RandomState(0).randn(16, 4).astype("float32")
+    Y = np.random.RandomState(1).randn(16, 2).astype("float32")
+    return model, X, Y
+
+
+def _loss_fn(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def test_localsgd_k1_matches_plain_dp():
+    """k_steps=1 LocalSGD == synchronous data parallel numerics."""
+    from paddle_tpu.distributed.fleet.comm_opt import LocalSGDStep
+
+    model, X, Y = _toy()
+    w0 = model.weight.numpy().copy()
+    sgd = opt.SGD(0.1, parameters=model.parameters())
+    step = LocalSGDStep(model, _loss_fn, sgd, k_steps=1)
+    for i in range(3):
+        loss = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+    # sequential single-device reference: same data, full batch
+    model2, _, _ = _toy()
+    np.testing.assert_allclose(model2.weight.numpy(), w0)
+    sgd2 = opt.SGD(0.1, parameters=model2.parameters())
+    for i in range(3):
+        l2 = _loss_fn(model2, paddle.to_tensor(X), paddle.to_tensor(Y))
+        l2.backward()
+        sgd2.step()
+        sgd2.clear_grad()
+    np.testing.assert_allclose(model.weight.numpy(), model2.weight.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(loss.numpy()), float(l2.numpy()),
+                               rtol=1e-4)
+
+
+def test_localsgd_diverges_then_syncs():
+    """Between syncs, rank copies differ; after the k-th step they agree."""
+    from paddle_tpu.distributed.fleet.comm_opt import LocalSGDStep
+
+    model, X, Y = _toy()
+    sgd = opt.SGD(0.1, parameters=model.parameters())
+    step = LocalSGDStep(model, _loss_fn, sgd, k_steps=3)
+    x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+    step(x, y)  # local step 1: no sync yet
+    r0 = step.rank_params(0)
+    r1 = step.rank_params(1)
+    key = sorted(r0)[0]
+    assert not np.allclose(np.asarray(r0[key]), np.asarray(r1[key]))
+    step(x, y)
+    step(x, y)  # step 3 = sync
+    r0 = step.rank_params(0)
+    r1 = step.rank_params(1)
+    np.testing.assert_allclose(np.asarray(r0[key]), np.asarray(r1[key]),
+                               rtol=1e-6)
+
+
+def test_fp16_allreduce_close_to_fp32():
+    from paddle_tpu.distributed.fleet.comm_opt import Fp16AllReduceStep
+
+    model, X, Y = _toy()
+    sgd = opt.SGD(0.1, parameters=model.parameters())
+    step = Fp16AllReduceStep(model, _loss_fn, sgd, dtype="bfloat16")
+    for _ in range(3):
+        loss = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+    model2, _, _ = _toy()
+    sgd2 = opt.SGD(0.1, parameters=model2.parameters())
+    for _ in range(3):
+        l2 = _loss_fn(model2, paddle.to_tensor(X), paddle.to_tensor(Y))
+        l2.backward()
+        sgd2.step()
+        sgd2.clear_grad()
+    # bf16 grad comm: close but not bit-equal
+    np.testing.assert_allclose(model.weight.numpy(), model2.weight.numpy(),
+                               rtol=0.05, atol=5e-3)
+
+
+def test_dgc_raises():
+    strat = fleet_mod.DistributedStrategy()
+    strat.dgc = True
+    fleet = fleet_mod.fleet
+    fleet.init(is_collective=True, strategy=strat)
+    model, X, Y = _toy()
+    sgd = opt.SGD(0.1, parameters=model.parameters())
+    with pytest.raises(NotImplementedError, match="dgc"):
+        fleet.distributed_train_step(model, _loss_fn, sgd, strategy=strat)
+
+
+def test_strategy_localsgd_via_fleet():
+    strat = fleet_mod.DistributedStrategy()
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+    fleet = fleet_mod.fleet
+    fleet.init(is_collective=True, strategy=strat)
+    model, X, Y = _toy()
+    sgd = opt.SGD(0.1, parameters=model.parameters())
+    step = fleet.distributed_train_step(model, _loss_fn, sgd,
+                                        strategy=strat)
+    from paddle_tpu.distributed.fleet.comm_opt import LocalSGDStep
+    assert isinstance(step, LocalSGDStep)
+    loss = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+    assert np.isfinite(float(loss.numpy()))
+
+
+# ----------------------------------------------------- wrapper optimizers
+def test_ema_matches_manual():
+    model, X, Y = _toy()
+    sgd = opt.SGD(0.1, parameters=model.parameters())
+    ema = opt.ExponentialMovingAverage(0.9, parameters=model.parameters())
+    manual = model.weight.numpy().astype(np.float64)
+    for _ in range(3):
+        loss = _loss_fn(model, paddle.to_tensor(X), paddle.to_tensor(Y))
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        ema.update()
+        manual = 0.9 * manual + 0.1 * model.weight.numpy()
+    live = model.weight.numpy().copy()
+    with ema.apply():
+        np.testing.assert_allclose(model.weight.numpy(), manual, rtol=1e-5)
+    np.testing.assert_allclose(model.weight.numpy(), live)  # restored
+
+
+def test_model_average_matches_mean():
+    model, X, Y = _toy()
+    sgd = opt.SGD(0.1, parameters=model.parameters())
+    ma = opt.ModelAverage(0.15, parameters=model.parameters(),
+                          min_average_window=2, max_average_window=10)
+    snaps = []
+    for _ in range(4):
+        loss = _loss_fn(model, paddle.to_tensor(X), paddle.to_tensor(Y))
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        ma.update()
+        snaps.append(model.weight.numpy().copy())
+    # window rotation (min_average_window=2): after 4 updates the applied
+    # average covers the last window = snaps 3 and 4 (reference
+    # average_accumulates_op.h rotation semantics)
+    with ma.apply():
+        np.testing.assert_allclose(model.weight.numpy(),
+                                   np.mean(snaps[2:], axis=0), rtol=1e-4)
+
+
+def test_lookahead_slow_weights():
+    model, X, Y = _toy()
+    inner = opt.SGD(0.1, parameters=model.parameters())
+    la = opt.LookaheadOptimizer(inner, alpha=0.5, k=2)
+    w0 = model.weight.numpy().astype(np.float64)
+    fast = [w0.copy()]
+    for i in range(2):
+        loss = _loss_fn(model, paddle.to_tensor(X), paddle.to_tensor(Y))
+        loss.backward()
+        # manual fast step BEFORE wrapper (grads available now)
+        g = model.weight.grad.numpy()
+        fast.append(fast[-1] - 0.1 * g)
+        la.step()
+        la.clear_grad()
+    expected = w0 + 0.5 * (fast[-1] - w0)
+    np.testing.assert_allclose(model.weight.numpy(), expected, rtol=1e-4)
+
+    with pytest.raises(ValueError):
+        opt.LookaheadOptimizer(inner, alpha=1.5)
+    with pytest.raises(ValueError):
+        opt.LookaheadOptimizer(inner, k=0)
