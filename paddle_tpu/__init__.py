@@ -49,6 +49,7 @@ from .core.random import seed, get_rng_state  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from . import ops  # noqa: F401
 from .ops import sum, max, min, abs, all, any, round, pow, slice  # noqa: F401,A004
+from .ops import fft  # noqa: E402  (paddle.fft module parity)
 
 # -- subsystem namespaces ---------------------------------------------------
 from . import nn  # noqa: F401,E402
@@ -71,7 +72,11 @@ from .hapi import Model, summary, flops  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .framework_io import save, load  # noqa: F401,E402
 
-from .nn.layer.base import ParamAttr  # noqa: F401,E402
+from .nn.layer.base import ParamAttr  # noqa: E402
+
+# legacy op-name aliases resolve against ops registered by nn.functional
+from .ops.extra_ops import register_legacy_aliases as _rla  # noqa: E402
+_rla()
 
 # dygraph-mode API parity helpers (reference: fluid/framework.py
 # in_dygraph_mode; this framework is dygraph-by-default like paddle 2.0)

@@ -20,12 +20,21 @@ import jax
 class _RNGState:
     seed = 0
     counter = 0
-    root_key = jax.random.PRNGKey(0)
+    # Lazily materialized: building a PRNGKey initializes the XLA backend,
+    # which must not happen at import time (jax.distributed.initialize in
+    # init_parallel_env must run before any backend use).
+    _root_key = None
+
+    @classmethod
+    def get_root_key(cls):
+        if cls._root_key is None:
+            cls._root_key = jax.random.PRNGKey(cls.seed)
+        return cls._root_key
 
 
 def seed(s: int):
     _RNGState.seed = int(s)
-    _RNGState.root_key = jax.random.PRNGKey(int(s))
+    _RNGState._root_key = jax.random.PRNGKey(int(s))
     _RNGState.counter = 0
     return _RNGState
 
@@ -71,7 +80,7 @@ def next_key():
         _TraceKey.site_counter += 1
         return jax.random.fold_in(_TraceKey.key, _TraceKey.site_counter)
     _RNGState.counter += 1
-    return jax.random.fold_in(_RNGState.root_key, _RNGState.counter)
+    return jax.random.fold_in(_RNGState.get_root_key(), _RNGState.counter)
 
 
 def default_seed() -> int:

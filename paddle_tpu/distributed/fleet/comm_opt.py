@@ -136,7 +136,8 @@ class _PerRankStep:
                     for a in args]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.next_key()
-        do_sync = jnp.asarray(self._should_sync())
+        synced_now = bool(self._should_sync())
+        do_sync = jnp.asarray(synced_now)
         if self._jitted is None:
             self._build(len(arr_args))
         loss, self._stacked, self._buffers, self._opt_state = self._jitted(
@@ -144,12 +145,12 @@ class _PerRankStep:
             *arr_args)
         self._i += 1
         self.optimizer._global_step += 1
-        if self._should_sync_writeback():
+        # write back to the Layer exactly when the per-rank copies were
+        # synchronized (model.parameters() stays consistent with the
+        # distributed state at sync boundaries)
+        if synced_now:
             self.sync_to_model()
         return Tensor(loss)
-
-    def _should_sync_writeback(self):
-        return self._i % self._k == 0
 
     def sync_to_model(self):
         """Write the rank-averaged params/buffers back into the Layer."""
@@ -223,6 +224,13 @@ class Fp16AllReduceStep(_PerRankStep):
                          sync_dtype=dt, k_steps=1)
 
     def _should_sync(self):
-        # grads are already synced in reduced precision each step; the param
-        # pmean is a cheap idempotent guard against drift
-        return True
+        # grads are pmean'd (in bf16) every step already, so all rank
+        # copies stay bit-identical — an extra f32 param pmean would cost
+        # MORE than the comm this strategy exists to save. The step-end
+        # writeback still runs (sync_to_model averages identical copies).
+        return False
+
+    def __call__(self, *args):
+        loss = super().__call__(*args)
+        self.sync_to_model()  # copies are identical; mean is exact
+        return loss

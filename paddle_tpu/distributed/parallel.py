@@ -81,14 +81,20 @@ def init_parallel_env():
     global _parallel_env_initialized
     env = ParallelEnv()
     if env.world_size > 1 and not _parallel_env_initialized:
-        coord = env.trainer_endpoints[0] if env.trainer_endpoints else None
-        try:
+        from jax._src import distributed as _jdist
+        if _jdist.global_state.client is not None:
+            # coordination service already up (e.g. user called
+            # jax.distributed.initialize directly) — idempotent re-init
+            pass
+        else:
+            coord = env.trainer_endpoints[0] if env.trainer_endpoints \
+                else None
+            # no blanket except: a failed bootstrap must propagate — a
+            # silently-single-process "distributed" run corrupts experiments
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=env.world_size,
                 process_id=env.rank)
-        except Exception as e:  # already initialized / unsupported backend
-            warnings.warn(f"jax.distributed.initialize skipped: {e}")
     if _mesh.get_global_mesh() is None:
         _mesh.set_global_mesh(_mesh.build_mesh(dp=len(jax.devices())))
     _parallel_env_initialized = True

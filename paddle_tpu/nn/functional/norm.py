@@ -188,3 +188,64 @@ def _lrn(x, size, alpha, beta, k):
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW", name=None):
     return _lrn(_wrap(x), size, alpha, beta, k)
+
+
+@op("sync_batch_norm")
+def _sync_bn_train(x, weight, bias, eps, c_axis, axes_names):
+    """reference: operators/sync_batch_norm_op.cu — batch stats allreduced
+    across the data-parallel group. Inside a shard_map/SPMD trace the
+    lax.pmean over the bound mesh axes computes GLOBAL batch statistics
+    over ICI; outside any mesh scope it degenerates to local batch_norm
+    (single-rank semantics, same as the reference with nranks==1)."""
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    mean = jnp.mean(x, axis=axes)
+    mean_sq = jnp.mean(x * x, axis=axes)
+    for ax in axes_names:
+        try:
+            mean = jax.lax.pmean(mean, ax)
+            mean_sq = jax.lax.pmean(mean_sq, ax)
+        except NameError:
+            pass  # axis not bound: local stats
+    var = mean_sq - mean * mean
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                    training=True, momentum=0.9, epsilon=1e-5,
+                    data_format="NCHW", sync_axes=("dp",), name=None):
+    """Cross-replica batch norm (reference: sync_batch_norm_op.cu +
+    nn.SyncBatchNorm). sync_axes: mesh axes to average stats over."""
+    xt = _wrap(x)
+    c_axis = xt.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else 1
+    if xt.ndim == 2:
+        c_axis = 1
+    if not training:
+        return batch_norm(x, running_mean, running_var, weight, bias,
+                          training=False, momentum=momentum,
+                          epsilon=epsilon, data_format=data_format)
+    out, mean, var = _sync_bn_train(
+        xt, None if weight is None else _wrap(weight),
+        None if bias is None else _wrap(bias), epsilon, c_axis,
+        tuple(sync_axes))
+    if running_mean is not None:
+        from ...static.program import Variable as _SVar
+        if isinstance(running_mean, _SVar):
+            from ...static.nn import static_assign
+            static_assign(running_mean,
+                          running_mean * momentum + mean * (1.0 - momentum))
+            static_assign(running_var,
+                          running_var * momentum + var * (1.0 - momentum))
+        else:
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * mean._value)
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * var._value)
+    return out

@@ -23,8 +23,15 @@ from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .array_ops import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .metrics_ops import *  # noqa: F401,F403
+from .amp_ops import *  # noqa: F401,F403
 
-from . import creation, math, logic, manipulation, linalg, search
+from . import (creation, math, logic, manipulation, linalg, search,  # noqa: F401,E501
+               array_ops, random_ops, metrics_ops, amp_ops, sequence_ops,
+               control_flow, optimizer_ops, vision_ops, fft, extra_ops,
+               fused_ops, quant_ops)
 
 # re-bind names that collide with builtins for explicit use
 from .math import sum, max, min, abs, all, any, round, pow  # noqa: F401,A004
@@ -136,9 +143,13 @@ def _tensor_setitem(self, item, value):
 # Method attachment
 # --------------------------------------------------------------------------
 def _binary_dunder(fn, reverse=False):
+    import builtins
+
     def method(self, other):
+        # builtins.complex explicitly: paddle.complex (math.py) shadows the
+        # builtin in this star-import namespace, matching paddle's API
         if isinstance(other, (list, tuple, np.ndarray, int, float, bool,
-                              complex, np.generic)):
+                              builtins.complex, np.generic)):
             other = to_tensor(other)
         elif not isinstance(other, Tensor):
             return NotImplemented

@@ -1,0 +1,75 @@
+"""AMP ops: check_finite_and_unscale, update_loss_scaling.
+
+Reference: operators/amp/check_finite_and_unscale_op.cc (scan grads for
+NaN/Inf, unscale by 1/loss_scaling, set found_inf flag) and
+update_loss_scaling_op.cc (the dynamic loss-scale state machine:
+good_steps/incr_every_n/decr_every_n). The GradScaler class
+(paddle_tpu.amp) drives these; the op forms compile into jitted steps.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["check_finite_and_unscale", "update_loss_scaling"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+@op("check_finite_and_unscale", differentiable=False)
+def _check_finite_and_unscale(xs, scale):
+    inv = 1.0 / scale
+    found = jnp.asarray(False)
+    outs = []
+    for x in xs:
+        found = jnp.logical_or(found, ~jnp.isfinite(x).all())
+        outs.append(x * inv.astype(x.dtype))
+    return tuple(outs), found
+
+
+def check_finite_and_unscale(x, scale, name=None):
+    """reference: check_finite_and_unscale_op.cc. x: list of grads.
+    Returns (unscaled_grads, found_inf)."""
+    outs, found = _check_finite_and_unscale([_wrap(t) for t in x],
+                                            _wrap(scale))
+    return list(outs), found
+
+
+@op("update_loss_scaling", differentiable=False)
+def _update_loss_scaling(scale, good_steps, found_inf, incr_every_n,
+                         decr_every_n, incr_ratio, decr_ratio):
+    def on_inf(_):
+        return (jnp.maximum(scale * decr_ratio, 1.0),
+                jnp.zeros_like(good_steps))
+
+    def on_ok(_):
+        new_good = good_steps + 1
+
+        def bump(_):
+            return scale * incr_ratio, jnp.zeros_like(good_steps)
+
+        def keep(_):
+            return scale, new_good
+        return jax.lax.cond(new_good >= incr_every_n, bump, keep, None)
+
+    return jax.lax.cond(found_inf, on_inf, on_ok, None)
+
+
+def update_loss_scaling(x, found_inf, prev_loss_scaling, num_good_steps,
+                        num_bad_steps=None, incr_every_n_steps=2000,
+                        decr_every_n_nan_or_inf=1, incr_ratio=2.0,
+                        decr_ratio=0.5, stop_update=False, name=None):
+    """reference: update_loss_scaling_op.cc — returns (new_scale,
+    new_good_steps). `x` (grads) kept in the signature for parity; the
+    reference zeroes them on overflow, which the scaler does by skipping
+    the step."""
+    scale, good = _update_loss_scaling(
+        _wrap(prev_loss_scaling), _wrap(num_good_steps), _wrap(found_inf),
+        int(incr_every_n_steps), int(decr_every_n_nan_or_inf),
+        float(incr_ratio), float(decr_ratio))
+    return scale, good

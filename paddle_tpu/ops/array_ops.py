@@ -1,0 +1,232 @@
+"""Array/meta ops: shape/size/rank, unique family, meshgrid, unbind,
+TensorArray (LoDTensorArray analogue), assign_value, crop, pad variants.
+
+Reference: operators/shape_op.cc, size_op.cc, unique_op.cc (+
+unique_consecutive_op.cc, unique_with_counts_op.cc), meshgrid_op.cc,
+unbind_op.cc, assign_value_op.cc, crop_tensor_op.cc, lod_array_length_op.cc
+/ array_read/array_write (controlflow/tensor_array_read_write_op.cc).
+
+Note on unique: XLA needs static shapes, so the compiled path cannot return
+a data-dependent-length tensor. Eagerly (tape mode) we return the exact
+result like the reference; under jit tracing `unique` raises with guidance
+to use masks — the honest TPU contract.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["shape", "size", "rank", "unique", "unique_consecutive",
+           "meshgrid", "unbind", "assign_value", "crop",
+           "create_array", "array_write", "array_read", "array_length",
+           "TensorArray", "broadcast_tensors", "numel"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+@op("shape", differentiable=False)
+def _shape(x):
+    return jnp.asarray(x.shape, jnp.int64)
+
+
+def shape(input, name=None):
+    """paddle.shape → int64 1-D tensor (reference: shape_op.cc)."""
+    return _shape(_wrap(input))
+
+
+@op("size", differentiable=False)
+def _size(x):
+    return jnp.asarray(np.prod(x.shape, dtype=np.int64))
+
+
+def size(x, name=None):
+    return _size(_wrap(x))
+
+
+numel = size
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(_wrap(input)._value.ndim, jnp.int32))
+
+
+# ---------------------------------------------------------------- unique
+@op("unique", differentiable=False)
+def _unique_sorted(x, axis):
+    # static-shape-safe pieces only (sorted unique with padding is possible,
+    # but the public API contract below keeps exact semantics eagerly)
+    return jnp.unique(x, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """reference: unique_op.cc. Exact (data-dependent shape) — eager only;
+    inside jit use sort+mask patterns instead."""
+    t = _wrap(x)
+    if isinstance(t._value, jax.core.Tracer):
+        raise RuntimeError(
+            "paddle.unique produces a data-dependent shape and cannot run "
+            "inside jit/to_static on TPU; compute it eagerly or use "
+            "sort/searchsorted + mask with a static bound.")
+    arr = np.asarray(t._value)
+    out = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(out, tuple):
+        return Tensor(jnp.asarray(out))
+    outs = [Tensor(jnp.asarray(o if i == 0 else o.astype(dtype)))
+            for i, o in enumerate(out)]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """reference: unique_consecutive_op.cc — dedup only adjacent repeats."""
+    t = _wrap(x)
+    if isinstance(t._value, jax.core.Tracer):
+        raise RuntimeError(
+            "paddle.unique_consecutive has a data-dependent output shape; "
+            "run it eagerly (outside jit).")
+    arr = np.asarray(t._value)
+    if axis is None:
+        flat = arr.reshape(-1)
+        keep = np.empty(flat.shape, bool)
+        keep[:1] = True
+        keep[1:] = flat[1:] != flat[:-1]
+        vals = flat[keep]
+        inverse = np.cumsum(keep) - 1
+        counts = np.diff(np.append(np.nonzero(keep)[0], flat.size))
+    else:
+        sl = [slice(None)] * arr.ndim
+        sl[axis] = slice(1, None)
+        sl2 = [slice(None)] * arr.ndim
+        sl2[axis] = slice(None, -1)
+        diff = (arr[tuple(sl)] != arr[tuple(sl2)])
+        red = tuple(i for i in range(arr.ndim) if i != axis)
+        keep = np.concatenate([[True], diff.any(axis=red)])
+        vals = np.compress(keep, arr, axis=axis)
+        inverse = np.cumsum(keep) - 1
+        counts = np.diff(np.append(np.nonzero(keep)[0], arr.shape[axis]))
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inverse.astype(dtype))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts.astype(dtype))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ------------------------------------------------------------- meshgrid etc
+@op("meshgrid")
+def _meshgrid(xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def meshgrid(*args, **kwargs):
+    """reference: meshgrid_op.cc ('ij' indexing, paddle semantics)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(_meshgrid([_wrap(a) for a in args]))
+
+
+@op("unbind")
+def _unbind(x, axis):
+    n = x.shape[axis]
+    parts = jnp.split(x, n, axis=axis)
+    return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+
+def unbind(input, axis=0, name=None):
+    """reference: unbind_op.cc."""
+    return list(_unbind(_wrap(input), axis))
+
+
+@op("assign_value")
+def _assign_value(values, dtype):
+    return jnp.asarray(values, dtype=dtype)
+
+
+def assign_value(shape, dtype, values, name=None):
+    """reference: assign_value_op.cc."""
+    out = _assign_value(np.asarray(values), dtype)
+    return out.reshape(shape) if shape else out
+
+
+@op("crop_tensor")
+def _crop(x, offsets, crop_shape):
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, crop_shape))
+    return x[sl]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """reference: crop_tensor_op.cc."""
+    t = _wrap(x)
+    if offsets is None:
+        offsets = [0] * t._value.ndim
+    offsets = [int(o) for o in (offsets.tolist()
+                                if isinstance(offsets, Tensor) else offsets)]
+    shp = [int(s) for s in (shape.tolist()
+                            if isinstance(shape, Tensor) else shape)]
+    shp = [t._value.shape[i] - offsets[i] if s == -1 else s
+           for i, s in enumerate(shp)]
+    return _crop(t, tuple(offsets), tuple(shp))
+
+
+@op("broadcast_tensors")
+def _broadcast_tensors(xs):
+    shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+    return tuple(jnp.broadcast_to(x, shape) for x in xs)
+
+
+def broadcast_tensors(input, name=None):
+    return list(_broadcast_tensors([_wrap(x) for x in input]))
+
+
+# ----------------------------------------------------------- TensorArray
+class TensorArray(list):
+    """LoDTensorArray analogue (reference: pybind LoDTensorArray +
+    controlflow/tensor_array_read_write_op.cc). A Python list of Tensors —
+    under jit, prefer lax.scan; this exists for API/eager parity."""
+
+    def append(self, t):
+        super().append(_wrap(t))
+        return self
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """reference: fluid/layers/control_flow.py create_array."""
+    arr = TensorArray()
+    for t in (initialized_list or []):
+        arr.append(t)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """reference: array_write op — write x at index i (extends like the
+    reference when i == len)."""
+    if array is None:
+        array = TensorArray()
+    idx = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    if idx < len(array):
+        array[idx] = _wrap(x)
+    else:
+        while len(array) < idx:
+            array.append(Tensor(jnp.zeros_like(_wrap(x)._value)))
+        array.append(x)
+    return array
+
+
+def array_read(array, i):
+    idx = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    return array[idx]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))

@@ -1,0 +1,160 @@
+"""Control-flow ops usable in dygraph AND under jit tracing.
+
+Reference: operators/controlflow/while_op.cc, conditional_block_op.cc,
+and the python surface fluid/layers/control_flow.py (while_loop:1075,
+cond:2334, case:2914, switch_case:3129). The static-graph (Program capture)
+versions live in paddle_tpu.static.nn; these are the eager/traced ones:
+eager mode runs real Python control flow (dygraph semantics), traced mode
+lowers to lax.while_loop / lax.cond so data-dependent control flow compiles
+— the migration path SURVEY.md hard part (b) calls for.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["while_loop", "cond", "case", "switch_case"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _is_traced(*tensors) -> bool:
+    for t in tensors:
+        leaves = jax.tree_util.tree_leaves(
+            t, is_leaf=lambda v: isinstance(v, Tensor))
+        for leaf in leaves:
+            v = leaf._value if isinstance(leaf, Tensor) else leaf
+            if isinstance(v, jax.core.Tracer):
+                return True
+    return False
+
+
+@op("while", differentiable=False)
+def _while_op(loop_vars, cond_fn, body_fn):
+    def c(carry):
+        out = cond_fn(*[Tensor(a) for a in carry])
+        out = out._value if isinstance(out, Tensor) else out
+        return jnp.reshape(out, ()).astype(bool)
+
+    def b(carry):
+        outs = body_fn(*[Tensor(a) for a in carry])
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return tuple(o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in outs)
+    return jax.lax.while_loop(c, b, tuple(loop_vars))
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test=False, name=None) -> List:
+    """reference: layers/control_flow.py while_loop:1075 / while_op.cc.
+    Eager: Python loop. Traced: lax.while_loop (single compiled loop)."""
+    lv = [_wrap(v) for v in loop_vars]
+    if not _is_traced(*lv):
+        while bool(_as_bool(cond(*lv))):
+            out = body(*lv)
+            lv = list(out) if isinstance(out, (tuple, list)) else [out]
+            lv = [_wrap(v) for v in lv]
+        return lv
+    outs = _while_op([v._value for v in lv], cond, body)
+    return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+
+
+def _as_bool(x):
+    x = x._value if isinstance(x, Tensor) else x
+    return jnp.reshape(x, ()).astype(bool)
+
+
+@op("conditional_block")
+def _cond_op(pred, operands, true_fn, false_fn):
+    def t(ops_):
+        out = true_fn(*[Tensor(a) for a in ops_]) if ops_ else true_fn()
+        return jax.tree_util.tree_map(
+            lambda o: o._value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+
+    def f(ops_):
+        out = false_fn(*[Tensor(a) for a in ops_]) if ops_ else false_fn()
+        return jax.tree_util.tree_map(
+            lambda o: o._value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+    return jax.lax.cond(jnp.reshape(pred, ()).astype(bool), t, f,
+                        tuple(operands))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, *, operands=()):
+    """reference: layers/control_flow.py cond:2334 /
+    conditional_block_op.cc. Both branches must return matching
+    structures under tracing (lax.cond contract — same as the reference's
+    requirement that both branches produce the same out vars)."""
+    p = _wrap(pred)
+    ops_ = [_wrap(o) for o in operands]
+    if not _is_traced(p, *ops_):
+        if bool(_as_bool(p)):
+            return true_fn(*ops_) if ops_ else true_fn()
+        return false_fn(*ops_) if ops_ else false_fn()
+    return _cond_op(p, [o._value for o in ops_], true_fn, false_fn)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: layers/control_flow.py case:2914 — first true pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must not be empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: layers/control_flow.py switch_case:3129 — dispatch on an
+    integer index (lax.switch under tracing)."""
+    idx = _wrap(branch_index)
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns)) \
+            if not isinstance(branch_fns[0], (tuple, list)) \
+            else sorted(branch_fns)
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+    if not _is_traced(idx):
+        i = int(idx.numpy())
+        for k, f in items:
+            if i == k:
+                return f()
+        return default()
+
+    # dense lax.switch over the key range; unknown keys hit default
+    table = {k: f for k, f in items}
+    lo, hi = min(keys), max(keys)
+    branches = [table.get(k, default) for k in range(lo, hi + 1)]
+    branches.append(default)  # out-of-range slot
+    return _switch_op(idx, lo, hi, branches)
+
+
+@op("switch_case")
+def _switch_op(iv, lo, hi, branches):
+    sel = jnp.where((iv >= lo) & (iv <= hi), iv - lo, len(branches) - 1)
+
+    def lift(f):
+        def g(_):
+            out = f()
+            return jax.tree_util.tree_map(
+                lambda o: o._value if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+        return g
+    return jax.lax.switch(jnp.reshape(sel, ()).astype(jnp.int32),
+                          [lift(f) for f in branches], None)

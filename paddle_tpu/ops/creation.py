@@ -174,13 +174,6 @@ def triu(x, diagonal=0, name=None):
     return _triu(x, diagonal)
 
 
-def meshgrid(*args, **kwargs):
-    if len(args) == 1 and isinstance(args[0], (list, tuple)):
-        args = args[0]
-    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-    return [Tensor(o) for o in jnp.meshgrid(*arrs, indexing="ij")]
-
-
 def clone(x, name=None):
     return assign(x)
 
@@ -245,37 +238,14 @@ def randperm(n, dtype=None, name=None):
                                          jnp.arange(n, dtype=d)))
 
 
-def bernoulli(x, name=None):
-    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    return Tensor(jax.random.bernoulli(_random.next_key(), p).astype(p.dtype))
-
-
-def multinomial(x, num_samples=1, replacement=False, name=None):
-    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    logits = jnp.log(jnp.maximum(p, 1e-30))
-    if replacement:
-        out = jax.random.categorical(
-            _random.next_key(), logits, axis=-1,
-            shape=(num_samples,) + p.shape[:-1]) \
-            if p.ndim > 1 else jax.random.categorical(
-                _random.next_key(), logits, shape=(num_samples,))
-        if p.ndim > 1:
-            out = jnp.moveaxis(out, 0, -1)
-        return Tensor(out.astype(jnp.int64))
-    # without replacement: Gumbel top-k trick
-    g = jax.random.gumbel(_random.next_key(), p.shape)
-    _, idx = jax.lax.top_k(logits + g, num_samples)
-    return Tensor(idx.astype(jnp.int64))
-
-
-def poisson(x, name=None):
-    lam = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    return Tensor(jax.random.poisson(_random.next_key(), lam).astype(lam.dtype))
-
-
 def rand_like(x, dtype=None, name=None):
     return uniform(x.shape, dtype or x.dtype, 0.0, 1.0)
 
 
 def randn_like(x, dtype=None, name=None):
     return standard_normal(x.shape, dtype or x.dtype)
+
+
+# canonical random/meta implementations live in random_ops/array_ops
+from .random_ops import bernoulli, multinomial, poisson  # noqa: E402,F401
+from .array_ops import meshgrid  # noqa: E402,F401

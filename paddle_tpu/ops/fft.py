@@ -1,0 +1,103 @@
+"""paddle.fft — spectral ops.
+
+Reference: python/paddle/fft.py + operators/spectral_op.cc (cuFFT/MKL
+backed). Here each transform is one jnp.fft call — XLA lowers to its own
+FFT HLO, which the TPU backend executes natively.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _norm(norm):
+    # paddle uses 'backward'/'ortho'/'forward' like numpy
+    return norm if norm in ("backward", "ortho", "forward") else "backward"
+
+
+def _make1d(name, fn):
+    wrapped = op(name)(
+        lambda x, n, axis, norm: fn(x, n=n, axis=axis, norm=norm))
+
+    def api(x, n=None, axis=-1, norm="backward", name=None):
+        return wrapped(_wrap(x), n, axis, _norm(norm))
+    api.__name__ = name
+    return api
+
+
+fft = _make1d("fft_c2c", jnp.fft.fft)
+ifft = _make1d("fft_c2c_inv", jnp.fft.ifft)
+rfft = _make1d("fft_r2c", jnp.fft.rfft)
+irfft = _make1d("fft_c2r", jnp.fft.irfft)
+hfft = _make1d("fft_c2r_h", jnp.fft.hfft)
+ihfft = _make1d("fft_r2c_ih", jnp.fft.ihfft)
+
+
+def _make2d(name, fn):
+    wrapped = op(name)(
+        lambda x, s, axes, norm: fn(x, s=s, axes=axes, norm=norm))
+
+    def api(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return wrapped(_wrap(x), s, tuple(axes), _norm(norm))
+    api.__name__ = name
+    return api
+
+
+fft2 = _make2d("fft2_c2c", jnp.fft.fft2)
+ifft2 = _make2d("fft2_c2c_inv", jnp.fft.ifft2)
+rfft2 = _make2d("fft2_r2c", jnp.fft.rfft2)
+irfft2 = _make2d("fft2_c2r", jnp.fft.irfft2)
+
+
+def _maken(name, fn):
+    wrapped = op(name)(
+        lambda x, s, axes, norm: fn(x, s=s, axes=axes, norm=norm))
+
+    def api(x, s=None, axes=None, norm="backward", name=None):
+        return wrapped(_wrap(x), s, None if axes is None else tuple(axes),
+                       _norm(norm))
+    api.__name__ = name
+    return api
+
+
+fftn = _maken("fftn_c2c", jnp.fft.fftn)
+ifftn = _maken("fftn_c2c_inv", jnp.fft.ifftn)
+rfftn = _maken("fftn_r2c", jnp.fft.rfftn)
+irfftn = _maken("fftn_c2r", jnp.fft.irfftn)
+
+
+@op("fft_shift")
+def _fftshift(x, axes):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@op("fft_ishift")
+def _ifftshift(x, axes):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    return _fftshift(_wrap(x), None if axes is None else tuple(axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return _ifftshift(_wrap(x), None if axes is None else tuple(axes))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))

@@ -1,0 +1,346 @@
+"""Fused ops.
+
+Reference: operators/fused/ (~10.9k LoC: fused_attention ingredients,
+fused_elemwise_activation_op.cc, fused_embedding_seq_pool_op.cc,
+fusion_gru_op.cc, fusion_lstm_op.cc, fused_bn_activation_op.cc,
+fused_bn_add_activation_op.cc, fused_gemm_epilogue,
+fusion_seqpool_concat_op.cc, fusion_repeated_fc_relu_op.cc,
+fused_bias_dropout_residual_layer_norm) + coalesce_tensor_op.cc.
+
+TPU-native: the POINT of these reference ops is to fuse kernels by hand
+because CUDA can't; XLA fuses automatically, so each "fused" op here is the
+straightforward composed jnp expression registered under the fused name —
+one traced call produces exactly one fused HLO computation. Registering
+them keeps program/op-name parity (static programs and OpTest can target
+the fused names) at zero extra kernel code.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core import random as _random
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["fused_linear_activation", "fused_elemwise_activation",
+           "fused_feedforward", "fused_attention",
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_embedding_seq_pool", "fusion_gru", "fusion_lstm",
+           "fused_bn_activation", "coalesce_tensor",
+           "fusion_seqpool_concat", "fusion_repeated_fc_relu"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+_ACTS = {
+    "relu": jax.nn.relu, "gelu": jax.nn.gelu, "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid, "identity": lambda x: x, "": lambda x: x,
+    "add": None, "swish": jax.nn.silu,
+}
+
+
+@op("fused_gemm_epilogue")
+def _fused_linear_act(x, w, b, act):
+    return _ACTS[act](x @ w + b)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="relu", name=None):
+    """reference: fused/fused_gemm_epilogue_op.cc (cublasLt epilogue —
+    XLA fuses bias+act into the matmul natively)."""
+    xv, yv = _wrap(x), _wrap(y)
+    if trans_x:
+        xv = Tensor(jnp.swapaxes(xv._value, -1, -2))
+    if trans_y:
+        yv = Tensor(jnp.swapaxes(yv._value, -1, -2))
+    return _fused_linear_act(xv, yv, _wrap(bias), activation)
+
+
+@op("fused_elemwise_activation")
+def _fused_elemwise_act(x, y, functor_list):
+    out = x
+    for f in functor_list:
+        if f.startswith("elementwise_add"):
+            out = out + y
+        elif f.startswith("elementwise_mul"):
+            out = out * y
+        else:
+            out = _ACTS.get(f.replace("scale", "identity"),
+                            _ACTS["identity"])(out)
+    return out
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              name=None):
+    """reference: fused/fused_elemwise_activation_op.cc."""
+    return _fused_elemwise_act(_wrap(x), _wrap(y), list(functor_list))
+
+
+@op("fused_feedforward")
+def _fused_ffn(x, w1, b1, w2, b2, ln_w, ln_b, act, eps, pre_ln):
+    def ln(v):
+        mu = v.mean(-1, keepdims=True)
+        var = ((v - mu) ** 2).mean(-1, keepdims=True)
+        return (v - mu) / jnp.sqrt(var + eps) * ln_w + ln_b
+    h = ln(x) if pre_ln else x
+    h = _ACTS[act](h @ w1 + b1) @ w2 + b2
+    out = x + h
+    return out if pre_ln else ln(out)
+
+
+def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
+                      linear2_bias, ln_scale=None, ln_bias=None,
+                      dropout1_rate=0.0, dropout2_rate=0.0,
+                      activation="relu", ln_epsilon=1e-5,
+                      pre_layer_norm=False, name=None):
+    """reference: fused/fused_feedforward_op.cc — LN + MLP + residual in
+    one op (dropout rates fold to 0 in eval; training dropout composes
+    outside)."""
+    d = _wrap(x)._value.shape[-1]
+    lw = _wrap(ln_scale) if ln_scale is not None else \
+        Tensor(jnp.ones(d, _wrap(x)._value.dtype))
+    lb = _wrap(ln_bias) if ln_bias is not None else \
+        Tensor(jnp.zeros(d, _wrap(x)._value.dtype))
+    return _fused_ffn(_wrap(x), _wrap(linear1_weight), _wrap(linear1_bias),
+                      _wrap(linear2_weight), _wrap(linear2_bias), lw, lb,
+                      activation, float(ln_epsilon), bool(pre_layer_norm))
+
+
+@op("fused_attention")
+def _fused_attention(x, qkv_w, qkv_b, out_w, out_b, ln_w, ln_b, nheads,
+                     eps, pre_ln, causal):
+    B, T, D = x.shape
+    hd = D // nheads
+
+    def ln(v):
+        mu = v.mean(-1, keepdims=True)
+        var = ((v - mu) ** 2).mean(-1, keepdims=True)
+        return (v - mu) / jnp.sqrt(var + eps) * ln_w + ln_b
+    h = ln(x) if pre_ln else x
+    qkv = h @ qkv_w + qkv_b                       # [B, T, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, T, nheads, hd).transpose(0, 2, 1, 3)
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = q @ jnp.swapaxes(k, -1, -2) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    attn = jax.nn.softmax(scores, axis=-1)
+    ctx = (attn @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
+    out = x + (ctx @ out_w + out_b)
+    return out if pre_ln else ln(out)
+
+
+def fused_attention(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
+                    ln_scale=None, ln_bias=None, num_heads=8,
+                    pre_layer_norm=False, epsilon=1e-5, causal=False,
+                    attn_dropout_rate=0.0, dropout_rate=0.0, name=None):
+    """reference: fused/fused_attention ingredients (fmha + bias + residual
+    + LN) as one traced op."""
+    D = _wrap(x)._value.shape[-1]
+    lw = _wrap(ln_scale) if ln_scale is not None else \
+        Tensor(jnp.ones(D, _wrap(x)._value.dtype))
+    lb = _wrap(ln_bias) if ln_bias is not None else \
+        Tensor(jnp.zeros(D, _wrap(x)._value.dtype))
+    return _fused_attention(_wrap(x), _wrap(qkv_weight), _wrap(qkv_bias),
+                            _wrap(linear_weight), _wrap(linear_bias),
+                            lw, lb, int(num_heads), float(epsilon),
+                            bool(pre_layer_norm), bool(causal))
+
+
+@op("fused_bias_dropout_residual_layer_norm")
+def _fused_bdrln(x, residual, bias, ln_w, ln_b, mask, keep_prob, eps):
+    h = x + bias
+    if mask is not None:
+        h = h * mask / keep_prob
+    h = h + residual
+    mu = h.mean(-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(-1, keepdims=True)
+    return (h - mu) / jnp.sqrt(var + eps) * ln_w + ln_b
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.0, ln_epsilon=1e-5, training=False, name=None):
+    """reference: fused/fused_bias_dropout_residual_layer_norm_op.cu."""
+    xv = _wrap(x)
+    D = xv._value.shape[-1]
+    b = _wrap(bias) if bias is not None else \
+        Tensor(jnp.zeros(D, xv._value.dtype))
+    lw = _wrap(ln_scale) if ln_scale is not None else \
+        Tensor(jnp.ones(D, xv._value.dtype))
+    lb = _wrap(ln_bias) if ln_bias is not None else \
+        Tensor(jnp.zeros(D, xv._value.dtype))
+    mask = None
+    if training and dropout_rate > 0:
+        keep = jax.random.bernoulli(_random.next_key(), 1 - dropout_rate,
+                                    tuple(xv._value.shape))
+        mask = Tensor(keep.astype(xv._value.dtype))
+    return _fused_bdrln(xv, _wrap(residual), b, lw, lb, mask,
+                        1.0 - dropout_rate, float(ln_epsilon))
+
+
+@op("fused_embedding_seq_pool")
+def _fused_emb_seqpool(w, ids, length, combiner):
+    emb = w[ids.astype(jnp.int32)]                 # [B, T, D]
+    m = (jnp.arange(ids.shape[1])[None, :]
+         < length[:, None]).astype(emb.dtype)[..., None]
+    s = (emb * m).sum(axis=1)
+    if combiner == "mean":
+        return s / jnp.maximum(length[:, None].astype(emb.dtype), 1)
+    return s
+
+
+def fused_embedding_seq_pool(weight, ids, length, combiner="sum",
+                             name=None):
+    """reference: fused/fused_embedding_seq_pool_op.cc (lookup + pool in
+    one pass)."""
+    return _fused_emb_seqpool(_wrap(weight), _wrap(ids), _wrap(length),
+                              combiner)
+
+
+@op("fusion_gru")
+def _fusion_gru(x, wx, wh, b, h0):
+    """reference: fused/fusion_gru_op.cc — input-projected GRU over time
+    in one op (lax.scan; XLA fuses the gates)."""
+    B, T, D = x.shape
+    H = wh.shape[0]
+    xp = x.reshape(B * T, D) @ wx + b              # [B*T, 3H]
+    xp = xp.reshape(B, T, 3 * H)
+
+    def step(h, xt):
+        ru = jax.nn.sigmoid(xt[:, :2 * H] + h @ wh[:, :2 * H])
+        r, u = ru[:, :H], ru[:, H:]
+        c = jnp.tanh(xt[:, 2 * H:] + (r * h) @ wh[:, 2 * H:])
+        h2 = u * h + (1 - u) * c
+        return h2, h2
+
+    hT, hs = jax.lax.scan(step, h0, jnp.swapaxes(xp, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), hT
+
+
+def fusion_gru(x, weight_x, weight_h, bias=None, h0=None, name=None):
+    xv, wx, wh = _wrap(x), _wrap(weight_x), _wrap(weight_h)
+    B = xv._value.shape[0]
+    H = wh._value.shape[0]
+    b = _wrap(bias) if bias is not None else \
+        Tensor(jnp.zeros(3 * H, xv._value.dtype))
+    h = _wrap(h0) if h0 is not None else \
+        Tensor(jnp.zeros((B, H), xv._value.dtype))
+    return _fusion_gru(xv, wx, wh, b, h)
+
+
+@op("fusion_lstm")
+def _fusion_lstm(x, wx, wh, b, h0, c0):
+    """reference: fused/fusion_lstm_op.cc."""
+    B, T, D = x.shape
+    H = wh.shape[0]
+    xp = (x.reshape(B * T, D) @ wx + b).reshape(B, T, 4 * H)
+
+    def step(carry, xt):
+        h, c = carry
+        g = xt + h @ wh
+        i = jax.nn.sigmoid(g[:, :H])
+        f = jax.nn.sigmoid(g[:, H:2 * H])
+        o = jax.nn.sigmoid(g[:, 2 * H:3 * H])
+        cc = jnp.tanh(g[:, 3 * H:])
+        c2 = f * c + i * cc
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (hT, cT), hs = jax.lax.scan(step, (h0, c0), jnp.swapaxes(xp, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), hT, cT
+
+
+def fusion_lstm(x, weight_x, weight_h, bias=None, h0=None, c0=None,
+                name=None):
+    xv, wx, wh = _wrap(x), _wrap(weight_x), _wrap(weight_h)
+    B = xv._value.shape[0]
+    H = wh._value.shape[0]
+    b = _wrap(bias) if bias is not None else \
+        Tensor(jnp.zeros(4 * H, xv._value.dtype))
+    h = _wrap(h0) if h0 is not None else \
+        Tensor(jnp.zeros((B, H), xv._value.dtype))
+    c = _wrap(c0) if c0 is not None else \
+        Tensor(jnp.zeros((B, H), xv._value.dtype))
+    return _fusion_lstm(xv, wx, wh, b, h, c)
+
+
+@op("fused_bn_act")
+def _fused_bn_act(x, mean, var, gamma, beta, eps, act):
+    inv = jax.lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    out = (x - mean.reshape(shape)) * (inv * gamma).reshape(shape) \
+        + beta.reshape(shape)
+    return _ACTS[act](out)
+
+
+def fused_bn_activation(x, running_mean, running_var, weight, bias,
+                        epsilon=1e-5, act="relu", name=None):
+    """reference: fused/fused_bn_activation_op.cc (inference form)."""
+    return _fused_bn_act(_wrap(x), _wrap(running_mean), _wrap(running_var),
+                         _wrap(weight), _wrap(bias), float(epsilon), act)
+
+
+@op("fusion_seqpool_concat")
+def _fusion_seqpool_concat(xs, lengths, pooltype):
+    outs = []
+    for x, ln in zip(xs, lengths):
+        m = (jnp.arange(x.shape[1])[None, :]
+             < ln[:, None]).astype(x.dtype)[..., None]
+        if pooltype == "sum":
+            outs.append((x * m).sum(1))
+        elif pooltype in ("mean", "average"):
+            outs.append((x * m).sum(1)
+                        / jnp.maximum(ln[:, None].astype(x.dtype), 1))
+        else:
+            neg = jnp.finfo(x.dtype).min
+            outs.append(jnp.where(m.astype(bool), x, neg).max(1))
+    return jnp.concatenate(outs, axis=1)
+
+
+def fusion_seqpool_concat(inputs, lengths, pooltype="sum", name=None):
+    """reference: fused/fusion_seqpool_concat_op.cc."""
+    return _fusion_seqpool_concat([_wrap(x) for x in inputs],
+                                  [_wrap(l) for l in lengths],
+                                  pooltype.lower())
+
+
+@op("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(x, ws, bs):
+    h = x
+    for w, b in zip(ws, bs):
+        h = jax.nn.relu(h @ w + b)
+    return h
+
+
+def fusion_repeated_fc_relu(x, weights, biases, name=None):
+    """reference: fused/fusion_repeated_fc_relu_op.cc."""
+    return _fusion_repeated_fc_relu(_wrap(x), [_wrap(w) for w in weights],
+                                    [_wrap(b) for b in biases])
+
+
+@op("coalesce_tensor")
+def _coalesce_tensor(xs):
+    """reference: coalesce_tensor_op.cc — flatten a list into one fused
+    buffer + return the views (the fused-allreduce enabler; under XLA one
+    compiled step already coalesces, this keeps the op surface)."""
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    views = []
+    off = 0
+    for x in xs:
+        n = int(np.prod(x.shape))
+        views.append(flat[off:off + n].reshape(x.shape))
+        off += n
+    return (flat, *views)
+
+
+def coalesce_tensor(inputs, dtype=None, name=None):
+    out = _coalesce_tensor([_wrap(x) for x in inputs])
+    return list(out[1:]), out[0]

@@ -229,11 +229,6 @@ def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
 
-def broadcast_tensors(inputs, name=None):
-    arrs = jnp.broadcast_arrays(*[_wrap(v)._value for v in inputs])
-    return [Tensor(a) for a in arrs]
-
-
 @op("tile")
 def _tile(x, reps):
     return jnp.tile(x, reps)
@@ -526,49 +521,6 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
                           tuple(int(s) for s in strides))
 
 
-def crop(x, shape=None, offsets=None, name=None):
-    x = _wrap(x)
-    shape = _static_shape(shape)
-    offsets = [0] * x.ndim if offsets is None else \
-        [int(o) for o in (offsets.tolist() if isinstance(offsets, Tensor)
-                          else offsets)]
-    shape = [x.shape[i] - offsets[i] if s == -1 else s
-             for i, s in enumerate(shape)]
-    return slice(x, list(range(x.ndim)), offsets,
-                 [o + s for o, s in zip(offsets, shape)])
-
-
-def unique(x, return_index=False, return_inverse=False, return_counts=False,
-           axis=None, dtype="int64", name=None):
-    x = _wrap(x)
-    res = np.unique(np.asarray(x._value), return_index=return_index,
-                    return_inverse=return_inverse,
-                    return_counts=return_counts, axis=axis)
-    if not isinstance(res, tuple):
-        return Tensor(jnp.asarray(res))
-    outs = [Tensor(jnp.asarray(r)) for r in res]
-    return tuple(outs)
-
-
-def unique_consecutive(x, return_inverse=False, return_counts=False,
-                       axis=None, dtype="int64", name=None):
-    a = np.asarray(_wrap(x)._value)
-    if axis is None:
-        a = a.reshape(-1)
-    keep = np.ones(a.shape[0], bool)
-    keep[1:] = np.any(a[1:] != a[:-1],
-                      axis=tuple(range(1, a.ndim))) if a.ndim > 1 \
-        else a[1:] != a[:-1]
-    out = [Tensor(jnp.asarray(a[keep]))]
-    if return_inverse:
-        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
-    if return_counts:
-        idx = np.nonzero(keep)[0]
-        counts = np.diff(np.append(idx, a.shape[0]))
-        out.append(Tensor(jnp.asarray(counts)))
-    return out[0] if len(out) == 1 else tuple(out)
-
-
 @op("as_complex")
 def _as_complex(x):
     return jax.lax.complex(x[..., 0], x[..., 1])
@@ -587,11 +539,6 @@ def as_real(x, name=None):
     return _as_real(_wrap(x))
 
 
-def numel(x, name=None):
-    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
-                              dtype=jnp.int64))
-
-
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     """reference: operators/shard_index_op.cc (PS embedding sharding)."""
     x = _wrap(input)
@@ -599,3 +546,10 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     v = x._value
     in_shard = (v // shard_size) == shard_id
     return Tensor(jnp.where(in_shard, v % shard_size, ignore_value))
+
+
+# canonical implementations live in array_ops (op-registered, trace-aware);
+# re-exported here for the legacy import paths
+from .array_ops import (  # noqa: E402,F401
+    crop, unique, unique_consecutive, numel, broadcast_tensors,
+)

@@ -407,3 +407,179 @@ def _cast(x, dtype):
 def cast(x, dtype):
     """reference: operators/cast_op.cc (grad casts back — jax.vjp handles)."""
     return _cast(_wrap(x), convert_dtype(dtype))
+
+
+# -- paddle 2.x math tail ----------------------------------------------------
+@op("complex")
+def _complex(real, imag):
+    # reference: complex_op.cc
+    return jax.lax.complex(real, imag)
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    return _complex(_wrap(real), _wrap(imag))
+
+
+@op("polar")
+def _polar(r, theta):
+    return jax.lax.complex(r * jnp.cos(theta), r * jnp.sin(theta))
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    return _polar(_wrap(abs), _wrap(angle))
+
+
+@op("logit")
+def _logit(x, eps):
+    z = jnp.clip(x, eps, 1 - eps) if eps else x
+    return jnp.log(z) - jnp.log1p(-z)
+
+
+def logit(x, eps=None, name=None):
+    return _logit(_wrap(x), float(eps) if eps else 0.0)
+
+
+@op("diff")
+def _diff(x, n, axis):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    t = _wrap(x)
+    parts = []
+    if prepend is not None:
+        parts.append(_wrap(prepend)._value)
+    parts.append(t._value)
+    if append is not None:
+        parts.append(_wrap(append)._value)
+    if len(parts) > 1:
+        t = Tensor(jnp.concatenate(parts, axis=axis))
+    return _diff(t, int(n), int(axis))
+
+
+@op("trapezoid")
+def _trapezoid(y, x, dx, axis):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    return _trapezoid(_wrap(y), None if x is None else _wrap(x),
+                      1.0 if dx is None else float(dx), int(axis))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    yt = _wrap(y)
+    d = _cumtrap(yt, None if x is None else _wrap(x),
+                 1.0 if dx is None else float(dx), int(axis))
+    return d
+
+
+@op("cumulative_trapezoid")
+def _cumtrap(y, x, dx, axis):
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    if x is not None:
+        if x.ndim == 1 and y.ndim > 1:
+            # 1-D sample points broadcast along `axis` (paddle semantics)
+            steps = jnp.diff(x)
+            shape = [1] * y.ndim
+            shape[axis] = steps.shape[0]
+            steps = steps.reshape(shape)
+        else:
+            x0 = jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)
+            x1 = jax.lax.slice_in_dim(x, 1, x.shape[axis], axis=axis)
+            steps = x1 - x0
+    else:
+        steps = dx
+    return jnp.cumsum((y0 + y1) * steps / 2.0, axis=axis)
+
+
+@op("vander")
+def _vander(x, n, increasing):
+    return jnp.vander(x, n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    t = _wrap(x)
+    return _vander(t, int(n) if n is not None else t._value.shape[0],
+                   bool(increasing))
+
+
+@op("renorm")
+def _renorm(x, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * factor[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    # reference: renorm_op.cc
+    return _renorm(_wrap(x), float(p), int(axis), float(max_norm))
+
+
+@op("take", differentiable=False)
+def _take(x, index, mode):
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int64)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:
+        idx = jnp.clip(idx, -n, n - 1)
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def take(x, index, mode="raise", name=None):
+    # reference: take (flattened gather, python/paddle/tensor/math.py)
+    xt, it = _wrap(x), _wrap(index)
+    if mode == "raise" and not isinstance(it._value, jax.core.Tracer):
+        n = int(np.prod(xt._value.shape))
+        idx = np.asarray(it._value)
+        if idx.size and (idx.min() < -n or idx.max() >= n):
+            raise IndexError(
+                f"paddle.take(mode='raise'): index out of range for a "
+                f"tensor of {n} elements (got min {idx.min()}, "
+                f"max {idx.max()})")
+    return _take(xt, it, mode)
+
+
+@op("nan_to_num")
+def _nan_to_num(x, nan, posinf, neginf):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _nan_to_num(_wrap(x), float(nan), posinf, neginf)
+
+
+@op("signbit", differentiable=False)
+def _signbit(x):
+    return jnp.signbit(x)
+
+
+def signbit(x, name=None):
+    return _signbit(_wrap(x))
+
+
+@op("ldexp")
+def _ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def ldexp(x, y, name=None):
+    return _ldexp(_wrap(x), _wrap(y))
+
+
+@op("frexp", differentiable=False)
+def _frexp(x):
+    return jnp.frexp(x)
+
+
+def frexp(x, name=None):
+    return _frexp(_wrap(x))

@@ -1,0 +1,62 @@
+"""Metric ops: accuracy, auc, precision/recall pieces.
+
+Reference: operators/metrics/accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc (+ python paddle.static.accuracy/auc). The op forms
+return tensors (usable inside compiled graphs); the stateful Metric classes
+live in paddle_tpu.metric.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["accuracy", "auc"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+@op("accuracy", differentiable=False)
+def _accuracy(pred, label, k):
+    topk_idx = jnp.argsort(-pred, axis=-1)[..., :k]
+    lab = label.reshape(label.shape[0], 1)
+    correct = (topk_idx == lab).any(axis=-1)
+    return correct.mean(dtype=jnp.float32)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference: metrics/accuracy_op.cc — top-k accuracy of a batch."""
+    return _accuracy(_wrap(input), _wrap(label), int(k))
+
+
+@op("auc", differentiable=False)
+def _auc(pred, label, num_thresholds):
+    # histogram-bucketed ROC-AUC, the reference's algorithm
+    # (metrics/auc_op.h): bucket positive scores, accumulate TP/FP per
+    # threshold, trapezoid integrate.
+    pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+        else pred.reshape(-1)
+    lab = label.reshape(-1).astype(jnp.float32)
+    idx = jnp.clip((pos_score * num_thresholds).astype(jnp.int32),
+                   0, num_thresholds)
+    tp_hist = jnp.zeros(num_thresholds + 1).at[idx].add(lab)
+    fp_hist = jnp.zeros(num_thresholds + 1).at[idx].add(1.0 - lab)
+    # cumulative from the high-score end: TP/FP at each threshold
+    tp = jnp.cumsum(tp_hist[::-1])
+    fp = jnp.cumsum(fp_hist[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1.0)
+    fpr = fp / jnp.maximum(tot_neg, 1.0)
+    return jnp.trapezoid(tpr, fpr)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """reference: metrics/auc_op.cc (batch AUC; the streaming stat
+    accumulation lives in paddle_tpu.metric.Auc)."""
+    return _auc(_wrap(input), _wrap(label), int(num_thresholds))

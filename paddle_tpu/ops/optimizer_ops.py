@@ -1,0 +1,152 @@
+"""Optimizer update-rule ops.
+
+Reference: operators/optimizers/ (~6.7k LoC: sgd_op, momentum_op, adam_op,
+adamw, lamb_op, lars_momentum_op, rmsprop_op, adagrad_op, adadelta_op,
+adamax_op, ftrl_op, proximal_gd, decayed_adagrad). Each reference op is one
+fused CUDA kernel applying a param update; here each is one pure jnp
+expression — XLA fuses the whole update chain, and under SPMD shardings the
+update runs sharded (ZeRO falls out, parallel/api.py).
+
+These op forms are what static-graph programs append (`_static_minimize`)
+and what the OpTest suite verifies against the optimizer classes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+
+__all__ = ["sgd_step", "momentum_step", "adam_step", "adamw_step",
+           "rmsprop_step", "adagrad_step", "adadelta_step", "adamax_step",
+           "lamb_step", "lars_momentum_step", "ftrl_step",
+           "decayed_adagrad_step"]
+
+
+@op("sgd", differentiable=False)
+def sgd_step(param, grad, lr):
+    """reference: optimizers/sgd_op.cc."""
+    return param - lr * grad
+
+
+@op("momentum", differentiable=False)
+def momentum_step(param, grad, velocity, lr, mu, use_nesterov=False):
+    """reference: optimizers/momentum_op.h."""
+    v = mu * velocity + grad
+    if use_nesterov:
+        return param - lr * (grad + mu * v), v
+    return param - lr * v, v
+
+
+@op("adam", differentiable=False)
+def adam_step(param, grad, m, v, beta1_pow, beta2_pow, lr,
+              beta1=0.9, beta2=0.999, eps=1e-8):
+    """reference: optimizers/adam_op.h."""
+    m2 = beta1 * m + (1 - beta1) * grad
+    v2 = beta2 * v + (1 - beta2) * grad * grad
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    return (param - lr_t * m2 / (jnp.sqrt(v2) + eps), m2, v2, b1p, b2p)
+
+
+@op("adamw", differentiable=False)
+def adamw_step(param, grad, m, v, beta1_pow, beta2_pow, lr,
+               beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.01):
+    """reference: adamw (adam + decoupled decay)."""
+    p = param * (1 - lr * weight_decay)
+    return adam_step.raw_fn(p, grad, m, v, beta1_pow, beta2_pow, lr,
+                            beta1, beta2, eps)
+
+
+@op("rmsprop", differentiable=False)
+def rmsprop_step(param, grad, mean_square, moment, lr,
+                 rho=0.95, eps=1e-6, momentum=0.0):
+    """reference: optimizers/rmsprop_op.cc."""
+    ms = rho * mean_square + (1 - rho) * grad * grad
+    mom = momentum * moment + lr * grad / jnp.sqrt(ms + eps)
+    return param - mom, ms, mom
+
+
+@op("adagrad", differentiable=False)
+def adagrad_step(param, grad, moment, lr, eps=1e-6):
+    """reference: optimizers/adagrad_op.cc."""
+    m2 = moment + grad * grad
+    return param - lr * grad / (jnp.sqrt(m2) + eps), m2
+
+
+@op("adadelta", differentiable=False)
+def adadelta_step(param, grad, avg_sq_grad, avg_sq_update,
+                  rho=0.95, eps=1e-6):
+    """reference: optimizers/adadelta_op.cc."""
+    g2 = rho * avg_sq_grad + (1 - rho) * grad * grad
+    update = grad * jnp.sqrt(avg_sq_update + eps) / jnp.sqrt(g2 + eps)
+    u2 = rho * avg_sq_update + (1 - rho) * update * update
+    return param - update, g2, u2
+
+
+@op("adamax", differentiable=False)
+def adamax_step(param, grad, m, inf_norm, beta1_pow, lr,
+                beta1=0.9, beta2=0.999, eps=1e-8):
+    """reference: optimizers/adamax_op.cc."""
+    m2 = beta1 * m + (1 - beta1) * grad
+    u2 = jnp.maximum(beta2 * inf_norm, jnp.abs(grad))
+    b1p = beta1_pow * beta1
+    return param - lr / (1 - b1p) * m2 / (u2 + eps), m2, u2, b1p
+
+
+@op("lamb", differentiable=False)
+def lamb_step(param, grad, m, v, beta1_pow, beta2_pow, lr,
+              beta1=0.9, beta2=0.999, eps=1e-6, weight_decay=0.01):
+    """reference: optimizers/lamb_op.h — layerwise trust ratio."""
+    m2 = beta1 * m + (1 - beta1) * grad
+    v2 = beta2 * v + (1 - beta2) * grad * grad
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    mhat = m2 / (1 - b1p)
+    vhat = v2 / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + eps) + weight_decay * param
+    w_norm = jnp.linalg.norm(param)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return param - lr * ratio * r, m2, v2, b1p, b2p
+
+
+@op("lars_momentum", differentiable=False)
+def lars_momentum_step(param, grad, velocity, lr, mu=0.9,
+                       lars_coeff=0.001, lars_weight_decay=0.0005,
+                       eps=0.0):
+    """reference: optimizers/lars_momentum_op.cc."""
+    w_norm = jnp.linalg.norm(param)
+    g_norm = jnp.linalg.norm(grad)
+    local_lr = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * w_norm
+        / (g_norm + lars_weight_decay * w_norm + eps), lr)
+    v2 = mu * velocity + local_lr * (grad + lars_weight_decay * param)
+    return param - v2, v2
+
+
+@op("ftrl", differentiable=False)
+def ftrl_step(param, grad, squared_accum, linear_accum, lr,
+              l1=0.0, l2=0.0, lr_power=-0.5):
+    """reference: optimizers/ftrl_op.cc."""
+    new_sq = squared_accum + grad * grad
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(squared_accum)) / lr
+    else:
+        sigma = (new_sq ** (-lr_power) - squared_accum ** (-lr_power)) / lr
+    new_lin = linear_accum + grad - sigma * param
+    pre = jnp.where(jnp.abs(new_lin) > l1,
+                    l1 * jnp.sign(new_lin) - new_lin, 0.0)
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = new_sq ** (-lr_power) / lr + 2 * l2
+    return pre / denom, new_sq, new_lin
+
+
+@op("decayed_adagrad", differentiable=False)
+def decayed_adagrad_step(param, grad, moment, lr, decay=0.95, eps=1e-6):
+    """reference: optimizers/decayed_adagrad_op.cc."""
+    m2 = decay * moment + (1 - decay) * grad * grad
+    return param - lr * grad / (jnp.sqrt(m2) + eps), m2

@@ -1,0 +1,160 @@
+"""Quantization ops (the slim/QAT kernel layer).
+
+Reference: operators/fake_quantize_op.cc (fake_quantize_abs_max,
+fake_quantize_moving_average_abs_max, fake_channel_wise_quantize_abs_max,
+the *_dequantize variants, moving_average_abs_max_scale) and
+fake_dequantize_op.cc; consumed by the slim QAT pass
+(fluid/contrib/slim/quantization/quantization_pass.py).
+
+TPU-native: fake-quant is simulate-only (float in, float out with
+round-to-scale), so each op is a pure jnp expression with a
+straight-through-estimator gradient via jax.custom_vjp — exactly what QAT
+needs under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["fake_quantize_abs_max", "fake_quantize_dequantize_abs_max",
+           "fake_channel_wise_quantize_abs_max",
+           "fake_channel_wise_quantize_dequantize_abs_max",
+           "fake_quantize_moving_average_abs_max",
+           "fake_quantize_dequantize_moving_average_abs_max",
+           "moving_average_abs_max_scale", "quantize_linear",
+           "dequantize_linear"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _quant_dequant(x, scale, bit_length):
+    """Straight-through estimator: forward quantize-dequantize, backward
+    identity (reference FakeQuantizeDequantize*GradOp passes the output
+    grad through unchanged — fake_quantize_op.cc grad maker)."""
+    bnt = (1 << (bit_length - 1)) - 1
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * bnt), -bnt, bnt)
+    qdq = q * s / bnt
+    return x + jax.lax.stop_gradient(qdq - x)
+
+
+@op("fake_quantize_abs_max", differentiable=False)
+def _fq_abs_max(x, bit_length):
+    scale = jnp.abs(x).max()
+    bnt = (1 << (bit_length - 1)) - 1
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-9) * bnt), -bnt, bnt)
+    return q, scale
+
+
+def fake_quantize_abs_max(x, bit_length=8, name=None):
+    """reference: FakeQuantizeAbsMaxOp — int-valued output + scale."""
+    return _fq_abs_max(_wrap(x), int(bit_length))
+
+
+@op("fake_quantize_dequantize_abs_max")
+def _fqdq_abs_max(x, bit_length):
+    scale = jax.lax.stop_gradient(jnp.abs(x).max())
+    return _quant_dequant(x, scale, bit_length), scale
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, name=None):
+    """reference: FakeQuantizeDequantizeAbsMaxOp — the QAT simulate op;
+    STE gradient."""
+    return _fqdq_abs_max(_wrap(x), int(bit_length))
+
+
+@op("fake_channel_wise_quantize_abs_max", differentiable=False)
+def _fcq_abs_max(x, bit_length, quant_axis):
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.abs(x).max(axis=axes)
+    bnt = (1 << (bit_length - 1)) - 1
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    s = jnp.maximum(scale.reshape(shape), 1e-9)
+    q = jnp.clip(jnp.round(x / s * bnt), -bnt, bnt)
+    return q, scale
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0,
+                                       name=None):
+    return _fcq_abs_max(_wrap(x), int(bit_length), int(quant_axis))
+
+
+@op("fake_channel_wise_quantize_dequantize_abs_max")
+def _fcqdq_abs_max(x, bit_length, quant_axis):
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jax.lax.stop_gradient(jnp.abs(x).max(axis=axes))
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return _quant_dequant(x, scale.reshape(shape), bit_length), scale
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0, name=None):
+    return _fcqdq_abs_max(_wrap(x), int(bit_length), int(quant_axis))
+
+
+@op("moving_average_abs_max_scale", differentiable=False)
+def _ma_scale(x, state, accum, moving_rate):
+    cur = jnp.abs(x).max()
+    new_state = moving_rate * state + 1.0
+    new_accum = moving_rate * accum + cur
+    return new_accum / new_state, new_state, new_accum
+
+
+def moving_average_abs_max_scale(x, state=None, accum=None,
+                                 moving_rate=0.9, name=None):
+    """reference: MovingAverageAbsMaxScaleOp — EMA of abs-max."""
+    st = _wrap(state) if state is not None else Tensor(jnp.asarray(1.0))
+    ac = _wrap(accum) if accum is not None else \
+        Tensor(jnp.abs(_wrap(x)._value).max())
+    return _ma_scale(_wrap(x), st, ac, float(moving_rate))
+
+
+@op("fake_quantize_moving_average_abs_max", differentiable=False)
+def _fq_ma(x, scale, bit_length):
+    bnt = (1 << (bit_length - 1)) - 1
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * bnt), -bnt, bnt)
+
+
+def fake_quantize_moving_average_abs_max(x, scale, bit_length=8, name=None):
+    return _fq_ma(_wrap(x), _wrap(scale), int(bit_length))
+
+
+@op("fake_quantize_dequantize_moving_average_abs_max")
+def _fqdq_ma(x, scale, bit_length):
+    return _quant_dequant(x, jax.lax.stop_gradient(scale), bit_length)
+
+
+def fake_quantize_dequantize_moving_average_abs_max(x, scale, bit_length=8,
+                                                    name=None):
+    """The QAT activation-quant op: scale tracked by EMA, STE gradient."""
+    return _fqdq_ma(_wrap(x), _wrap(scale), int(bit_length))
+
+
+@op("quantize_linear", differentiable=False)
+def _quantize_linear(x, scale, zero_point, bit_length):
+    bnt = (1 << (bit_length - 1)) - 1
+    return jnp.clip(jnp.round(x / scale + zero_point), -bnt - 1, bnt) \
+        .astype(jnp.int8 if bit_length <= 8 else jnp.int32)
+
+
+def quantize_linear(x, scale, zero_point=0.0, bit_length=8, name=None):
+    """reference: quantize_linear_op (ONNX-style QDQ)."""
+    return _quantize_linear(_wrap(x), _wrap(scale), float(zero_point),
+                            int(bit_length))
+
+
+@op("dequantize_linear", differentiable=False)
+def _dequantize_linear(q, scale, zero_point):
+    return (q.astype(scale.dtype) - zero_point) * scale
+
+
+def dequantize_linear(x, scale, zero_point=0.0, name=None):
+    return _dequantize_linear(_wrap(x), _wrap(scale), float(zero_point))

@@ -1,0 +1,131 @@
+"""Random sampling ops beyond basic creation.
+
+Reference: operators/bernoulli_op.cc, multinomial_op.cc, poisson_op.cc,
+exponential_op.cc, sampling_id_op.cc, truncated_gaussian_random_op.cc,
+randperm_op.cc, class_center_sample, dirichlet_op.cc. Each draws from the
+framework RNG stream (core.random next_key — fold_in per draw, trace-safe).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core import random as _random
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtypes import get_default_dtype
+
+__all__ = ["bernoulli", "multinomial", "poisson", "exponential_",
+           "standard_gamma", "dirichlet", "sampling_id",
+           "truncated_normal", "normal_like"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+@op("bernoulli", differentiable=False)
+def _bernoulli(x, key):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def bernoulli(x, name=None):
+    """reference: bernoulli_op.cc — elementwise p=x draws."""
+    return _bernoulli(_wrap(x), _random.next_key())
+
+
+@op("multinomial", differentiable=False)
+def _multinomial(x, key, num_samples, replacement):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(
+            key, logits, axis=-1,
+            shape=x.shape[:-1] + (num_samples,)).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    """reference: multinomial_op.cc."""
+    return _multinomial(_wrap(x), _random.next_key(), int(num_samples),
+                        bool(replacement))
+
+
+@op("poisson", differentiable=False)
+def _poisson(x, key):
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+def poisson(x, name=None):
+    """reference: poisson_op.cc — rate=x elementwise."""
+    return _poisson(_wrap(x), _random.next_key())
+
+
+@op("exponential", differentiable=False)
+def _exponential(x, key, lam):
+    return (jax.random.exponential(key, x.shape, x.dtype) / lam)
+
+
+def exponential_(x, lam=1.0, name=None):
+    """reference: exponential_op.cc (in-place in paddle; returns the
+    refilled tensor)."""
+    from ..core.tensor import check_inplace_allowed, alias_for_inplace, \
+        rebind_inplace
+    t = _wrap(x)
+    check_inplace_allowed(t)
+    out = _exponential(alias_for_inplace(t), _random.next_key(), float(lam))
+    return rebind_inplace(t, out)
+
+
+@op("standard_gamma", differentiable=False)
+def _standard_gamma(x, key):
+    return jax.random.gamma(key, x).astype(x.dtype)
+
+
+def standard_gamma(x, name=None):
+    return _standard_gamma(_wrap(x), _random.next_key())
+
+
+@op("dirichlet", differentiable=False)
+def _dirichlet(alpha, key):
+    return jax.random.dirichlet(key, alpha).astype(alpha.dtype)
+
+
+def dirichlet(alpha, name=None):
+    """reference: dirichlet_op.cc."""
+    return _dirichlet(_wrap(alpha), _random.next_key())
+
+
+@op("sampling_id", differentiable=False)
+def _sampling_id(x, key):
+    return jax.random.categorical(
+        key, jnp.log(jnp.clip(x, 1e-30, None)), axis=-1).astype(jnp.int64)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    """reference: sampling_id_op.cc — sample one id per row of prob x."""
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return _sampling_id(_wrap(x), key)
+
+
+@op("truncated_gaussian_random", differentiable=False)
+def _truncated_normal(key, shape, mean, std, dtype):
+    # reference truncates at 2 std
+    return mean + std * jax.random.truncated_normal(
+        key, -2.0, 2.0, shape, dtype)
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    """reference: truncated_gaussian_random_op.cc."""
+    dtype = dtype or get_default_dtype()
+    return _truncated_normal(_random.next_key(), tuple(shape), float(mean),
+                             float(std), dtype)
+
+
+def normal_like(x, mean=0.0, std=1.0, name=None):
+    t = _wrap(x)
+    return Tensor(mean + std * jax.random.normal(
+        _random.next_key(), t._value.shape, t._value.dtype))

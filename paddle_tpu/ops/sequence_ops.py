@@ -1,0 +1,221 @@
+"""Sequence ops — the LoD/ragged-batch capability class.
+
+Reference: operators/sequence_ops/ (~6.2k LoC: sequence_pool, sequence_pad,
+sequence_unpad, sequence_expand, sequence_softmax, sequence_reverse,
+sequence_mask, sequence_slice, sequence_erase, sequence_conv) built on
+LoDTensor's offset ragged encoding (framework/lod_tensor.h).
+
+TPU-native redesign: ragged batches are (dense [B, T, ...] tensor, lengths
+[B] int vector) pairs — the static-shape encoding XLA needs. Every op takes
+`length` where the reference consumed LoD offsets; masks are built with
+broadcasted iota, so everything jits and shards. This is the documented
+LoD replacement (SURVEY.md hard part (b)).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["sequence_mask", "sequence_pool", "sequence_pad",
+           "sequence_unpad", "sequence_expand", "sequence_softmax",
+           "sequence_reverse", "sequence_slice", "sequence_erase",
+           "edit_distance"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _mask2d(length, maxlen, dtype=jnp.bool_):
+    """[B, maxlen] validity mask from lengths."""
+    pos = jnp.arange(maxlen)
+    return (pos[None, :] < length[:, None]).astype(dtype)
+
+
+@op("sequence_mask", differentiable=False)
+def _sequence_mask(x, maxlen, dtype):
+    pos = jnp.arange(maxlen)
+    return (pos[None, :] < x.reshape(-1, 1)).astype(dtype).reshape(
+        tuple(x.shape) + (maxlen,))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: sequence_mask_op.cc."""
+    t = _wrap(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(jnp.max(t._value)))
+    return _sequence_mask(t, int(maxlen), dtype)
+
+
+@op("sequence_pool")
+def _sequence_pool(x, length, pool_type, pad_value):
+    m = _mask2d(length, x.shape[1], x.dtype)
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    n = jnp.maximum(length, 1).reshape(
+        (-1,) + (1,) * (x.ndim - 2)).astype(x.dtype)
+    if pool_type == "sum":
+        out = (x * m).sum(axis=1)
+    elif pool_type in ("mean", "average", "avg"):
+        out = (x * m).sum(axis=1) / n
+    elif pool_type == "sqrt":
+        out = (x * m).sum(axis=1) / jnp.sqrt(n)
+    elif pool_type == "max":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        out = jnp.where(m.astype(bool), x, neg).max(axis=1)
+    elif pool_type == "last":
+        idx = jnp.clip(length - 1, 0, x.shape[1] - 1)
+        idx = jnp.broadcast_to(
+            idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            (x.shape[0], 1) + x.shape[2:])
+        out = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+    elif pool_type == "first":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type}")
+    empty = (length == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+    return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+
+def sequence_pool(input, length, pool_type="sum", pad_value=0.0, name=None):
+    """reference: sequence_pool_op.cc (LoD offsets → `length` vector)."""
+    return _sequence_pool(_wrap(input), _wrap(length), pool_type.lower(),
+                          float(pad_value))
+
+
+@op("sequence_pad")
+def _sequence_pad(flat, length, maxlen, pad_value):
+    B = length.shape[0]
+    starts = jnp.concatenate([jnp.zeros(1, length.dtype),
+                              jnp.cumsum(length)[:-1]])
+    pos = jnp.arange(maxlen)
+    gather_idx = starts[:, None] + pos[None, :]
+    gather_idx = jnp.clip(gather_idx, 0, flat.shape[0] - 1)
+    out = flat[gather_idx.reshape(-1).astype(jnp.int32)]
+    out = out.reshape((B, maxlen) + flat.shape[1:])
+    m = _mask2d(length, maxlen, jnp.bool_)
+    while m.ndim < out.ndim:
+        m = m[..., None]
+    return jnp.where(m, out, jnp.asarray(pad_value, flat.dtype))
+
+
+def sequence_pad(x, length, maxlen=None, pad_value=0.0, name=None):
+    """reference: sequence_pad_op.cc — ragged-concat rows → [B, T, ...].
+    x: the concatenated sequences ([sum(length), ...])."""
+    t, ln = _wrap(x), _wrap(length)
+    if maxlen is None:
+        maxlen = int(np.asarray(jnp.max(ln._value)))
+    return _sequence_pad(t, ln, int(maxlen), float(pad_value)), ln
+
+
+def sequence_unpad(x, length, name=None):
+    """reference: sequence_unpad_op.cc. Output shape is data-dependent —
+    eager only (jit: keep the padded form + mask)."""
+    t, ln = _wrap(x), _wrap(length)
+    if isinstance(t._value, jax.core.Tracer):
+        raise RuntimeError(
+            "sequence_unpad produces a data-dependent shape; inside "
+            "jit keep the padded tensor + sequence_mask instead.")
+    arr = np.asarray(t._value)
+    lens = np.asarray(ln._value)
+    return Tensor(jnp.asarray(
+        np.concatenate([arr[i, :lens[i]] for i in range(arr.shape[0])], 0)))
+
+
+def sequence_expand(x, y_length, name=None):
+    """reference: sequence_expand_op.cc — repeat row i y_length[i] times
+    (eager; data-dependent output shape)."""
+    t, ln = _wrap(x), _wrap(y_length)
+    if isinstance(t._value, jax.core.Tracer):
+        raise RuntimeError("sequence_expand output shape is data-dependent;"
+                           " run eagerly or use repeat with a static count.")
+    arr = np.asarray(t._value)
+    lens = np.asarray(ln._value).astype(np.int64)
+    return Tensor(jnp.asarray(np.repeat(arr, lens, axis=0)))
+
+
+@op("sequence_softmax")
+def _sequence_softmax(x, length):
+    m = _mask2d(length, x.shape[1], jnp.bool_)
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    z = jnp.where(m, x, neg)
+    z = z - jax.scipy.special.logsumexp(z, axis=1, keepdims=True)
+    return jnp.where(m, jnp.exp(z), jnp.zeros_like(x))
+
+
+def sequence_softmax(input, length, name=None):
+    """reference: sequence_softmax_op.cc — softmax within each sequence,
+    zeros on padding. input: [B, T]."""
+    return _sequence_softmax(_wrap(input), _wrap(length))
+
+
+@op("sequence_reverse")
+def _sequence_reverse(x, length):
+    T = x.shape[1]
+    pos = jnp.arange(T)
+    # index (len-1-pos) for valid positions, identity on padding
+    rev = jnp.where(pos[None, :] < length[:, None],
+                    length[:, None] - 1 - pos[None, :], pos[None, :])
+    rev = jnp.broadcast_to(
+        rev.astype(jnp.int32).reshape(rev.shape + (1,) * (x.ndim - 2)),
+        (x.shape[0], T) + x.shape[2:])
+    return jnp.take_along_axis(x, rev, axis=1)
+
+
+def sequence_reverse(x, length, name=None):
+    """reference: sequence_reverse_op.cc — reverse valid prefix per row."""
+    return _sequence_reverse(_wrap(x), _wrap(length))
+
+
+def sequence_slice(input, offset, length, name=None):
+    """reference: sequence_slice_op.cc — per-row [offset, offset+length)
+    (eager, ragged output re-padded to max(length))."""
+    t = _wrap(input)
+    off = np.asarray(_wrap(offset)._value).reshape(-1)
+    ln = np.asarray(_wrap(length)._value).reshape(-1)
+    arr = np.asarray(t._value)
+    maxlen = int(ln.max()) if ln.size else 0
+    out = np.zeros((arr.shape[0], maxlen) + arr.shape[2:], arr.dtype)
+    for i in range(arr.shape[0]):
+        out[i, :ln[i]] = arr[i, off[i]:off[i] + ln[i]]
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(ln))
+
+
+def sequence_erase(x, tokens, name=None):
+    """reference: sequence_erase_op.cc — drop listed tokens (eager)."""
+    t = _wrap(x)
+    arr = np.asarray(t._value).reshape(-1)
+    keep = ~np.isin(arr, np.asarray(tokens))
+    return Tensor(jnp.asarray(arr[keep]))
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """reference: edit_distance_op.cc — Levenshtein distance per pair
+    (host computation; the reference's is a CPU kernel too)."""
+    a = np.asarray(_wrap(input)._value)
+    b = np.asarray(_wrap(label)._value)
+    if a.ndim == 1:
+        a, b = a[None], b[None]
+    la = np.asarray(_wrap(input_length)._value) if input_length is not None \
+        else np.full(a.shape[0], a.shape[1])
+    lb = np.asarray(_wrap(label_length)._value) if label_length is not None \
+        else np.full(b.shape[0], b.shape[1])
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for k in range(a.shape[0]):
+        s, t = a[k, :la[k]], b[k, :lb[k]]
+        dp = np.arange(len(t) + 1, dtype=np.int64)
+        for i in range(1, len(s) + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, len(t) + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (s[i - 1] != t[j - 1]))
+        d = float(dp[-1])
+        out[k, 0] = d / max(len(t), 1) if normalized else d
+    seq_num = Tensor(jnp.asarray(np.int64(a.shape[0])))
+    return Tensor(jnp.asarray(out)), seq_num

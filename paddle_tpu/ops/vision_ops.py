@@ -1,0 +1,629 @@
+"""Vision/detection ops.
+
+Reference: operators/detection/ (~18k LoC: prior_box_op, box_coder_op,
+yolo_box_op, multiclass_nms_op, matrix_nms_op, bipartite_match_op,
+iou_similarity_op, roi_align/roi_pool ops), affine_grid_op, grid_sampler_op,
+temporal_shift_op, pixel_shuffle/unshuffle, fold/unfold, shuffle_channel_op.
+
+TPU-native split: dense, fixed-shape ops (roi_align, grid_sample,
+affine_grid, prior_box, box_coder, yolo_box, iou, temporal_shift, fold,
+pixel_unshuffle, shuffle_channel) are pure jnp and jit/shard cleanly; NMS
+variants have data-dependent output sizes and run on host eagerly — exactly
+the reference's split (its NMS kernels are CPU too,
+multiclass_nms_op.cc uses no CUDA kernel).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["roi_align", "roi_pool", "grid_sample", "affine_grid",
+           "prior_box", "box_coder", "yolo_box", "box_iou",
+           "multiclass_nms", "matrix_nms", "nms", "bipartite_match",
+           "temporal_shift", "pixel_unshuffle", "fold", "shuffle_channel",
+           "channel_shuffle"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---------------------------------------------------------------- roi ops
+@op("roi_align")
+def _roi_align(x, boxes, boxes_num, out_h, out_w, spatial_scale,
+               sampling_ratio, aligned):
+    """reference: roi_align_op.cc — bilinear-sampled average per bin."""
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    offset = 0.5 if aligned else 0.0
+    b = boxes * spatial_scale - offset
+    x0, y0, x1, y1 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    rw = jnp.maximum(x1 - x0, 1e-6 if aligned else 1.0)
+    rh = jnp.maximum(y1 - y0, 1e-6 if aligned else 1.0)
+    bin_h = rh / out_h
+    bin_w = rw / out_w
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, out_h*s, out_w*s]
+    iy = (jnp.arange(out_h * s) + 0.5) / s
+    ix = (jnp.arange(out_w * s) + 0.5) / s
+    ys = y0[:, None] + bin_h[:, None] * iy[None, :]
+    xs = x0[:, None] + bin_w[:, None] * ix[None, :]
+
+    # batch index per roi
+    ridx = jnp.repeat(jnp.arange(boxes_num.shape[0]), 0)  # placeholder
+    # boxes_num: rois per image, cumulative mapping
+    img_of_roi = jnp.searchsorted(jnp.cumsum(boxes_num), jnp.arange(R),
+                                  side="right")
+
+    def bilinear(img, yy, xx):
+        y0i = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+        x0i = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        y1i = jnp.clip(y0i + 1, 0, H - 1)
+        x1i = jnp.clip(x0i + 1, 0, W - 1)
+        ly = jnp.clip(yy - y0i, 0.0, 1.0)
+        lx = jnp.clip(xx - x0i, 0.0, 1.0)
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+                + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+    def per_roi(r):
+        img = x[img_of_roi[r]]
+        yy, xx = jnp.meshgrid(ys[r], xs[r], indexing="ij")
+        samp = bilinear(img, yy, xx)          # [C, out_h*s, out_w*s]
+        samp = samp.reshape(C, out_h, s, out_w, s)
+        return samp.mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_align(_wrap(x), _wrap(boxes), _wrap(boxes_num),
+                      int(output_size[0]), int(output_size[1]),
+                      float(spatial_scale), int(sampling_ratio),
+                      bool(aligned))
+
+
+@op("roi_pool")
+def _roi_pool(x, boxes, boxes_num, out_h, out_w, spatial_scale):
+    """reference: roi_pool_op.cc — max pool per quantized bin (approximated
+    on a fixed sample grid for static shapes)."""
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    b = jnp.round(boxes * spatial_scale)
+    img_of_roi = jnp.searchsorted(jnp.cumsum(boxes_num), jnp.arange(R),
+                                  side="right")
+    s = 4  # samples per bin edge
+
+    def per_roi(r):
+        x0, y0, x1, y1 = b[r, 0], b[r, 1], b[r, 2], b[r, 3]
+        rh = jnp.maximum(y1 - y0 + 1, 1.0)
+        rw = jnp.maximum(x1 - x0 + 1, 1.0)
+        iy = y0 + (jnp.arange(out_h * s) + 0.5) * rh / (out_h * s)
+        ix = x0 + (jnp.arange(out_w * s) + 0.5) * rw / (out_w * s)
+        yi = jnp.clip(iy.astype(jnp.int32), 0, H - 1)
+        xi = jnp.clip(ix.astype(jnp.int32), 0, W - 1)
+        img = x[img_of_roi[r]]
+        samp = img[:, yi[:, None], xi[None, :]]
+        samp = samp.reshape(C, out_h, s, out_w, s)
+        return samp.max(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_pool(_wrap(x), _wrap(boxes), _wrap(boxes_num),
+                     int(output_size[0]), int(output_size[1]),
+                     float(spatial_scale))
+
+
+# ------------------------------------------------------------ grid sample
+@op("grid_sampler")
+def _grid_sample(x, grid, mode, padding_mode, align_corners):
+    """reference: grid_sampler_op.cc (NCHW, grid in [-1, 1])."""
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (W - 1) / 2
+        fy = (gy + 1) * (H - 1) / 2
+    else:
+        fx = ((gx + 1) * W - 1) / 2
+        fy = ((gy + 1) * H - 1) / 2
+
+    def sample_one(img, fy_, fx_):
+        if mode == "nearest":
+            yi = jnp.clip(jnp.round(fy_).astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(jnp.round(fx_).astype(jnp.int32), 0, W - 1)
+            out = img[:, yi, xi]
+            if padding_mode == "zeros":
+                valid = ((fy_ >= -0.5) & (fy_ <= H - 0.5)
+                         & (fx_ >= -0.5) & (fx_ <= W - 0.5))
+                out = out * valid[None].astype(img.dtype)
+            return out
+        y0 = jnp.floor(fy_)
+        x0 = jnp.floor(fx_)
+        ly, lx = fy_ - y0, fx_ - x0
+        vals = 0
+        for dy, wy in ((0, 1 - ly), (1, ly)):
+            for dx, wx in ((0, 1 - lx), (1, lx)):
+                yi = (y0 + dy).astype(jnp.int32)
+                xi = (x0 + dx).astype(jnp.int32)
+                yc = jnp.clip(yi, 0, H - 1)
+                xc = jnp.clip(xi, 0, W - 1)
+                v = img[:, yc, xc]
+                if padding_mode == "zeros":
+                    inside = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                    v = v * inside[None].astype(img.dtype)
+                vals = vals + v * (wy * wx)[None].astype(img.dtype)
+        return vals
+
+    return jax.vmap(sample_one)(x, fy, fx)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return _grid_sample(_wrap(x), _wrap(grid), mode, padding_mode,
+                        bool(align_corners))
+
+
+@op("affine_grid")
+def _affine_grid(theta, n, h, w, align_corners):
+    """reference: affine_grid_op.cc — sampling grid from 2x3 affine."""
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1
+        xs = (jnp.arange(w) * 2 + 1) / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [h*w, 3]
+    out = jnp.einsum("hk,nck->nhc", base, theta)              # [n, h*w, 2]
+    return out.reshape(n, h, w, 2).astype(theta.dtype)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    shp = [int(s) for s in (out_shape.tolist()
+                            if isinstance(out_shape, Tensor) else out_shape)]
+    n, _, h, w = shp
+    return _affine_grid(_wrap(theta), n, h, w, bool(align_corners))
+
+
+# -------------------------------------------------------------- box ops
+@op("prior_box", differentiable=False)
+def _prior_box(feat_h, feat_w, img_h, img_w, min_sizes, max_sizes,
+               aspect_ratios, variances, flip, clip, step_w, step_h,
+               offset, min_max_aspect_ratios_order, dtype):
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes_per_cell = []
+    for ms in min_sizes:
+        for ar in ars:
+            boxes_per_cell.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            for mx in max_sizes:
+                s = np.sqrt(ms * mx)
+                boxes_per_cell.append((s, s))
+    sw = step_w or img_w / feat_w
+    sh = step_h or img_h / feat_h
+    cx = (jnp.arange(feat_w) + offset) * sw
+    cy = (jnp.arange(feat_h) + offset) * sh
+    gx, gy = jnp.meshgrid(cx, cy, indexing="xy")
+    outs = []
+    for bw, bh in boxes_per_cell:
+        box = jnp.stack([(gy * 0 + gx - bw / 2) / img_w,
+                         (gy - bh / 2) / img_h,
+                         (gx + bw / 2) / img_w,
+                         (gy + bh / 2) / img_h], axis=-1)
+        outs.append(box)
+    out = jnp.stack(outs, axis=2)  # [H, W, nboxes, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, dtype), out.shape)
+    return out.astype(dtype), var
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """reference: detection/prior_box_op.cc (SSD anchors)."""
+    x, im = _wrap(input), _wrap(image)
+    return _prior_box(x._value.shape[2], x._value.shape[3],
+                      im._value.shape[2], im._value.shape[3],
+                      [float(s) for s in min_sizes],
+                      [float(s) for s in (max_sizes or [])],
+                      tuple(aspect_ratios), tuple(variance), bool(flip),
+                      bool(clip), float(steps[0]), float(steps[1]),
+                      float(offset), bool(min_max_aspect_ratios_order),
+                      "float32")
+
+
+@op("box_coder")
+def _box_coder(prior, prior_var, target, code_type, normalized):
+    """reference: detection/box_coder_op.cc (encode/decode_center_size)."""
+    pw = prior[:, 2] - prior[:, 0] + (0.0 if normalized else 1.0)
+    ph = prior[:, 3] - prior[:, 1] + (0.0 if normalized else 1.0)
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + (0.0 if normalized else 1.0)
+        th = target[:, 3] - target[:, 1] + (0.0 if normalized else 1.0)
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        return out / prior_var if prior_var is not None else out
+    # decode
+    t = target * prior_var if prior_var is not None else target
+    ocx = t[..., 0] * pw + pcx
+    ocy = t[..., 1] * ph + pcy
+    ow = jnp.exp(t[..., 2]) * pw
+    oh = jnp.exp(t[..., 3]) * ph
+    return jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                      ocx + ow / 2 - (0.0 if normalized else 1.0),
+                      ocy + oh / 2 - (0.0 if normalized else 1.0)], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    pv = None
+    if prior_box_var is not None:
+        pv = _wrap(prior_box_var)
+    return _box_coder(_wrap(prior_box), pv, _wrap(target_box),
+                      code_type.lower(), bool(box_normalized))
+
+
+@op("yolo_box")
+def _yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample,
+              clip_bbox, scale_x_y):
+    """reference: detection/yolo_box_op.cc."""
+    N, C, H, W = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(N, na, 5 + class_num, H, W)
+    gx, gy = jnp.meshgrid(jnp.arange(W), jnp.arange(H), indexing="xy")
+    bias = (scale_x_y - 1) / 2
+    sx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - bias
+    sy = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - bias
+    cx = (gx[None, None] + sx) / W
+    cy = (gy[None, None] + sy) / H
+    aw = jnp.asarray(anchors[0::2], x.dtype).reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2], x.dtype).reshape(1, na, 1, 1)
+    bw = jnp.exp(x[:, :, 2]) * aw / (downsample * W)
+    bh = jnp.exp(x[:, :, 3]) * ah / (downsample * H)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].reshape(N, 1, 1, 1).astype(x.dtype)
+    imw = img_size[:, 1].reshape(N, 1, 1, 1).astype(x.dtype)
+    x0 = (cx - bw / 2) * imw
+    y0 = (cy - bh / 2) * imh
+    x1 = (cx + bw / 2) * imw
+    y1 = (cy + bh / 2) * imh
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)
+    if clip_bbox:
+        boxes = jnp.stack([jnp.clip(x0, 0, imw - 1),
+                           jnp.clip(y0, 0, imh - 1),
+                           jnp.clip(x1, 0, imw - 1),
+                           jnp.clip(y1, 0, imh - 1)], axis=-1)
+    mask = (conf > conf_thresh).astype(x.dtype)
+    boxes = boxes * mask[..., None]
+    boxes = boxes.reshape(N, na * H * W, 4)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2).reshape(
+        N, na * H * W, class_num)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None):
+    return _yolo_box(_wrap(x), _wrap(img_size), list(anchors),
+                     int(class_num), float(conf_thresh),
+                     int(downsample_ratio), bool(clip_bbox), float(scale_x_y))
+
+
+@op("iou_similarity")
+def _box_iou(a, b):
+    """reference: detection/iou_similarity_op.cc — pairwise IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    x0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(x1 - x0, 0) * jnp.maximum(y1 - y0, 0)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    return _box_iou(_wrap(boxes1), _wrap(boxes2))
+
+
+iou_similarity = box_iou
+
+
+# ------------------------------------------------------------------- NMS
+def _nms_host(boxes, scores, threshold):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        xx0 = np.maximum(boxes[i, 0], boxes[:, 0])
+        yy0 = np.maximum(boxes[i, 1], boxes[:, 1])
+        xx1 = np.minimum(boxes[i, 2], boxes[:, 2])
+        yy1 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = np.maximum(xx1 - xx0, 0) * np.maximum(yy1 - yy0, 0)
+        a = np.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+            np.maximum(boxes[:, 3] - boxes[:, 1], 0)
+        iou = inter / np.maximum(a[i] + a - inter, 1e-10)
+        sup |= iou > threshold
+        sup[i] = True
+    return np.asarray(keep, np.int64)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """reference: detection NMS family — kept-index form (host eager, like
+    the reference CPU kernel; data-dependent output size)."""
+    b = np.asarray(_wrap(boxes)._value)
+    s = np.asarray(_wrap(scores)._value) if scores is not None \
+        else np.arange(len(b), 0, -1, dtype=np.float32)
+    if category_idxs is not None:
+        cats = np.asarray(_wrap(category_idxs)._value)
+        keep_all = []
+        for c in (categories if categories is not None
+                  else np.unique(cats)):
+            idx = np.nonzero(cats == c)[0]
+            if idx.size == 0:
+                continue
+            kept = _nms_host(b[idx], s[idx], iou_threshold)
+            keep_all.append(idx[kept])
+        keep = np.concatenate(keep_all) if keep_all else np.zeros(0, np.int64)
+        keep = keep[np.argsort(-s[keep])]
+    else:
+        keep = _nms_host(b, s, iou_threshold)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   rois_num=None, name=None):
+    """reference: detection/multiclass_nms_op.cc (host; returns
+    [M, 6] = label, score, x0, y0, x1, y1)."""
+    b = np.asarray(_wrap(bboxes)._value)   # [N, M, 4]
+    s = np.asarray(_wrap(scores)._value)   # [N, C, M]
+    outs, idxs, nums = [], [], []
+    for n in range(b.shape[0]):
+        dets = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            m = sc > score_threshold
+            if not m.any():
+                continue
+            cand = np.nonzero(m)[0]
+            cand = cand[np.argsort(-sc[cand])][:nms_top_k]
+            kept = _nms_host(b[n, cand], sc[cand], nms_threshold)
+            for k in cand[kept]:
+                dets.append([c, sc[k], *b[n, k]])
+        dets = np.asarray(dets, np.float32) if dets else \
+            np.zeros((0, 6), np.float32)
+        if len(dets) > keep_top_k:
+            dets = dets[np.argsort(-dets[:, 1])][:keep_top_k]
+        outs.append(dets)
+        nums.append(len(dets))
+    out = np.concatenate(outs) if outs else np.zeros((0, 6), np.float32)
+    res = Tensor(jnp.asarray(out))
+    nums_t = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    if return_index:
+        return res, Tensor(jnp.asarray(np.zeros((len(out), 1), np.int64))), \
+            nums_t
+    return res, nums_t
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """reference: detection/matrix_nms_op.cc — soft suppression by decayed
+    scores (host)."""
+    b = np.asarray(_wrap(bboxes)._value)
+    s = np.asarray(_wrap(scores)._value)
+    outs, nums = [], []
+    for n in range(b.shape[0]):
+        dets = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c].copy()
+            m = sc > score_threshold
+            if not m.any():
+                continue
+            cand = np.nonzero(m)[0]
+            cand = cand[np.argsort(-sc[cand])][:nms_top_k]
+            bb = b[n, cand]
+            ss = sc[cand]
+            # pairwise IoU of sorted candidates
+            x0 = np.maximum(bb[:, None, 0], bb[None, :, 0])
+            y0 = np.maximum(bb[:, None, 1], bb[None, :, 1])
+            x1 = np.minimum(bb[:, None, 2], bb[None, :, 2])
+            y1 = np.minimum(bb[:, None, 3], bb[None, :, 3])
+            inter = np.maximum(x1 - x0, 0) * np.maximum(y1 - y0, 0)
+            ar = np.maximum(bb[:, 2] - bb[:, 0], 0) * \
+                np.maximum(bb[:, 3] - bb[:, 1], 0)
+            iou = inter / np.maximum(ar[:, None] + ar[None, :] - inter,
+                                     1e-10)
+            iou = np.triu(iou, 1)
+            comp = iou.max(axis=0)  # max IoU with any higher-scored box
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[None, :] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - comp[None, :], 1e-10)
+                         ).min(axis=0)
+            ss = ss * decay
+            keep = ss > post_threshold
+            for k in range(len(cand)):
+                if keep[k]:
+                    dets.append([c, ss[k], *bb[k]])
+        dets = np.asarray(dets, np.float32) if dets else \
+            np.zeros((0, 6), np.float32)
+        if len(dets) > keep_top_k:
+            dets = dets[np.argsort(-dets[:, 1])][:keep_top_k]
+        outs.append(dets)
+        nums.append(len(dets))
+    out = np.concatenate(outs) if outs else np.zeros((0, 6), np.float32)
+    ret = [Tensor(jnp.asarray(out))]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(np.zeros((len(out), 1), np.int64))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(ret) if len(ret) > 1 else ret[0]
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """reference: detection/bipartite_match_op.cc — greedy max matching
+    (host)."""
+    d = np.asarray(_wrap(dist_matrix)._value).copy()
+    rows, cols = d.shape
+    match_idx = np.full(cols, -1, np.int64)
+    match_dist = np.zeros(cols, np.float32)
+    used_r = np.zeros(rows, bool)
+    used_c = np.zeros(cols, bool)
+    while True:
+        masked = np.where(used_r[:, None] | used_c[None, :], -np.inf, d)
+        r, c = np.unravel_index(np.argmax(masked), d.shape)
+        if not np.isfinite(masked[r, c]) or masked[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = d[r, c]
+        used_r[r] = True
+        used_c[c] = True
+    if match_type == "per_prediction":
+        for c in range(cols):
+            if match_idx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= dist_threshold:
+                    match_idx[c] = r
+                    match_dist[c] = d[r, c]
+    return Tensor(jnp.asarray(match_idx[None])), \
+        Tensor(jnp.asarray(match_dist[None]))
+
+
+# -------------------------------------------------------- layout/shift ops
+@op("temporal_shift")
+def _temporal_shift(x, seg_num, shift_ratio):
+    """reference: temporal_shift_op.cc — shift channels across time."""
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    v = x.reshape(N, seg_num, C, H, W)
+    c1 = int(C * shift_ratio)
+    c2 = int(C * 2 * shift_ratio)
+    fwd = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]),
+                           v[:, :-1, c1:c2]], 1)
+    keep = v[:, :, c2:]
+    return jnp.concatenate([fwd, bwd, keep], axis=2).reshape(NT, C, H, W)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    t = _wrap(x)
+    if data_format == "NHWC":
+        t = Tensor(jnp.transpose(t._value, (0, 3, 1, 2)))
+        out = _temporal_shift(t, int(seg_num), float(shift_ratio))
+        return Tensor(jnp.transpose(out._value, (0, 2, 3, 1)))
+    return _temporal_shift(t, int(seg_num), float(shift_ratio))
+
+
+@op("pixel_unshuffle")
+def _pixel_unshuffle(x, factor):
+    """reference: pixel_unshuffle (inverse of pixel_shuffle_op.cc)."""
+    N, C, H, W = x.shape
+    r = factor
+    v = x.reshape(N, C, H // r, r, W // r, r)
+    return v.transpose(0, 1, 3, 5, 2, 4).reshape(
+        N, C * r * r, H // r, W // r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(_wrap(x), int(downscale_factor))
+
+
+@op("fold")
+def _fold(x, out_h, out_w, kh, kw, sh, sw, ph, pw, dh, dw):
+    """reference: fold_op.cc (col2im) — inverse of unfold: scatter-add
+    patches back into the image."""
+    N, CKK, L = x.shape
+    C = CKK // (kh * kw)
+    nh = (out_h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (out_w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    v = x.reshape(N, C, kh, kw, nh, nw)
+    out = jnp.zeros((N, C, out_h + 2 * ph, out_w + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + nh * sh:sh,
+                         j * dw:j * dw + nw * sw:sw].add(v[:, :, i, j])
+    return out[:, :, ph:ph + out_h, pw:pw + out_w]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings) if not (isinstance(paddings, (list, tuple))
+                                    and len(paddings) == 4) else \
+        (paddings[0], paddings[1])
+    dh, dw = pair(dilations)
+    return _fold(_wrap(x), oh, ow, kh, kw, sh, sw, ph, pw, dh, dw)
+
+
+@op("shuffle_channel")
+def _shuffle_channel(x, group):
+    """reference: shuffle_channel_op.cc."""
+    N, C, H, W = x.shape
+    return x.reshape(N, group, C // group, H, W).transpose(
+        0, 2, 1, 3, 4).reshape(N, C, H, W)
+
+
+def shuffle_channel(x, group, name=None):
+    return _shuffle_channel(_wrap(x), int(group))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    t = _wrap(x)
+    if data_format == "NHWC":
+        t = Tensor(jnp.transpose(t._value, (0, 3, 1, 2)))
+        out = _shuffle_channel(t, int(groups))
+        return Tensor(jnp.transpose(out._value, (0, 2, 3, 1)))
+    return _shuffle_channel(t, int(groups))

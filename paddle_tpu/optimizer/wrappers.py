@@ -38,6 +38,10 @@ class ExponentialMovingAverage:
             raise ValueError("parameters is required (pass "
                              "model.parameters())")
         self._decay = float(decay)
+        # reference semantics (fluid/optimizer.py:3466): with thres_steps
+        # the effective decay ramps as min(decay, (1+t)/(10+t)) so the
+        # early EMA is not biased toward the random init
+        self._use_thres = thres_steps is not None
         self._params = _named_params(parameters)
         self._shadow = {k: p._value.astype(jnp.float32)
                         for k, p in self._params.items()}
@@ -48,7 +52,7 @@ class ExponentialMovingAverage:
         """Call after each optimizer.step()."""
         self._step += 1
         d = min(self._decay, (1 + self._step) / (10 + self._step)) \
-            if self._decay >= 1.0 else self._decay
+            if self._use_thres else self._decay
         for k, p in self._params.items():
             self._shadow[k] = (d * self._shadow[k]
                                + (1.0 - d) * p._value.astype(jnp.float32))

@@ -6,6 +6,7 @@ from .mesh import (  # noqa: F401
     register_ring, ring_axis, TopologyError,
 )
 from .api import (  # noqa: F401
-    ShardedTrainStep, ShardingStage, shard_activation, mark_sharding,
+    ShardedTrainStep, ShardingStage, shard_activation, shard_batch,
+    mark_sharding,
     param_spec,
 )

@@ -56,6 +56,26 @@ def shard_activation(x, *spec):
     return _shard_constraint(x, tuple(spec))
 
 
+def shard_batch(data, mesh: Mesh = None, spec=("dp",)):
+    """Build a GLOBAL batch array from this process's local shard.
+
+    Single-process: device_put with the batch sharding. Multi-process SPMD
+    (the reference's multi-trainer data feed, §2.4 env contract): each
+    process contributes its local rows via
+    jax.make_array_from_process_local_data — the analogue of each trainer
+    feeding its DataLoader shard, with XLA seeing one global array.
+    """
+    mesh = mesh or _mesh.ensure_global_mesh()
+    arr = data._value if isinstance(data, Tensor) else jnp.asarray(data)
+    axes = tuple(s for s in spec if mesh.shape.get(s, 1) > 1) or None
+    pspec = (axes,) + (None,) * (arr.ndim - 1) if axes else ()
+    ns = NamedSharding(mesh, P(*pspec))
+    if jax.process_count() == 1:
+        return Tensor(jax.device_put(arr, ns))
+    return Tensor(jax.make_array_from_process_local_data(
+        ns, np.asarray(arr)))
+
+
 def mark_sharding(param: Tensor, *spec):
     """Attach a PartitionSpec to a parameter (consumed by ShardedTrainStep;
     the analogue of the reference sharding_optimizer's param→rank

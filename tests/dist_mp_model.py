@@ -1,0 +1,65 @@
+"""2-process distributed training script (reference:
+fluid/tests/unittests/dist_mnist.py — the model file TestDistBase launches
+in trainer subprocesses). Run via paddle_tpu.distributed.launch; prints one
+JSON line of per-step losses for the parent test to compare."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.optimizer as opt  # noqa: E402
+from paddle_tpu.parallel import ShardedTrainStep, shard_batch  # noqa: E402
+
+GLOBAL_BATCH = 16
+STEPS = 5
+
+
+def make_data(step):
+    """Deterministic global batch, identical in every process."""
+    rs = np.random.RandomState(1234 + step)
+    X = rs.randn(GLOBAL_BATCH, 8).astype("float32")
+    Y = rs.randn(GLOBAL_BATCH, 2).astype("float32")
+    return X, Y
+
+
+def build():
+    with paddle.utils.unique_name.guard():
+        paddle.seed(42)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+            paddle.nn.Linear(16, 2))
+        optim = opt.Momentum(0.05, parameters=model.parameters())
+    return model, optim
+
+
+def loss_fn(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def main():
+    env = paddle.distributed.init_parallel_env()
+    rank, world = env.rank, env.world_size
+    assert jax.device_count() == world, (jax.device_count(), world)
+
+    model, optim = build()
+    step = ShardedTrainStep(model, loss_fn, optim)
+    losses = []
+    per_rank = GLOBAL_BATCH // world
+    for i in range(STEPS):
+        X, Y = make_data(i)
+        # each process feeds ONLY its shard of the global batch
+        xs = shard_batch(X[rank * per_rank:(rank + 1) * per_rank])
+        ys = shard_batch(Y[rank * per_rank:(rank + 1) * per_rank])
+        losses.append(float(step(xs, ys).numpy()))
+    print("DIST_LOSSES " + json.dumps({"rank": rank, "losses": losses}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -523,10 +523,66 @@ EXEMPT = {
 }
 
 
+# Explicit snapshot of ops exercised by tests/test_op_tail.py (and
+# test_math_tail). A NEW op registered anywhere must be added to a test
+# AND listed here (or given OpTestCase coverage above) — the gate stays
+# closed by default.
+TAIL_COVERED = {
+    'accuracy', 'adadelta', 'adagrad', 'adam', 'adamax', 'adamw',
+    'affine_grid', 'assign_value', 'auc', 'beam_search', 'bernoulli',
+    'box_coder', 'bpr_loss', 'broadcast_tensors', 'center_loss',
+    'check_finite_and_unscale', 'coalesce_tensor', 'conditional_block',
+    'conv_shift', 'cos_sim', 'crf_decoding', 'crop_tensor', 'cvm',
+    'data_norm', 'decayed_adagrad', 'dequantize_linear', 'dirichlet',
+    'exponential', 'fake_channel_wise_quantize_abs_max',
+    'fake_channel_wise_quantize_dequantize_abs_max',
+    'fake_quantize_abs_max', 'fake_quantize_dequantize_abs_max',
+    'fake_quantize_dequantize_moving_average_abs_max',
+    'fake_quantize_moving_average_abs_max', 'fft2_c2c', 'fft2_c2c_inv',
+    'fft2_c2r', 'fft2_r2c', 'fft_c2c', 'fft_c2c_inv', 'fft_c2r',
+    'fft_c2r_h', 'fft_ishift', 'fft_r2c', 'fft_r2c_ih', 'fft_shift',
+    'fftn_c2c', 'fftn_c2c_inv', 'fftn_c2r', 'fftn_r2c', 'fold', 'fsp',
+    'ftrl', 'fused_attention', 'fused_bias_dropout_residual_layer_norm',
+    'fused_bn_act', 'fused_elemwise_activation',
+    'fused_embedding_seq_pool', 'fused_feedforward',
+    'fused_gemm_epilogue', 'fusion_gru', 'fusion_lstm',
+    'fusion_repeated_fc_relu', 'fusion_seqpool_concat', 'gather_tree',
+    'grid_sampler', 'hinge_loss', 'iou_similarity', 'l1_norm', 'lamb',
+    'lars_momentum', 'linear_chain_crf', 'meshgrid', 'minus', 'momentum',
+    'moving_average_abs_max_scale', 'mul', 'multinomial', 'multiplex',
+    'pad_constant_like', 'partial_concat', 'partial_sum',
+    'pixel_unshuffle', 'poisson', 'prior_box', 'quantize_linear',
+    'rank_loss', 'rmsprop', 'roi_align', 'roi_pool', 'row_conv',
+    'sample_logits', 'sampling_id', 'segment_pool_max',
+    'segment_pool_min', 'segment_pool_sum', 'sequence_mask',
+    'sequence_pad', 'sequence_pool', 'sequence_reverse',
+    'sequence_softmax', 'sgd', 'shape', 'shuffle_batch',
+    'shuffle_channel', 'sigmoid_focal_loss', 'size', 'space_to_depth',
+    'spectral_norm', 'squared_l2_norm', 'standard_gamma', 'switch_case',
+    'temporal_shift', 'truncated_gaussian_random', 'unbind', 'unique',
+    'unpool', 'update_loss_scaling', 'viterbi_decode', 'while',
+    'yolo_box',
+    # math tail (test_op_tail.py::test_math_tail)
+    'complex', 'polar', 'logit', 'diff', 'trapezoid',
+    'cumulative_trapezoid', 'vander', 'renorm', 'take', 'nan_to_num',
+    'signbit', 'ldexp', 'frexp', 'sync_batch_norm',
+}
+
+
 def test_every_registered_op_is_covered():
-    from paddle_tpu.core.dispatch import registered_ops
-    covered = set(EXEMPT)
+    from paddle_tpu.core.dispatch import registered_ops, get_op
+    covered = set(EXEMPT) | TAIL_COVERED
     for c in ALL_CASES:
         covered.update(c.op_types)
-    missing = [o for o in registered_ops() if o not in covered]
+    covered_fns = {id(get_op(n).raw_fn) for n in covered
+                   if get_op(n) is not None}
+    missing = []
+    for o in registered_ops():
+        if o in covered:
+            continue
+        fn = get_op(o)
+        # alias of a covered op (same kernel object) counts as covered
+        if id(fn.raw_fn) in covered_fns:
+            continue
+        missing.append(o)
     assert not missing, f"ops with no harness coverage: {missing}"

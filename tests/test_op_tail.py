@@ -1,0 +1,589 @@
+"""Tests for the op-corpus tail: fft, array/meta, random, sequence,
+control flow, vision/detection, fused, quant, optimizer ops, extras.
+
+Oracles: numpy/scipy-free numpy + torch where available (the reference
+verifies the same families through OpTest with CPU-kernel oracles).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import (array_ops, control_flow, extra_ops, fused_ops,
+                            metrics_ops, quant_ops, random_ops,
+                            sequence_ops, vision_ops, optimizer_ops)
+
+rng = np.random.RandomState(3)
+
+
+def r(*shape, lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, size=shape).astype("float32")
+
+
+# ------------------------------------------------------------------- fft
+def test_fft_matches_numpy():
+    x = r(4, 8)
+    np.testing.assert_allclose(paddle.fft.fft(paddle.to_tensor(x)).numpy(),
+                               np.fft.fft(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.fft.rfft(paddle.to_tensor(x)).numpy(),
+                               np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+    c = (r(4, 8) + 1j * r(4, 8)).astype(np.complex64)
+    np.testing.assert_allclose(paddle.fft.ifft(paddle.to_tensor(c)).numpy(),
+                               np.fft.ifft(c), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.fft.fft2(paddle.to_tensor(x)).numpy(),
+        np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+        np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5), rtol=1e-6)
+
+
+def test_fft_grad():
+    x = paddle.to_tensor(r(8), stop_gradient=False)
+    out = paddle.fft.rfft(x)
+    (out.numpy() is not None)
+    loss = (paddle.as_real(out) ** 2).sum()
+    loss.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+# ------------------------------------------------------------- array ops
+def test_shape_size_rank_unbind_meshgrid():
+    x = paddle.ones([2, 3, 4])
+    np.testing.assert_array_equal(paddle.shape(x).numpy(), [2, 3, 4])
+    assert int(paddle.numel(x).numpy()) == 24
+    assert int(paddle.rank(x).numpy()) == 3
+    parts = paddle.unbind(x, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 4]
+    a, b = paddle.meshgrid(paddle.to_tensor([1., 2.]),
+                           paddle.to_tensor([3., 4., 5.]))
+    assert a.shape == [2, 3] and b.shape == [2, 3]
+    np.testing.assert_array_equal(a.numpy(), [[1, 1, 1], [2, 2, 2]])
+
+
+def test_unique_and_consecutive():
+    x = paddle.to_tensor(np.array([2, 1, 2, 3, 1]))
+    np.testing.assert_array_equal(paddle.unique(x).numpy(), [1, 2, 3])
+    vals, counts = paddle.unique(x, return_counts=True)
+    np.testing.assert_array_equal(counts.numpy(), [2, 2, 1])
+    y = paddle.to_tensor(np.array([1, 1, 2, 2, 3, 1]))
+    np.testing.assert_array_equal(
+        array_ops.unique_consecutive(y).numpy(), [1, 2, 3, 1])
+
+
+def test_tensor_array_roundtrip():
+    arr = array_ops.create_array()
+    array_ops.array_write(paddle.ones([2]), 0, arr)
+    array_ops.array_write(paddle.zeros([2]), 1, arr)
+    assert int(array_ops.array_length(arr).numpy()) == 2
+    np.testing.assert_array_equal(array_ops.array_read(arr, 0).numpy(),
+                                  [1, 1])
+
+
+def test_broadcast_tensors_and_crop():
+    outs = paddle.broadcast_tensors([paddle.ones([1, 3]),
+                                     paddle.zeros([4, 1])])
+    assert outs[0].shape == [4, 3] and outs[1].shape == [4, 3]
+    x = np.arange(24, dtype="float32").reshape(4, 6)
+    out = paddle.crop(paddle.to_tensor(x), shape=[2, 3], offsets=[1, 2])
+    np.testing.assert_array_equal(out.numpy(), x[1:3, 2:5])
+
+
+# ------------------------------------------------------------- random ops
+def test_random_ops_distributions():
+    paddle.seed(7)
+    p = paddle.full([2000], 0.3)
+    draws = random_ops.bernoulli(p)
+    assert 0.2 < float(draws.numpy().mean()) < 0.4
+    probs = paddle.to_tensor(np.array([[0.8, 0.1, 0.1]], np.float32))
+    m = random_ops.multinomial(probs, 200, replacement=True)
+    assert (np.bincount(m.numpy()[0], minlength=3)[0] > 100)
+    m2 = random_ops.multinomial(paddle.ones([1, 5]), 5, replacement=False)
+    assert sorted(m2.numpy()[0].tolist()) == [0, 1, 2, 3, 4]
+    lam = paddle.full([500], 4.0)
+    ps = random_ops.poisson(lam)
+    assert 3.0 < float(ps.numpy().mean()) < 5.0
+    tn = random_ops.truncated_normal([1000])
+    assert float(np.abs(tn.numpy()).max()) <= 2.01
+    d = random_ops.dirichlet(paddle.ones([10, 3]))
+    np.testing.assert_allclose(d.numpy().sum(-1), np.ones(10), rtol=1e-5)
+
+
+# ------------------------------------------------------------ metric ops
+def test_accuracy_auc_ops():
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+    label = np.array([1, 0, 0])
+    acc = metrics_ops.accuracy(paddle.to_tensor(pred),
+                               paddle.to_tensor(label))
+    np.testing.assert_allclose(float(acc.numpy()), 2 / 3, rtol=1e-6)
+    # AUC oracle vs sklearn-free manual: perfect separation → 1.0
+    s = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]],
+                 np.float32)
+    y = np.array([0, 0, 1, 1])
+    a = metrics_ops.auc(paddle.to_tensor(s), paddle.to_tensor(y))
+    assert float(a.numpy()) > 0.99
+
+
+# --------------------------------------------------------------- amp ops
+def test_amp_ops():
+    from paddle_tpu.ops.amp_ops import (check_finite_and_unscale,
+                                        update_loss_scaling)
+    g = [paddle.to_tensor(np.array([2.0, 4.0], np.float32))]
+    outs, found = check_finite_and_unscale(g, paddle.to_tensor(2.0))
+    np.testing.assert_allclose(outs[0].numpy(), [1.0, 2.0])
+    assert not bool(found.numpy())
+    g_bad = [paddle.to_tensor(np.array([np.inf], np.float32))]
+    _, found = check_finite_and_unscale(g_bad, paddle.to_tensor(1.0))
+    assert bool(found.numpy())
+    s, good = update_loss_scaling(
+        [], paddle.to_tensor(True), paddle.to_tensor(1024.0),
+        paddle.to_tensor(5), decr_ratio=0.5)
+    np.testing.assert_allclose(float(s.numpy()), 512.0)
+    assert int(good.numpy()) == 0
+    s2, good2 = update_loss_scaling(
+        [], paddle.to_tensor(False), paddle.to_tensor(1024.0),
+        paddle.to_tensor(1999), incr_every_n_steps=2000, incr_ratio=2.0)
+    np.testing.assert_allclose(float(s2.numpy()), 2048.0)
+
+
+# ----------------------------------------------------------- sequence ops
+def test_sequence_ops():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    lens = np.array([2, 3])
+    t, ln = paddle.to_tensor(x), paddle.to_tensor(lens)
+    m = sequence_ops.sequence_mask(ln, maxlen=4)
+    np.testing.assert_array_equal(m.numpy(),
+                                  [[1, 1, 0, 0], [1, 1, 1, 0]])
+    s = sequence_ops.sequence_pool(t, ln, "sum")
+    np.testing.assert_allclose(s.numpy(), [x[0, :2].sum(0), x[1].sum(0)])
+    mx = sequence_ops.sequence_pool(t, ln, "max")
+    np.testing.assert_allclose(mx.numpy(), [x[0, :2].max(0), x[1].max(0)])
+    last = sequence_ops.sequence_pool(t, ln, "last")
+    np.testing.assert_allclose(last.numpy(), [x[0, 1], x[1, 2]])
+    sm = sequence_ops.sequence_softmax(paddle.to_tensor(
+        np.array([[1., 2., 3.], [1., 1., 1.]], np.float32)),
+        paddle.to_tensor(np.array([2, 3])))
+    out = sm.numpy()
+    assert abs(out[0, :2].sum() - 1) < 1e-5 and out[0, 2] == 0
+    rv = sequence_ops.sequence_reverse(t, ln)
+    np.testing.assert_allclose(rv.numpy()[0, :2], x[0, 1::-1])
+    np.testing.assert_allclose(rv.numpy()[1], x[1, ::-1])
+    # pad/unpad roundtrip
+    flat = np.arange(10, dtype="float32").reshape(5, 2)
+    lens2 = np.array([2, 3])
+    padded, _ = sequence_ops.sequence_pad(paddle.to_tensor(flat),
+                                          paddle.to_tensor(lens2))
+    assert padded.shape == [2, 3, 2]
+    np.testing.assert_allclose(padded.numpy()[0, :2], flat[:2])
+    np.testing.assert_allclose(padded.numpy()[1], flat[2:])
+    back = sequence_ops.sequence_unpad(padded, paddle.to_tensor(lens2))
+    np.testing.assert_allclose(back.numpy(), flat)
+    ex = sequence_ops.sequence_expand(
+        paddle.to_tensor(np.array([[1.], [2.]], np.float32)),
+        paddle.to_tensor(np.array([2, 3])))
+    np.testing.assert_allclose(ex.numpy().ravel(), [1, 1, 2, 2, 2])
+
+
+def test_edit_distance():
+    d, n = sequence_ops.edit_distance(
+        paddle.to_tensor(np.array([[1, 2, 3]])),
+        paddle.to_tensor(np.array([[1, 3, 3]])), normalized=False)
+    assert float(d.numpy()[0, 0]) == 1.0
+    assert int(n.numpy()) == 1
+
+
+# ---------------------------------------------------------- control flow
+def test_control_flow_eager_and_jit():
+    # eager
+    out = control_flow.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: [i + 1, s * 2.0],
+        [paddle.to_tensor(0), paddle.to_tensor(1.0)])
+    assert int(out[0].numpy()) == 5 and float(out[1].numpy()) == 32.0
+    c = control_flow.cond(paddle.to_tensor(True),
+                          lambda: paddle.to_tensor(1.0),
+                          lambda: paddle.to_tensor(2.0))
+    assert float(c.numpy()) == 1.0
+
+    # under jit (lax lowering)
+    def fn(x):
+        out = control_flow.while_loop(
+            lambda i, acc: i < 3,
+            lambda i, acc: [i + 1, acc + x.sum()],
+            [paddle.to_tensor(0), (x * 0.0).sum()])
+        return out[1]
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    eager = fn(x)
+    jitted = paddle.jit.to_static(fn)(x)
+    np.testing.assert_allclose(jitted.numpy(), eager.numpy())
+    np.testing.assert_allclose(eager.numpy(), 12.0)
+
+
+def test_case_switch_case():
+    v = control_flow.case([(paddle.to_tensor(False), lambda: paddle.ones([1])),
+                           (paddle.to_tensor(True), lambda: paddle.zeros([1]))],
+                          default=lambda: paddle.full([1], 7.0))
+    assert float(v.numpy()[0]) == 0.0
+    s = control_flow.switch_case(paddle.to_tensor(2),
+                                 {1: lambda: paddle.full([1], 1.0),
+                                  2: lambda: paddle.full([1], 2.0)},
+                                 default=lambda: paddle.full([1], -1.0))
+    assert float(s.numpy()[0]) == 2.0
+    s2 = control_flow.switch_case(paddle.to_tensor(9),
+                                  {1: lambda: paddle.full([1], 1.0)},
+                                  default=lambda: paddle.full([1], -1.0))
+    assert float(s2.numpy()[0]) == -1.0
+
+
+# ------------------------------------------------------------ vision ops
+def _roi_align_oracle(x, boxes, out_size, sampling_ratio, aligned):
+    """Manual numpy roi_align (the reference roi_align_op.cc algorithm)."""
+    N, C, H, W = x.shape
+    R = len(boxes)
+    s = sampling_ratio
+    out = np.zeros((R, C, out_size, out_size), np.float32)
+
+    def bilin(img, y, f):
+        y0, x0 = int(np.floor(y)), int(np.floor(f))
+        y0c, x0c = min(max(y0, 0), H - 1), min(max(x0, 0), W - 1)
+        y1c, x1c = min(y0c + 1, H - 1), min(x0c + 1, W - 1)
+        ly, lx = np.clip(y - y0, 0, 1), np.clip(f - x0, 0, 1)
+        return (img[:, y0c, x0c] * (1 - ly) * (1 - lx)
+                + img[:, y0c, x1c] * (1 - ly) * lx
+                + img[:, y1c, x0c] * ly * (1 - lx)
+                + img[:, y1c, x1c] * ly * lx)
+
+    off = 0.5 if aligned else 0.0
+    for ri, b in enumerate(boxes):
+        x0, y0, x1, y1 = b - off
+        rw = max(x1 - x0, 1e-6 if aligned else 1.0)
+        rh = max(y1 - y0, 1e-6 if aligned else 1.0)
+        for oy in range(out_size):
+            for ox in range(out_size):
+                acc = np.zeros(C, np.float32)
+                for sy in range(s):
+                    for sx in range(s):
+                        yy = y0 + rh / out_size * (oy + (sy + 0.5) / s)
+                        xx = x0 + rw / out_size * (ox + (sx + 0.5) / s)
+                        acc += bilin(x[0], yy, xx)
+                out[ri, :, oy, ox] = acc / (s * s)
+    return out
+
+
+def test_roi_align_matches_manual_oracle():
+    x = r(1, 2, 8, 8)
+    boxes = np.array([[0.0, 0.0, 4.0, 4.0], [2.0, 2.0, 6.0, 6.0]],
+                     np.float32)
+    out = vision_ops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                               paddle.to_tensor(np.array([2])), 2,
+                               spatial_scale=1.0, sampling_ratio=2,
+                               aligned=True)
+    ref = _roi_align_oracle(x, boxes, 2, 2, True)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_grid_sample_matches_torch():
+    x = r(2, 3, 5, 5)
+    grid = np.stack(np.meshgrid(np.linspace(-1, 1, 4),
+                                np.linspace(-1, 1, 4), indexing="xy"),
+                    axis=-1).astype("float32")
+    grid = np.broadcast_to(grid, (2, 4, 4, 2)).copy()
+    out = vision_ops.grid_sample(paddle.to_tensor(x),
+                                 paddle.to_tensor(grid),
+                                 align_corners=True)
+    ref = tF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                         align_corners=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_affine_grid_matches_torch():
+    theta = r(2, 2, 3)
+    out = vision_ops.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                                 align_corners=True)
+    ref = tF.affine_grid(torch.tensor(theta), (2, 3, 4, 5),
+                         align_corners=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_box_ops():
+    a = np.array([[0., 0., 2., 2.]], np.float32)
+    b = np.array([[1., 1., 3., 3.], [0., 0., 2., 2.]], np.float32)
+    iou = vision_ops.box_iou(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(iou.numpy(), [[1 / 7, 1.0]], rtol=1e-5)
+    keep = vision_ops.nms(paddle.to_tensor(b), 0.5,
+                          scores=paddle.to_tensor(np.array([0.9, 0.8],
+                                                           np.float32)))
+    assert keep.numpy().tolist() == [0, 1]  # IoU 1/7 < 0.5: both kept
+    dets, nums = vision_ops.multiclass_nms(
+        paddle.to_tensor(b[None]),
+        paddle.to_tensor(np.array([[[0.1, 0.1], [0.9, 0.85]]], np.float32)))
+    assert int(nums.numpy()[0]) >= 1
+
+
+def test_temporal_shift_pixel_unshuffle_fold():
+    x = r(4, 4, 2, 2)  # NT=4 (N=2, T=2)
+    out = vision_ops.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                                    shift_ratio=0.25)
+    assert out.shape == [4, 4, 2, 2]
+    y = r(1, 4, 4, 4)
+    pu = vision_ops.pixel_unshuffle(paddle.to_tensor(y), 2)
+    assert pu.shape == [1, 16, 2, 2]
+    # fold∘unfold == multiplicity-weighted identity; with stride=kernel it
+    # IS identity
+    z = r(1, 2, 4, 4)
+    cols = F.unfold(paddle.to_tensor(z), kernel_sizes=2, strides=2)
+    back = vision_ops.fold(cols, output_sizes=(4, 4), kernel_sizes=2,
+                           strides=2)
+    np.testing.assert_allclose(back.numpy(), z, rtol=1e-5)
+
+
+def test_yolo_box_and_prior_box_shapes():
+    x = r(1, 14, 4, 4)  # na=2, class=2 → 2*(5+2)=14
+    boxes, scores = vision_ops.yolo_box(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.array([[64, 64]], np.int32)),
+        anchors=[10, 13, 16, 30], class_num=2, conf_thresh=0.0)
+    assert boxes.shape == [1, 32, 4] and scores.shape == [1, 32, 2]
+    pb, var = vision_ops.prior_box(
+        paddle.to_tensor(r(1, 8, 4, 4)), paddle.to_tensor(r(1, 3, 32, 32)),
+        min_sizes=[4.0], aspect_ratios=[2.0], flip=True)
+    assert pb.shape[0] == 4 and pb.shape[1] == 4 and pb.shape[3] == 4
+
+
+# -------------------------------------------------------------- fused ops
+def test_fused_ops_match_composed():
+    x, w, b = r(3, 4), r(4, 5), r(5)
+    out = fused_ops.fused_linear_activation(
+        paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+        activation="relu")
+    np.testing.assert_allclose(out.numpy(), np.maximum(x @ w + b, 0),
+                               rtol=1e-5)
+    D = 8
+    xx = r(2, 5, D)
+    ffn = fused_ops.fused_feedforward(
+        paddle.to_tensor(xx), paddle.to_tensor(r(D, 16)),
+        paddle.to_tensor(r(16)), paddle.to_tensor(r(16, D)),
+        paddle.to_tensor(r(D)), pre_layer_norm=True)
+    assert ffn.shape == [2, 5, D]
+    att = fused_ops.fused_attention(
+        paddle.to_tensor(xx), paddle.to_tensor(r(D, 3 * D)),
+        paddle.to_tensor(r(3 * D)), paddle.to_tensor(r(D, D)),
+        paddle.to_tensor(r(D)), num_heads=2, pre_layer_norm=True)
+    assert att.shape == [2, 5, D]
+    # fusion_lstm vs rnn semantics smoke + numerics sanity
+    hs, hT, cT = fused_ops.fusion_lstm(
+        paddle.to_tensor(r(2, 3, 4)), paddle.to_tensor(r(4, 16)),
+        paddle.to_tensor(r(4, 16)))
+    assert hs.shape == [2, 3, 4] and hT.shape == [2, 4]
+    gs, gT = fused_ops.fusion_gru(
+        paddle.to_tensor(r(2, 3, 4)), paddle.to_tensor(r(4, 12)),
+        paddle.to_tensor(r(4, 12)))
+    assert gs.shape == [2, 3, 4]
+    emb = fused_ops.fused_embedding_seq_pool(
+        paddle.to_tensor(r(10, 4)),
+        paddle.to_tensor(np.array([[1, 2, 0], [3, 0, 0]])),
+        paddle.to_tensor(np.array([2, 1])), combiner="sum")
+    assert emb.shape == [2, 4]
+
+
+def test_coalesce_tensor():
+    xs = [paddle.ones([2, 2]), paddle.zeros([3])]
+    views, flat = fused_ops.coalesce_tensor(xs)
+    assert flat.shape == [7]
+    np.testing.assert_array_equal(views[0].numpy(), np.ones((2, 2)))
+
+
+# -------------------------------------------------------------- quant ops
+def test_fake_quant_roundtrip_and_ste():
+    x = paddle.to_tensor(r(4, 4), stop_gradient=False)
+    out, scale = quant_ops.fake_quantize_dequantize_abs_max(x)
+    assert float(np.abs(out.numpy() - x.numpy()).max()) <= \
+        float(scale.numpy()) / 127 + 1e-6
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 4)), rtol=1e-6)
+
+    q, s = quant_ops.fake_quantize_abs_max(paddle.to_tensor(r(3, 3)))
+    assert float(np.abs(q.numpy()).max()) <= 127
+    cq, cs = quant_ops.fake_channel_wise_quantize_abs_max(
+        paddle.to_tensor(r(4, 3)), quant_axis=0)
+    assert cs.shape == [4]
+    qz = quant_ops.quantize_linear(paddle.to_tensor(r(2, 2)),
+                                   paddle.to_tensor(0.05))
+    dz = quant_ops.dequantize_linear(qz, paddle.to_tensor(0.05))
+    assert dz.shape == [2, 2]
+
+
+# ---------------------------------------------------------- optimizer ops
+def test_optimizer_ops_match_classes():
+    import jax.numpy as jnp
+    p = jnp.asarray(r(4))
+    g = jnp.asarray(r(4))
+    out = optimizer_ops.sgd_step(p, g, 0.1)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(p) - 0.1 * np.asarray(g),
+                               rtol=1e-6)
+    new_p, m2, v2, b1, b2 = optimizer_ops.adam_step(
+        p, g, jnp.zeros(4), jnp.zeros(4), jnp.asarray(1.0),
+        jnp.asarray(1.0), 0.01)
+    # one torch oracle step
+    tp = torch.tensor(np.asarray(p), requires_grad=True)
+    opt = torch.optim.Adam([tp], lr=0.01, eps=1e-8)
+    tp.grad = torch.tensor(np.asarray(g))
+    opt.step()
+    np.testing.assert_allclose(new_p.numpy(), tp.detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- extra ops
+def test_extra_losses():
+    x, y = r(3, 4), r(3, 4)
+    lab = np.array([1, 0, 2])
+    hl = extra_ops.hinge_loss(paddle.to_tensor(x),
+                              paddle.to_tensor((y > 0).astype("float32")))
+    assert hl.shape == [3, 4]
+    rl = extra_ops.rank_loss(paddle.to_tensor(np.ones((3, 1), np.float32)),
+                             paddle.to_tensor(r(3, 1)),
+                             paddle.to_tensor(r(3, 1)))
+    assert (rl.numpy() >= 0).all()
+    bl = extra_ops.bpr_loss(paddle.to_tensor(x), paddle.to_tensor(lab))
+    assert bl.shape == [3, 1]
+    fl = extra_ops.sigmoid_focal_loss(
+        paddle.to_tensor(x), paddle.to_tensor((y > 0).astype("float32")))
+    ref = torchvision_focal(x, (y > 0).astype("float32"))
+    np.testing.assert_allclose(fl.numpy(), ref, rtol=1e-4, atol=1e-5)
+    cs = extra_ops.cos_sim(paddle.to_tensor(x), paddle.to_tensor(y))
+    ref_cs = (x * y).sum(-1) / (np.linalg.norm(x, axis=-1)
+                                * np.linalg.norm(y, axis=-1))
+    np.testing.assert_allclose(cs.numpy()[:, 0], ref_cs, rtol=1e-4)
+    np.testing.assert_allclose(
+        float(extra_ops.squared_l2_norm(paddle.to_tensor(x)).numpy()),
+        (x ** 2).sum(), rtol=1e-5)
+
+
+def torchvision_focal(x, y, alpha=0.25, gamma=2.0):
+    """Manual focal-loss oracle (RetinaNet formula, float64)."""
+    x = x.astype(np.float64)
+    y = y.astype(np.float64)
+    p = 1 / (1 + np.exp(-x))
+    ce = np.logaddexp(0.0, x) - x * y
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    return (a_t * (1 - p_t) ** gamma * ce).astype(np.float32)
+
+
+def test_extra_layout_and_misc():
+    x = r(1, 2, 4, 4)
+    sd = extra_ops.space_to_depth(paddle.to_tensor(x), 2)
+    assert sd.shape == [1, 8, 2, 2]
+    seg = extra_ops.segment_sum(
+        paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                  np.float32)),
+        paddle.to_tensor(np.array([0, 0, 1])))
+    np.testing.assert_allclose(seg.numpy(), [[4, 6], [5, 6]])
+    segm = extra_ops.segment_mean(
+        paddle.to_tensor(np.array([[2., 2.], [4., 4.], [6., 6.]],
+                                  np.float32)),
+        paddle.to_tensor(np.array([0, 0, 1])))
+    np.testing.assert_allclose(segm.numpy(), [[3, 3], [6, 6]])
+    mx = extra_ops.multiplex(
+        [paddle.to_tensor(np.ones((2, 3), np.float32)),
+         paddle.to_tensor(np.zeros((2, 3), np.float32))],
+        paddle.to_tensor(np.array([1, 0])))
+    np.testing.assert_allclose(mx.numpy(), [[0, 0, 0], [1, 1, 1]])
+    m = extra_ops.mul(paddle.to_tensor(r(2, 3, 4)),
+                      paddle.to_tensor(r(12, 5)), x_num_col_dims=1)
+    assert m.shape == [2, 5]
+    pc = extra_ops.partial_sum([paddle.to_tensor(np.ones((2, 4), np.float32)),
+                                paddle.to_tensor(np.ones((2, 4), np.float32))],
+                               start_index=1, length=2)
+    np.testing.assert_allclose(pc.numpy(), np.full((2, 2), 2.0))
+    sn = extra_ops.spectral_norm(paddle.to_tensor(r(4, 4)), power_iters=20)
+    u, s, v = np.linalg.svd(np.asarray(sn.numpy()))
+    assert s.max() < 1.3  # sigma_max normalized toward 1
+
+
+def test_gather_tree_and_beam_step():
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]])      # [T=3, B=1, beam=2]
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]])
+    out = extra_ops.gather_tree(paddle.to_tensor(ids),
+                                paddle.to_tensor(parents))
+    # beam 0 at t=2 came from parent 1: path 2,4? backtrack: t2 beam0
+    # parent=1 → t1 beam1=4, its parent 0 → t0 beam0=1
+    np.testing.assert_array_equal(out.numpy()[:, 0, 0], [1, 4, 5])
+    lp = paddle.to_tensor(np.log(np.array(
+        [[[0.7, 0.2, 0.1], [0.5, 0.3, 0.2]]], np.float32)))
+    sc = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    ns, par, tok = extra_ops.beam_search_step(lp, sc, 2)
+    assert tok.numpy()[0, 0] == 0 and par.numpy()[0, 0] == 0
+
+
+def test_crf_and_viterbi():
+    B, T, C = 2, 4, 3
+    em = r(B, T, C)
+    trans_full = r(C + 2, C)
+    lens = np.array([4, 3])
+    nll = extra_ops.linear_chain_crf(
+        paddle.to_tensor(em), paddle.to_tensor(trans_full),
+        paddle.to_tensor(np.array([[0, 1, 2, 1], [2, 0, 1, 0]])),
+        paddle.to_tensor(lens))
+    assert (nll.numpy() > 0).all()  # NLL of one path < total mass
+    scores, path = extra_ops.viterbi_decode(
+        paddle.to_tensor(em), paddle.to_tensor(trans_full[2:]),
+        paddle.to_tensor(lens))
+    assert path.shape == [B, T]
+    # brute-force oracle for row 0 (length 4, no bos/eos)
+    best, best_path = -1e9, None
+    import itertools
+    for p in itertools.product(range(C), repeat=T):
+        s = em[0, 0, p[0]] + sum(
+            trans_full[2:][p[i - 1], p[i]] + em[0, i, p[i]]
+            for i in range(1, T))
+        if s > best:
+            best, best_path = s, p
+    np.testing.assert_allclose(float(scores.numpy()[0]), best, rtol=1e-4)
+    np.testing.assert_array_equal(path.numpy()[0], best_path)
+
+
+def test_sync_batch_norm_functional():
+    x = r(4, 3, 2, 2)
+    rm = paddle.to_tensor(np.zeros(3, np.float32))
+    rv = paddle.to_tensor(np.ones(3, np.float32))
+    out = F.sync_batch_norm(paddle.to_tensor(x), rm, rv, training=True)
+    # outside any mesh scope == plain batch norm stats
+    mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(
+        out.numpy().mean(axis=(0, 2, 3)), np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(rm.numpy(), 0.1 * mean, rtol=1e-4)
+
+
+def test_math_tail():
+    np.testing.assert_allclose(
+        paddle.ops.math.complex(paddle.to_tensor(np.float32(1)),
+                                paddle.to_tensor(np.float32(2))).numpy(),
+        1 + 2j)
+    x = r(3, 5)
+    np.testing.assert_allclose(paddle.ops.math.diff(
+        paddle.to_tensor(x)).numpy(), np.diff(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.ops.math.trapezoid(paddle.to_tensor(x)).numpy(),
+        np.trapezoid(x) if hasattr(np, "trapezoid") else np.trapz(x),
+        rtol=1e-5)
+    lg = paddle.ops.math.logit(paddle.to_tensor(
+        np.array([0.2, 0.5, 0.8], np.float32)))
+    np.testing.assert_allclose(lg.numpy(),
+                               np.log([0.25, 1.0, 4.0]), rtol=1e-4)
+    v = paddle.ops.math.vander(paddle.to_tensor(
+        np.array([1., 2., 3.], np.float32)), 3)
+    np.testing.assert_allclose(v.numpy(), np.vander([1, 2, 3], 3))
+    t = paddle.ops.math.take(paddle.to_tensor(x),
+                             paddle.to_tensor(np.array([0, 6, -1])))
+    np.testing.assert_allclose(t.numpy(), x.ravel()[[0, 6, -1]])
+    n2n = paddle.ops.math.nan_to_num(paddle.to_tensor(
+        np.array([np.nan, np.inf], np.float32)))
+    assert np.isfinite(n2n.numpy()).all()
