@@ -37,6 +37,7 @@ from .core.place import (  # noqa: F401
 # -- flags / errors ---------------------------------------------------------
 from .core.flags import set_flags, get_flags  # noqa: F401
 from .core import errors  # noqa: F401
+from .core import monitor  # noqa: F401
 
 # -- tensor + autograd ------------------------------------------------------
 from .core.tensor import Tensor, to_tensor  # noqa: F401
@@ -68,6 +69,8 @@ from . import utils  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import slim  # noqa: F401,E402
 from .hapi import Model, summary, flops  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .framework_io import save, load  # noqa: F401,E402

@@ -1,0 +1,71 @@
+"""Global stat registry (monitoring counters).
+
+Reference: platform/monitor.h — StatRegistry:77 (named int64 stats,
+STAT_ADD:130 / STAT_SUB / STAT_RESET macros, e.g. STAT_gpu0_mem_size used
+by the allocator), exported to Python via pybind.
+
+TPU-native: host-side counters over the same API; device-memory stats read
+live from the PJRT client (memory_stats) instead of allocator hooks —
+PJRT owns memory here (SURVEY C11 collapse).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+__all__ = ["stat_add", "stat_sub", "stat_reset", "stat_get", "stat_names",
+           "print_stats", "device_memory_stats"]
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    """reference: STAT_ADD (monitor.h:130)."""
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+        return _stats[name]
+
+
+def stat_sub(name: str, value: int = 1) -> int:
+    return stat_add(name, -int(value))
+
+
+def stat_reset(name: str = None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats[name] = 0
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_names() -> List[str]:
+    with _lock:
+        return sorted(_stats)
+
+
+def print_stats() -> str:
+    """reference: StatRegistry::publish-style dump."""
+    with _lock:
+        rows = sorted(_stats.items())
+    lines = ["-" * 44, f"{'Stat':<32}{'Value':>12}", "-" * 44]
+    lines += [f"{k[:31]:<32}{v:>12}" for k, v in rows]
+    lines.append("-" * 44)
+    return "\n".join(lines)
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """Live device memory counters from PJRT (the analogue of the
+    reference's STAT_gpuN_mem_size fed by the allocator)."""
+    import jax
+    dev = device or jax.devices()[0]
+    try:
+        ms = dev.memory_stats() or {}
+    except (AttributeError, RuntimeError, jax.errors.JaxRuntimeError):
+        return {}
+    return {k: int(v) for k, v in ms.items() if isinstance(v, (int, float))}

@@ -1,0 +1,138 @@
+"""SelectedRows: sparse row-wise gradients.
+
+Reference: framework/selected_rows.h (rows vector + value tensor + height)
+— the representation lookup_table's grad kernel emits so a huge embedding
+table's gradient costs O(batch·dim), not O(vocab·dim); consumed by sgd/adam
+kernels with row-wise updates (operators/optimizers/sgd_op.h SelectedRows
+branch, adam_op.h lazy_mode) and by merge_selected_rows /
+get_tensor_from_selected_rows ops.
+
+TPU-native placement: inside a COMPILED step XLA's scatter-add on the dense
+buffer is already optimal, so SelectedRows is an EAGER-path structure —
+exactly where the reference uses it (the eager dygraph tape + PS push).
+F.embedding(..., sparse=True) makes the tape deliver one of these to the
+weight's .grad; optimizers apply row-sliced updates.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """rows: int array [n]; values: [n, ...dim]; height: vocab size."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self) -> "SelectedRows":
+        """Deduplicate rows, summing values (reference:
+        operators/math/selected_rows_functor.h MergeAdd)."""
+        rows = np.asarray(self.rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        merged = jnp.zeros((len(uniq),) + self.values.shape[1:],
+                           self.values.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(self.values)
+        return SelectedRows(jnp.asarray(uniq), merged, self.height)
+
+    def to_dense(self):
+        """get_tensor_from_selected_rows (reference:
+        get_tensor_from_selected_rows_op.cc)."""
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            assert other.height == self.height
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # dense + sparse → dense
+        return jnp.asarray(other).at[self.rows].add(self.values)
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={self.rows.shape[0]}, dim={self.values.shape[1:]})")
+
+
+def merge_selected_rows(x: SelectedRows) -> SelectedRows:
+    """reference: merge_selected_rows_op.cc."""
+    return x.merge()
+
+
+def get_tensor_from_selected_rows(x: SelectedRows):
+    from .tensor import Tensor
+    return Tensor(x.to_dense())
+
+
+def rowwise_update(optimizer, param_arr, sr: SelectedRows, state, lr):
+    """Apply an optimizer update only on touched rows (reference: the
+    SelectedRows branches of sgd_op.h / adam_op.h lazy_mode / momentum).
+    Falls back to a dense update for optimizers whose math is not
+    row-separable (those with global-norm terms, e.g. Lamb/Lars)."""
+    from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
+    m = sr.merge()
+    rows = m.rows
+
+    if "master" in state:
+        # amp O2: the fp32 master is authoritative — a row-sliced update of
+        # only the low-precision param would be erased by the next dense
+        # step reading the stale master. Densify (correct, loses sparsity
+        # only under multi_precision).
+        return None, m.to_dense()
+
+    if isinstance(optimizer, SGD):
+        return param_arr.at[rows].add(-lr * m.values), state
+    if isinstance(optimizer, Momentum):
+        vel = state.get("velocity")
+        v_rows = optimizer._momentum * vel[rows] + m.values
+        new_p = param_arr.at[rows].add(-lr * v_rows)
+        state = dict(state)
+        state["velocity"] = vel.at[rows].set(v_rows)
+        return new_p, state
+    if isinstance(optimizer, (Adam, AdamW)) and \
+            getattr(optimizer, "_lazy_mode", False):
+        # lazy adam: moments/bias-correction advance only on touched rows
+        st = dict(state)
+        b1, b2, eps = optimizer._beta1, optimizer._beta2, optimizer._epsilon
+        m1 = st["moment1"]
+        m2 = st["moment2"]
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        g = m.values
+        if isinstance(optimizer, AdamW):
+            fn = optimizer._apply_decay_param_fun
+            pname = getattr(optimizer, "_current_param_name", None)
+            if fn is None or (pname is not None and fn(pname)):
+                param_arr = param_arr.at[rows].multiply(
+                    1.0 - lr * optimizer._coeff)
+        nm1 = b1 * m1[rows] + (1 - b1) * g
+        nm2 = b2 * m2[rows] + (1 - b2) * g * g
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_p = param_arr.at[rows].add(-lr_t * nm1 / (jnp.sqrt(nm2) + eps))
+        st["moment1"] = m1.at[rows].set(nm1)
+        st["moment2"] = m2.at[rows].set(nm2)
+        st["beta1_pow"] = b1p
+        st["beta2_pow"] = b2p
+        return new_p, st
+    # not row-separable (or non-lazy adam, which must update ALL moments):
+    # densify — correct, costs the dense memory the caller opted out of
+    dense = m.to_dense()
+    return None, dense  # caller falls back to the dense path

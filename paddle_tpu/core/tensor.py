@@ -24,6 +24,7 @@ import numpy as np
 from . import dtypes as _dt
 from . import place as _place
 from .autograd import backward as _backward
+from .selected_rows import SelectedRows as _SelectedRows
 
 _tensor_name_counter = [0]
 
@@ -44,7 +45,8 @@ class Tensor:
                  persistable: bool = False):
         if isinstance(value, Tensor):
             value = value._value
-        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+        elif not isinstance(value, (jax.Array, jax.core.Tracer,
+                                    _SelectedRows)):
             value = jnp.asarray(value)
         self._value = value
         self.stop_gradient = stop_gradient

@@ -185,18 +185,55 @@ class Fleet:
         if dirname and self._origin_optimizer:
             framework_io.save(self.state_dict(), dirname + "/fleet.pdopt")
 
+    # -------------------------------------------------- parameter server
+    # reference: fleet_base.py init_server/run_server/init_worker/
+    # stop_worker driving the_one_ps.py; here backed by distributed/ps
+    # (CPU tables + TCP RPC — SURVEY §7 stage 9).
+    def init_server(self, *args, dense_tables=None, sparse_tables=None,
+                    host="127.0.0.1", port=0, **kwargs):
+        """Create the server and its tables. dense_tables:
+        {table_id: dict(shape=..., optimizer='sgd', lr=...)};
+        sparse_tables: {table_id: dict(dim=..., optimizer=..., lr=...)}."""
+        from ..ps import ParameterServer
+        self._ps_server = ParameterServer(host, port)
+        for tid, spec in (dense_tables or {}).items():
+            self._ps_server.add_dense_table(tid, **spec)
+        for tid, spec in (sparse_tables or {}).items():
+            self._ps_server.add_sparse_table(tid, **spec)
+        return self._ps_server
+
+    def run_server(self, block: bool = False):
+        if getattr(self, "_ps_server", None) is None:
+            raise RuntimeError("call fleet.init_server(...) first")
+        self._ps_server.start()
+        if block:
+            self._ps_server.join()
+        return self._ps_server.endpoint
+
+    def init_worker(self, endpoints=None):
+        from ..ps import PsClient
+        eps = endpoints or self._role_maker.get_pserver_endpoints()
+        if not eps:
+            raise RuntimeError(
+                "no pserver endpoints: pass init_worker(endpoints=[...]) "
+                "or set PADDLE_PSERVERS_IP_PORT_LIST")
+        self._ps_client = PsClient(list(eps))
+        return self._ps_client
+
     def stop_worker(self):
-        pass
-
-    def init_worker(self):
-        pass
-
-    def init_server(self, *args, **kwargs):
-        pass
-
-    def run_server(self):
-        warnings.warn("parameter-server mode is CPU-side and out of the TPU "
-                      "fast path; see SURVEY.md §7 stage 9")
+        """reference: the_one_ps stop_worker — workers barrier, then ONLY
+        the first worker tears the servers down (any-worker shutdown would
+        kill the PS under still-training peers)."""
+        client = getattr(self, "_ps_client", None)
+        if client is not None:
+            rm = self._role_maker
+            world = rm.worker_num() if rm is not None else 1
+            if world > 1:
+                client.barrier(world)
+            if rm is None or rm.is_first_worker():
+                client.stop_server()
+            client.close()
+            self._ps_client = None
 
 
 class _FleetOptimizer:

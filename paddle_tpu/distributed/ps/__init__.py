@@ -1,0 +1,21 @@
+"""Parameter-server mode (CPU-side tables + RPC workers).
+
+Reference: /root/reference/paddle/fluid/distributed/ (pscore, ~11.5k LoC):
+brpc PS service (service/brpc_ps_server.cc, brpc_ps_client.cc), table
+storage (table/common_dense_table.cc, common_sparse_table.cc,
+sparse_geo_table.cc), async communicator (service/communicator.cc), plus
+fleet/runtime/the_one_ps.py init/run server and worker glue.
+
+TPU-native placement: PS is a CPU/host capability class — huge sparse
+embeddings live on host tables while dense compute runs on chips. Here:
+- tables: DenseTable / SparseTable (numpy host storage, SGD/adagrad/sum
+  update rules, SelectedRows-shaped sparse push)
+- transport: length-prefixed pickle over TCP (the brpc stand-in; same
+  pull/push RPC surface)
+- modes: sync push (apply immediately) and a_sync with geo-style local
+  step counting (reference GeoCommunicator semantics: workers train
+  locally, push deltas every k steps)
+"""
+from .table import DenseTable, SparseTable  # noqa: F401
+from .server import ParameterServer  # noqa: F401
+from .client import PsClient  # noqa: F401

@@ -1,0 +1,272 @@
+"""paddle.inference — the deployment API.
+
+Reference: /root/reference/paddle/fluid/inference/api/analysis_predictor.cc
+(AnalysisPredictor::Run, ZeroCopyTensor handles) + paddle_inference_api.h
+(Config/create_predictor/Predictor), python surface
+python/paddle/inference/__init__.py.
+
+TPU-native: the serialized artifact is StableHLO (jax.export) produced by
+paddle.jit.save or paddle.static.save_inference_model; "analysis passes"
+collapse into XLA compilation at load time. The Config knobs that steer
+CUDA/TensorRT/MKLDNN keep their API shape and record state (introspectable
+via summary()) but the execution engine is always the XLA backend in this
+build.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor",
+           "PredictorPool", "get_version"]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class Config:
+    """reference: paddle_analysis_config.h AnalysisConfig."""
+
+    def __init__(self, prog_file: str = None, params_file: str = None):
+        # accept (model_dir) or (prog_file, params_file) like the reference
+        self._model_dir = None
+        self._prog_file = None
+        self._params_file = None
+        if prog_file is not None and params_file is None:
+            if os.path.isdir(prog_file):
+                self._model_dir = prog_file
+            else:
+                self._prog_file = prog_file
+        else:
+            self._prog_file = prog_file
+            self._params_file = params_file
+        self._use_gpu = False
+        self._use_tpu = True
+        self._device_id = 0
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cpu_math_threads = 1
+        self._enable_profile = False
+        self._glog_info = True
+
+    # --------------------------------------------------------------- model
+    def set_model(self, prog_file: str, params_file: str = None):
+        if params_file is None:
+            self._model_dir = prog_file
+        else:
+            self._prog_file = prog_file
+            self._params_file = params_file
+
+    def set_prog_file(self, path: str):
+        self._prog_file = path
+
+    def set_params_file(self, path: str):
+        self._params_file = path
+
+    def model_dir(self) -> Optional[str]:
+        return self._model_dir
+
+    def prog_file(self) -> Optional[str]:
+        return self._prog_file
+
+    def params_file(self) -> Optional[str]:
+        return self._params_file
+
+    def _artifact_prefix(self) -> str:
+        if self._prog_file:
+            return self._prog_file[:-len(".pdmodel")] \
+                if self._prog_file.endswith(".pdmodel") else self._prog_file
+        if self._model_dir:
+            for name in sorted(os.listdir(self._model_dir)):
+                if name.endswith(".pdmodel"):
+                    return os.path.join(self._model_dir,
+                                        name[:-len(".pdmodel")])
+            raise ValueError(
+                f"no .pdmodel artifact in {self._model_dir}")
+        raise ValueError("Config: no model set")
+
+    # -------------------------------------------------------------- device
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def use_gpu(self) -> bool:
+        return self._use_gpu
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def gpu_device_id(self) -> int:
+        return self._device_id
+
+    # ------------------------------------------------------ engine options
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_threads = n
+
+    def enable_mkldnn(self):
+        pass  # XLA:CPU owns codegen in this build
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT subgraph offload does not exist on the TPU backend; "
+            "the whole model is one XLA computation already")
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def summary(self) -> str:
+        lines = ["----- paddle_tpu inference config -----",
+                 f"model prefix: {self._artifact_prefix()}",
+                 f"backend: {jax.default_backend()}",
+                 f"ir_optim (XLA): {self._ir_optim}",
+                 f"memory_optim: {self._memory_optim}",
+                 f"profiling: {self._enable_profile}"]
+        return "\n".join(lines)
+
+
+class Tensor:
+    """Zero-copy-style IO handle (reference: ZeroCopyTensor,
+    paddle_tensor.h). copy_from_cpu stages the input; copy_to_cpu fetches
+    the output after run()."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._arr: Optional[jax.Array] = None
+
+    def name(self) -> str:
+        return self._name
+
+    def reshape(self, shape):
+        if self._arr is not None:
+            self._arr = self._arr.reshape(shape)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._arr = jnp.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._arr is None:
+            raise RuntimeError("output not populated; call predictor.run()")
+        return np.asarray(self._arr)
+
+    def shape(self):
+        return list(self._arr.shape) if self._arr is not None else []
+
+    def type(self):
+        return str(self._arr.dtype) if self._arr is not None else "unset"
+
+
+class Predictor:
+    """reference: AnalysisPredictor — load artifact, bind IO handles,
+    run one compiled executable."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        prefix = config._artifact_prefix()
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        state = None
+        meta: Dict = {}
+        params_path = prefix + ".pdiparams"
+        if os.path.exists(params_path):
+            with open(params_path, "rb") as f:
+                blob = pickle.load(f)
+            if isinstance(blob, dict) and "feed_names" in blob:
+                meta = blob          # static save_inference_model artifact
+            else:
+                state = jax.tree_util.tree_map(jnp.asarray, blob)
+        self._state = state          # jit.save artifact closes over params
+        n_state = len(jax.tree_util.tree_leaves(state)) if state else 0
+        n_inputs = len(self._exported.in_avals) - n_state
+        self._input_names = meta.get("feed_names") or [
+            f"x{i}" for i in range(n_inputs)]
+        self._output_names = meta.get("fetch_names") or None
+        self._inputs = {n: Tensor(n) for n in self._input_names}
+        self._outputs: Dict[str, Tensor] = {}
+
+    # ------------------------------------------------------------------ io
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        if self._output_names is None:
+            return [f"out{i}" for i in range(len(self._outputs))] \
+                if self._outputs else ["out0"]
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+    # ----------------------------------------------------------------- run
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """reference: AnalysisPredictor::ZeroCopyRun (handle style) and
+        Run(inputs) (list style)."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._arr is None:
+                raise RuntimeError(f"input '{n}' not set; use "
+                                   "get_input_handle(name).copy_from_cpu")
+            args.append(h._arr)
+        if self._state is not None:
+            outs = self._exported.call(self._state, *args)
+        else:
+            outs = self._exported.call(*args)
+        flat = jax.tree_util.tree_leaves(outs)
+        names = self._output_names or [f"out{i}" for i in range(len(flat))]
+        self._outputs = {}
+        for n, a in zip(names, flat):
+            t = Tensor(n)
+            t._arr = a
+            self._outputs[n] = t
+        if inputs is not None:
+            return [np.asarray(a) for a in flat]
+        return None
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor."""
+    return Predictor(config)
+
+
+class PredictorPool:
+    """reference: paddle_infer::services::PredictorPool."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]

@@ -97,12 +97,52 @@ def _embedding(weight, ids, padding_idx):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """reference: operators/lookup_table_v2_op.cc. `sparse` (SelectedRows
-    grads) is accepted for parity; on TPU dense scatter-add grads via XLA
-    are used either way."""
+    """reference: operators/lookup_table_v2_op.cc. sparse=True delivers a
+    SelectedRows gradient to the weight (its grad kernel's is_sparse branch
+    — O(batch·dim) instead of O(vocab·dim)); effective on the EAGER path
+    for leaf weights. Inside jit, XLA's dense scatter-add is already
+    optimal, so the traced path stays dense either way."""
     if padding_idx is not None and padding_idx < 0:
         padding_idx = weight.shape[0] + padding_idx
-    return _embedding(_wrap(weight), _wrap(x), padding_idx)
+    w, ids = _wrap(weight), _wrap(x)
+    if sparse and not w.stop_gradient and w._node is None \
+            and not isinstance(w._value, jax.core.Tracer) \
+            and not isinstance(ids._value, jax.core.Tracer):
+        return _sparse_embedding(w, ids, padding_idx)
+    return _embedding(w, ids, padding_idx)
+
+
+def _sparse_embedding(w, ids, padding_idx):
+    """Forward = gather; tape vjp emits SelectedRows(ids, out_cot)."""
+    from ...core.autograd import TapeNode, _GradState
+    from ...core.selected_rows import SelectedRows
+
+    idx = ids._value.astype(jnp.int32)
+    out_arr = w._value[idx]
+    if padding_idx is not None:
+        out_arr = jnp.where((idx == padding_idx)[..., None],
+                            jnp.zeros_like(out_arr), out_arr)
+    out = Tensor(out_arr, stop_gradient=not _GradState.enabled)
+    if _GradState.enabled:
+        vocab = w._value.shape[0]
+        flat_idx = idx.reshape(-1)
+
+        def vjp(cot):
+            vals = cot.reshape(-1, cot.shape[-1])
+            if padding_idx is not None:
+                keep = flat_idx != padding_idx
+                vals = vals * keep[:, None].astype(vals.dtype)
+            sr = SelectedRows(flat_idx, vals, vocab)
+            return (sr, np.zeros(ids._value.shape, jax.dtypes.float0))
+
+        node = TapeNode("lookup_table_v2_sparse", vjp, [w, ids],
+                        [(tuple(out_arr.shape), out_arr.dtype)])
+        out.stop_gradient = False
+        out._node = node
+        out._out_idx = 0
+        import weakref
+        node.out_refs[0] = weakref.ref(out)
+    return out
 
 
 @op("one_hot_v2", differentiable=False)

@@ -113,15 +113,32 @@ class Optimizer:
         return getattr(p, "optimize_attr", None) or {"learning_rate": 1.0}
 
     def step(self):
+        from ..core.selected_rows import SelectedRows, rowwise_update
         with no_grad():
             params_grads = [(p, p.grad) for p in self._parameter_list
                             if p.grad is not None
                             and getattr(p, "trainable", True)]
             if self._grad_clip is not None:
+                # global-norm clipping needs dense values; densify sparse
+                # grads first (reference: clip merges SelectedRows too)
+                for p, g in params_grads:
+                    if isinstance(g._value, SelectedRows):
+                        g._value = g._value.to_dense()
                 params_grads = self._grad_clip(params_grads)
             lr = self.get_lr()
             for p, g in params_grads:
                 garr = g._value
+                if isinstance(garr, SelectedRows):
+                    state = self._state_for(p)
+                    p_lr = lr * self._param_lr(p).get("learning_rate", 1.0)
+                    self._current_param_name = p.name
+                    new_p, new_state = rowwise_update(
+                        self, p._value, garr, state, p_lr)
+                    if new_p is not None:
+                        p._value = new_p
+                        self._accumulators[id(p)] = new_state
+                        continue
+                    garr = new_state  # densified fallback
                 if self.regularization is not None and \
                         getattr(p, "regularizer", None) is None:
                     garr = self.regularization.apply(p._value, garr)

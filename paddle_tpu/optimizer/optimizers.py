@@ -55,6 +55,9 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # lazy_mode: SelectedRows grads update only touched rows
+        # (reference: adam_op.h lazy_mode branch)
+        self._lazy_mode = lazy_mode
 
     def _init_state(self, param):
         return {

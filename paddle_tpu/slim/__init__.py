@@ -1,0 +1,11 @@
+"""paddle.slim — model compression: QAT + post-training quantization.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ (~8k LoC):
+ImperativeQuantAware (imperative/qat.py) wraps Linear/Conv2D with
+fake-quant layers; QuantizationTransformPass rewrites static programs;
+post_training_quantization.py calibrates activation ranges over sample
+batches. Kernel layer: operators/fake_quantize_op.cc — implemented here as
+paddle_tpu.ops.quant_ops (STE gradients).
+"""
+from .qat import ImperativeQuantAware, QAT  # noqa: F401
+from .ptq import PostTrainingQuantization, PTQ  # noqa: F401

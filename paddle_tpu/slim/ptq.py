@@ -1,0 +1,124 @@
+"""Post-training quantization.
+
+Reference: fluid/contrib/slim/quantization/post_training_quantization.py —
+feed calibration batches through the model, collect per-tensor activation
+abs-max (or histogram/KL) ranges and per-channel weight ranges, then emit a
+quantized inference model + scales.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["PostTrainingQuantization", "PTQ"]
+
+
+class PostTrainingQuantization:
+    """Minimal abs-max PTQ (the reference's default algo='abs_max').
+
+    Usage:
+        ptq = PostTrainingQuantization(model)
+        for batch in calib_loader: ptq.sample(batch)
+        qmodel, scales = ptq.quantize()
+    """
+
+    def __init__(self, model: Layer, weight_bits: int = 8,
+                 activation_bits: int = 8, algo: str = "abs_max"):
+        if algo not in ("abs_max", "avg"):
+            raise NotImplementedError(
+                f"algo={algo!r}: this build implements 'abs_max' and 'avg' "
+                "(the reference's histogram/KL calibrators are CPU-side "
+                "statistics refinements, not kernel features)")
+        self._model = model
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._algo = algo
+        self._act_scales: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._hooks = []
+        self._install_hooks()
+
+    def _install_hooks(self):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+
+        def make_hook(lname):
+            def hook(layer, inputs, output=None):
+                x = inputs[0] if isinstance(inputs, (tuple, list)) \
+                    else inputs
+                cur = float(jnp.abs(x._value).max())
+                if self._algo == "abs_max":
+                    self._act_scales[lname] = max(
+                        self._act_scales.get(lname, 0.0), cur)
+                else:  # running average
+                    n = self._counts.get(lname, 0)
+                    prev = self._act_scales.get(lname, 0.0)
+                    self._act_scales[lname] = (prev * n + cur) / (n + 1)
+                    self._counts[lname] = n + 1
+            return hook
+
+        for name, sub in self._model.named_sublayers():
+            if isinstance(sub, (Linear, Conv2D)):
+                self._hooks.append(
+                    sub.register_forward_post_hook(make_hook(name)))
+
+    def sample(self, *batch):
+        """Run one calibration batch through the model."""
+        from ..core.autograd import no_grad
+        self._model.eval()
+        with no_grad():
+            self._model(*batch)
+
+    def quantize(self):
+        """Freeze: returns (quantized_model, scales). The model's
+        quantizable layers are swapped for fake-quant wrappers whose
+        activation scales are the calibrated values (simulated int8 —
+        the reference's quantized inference graph before kernel
+        substitution)."""
+        from .qat import QuantedConv2D, QuantedLinear, _ActQuant
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+
+        scales = {"activations": dict(self._act_scales), "weights": {}}
+        for name, sub in self._model.named_sublayers():
+            if isinstance(sub, (Linear, Conv2D)):
+                scales["weights"][name] = float(
+                    jnp.abs(sub.weight._value).max())
+
+        def swap(model, prefix=""):
+            for cname, child in list(model.named_children()):
+                full = f"{prefix}.{cname}" if prefix else cname
+                if isinstance(child, Linear):
+                    q = QuantedLinear(child, self._wbits, self._abits)
+                    q._act = _frozen_act(self._act_scales.get(full),
+                                         self._abits)
+                    setattr(model, cname, q)
+                elif isinstance(child, Conv2D):
+                    q = QuantedConv2D(child, self._wbits, self._abits)
+                    q._act = _frozen_act(self._act_scales.get(full),
+                                         self._abits)
+                    setattr(model, cname, q)
+                else:
+                    swap(child, full)
+
+        swap(self._model)
+        return self._model, scales
+
+
+def _frozen_act(scale: Optional[float], bits: int):
+    from .qat import _ActQuant
+    aq = _ActQuant(bits)
+    if scale is not None:
+        aq.scale = Tensor(jnp.asarray(scale))
+    return aq
+
+
+PTQ = PostTrainingQuantization

@@ -1,0 +1,143 @@
+"""Quantization-aware training.
+
+Reference: fluid/contrib/slim/quantization/imperative/qat.py
+(ImperativeQuantAware.quantize walks the Layer tree and swaps
+Linear/Conv2D for Quantized* wrappers whose forward fake-quants weights
+and activations with the fake_quantize ops).
+
+TPU-native: the same wrapper strategy over this framework's Layer tree;
+fake-quant ops are pure jnp with STE grads (ops/quant_ops.py), so the
+whole QAT train step still compiles into ONE XLA module under
+jit.TrainStep — quantization simulation rides the fused graph for free.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.quant_ops import (
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_moving_average_abs_max,
+)
+
+__all__ = ["ImperativeQuantAware", "QAT", "QuantedLinear", "QuantedConv2D"]
+
+
+class _ActQuant:
+    """Activation fake-quant with a moving-average abs-max scale
+    (reference: quant_layers.py FakeQuantMovingAverageAbsMax)."""
+
+    def __init__(self, bits: int, moving_rate: float = 0.9):
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.scale: Optional[Tensor] = None
+        self._state = 1.0
+        self._accum = None
+
+    def __call__(self, x: Tensor, training: bool) -> Tensor:
+        import jax
+        if isinstance(x._value, jax.core.Tracer):
+            # traced (compiled) step: use the frozen scale
+            if self.scale is None:
+                return x
+            return fake_quantize_dequantize_moving_average_abs_max(
+                x, self.scale, self.bits)
+        if training:
+            cur = float(jnp.abs(x._value).max())
+            if self._accum is None:
+                self._accum = cur
+            else:
+                self._state = self.moving_rate * self._state + 1.0
+                self._accum = self.moving_rate * self._accum + cur
+            self.scale = Tensor(jnp.asarray(self._accum / self._state))
+        if self.scale is None:
+            return x
+        return fake_quantize_dequantize_moving_average_abs_max(
+            x, self.scale, self.bits)
+
+
+class QuantedLinear(Layer):
+    """reference: quant_layers.py QuantizedLinear."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 quantize_activation=True):
+        super().__init__()
+        self._inner = inner
+        self._wbits = weight_bits
+        self._act = _ActQuant(activation_bits) if quantize_activation \
+            else None
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self._act is not None:
+            x = self._act(x, self.training)
+        wq, _ = fake_quantize_dequantize_abs_max(self._inner.weight,
+                                                 self._wbits)
+        return F.linear(x, wq, self._inner.bias)
+
+
+class QuantedConv2D(Layer):
+    """reference: quant_layers.py QuantizedConv2D (channel-wise weight
+    quant along the output-channel axis)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 quantize_activation=True):
+        super().__init__()
+        self._inner = inner
+        self._wbits = weight_bits
+        self._act = _ActQuant(activation_bits) if quantize_activation \
+            else None
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self._act is not None:
+            x = self._act(x, self.training)
+        wq, _ = fake_channel_wise_quantize_dequantize_abs_max(
+            self._inner.weight, self._wbits, quant_axis=0)
+        c = self._inner
+        return F.conv2d(x, wq, c.bias, stride=c._stride, padding=c._padding,
+                        dilation=c._dilation, groups=c._groups)
+
+
+class ImperativeQuantAware:
+    """reference: imperative/qat.py ImperativeQuantAware."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, quantizable_layer_type=("Linear",
+                                                          "Conv2D")):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._types = tuple(quantizable_layer_type)
+
+    def quantize(self, model: Layer) -> Layer:
+        """Swap quantizable sublayers in place (like the reference, which
+        mutates the model) and return it."""
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        for name, child in list(model.named_children()):
+            cls = type(child).__name__
+            if isinstance(child, Linear) and "Linear" in self._types:
+                setattr(model, name,
+                        QuantedLinear(child, self._wbits, self._abits))
+            elif isinstance(child, Conv2D) and "Conv2D" in self._types:
+                setattr(model, name,
+                        QuantedConv2D(child, self._wbits, self._abits))
+            else:
+                self.quantize(child)
+        return model
+
+    def save_quantized_model(self, model: Layer, path: str,
+                             input_spec=None):
+        """reference: qat.py save_quantized_model — exports the fake-quant
+        inference graph (jit.save → StableHLO artifact, servable through
+        paddle_tpu.inference)."""
+        from .. import jit
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)
+
+
+QAT = ImperativeQuantAware

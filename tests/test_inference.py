@@ -1,0 +1,92 @@
+"""paddle.inference Predictor API tests (reference:
+test_analysis_predictor / inference_api_test pattern: save artifact,
+create_predictor, handle-style IO, numeric parity with the source model)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference as paddle_infer
+
+
+def _save_jit_model(tmp_path):
+    paddle.seed(0)
+    model = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(8, 2))
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(model, prefix,
+                    input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+    return model, prefix
+
+
+def test_predictor_handle_io_matches_model(tmp_path):
+    model, prefix = _save_jit_model(tmp_path)
+    config = paddle_infer.Config(prefix + ".pdmodel")
+    pred = paddle_infer.create_predictor(config)
+
+    x = np.random.randn(2, 4).astype("float32")
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out_h = pred.get_output_handle(pred.get_output_names()[0])
+    got = out_h.copy_to_cpu()
+    want = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_list_run_and_pool(tmp_path):
+    model, prefix = _save_jit_model(tmp_path)
+    config = paddle_infer.Config(str(tmp_path))  # model_dir form
+    pred = paddle_infer.create_predictor(config)
+    x = np.random.randn(2, 4).astype("float32")
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], model(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    pool = paddle_infer.PredictorPool(config, 2)
+    outs2 = pool.retrieve(1).run([x])
+    np.testing.assert_allclose(outs2[0], outs[0], rtol=1e-6)
+
+
+def test_predictor_on_static_artifact(tmp_path):
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [3, 4], "float32")
+            lin = paddle.nn.Linear(4, 2)
+            out = lin(x)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            prefix = str(tmp_path / "static_m")
+            paddle.static.io.save_inference_model(prefix, [x], [out],
+                                                  program=main)
+            xv = np.random.randn(3, 4).astype("float32")
+            ref = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    finally:
+        paddle.disable_static()
+
+    config = paddle_infer.Config(prefix + ".pdmodel")
+    pred = paddle_infer.create_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    outs = pred.run([xv])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_config_knobs_and_errors(tmp_path):
+    model, prefix = _save_jit_model(tmp_path)
+    c = paddle_infer.Config(prefix + ".pdmodel")
+    c.disable_gpu()
+    assert not c.use_gpu()
+    c.switch_ir_optim(False)
+    assert not c.ir_optim()
+    assert "inference config" in c.summary()
+    with pytest.raises(NotImplementedError):
+        c.enable_tensorrt_engine()
+    bad = paddle_infer.Config(str(tmp_path / "nope"))
+    with pytest.raises((ValueError, FileNotFoundError)):
+        paddle_infer.create_predictor(bad)
+    pred = paddle_infer.create_predictor(c)
+    with pytest.raises(RuntimeError):
+        pred.run()  # inputs never set

@@ -1,0 +1,87 @@
+"""slim quantization tests (reference: slim/tests/test_imperative_qat.py,
+test_post_training_quantization pattern: quantize, train/calibrate, check
+outputs stay close and the artifact serves)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import slim
+
+
+def _mlp():
+    paddle.seed(0)
+    return paddle.nn.Sequential(
+        paddle.nn.Conv2D(1, 4, 3, padding=1), paddle.nn.ReLU(),
+        paddle.nn.Flatten(), paddle.nn.Linear(4 * 8 * 8, 10))
+
+
+def test_qat_swaps_layers_and_trains():
+    model = _mlp()
+    x = paddle.to_tensor(np.random.randn(4, 1, 8, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 10, (4,)))
+    ref = model(x).numpy()
+
+    qat = slim.QAT()
+    qat.quantize(model)
+    from paddle_tpu.slim.qat import QuantedConv2D, QuantedLinear
+    kinds = [type(m).__name__ for _, m in model.named_children()]
+    assert "QuantedConv2D" in kinds and "QuantedLinear" in kinds
+
+    model.train()
+    out = model(x)
+    # int8 simulation ≈ fp32 within quant error
+    np.testing.assert_allclose(out.numpy(), ref, rtol=0.2, atol=0.15)
+
+    optim = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    losses = []
+    for _ in range(15):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]  # STE grads flow
+
+
+def test_qat_save_and_serve(tmp_path):
+    model = _mlp()
+    slim.QAT().quantize(model)
+    x = np.random.randn(2, 1, 8, 8).astype("float32")
+    model.train()
+    model(paddle.to_tensor(x))  # populate act scales
+    prefix = str(tmp_path / "qmodel")
+    slim.QAT().save_quantized_model(
+        model, prefix,
+        input_spec=[paddle.jit.InputSpec([2, 1, 8, 8], "float32")])
+    from paddle_tpu import inference as paddle_infer
+    pred = paddle_infer.create_predictor(
+        paddle_infer.Config(prefix + ".pdmodel"))
+    outs = pred.run([x])
+    model.eval()
+    np.testing.assert_allclose(outs[0], model(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ptq_calibrates_and_quantizes():
+    model = _mlp()
+    x1 = np.random.randn(4, 1, 8, 8).astype("float32")
+    x2 = 3 * np.random.randn(4, 1, 8, 8).astype("float32")
+    ref = model(paddle.to_tensor(x1)).numpy()
+
+    ptq = slim.PTQ(model)
+    ptq.sample(paddle.to_tensor(x1))
+    ptq.sample(paddle.to_tensor(x2))
+    qmodel, scales = ptq.quantize()
+    assert scales["activations"] and scales["weights"]
+    # abs_max calibration saw the wider batch
+    first_key = sorted(scales["activations"])[0]
+    assert scales["activations"][first_key] >= float(np.abs(x1).max()) - 1e-5
+
+    qmodel.eval()
+    out = qmodel(paddle.to_tensor(x1)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=0.25, atol=0.2)
+
+
+def test_ptq_rejects_unknown_algo():
+    import pytest
+    with pytest.raises(NotImplementedError):
+        slim.PTQ(_mlp(), algo="KL")

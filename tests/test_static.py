@@ -19,10 +19,15 @@ import paddle_tpu as paddle
 def _static_mode():
     main = paddle.static.Program()
     startup = paddle.static.Program()
-    paddle.enable_static()
-    with paddle.static.program_guard(main, startup):
-        yield main, startup
-    paddle.disable_static()
+    # fresh scope + name counters per test: auto-generated param names must
+    # not collide with variables an earlier test initialized in the global
+    # scope (reference tests use scope_guard/unique_name.guard the same way)
+    paddle.static.global_scope().drop_kids()
+    with paddle.utils.unique_name.guard():
+        paddle.enable_static()
+        with paddle.static.program_guard(main, startup):
+            yield main, startup
+        paddle.disable_static()
 
 
 def _exe():
