@@ -101,6 +101,11 @@ def rowwise_update(optimizer, param_arr, sr: SelectedRows, state, lr):
     if isinstance(optimizer, SGD):
         return param_arr.at[rows].add(-lr * m.values), state
     if isinstance(optimizer, Momentum):
+        if getattr(optimizer, "_use_nesterov", False) or \
+                getattr(optimizer, "_rescale_grad", 1.0) != 1.0:
+            # dense path applies the Nesterov/rescale formula
+            # (optimizers.py Momentum._update); keep the math identical
+            return None, m.to_dense()
         vel = state.get("velocity")
         v_rows = optimizer._momentum * vel[rows] + m.values
         new_p = param_arr.at[rows].add(-lr * v_rows)

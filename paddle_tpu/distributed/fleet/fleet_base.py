@@ -212,7 +212,9 @@ class Fleet:
 
     def init_worker(self, endpoints=None):
         from ..ps import PsClient
-        eps = endpoints or self._role_maker.get_pserver_endpoints()
+        eps = endpoints
+        if not eps and self._role_maker is not None:
+            eps = self._role_maker.get_pserver_endpoints()
         if not eps:
             raise RuntimeError(
                 "no pserver endpoints: pass init_worker(endpoints=[...]) "

@@ -20,3 +20,4 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataset_native import InMemoryDataset, QueueDataset  # noqa: F401,E402

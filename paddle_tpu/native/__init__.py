@@ -1,0 +1,85 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference's runtime around the compute path is C++ (SURVEY §2.1); the
+pieces that still matter on TPU — host-side data ingestion that must run
+off the GIL while chips execute — are C++ here too. pybind11 is not
+available in this environment, so bindings are plain `extern "C"` + ctypes
+(zero-dependency, ABI-stable).
+
+Compilation happens on first import with g++ (cached by source mtime in
+paddle_tpu/native/_build/).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "datafeed.cc")
+_BUILD = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD, "_datafeed.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _compile():
+    os.makedirs(_BUILD, exist_ok=True)
+    # pid-unique temp: two processes building concurrently must not write
+    # the same file (os.replace makes the final install atomic either way)
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, _SO)
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _compile()
+        lib = ctypes.CDLL(_SO)
+        lib.df_create.restype = ctypes.c_void_p
+        lib.df_create.argtypes = [ctypes.c_char_p]
+        lib.df_destroy.argtypes = [ctypes.c_void_p]
+        lib.df_last_error.restype = ctypes.c_char_p
+        lib.df_last_error.argtypes = [ctypes.c_void_p]
+        lib.df_load.restype = ctypes.c_int64
+        lib.df_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int]
+        lib.df_size.restype = ctypes.c_int64
+        lib.df_size.argtypes = [ctypes.c_void_p]
+        lib.df_memory_bytes.restype = ctypes.c_int64
+        lib.df_memory_bytes.argtypes = [ctypes.c_void_p]
+        lib.df_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.df_begin_pass.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_int]
+        lib.df_next_batch.restype = ctypes.c_int
+        lib.df_next_batch.argtypes = [ctypes.c_void_p]
+        lib.df_batch_maxlen.restype = ctypes.c_int64
+        lib.df_batch_maxlen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.df_batch_fill.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int64),
+                                      ctypes.c_int64, ctypes.c_double]
+        lib.df_release_memory.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def lib():
+    """The loaded native library (compiles on first use)."""
+    return _load()

@@ -1,0 +1,311 @@
+// Native DataFeed: multi-slot sample parsing, shuffling, batching.
+//
+// TPU-native equivalent of the reference's C++ data-ingestion layer:
+//   /root/reference/paddle/fluid/framework/data_feed.{h,cc}
+//     - MultiSlotDataFeed (:664): text lines of `<n> v1 ... vn` per slot
+//     - InMemoryDataFeed (:305): parse into memory, then serve batches
+//   /root/reference/paddle/fluid/framework/data_set.{h,cc}
+//     - Dataset::LoadIntoMemory (:101): multi-threaded file parsing
+//     - LocalShuffle / global shuffle
+//
+// Same role here: parsing and shuffling run in C++ threads OFF the Python
+// GIL while TPU steps execute; Python (ctypes) only sees filled numpy
+// buffers. Slots are float32 ('f') or int64 ('u') — the reference's two
+// MultiSlotType kinds.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread (paddle_tpu/native).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotColumn {
+  char type;                    // 'f' float32, 'u' int64 (uint64 ids)
+  std::vector<float> fvals;     // flat values (type 'f')
+  std::vector<int64_t> ivals;   // flat values (type 'u')
+  std::vector<int64_t> offsets; // record i occupies [offsets[i], offsets[i+1])
+  SlotColumn() { offsets.push_back(0); }
+  int64_t len(int64_t rec) const { return offsets[rec + 1] - offsets[rec]; }
+};
+
+struct DataFeed {
+  std::vector<SlotColumn> slots;
+  int64_t n_records = 0;
+  std::vector<int64_t> order;   // shuffled record permutation
+  // pass state
+  int64_t cursor = 0;
+  int batch_size = 1;
+  bool drop_last = false;
+  // current batch record ids
+  std::vector<int64_t> cur;
+  std::mutex mu;
+  std::string last_error;
+};
+
+// parse one line: for each slot, `<n> v...`; returns false on malformed
+bool parse_line(const char* p, DataFeed* df,
+                std::vector<std::vector<float>>* frec,
+                std::vector<std::vector<int64_t>>* irec) {
+  char* end = nullptr;
+  for (size_t s = 0; s < df->slots.size(); ++s) {
+    long n = strtol(p, &end, 10);
+    if (end == p || n < 0) return false;
+    p = end;
+    auto& col = df->slots[s];
+    if (col.type == 'f') {
+      auto& v = (*frec)[s];
+      v.clear();
+      v.reserve(n);
+      for (long i = 0; i < n; ++i) {
+        float x = strtof(p, &end);
+        if (end == p) return false;
+        v.push_back(x);
+        p = end;
+      }
+    } else {
+      auto& v = (*irec)[s];
+      v.clear();
+      v.reserve(n);
+      for (long i = 0; i < n; ++i) {
+        long long x = strtoll(p, &end, 10);
+        if (end == p) return false;
+        v.push_back((int64_t)x);
+        p = end;
+      }
+    }
+  }
+  return true;
+}
+
+struct ParsedShard {
+  // per-slot parsed values for a file shard
+  std::vector<SlotColumn> slots;
+  int64_t n_records = 0;
+};
+
+bool parse_file(const std::string& path, const DataFeed* proto,
+                ParsedShard* out, std::string* err) {
+  std::ifstream in(path);
+  if (!in) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  size_t ns = proto->slots.size();
+  out->slots.resize(ns);
+  for (size_t s = 0; s < ns; ++s) out->slots[s].type = proto->slots[s].type;
+  std::vector<std::vector<float>> frec(ns);
+  std::vector<std::vector<int64_t>> irec(ns);
+  std::string line;
+  long lineno = 0;
+  while (std::getline(in, line)) {
+    ++lineno;
+    if (line.empty()) continue;
+    if (!parse_line(line.c_str(), const_cast<DataFeed*>(proto), &frec,
+                    &irec)) {
+      *err = path + ":" + std::to_string(lineno) + ": malformed record";
+      return false;
+    }
+    for (size_t s = 0; s < ns; ++s) {
+      auto& col = out->slots[s];
+      if (col.type == 'f') {
+        col.fvals.insert(col.fvals.end(), frec[s].begin(), frec[s].end());
+        col.offsets.push_back((int64_t)col.fvals.size());
+      } else {
+        col.ivals.insert(col.ivals.end(), irec[s].begin(), irec[s].end());
+        col.offsets.push_back((int64_t)col.ivals.size());
+      }
+    }
+    ++out->n_records;
+  }
+  return true;
+}
+
+void append_shard(DataFeed* df, ParsedShard&& sh) {
+  for (size_t s = 0; s < df->slots.size(); ++s) {
+    auto& dst = df->slots[s];
+    auto& src = sh.slots[s];
+    int64_t base =
+        dst.type == 'f' ? (int64_t)dst.fvals.size() : (int64_t)dst.ivals.size();
+    if (dst.type == 'f')
+      dst.fvals.insert(dst.fvals.end(), src.fvals.begin(), src.fvals.end());
+    else
+      dst.ivals.insert(dst.ivals.end(), src.ivals.begin(), src.ivals.end());
+    for (size_t r = 1; r < src.offsets.size(); ++r)
+      dst.offsets.push_back(base + src.offsets[r]);
+  }
+  df->n_records += sh.n_records;
+}
+
+}  // namespace
+
+extern "C" {
+
+// slot_types: string like "ufff" — one char per slot
+void* df_create(const char* slot_types) {
+  auto* df = new DataFeed();
+  for (const char* p = slot_types; *p; ++p) {
+    SlotColumn c;
+    c.type = (*p == 'u') ? 'u' : 'f';
+    df->slots.push_back(std::move(c));
+  }
+  return df;
+}
+
+void df_destroy(void* h) { delete (DataFeed*)h; }
+
+const char* df_last_error(void* h) {
+  return ((DataFeed*)h)->last_error.c_str();
+}
+
+// Multi-threaded load (reference: Dataset::LoadIntoMemory thread pool).
+// paths: '\n'-joined file list. Returns records loaded, or -1 on error.
+int64_t df_load(void* h, const char* paths, int nthreads) {
+  auto* df = (DataFeed*)h;
+  std::vector<std::string> files;
+  {
+    std::string all(paths), cur;
+    for (char c : all) {
+      if (c == '\n') {
+        if (!cur.empty()) files.push_back(cur);
+        cur.clear();
+      } else {
+        cur += c;
+      }
+    }
+    if (!cur.empty()) files.push_back(cur);
+  }
+  if (files.empty()) return 0;
+  if (nthreads < 1) nthreads = 1;
+  nthreads = std::min<int>(nthreads, (int)files.size());
+
+  std::vector<ParsedShard> shards(files.size());
+  std::vector<std::string> errs(files.size());
+  std::atomic<size_t> next(0);
+  std::atomic<bool> failed(false);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t) {
+    ts.emplace_back([&]() {
+      while (true) {
+        size_t i = next.fetch_add(1);
+        if (i >= files.size() || failed.load()) return;
+        if (!parse_file(files[i], df, &shards[i], &errs[i]))
+          failed.store(true);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  if (failed.load()) {
+    for (auto& e : errs)
+      if (!e.empty()) {
+        df->last_error = e;
+        break;
+      }
+    return -1;
+  }
+  for (auto& sh : shards) append_shard(df, std::move(sh));
+  df->order.resize(df->n_records);
+  for (int64_t i = 0; i < df->n_records; ++i) df->order[i] = i;
+  return df->n_records;
+}
+
+int64_t df_size(void* h) { return ((DataFeed*)h)->n_records; }
+
+int64_t df_memory_bytes(void* h) {
+  auto* df = (DataFeed*)h;
+  int64_t b = 0;
+  for (auto& s : df->slots)
+    b += (int64_t)(s.fvals.size() * 4 + s.ivals.size() * 8 +
+                   s.offsets.size() * 8);
+  return b;
+}
+
+// reference: Dataset local_shuffle
+void df_shuffle(void* h, uint64_t seed) {
+  auto* df = (DataFeed*)h;
+  std::mt19937_64 rng(seed);
+  std::shuffle(df->order.begin(), df->order.end(), rng);
+}
+
+void df_begin_pass(void* h, int batch_size, int drop_last) {
+  auto* df = (DataFeed*)h;
+  df->cursor = 0;
+  df->batch_size = batch_size < 1 ? 1 : batch_size;
+  df->drop_last = drop_last != 0;
+}
+
+// advance to the next batch; returns its size (0 = pass done)
+int df_next_batch(void* h) {
+  auto* df = (DataFeed*)h;
+  int64_t remain = df->n_records - df->cursor;
+  if (remain <= 0) return 0;
+  int64_t n = std::min<int64_t>(df->batch_size, remain);
+  if (df->drop_last && n < df->batch_size) return 0;
+  df->cur.assign(df->order.begin() + df->cursor,
+                 df->order.begin() + df->cursor + n);
+  df->cursor += n;
+  return (int)n;
+}
+
+// max sequence length of `slot` within the current batch
+int64_t df_batch_maxlen(void* h, int slot) {
+  auto* df = (DataFeed*)h;
+  auto& col = df->slots[slot];
+  int64_t m = 0;
+  for (int64_t r : df->cur) m = std::max<int64_t>(m, col.len(r));
+  return m;
+}
+
+// fill a padded [batch, maxlen] buffer; lens gets per-record lengths.
+// For 'f' slots out is float*; for 'u' slots out is int64_t*.
+void df_batch_fill(void* h, int slot, void* out, int64_t* lens,
+                   int64_t maxlen, double pad) {
+  auto* df = (DataFeed*)h;
+  auto& col = df->slots[slot];
+  int64_t B = (int64_t)df->cur.size();
+  if (col.type == 'f') {
+    float* o = (float*)out;
+    std::fill(o, o + B * maxlen, (float)pad);
+    for (int64_t b = 0; b < B; ++b) {
+      int64_t r = df->cur[b];
+      int64_t n = std::min<int64_t>(col.len(r), maxlen);
+      std::memcpy(o + b * maxlen, col.fvals.data() + col.offsets[r],
+                  n * sizeof(float));
+      lens[b] = n;
+    }
+  } else {
+    int64_t* o = (int64_t*)out;
+    std::fill(o, o + B * maxlen, (int64_t)pad);
+    for (int64_t b = 0; b < B; ++b) {
+      int64_t r = df->cur[b];
+      int64_t n = std::min<int64_t>(col.len(r), maxlen);
+      std::memcpy(o + b * maxlen, col.ivals.data() + col.offsets[r],
+                  n * sizeof(int64_t));
+      lens[b] = n;
+    }
+  }
+}
+
+void df_release_memory(void* h) {
+  auto* df = (DataFeed*)h;
+  for (auto& s : df->slots) {
+    s.fvals.clear();
+    s.fvals.shrink_to_fit();
+    s.ivals.clear();
+    s.ivals.shrink_to_fit();
+    s.offsets.assign(1, 0);
+  }
+  df->n_records = 0;
+  df->order.clear();
+}
+
+}  // extern "C"

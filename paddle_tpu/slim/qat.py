@@ -111,6 +111,7 @@ class ImperativeQuantAware:
                                                           "Conv2D")):
         self._wbits = weight_bits
         self._abits = activation_bits
+        self._moving_rate = float(moving_rate)
         self._types = tuple(quantizable_layer_type)
 
     def quantize(self, model: Layer) -> Layer:
@@ -119,13 +120,14 @@ class ImperativeQuantAware:
         from ..nn.layer.common import Linear
         from ..nn.layer.conv import Conv2D
         for name, child in list(model.named_children()):
-            cls = type(child).__name__
             if isinstance(child, Linear) and "Linear" in self._types:
-                setattr(model, name,
-                        QuantedLinear(child, self._wbits, self._abits))
+                q = QuantedLinear(child, self._wbits, self._abits)
+                q._act.moving_rate = self._moving_rate
+                setattr(model, name, q)
             elif isinstance(child, Conv2D) and "Conv2D" in self._types:
-                setattr(model, name,
-                        QuantedConv2D(child, self._wbits, self._abits))
+                q = QuantedConv2D(child, self._wbits, self._abits)
+                q._act.moving_rate = self._moving_rate
+                setattr(model, name, q)
             else:
                 self.quantize(child)
         return model

@@ -1,0 +1,129 @@
+"""Native C++ DataFeed tests (reference: test_dataset.py — slot files →
+InMemoryDataset → load/shuffle/batch → train_from_dataset)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import InMemoryDataset
+
+
+def _write_slot_file(path, rows, rng):
+    """MultiSlot text format: per slot `<n> v1 ... vn` (reference
+    data_feed.cc MultiSlotDataFeed line format). Slots: ids(u), feat(f),
+    label(f)."""
+    with open(path, "w") as f:
+        recs = []
+        for _ in range(rows):
+            n_ids = rng.randint(1, 5)
+            ids = rng.randint(0, 50, n_ids)
+            feat = rng.randn(3)
+            label = [float(ids.sum() % 2)]
+            f.write(f"{n_ids} " + " ".join(map(str, ids)) + " "
+                    + "3 " + " ".join(f"{v:.6f}" for v in feat) + " "
+                    + "1 " + f"{label[0]}" + "\n")
+            recs.append((ids, feat, label))
+    return recs
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    rng = np.random.RandomState(0)
+    recs = []
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"part-{i}.txt")
+        recs += _write_slot_file(p, 20, rng)
+        paths.append(p)
+    return paths, recs
+
+
+def _make_ds(paths, batch_size=8):
+    ds = InMemoryDataset()
+    ds.set_use_var([("ids", "int64"), ("feat", "float32"),
+                    ("label", "float32")])
+    ds.set_filelist(paths)
+    ds.set_batch_size(batch_size)
+    ds.set_thread(3)
+    return ds
+
+
+def test_load_parse_and_values(slot_files):
+    paths, recs = slot_files
+    ds = _make_ds(paths)
+    n = ds.load_into_memory()
+    assert n == 60 == ds.get_memory_data_size()
+    assert ds.memory_bytes() > 0
+    batches = list(ds.batches())
+    assert sum(b["ids"][0].shape[0] for b in batches) == 60
+    # unshuffled first record matches file order
+    b0 = batches[0]
+    ids0, len0 = b0["ids"]
+    np.testing.assert_array_equal(ids0[0, :len0[0]], recs[0][0])
+    np.testing.assert_allclose(b0["feat"][0][0], recs[0][1], rtol=1e-5)
+    np.testing.assert_allclose(b0["label"][0][0, 0], recs[0][2][0])
+    # ragged ids are padded with 0 beyond the length
+    assert (ids0[0, len0[0]:] == 0).all()
+
+
+def test_shuffle_permutes_but_preserves_set(slot_files):
+    paths, recs = slot_files
+    ds = _make_ds(paths, batch_size=60)
+    ds.load_into_memory()
+    before = next(iter(ds.batches()))["label"][0].ravel().copy()
+    ds.local_shuffle(seed=7)
+    after = next(iter(ds.batches()))["label"][0].ravel()
+    assert not np.array_equal(before, after)
+    np.testing.assert_allclose(np.sort(before), np.sort(after))
+
+
+def test_malformed_file_reports_error(tmp_path):
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("2 1\n")  # declares 2 ids, provides 1
+    ds = _make_ds([p])
+    ds.set_use_var([("ids", "int64")])
+    with pytest.raises(RuntimeError, match="malformed"):
+        ds.load_into_memory()
+
+
+def test_release_memory(slot_files):
+    paths, _ = slot_files
+    ds = _make_ds(paths)
+    ds.load_into_memory()
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+
+def test_train_from_dataset(tmp_path, slot_files):
+    """Static program trained from the native dataset (reference:
+    test_dataset.py train_from_dataset flow)."""
+    paths, _ = slot_files
+    ds = _make_ds(paths, batch_size=20)
+    ds.set_pad_value("ids", 0)
+    ds.load_into_memory()
+
+    paddle.static.global_scope().drop_kids()
+    with paddle.utils.unique_name.guard():
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                feat = paddle.static.data("feat", [-1, 3], "float32")
+                label = paddle.static.data("label", [-1, 1], "float32")
+                lin = paddle.nn.Linear(3, 1)
+                loss = ((lin(feat) - label) ** 2).mean()
+                opt = paddle.optimizer.SGD(0.1)
+                opt.minimize(loss)
+                exe = paddle.static.Executor()
+                exe.run(startup)
+                first = None
+                for _ in range(5):
+                    res = exe.train_from_dataset(main, ds,
+                                                 fetch_list=[loss])
+                    if first is None:
+                        first = float(np.asarray(res[0]))
+                last = float(np.asarray(res[0]))
+                assert last < first
+        finally:
+            paddle.disable_static()
