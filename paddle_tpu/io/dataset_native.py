@@ -24,7 +24,9 @@ __all__ = ["InMemoryDataset", "QueueDataset"]
 class _SlotSpec:
     def __init__(self, name: str, dtype: str):
         self.name = name
-        self.dtype = "u" if dtype in ("int64", "u", "uint64") else "f"
+        # single place that maps a dtype to a slot kind
+        self.dtype = "u" if "int" in str(dtype) or str(dtype) == "u" \
+            else "f"
 
 
 class InMemoryDataset:
@@ -67,8 +69,7 @@ class InMemoryDataset:
             else:
                 name = v.name
                 dtype = str(getattr(v, "dtype", "float32"))
-            self._slots.append(_SlotSpec(name, "u" if "int" in str(dtype)
-                                         else "f"))
+            self._slots.append(_SlotSpec(name, dtype))
 
     def set_pad_value(self, name: str, value: float):
         self._pad_values[name] = value
@@ -135,6 +136,11 @@ class InMemoryDataset:
         """Yield {slot_name: (padded_values, lengths)} per batch."""
         from ..native import lib
         h = self._ensure_handle()
+        if lib().df_size(h) == 0 and self._filelist:
+            # reference QueueDataset streams without an explicit
+            # load_into_memory; auto-load so that usage pattern trains
+            # instead of silently yielding zero batches
+            self.load_into_memory()
         L = lib()
         L.df_begin_pass(h, self._batch_size,
                         1 if (self._drop_last if drop_last is None

@@ -57,9 +57,12 @@ bool parse_line(const char* p, DataFeed* df,
                 std::vector<std::vector<float>>* frec,
                 std::vector<std::vector<int64_t>>* irec) {
   char* end = nullptr;
+  // bound the declared count: a corrupt header must become a parse error,
+  // not a std::bad_alloc escaping a worker thread (std::terminate)
+  constexpr long kMaxSlotValues = 16 * 1024 * 1024;
   for (size_t s = 0; s < df->slots.size(); ++s) {
     long n = strtol(p, &end, 10);
-    if (end == p || n < 0) return false;
+    if (end == p || n < 0 || n > kMaxSlotValues) return false;
     p = end;
     auto& col = df->slots[s];
     if (col.type == 'f') {

@@ -64,12 +64,12 @@ class QuantedLinear(Layer):
     """reference: quant_layers.py QuantizedLinear."""
 
     def __init__(self, inner, weight_bits=8, activation_bits=8,
-                 quantize_activation=True):
+                 quantize_activation=True, moving_rate=0.9):
         super().__init__()
         self._inner = inner
         self._wbits = weight_bits
-        self._act = _ActQuant(activation_bits) if quantize_activation \
-            else None
+        self._act = _ActQuant(activation_bits, moving_rate) \
+            if quantize_activation else None
 
     def forward(self, x):
         from ..nn import functional as F
@@ -85,12 +85,12 @@ class QuantedConv2D(Layer):
     quant along the output-channel axis)."""
 
     def __init__(self, inner, weight_bits=8, activation_bits=8,
-                 quantize_activation=True):
+                 quantize_activation=True, moving_rate=0.9):
         super().__init__()
         self._inner = inner
         self._wbits = weight_bits
-        self._act = _ActQuant(activation_bits) if quantize_activation \
-            else None
+        self._act = _ActQuant(activation_bits, moving_rate) \
+            if quantize_activation else None
 
     def forward(self, x):
         from ..nn import functional as F
@@ -121,13 +121,13 @@ class ImperativeQuantAware:
         from ..nn.layer.conv import Conv2D
         for name, child in list(model.named_children()):
             if isinstance(child, Linear) and "Linear" in self._types:
-                q = QuantedLinear(child, self._wbits, self._abits)
-                q._act.moving_rate = self._moving_rate
-                setattr(model, name, q)
+                setattr(model, name, QuantedLinear(
+                    child, self._wbits, self._abits,
+                    moving_rate=self._moving_rate))
             elif isinstance(child, Conv2D) and "Conv2D" in self._types:
-                q = QuantedConv2D(child, self._wbits, self._abits)
-                q._act.moving_rate = self._moving_rate
-                setattr(model, name, q)
+                setattr(model, name, QuantedConv2D(
+                    child, self._wbits, self._abits,
+                    moving_rate=self._moving_rate))
             else:
                 self.quantize(child)
         return model

@@ -1,2 +1,4 @@
 """paddle.text (reference: python/paddle/text/datasets/)."""
-from .datasets import Imdb, UCIHousing  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, UCIHousing, Imikolov, Movielens, Conll05st, WMT14, WMT16,
+)
