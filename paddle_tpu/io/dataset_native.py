@@ -39,6 +39,7 @@ class InMemoryDataset:
         self._drop_last = False
         self._thread_num = 4
         self._handle = None
+        self._loaded = False
         self._pad_values: Dict[str, float] = {}
 
     # ---------------------------------------------------------------- setup
@@ -94,6 +95,7 @@ class InMemoryDataset:
         if n < 0:
             raise RuntimeError("dataset load failed: "
                                + lib().df_last_error(h).decode())
+        self._loaded = True
         return n
 
     def local_shuffle(self, seed: int = 0):
@@ -120,6 +122,8 @@ class InMemoryDataset:
         from ..native import lib
         if self._handle is not None:
             lib().df_release_memory(self._handle)
+        self._loaded = False
+        self._filelist = []  # released data is gone; no silent re-read
 
     def __del__(self):
         try:
@@ -136,10 +140,11 @@ class InMemoryDataset:
         """Yield {slot_name: (padded_values, lengths)} per batch."""
         from ..native import lib
         h = self._ensure_handle()
-        if lib().df_size(h) == 0 and self._filelist:
+        if not self._loaded and self._filelist:
             # reference QueueDataset streams without an explicit
-            # load_into_memory; auto-load so that usage pattern trains
-            # instead of silently yielding zero batches
+            # load_into_memory; auto-load ONCE so that usage pattern
+            # trains instead of silently yielding zero batches (but never
+            # re-read after release_memory or for genuinely empty files)
             self.load_into_memory()
         L = lib()
         L.df_begin_pass(h, self._batch_size,

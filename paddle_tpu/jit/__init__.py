@@ -99,21 +99,66 @@ class _FunctionalizedLayer:
                 named_b[k]._value = saved["__buf__" + k]
 
 
+def _is_traceable_leaf(leaf) -> bool:
+    """Arrays trace; python scalars (bool/int/float/str...) specialize the
+    trace — the reference re-translates the program per python-scalar
+    value, so `if flag:` / `x.reshape([n, -1])` on a python scalar keeps
+    python semantics here too."""
+    if isinstance(leaf, (bool, np.bool_)):
+        return False
+    return isinstance(leaf, (jax.Array, jax.core.Tracer, np.ndarray,
+                             np.generic))
+
+
+def _extract_statics(args, kwargs):
+    """Pull non-traceable python leaves (bools/strings/callables...) out of
+    the arg pytrees; they ride the jit cache key instead of the trace."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    statics, new_leaves = [], []
+    for i, leaf in enumerate(leaves):
+        if _is_traceable_leaf(leaf):
+            new_leaves.append(leaf)
+        else:
+            statics.append((i, leaf))
+            new_leaves.append(np.int32(0))  # placeholder, replaced in-trace
+    args2, kwargs2 = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return tuple(statics), args2, kwargs2
+
+
+def _restore_statics(statics, args, kwargs):
+    if not statics:
+        return args, kwargs
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    for i, v in statics:
+        leaves[i] = v
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 class StaticFunction:
     """The to_static wrapper (reference: program_translator.StaticFunction)."""
 
     def __init__(self, fn, layer=None, input_spec=None):
-        self._inner = _FunctionalizedLayer(fn, layer)
+        # AST pass first (reference: ProgramTranslator → DygraphToStaticAst):
+        # if/while on tensors become lax-lowered control flow; functions
+        # with no rewritable statements come back unchanged
+        from .dy2static import convert_to_static
+        converted = convert_to_static(fn)
+        self._inner = _FunctionalizedLayer(converted, layer)
         self._input_spec = input_spec
         self._raw_fn = fn
         self._layer = layer
 
-        def _jitted_impl(mode_sig, params, buffers, key, args, kwargs):
-            # mode_sig: per-(sub)layer training flags — a static cache key so
-            # train/eval retrace instead of silently reusing the other
-            # mode's trace (Dropout/BatchNorm change the program)
+        def _jitted_impl(mode_sig, statics, params, buffers, key, args,
+                         kwargs):
+            # mode_sig: per-(sub)layer training flags — a static cache key
+            # so train/eval retrace instead of silently reusing the other
+            # mode's trace (Dropout/BatchNorm change the program).
+            # statics: ((leaf_index, value), ...) — python-scalar args
+            # specialize the trace instead of being traced (see
+            # _is_traceable_leaf).
+            args, kwargs = _restore_statics(statics, args, kwargs)
             return self._inner.pure_call(params, buffers, key, args, kwargs)
-        self._jitted = jax.jit(_jitted_impl, static_argnums=(0,))
+        self._jitted = jax.jit(_jitted_impl, static_argnums=(0, 1))
         functools.update_wrapper(self, fn)
 
     def _mode_sig(self):
@@ -130,9 +175,11 @@ class StaticFunction:
             _unwrap, args, is_leaf=lambda t: isinstance(t, Tensor))
         arr_kwargs = jax.tree_util.tree_map(
             _unwrap, kwargs, is_leaf=lambda t: isinstance(t, Tensor))
+        statics, arr_args, arr_kwargs = _extract_statics(arr_args,
+                                                         arr_kwargs)
         key = _random.next_key()
-        out, new_buffers = self._jitted(self._mode_sig(), params, buffers,
-                                        key, arr_args, arr_kwargs)
+        out, new_buffers = self._jitted(self._mode_sig(), statics, params,
+                                        buffers, key, arr_args, arr_kwargs)
         if self._layer is not None and new_buffers:
             named_b = dict(self._layer.named_buffers())
             for k, v in new_buffers.items():

@@ -91,20 +91,38 @@ class Imikolov(Dataset):
     """reference: text/datasets/imikolov.py — PTB-style n-gram/seq pairs.
     Local-file loading with synthetic fallback (zero egress)."""
 
+    BOS, EOS = 0, 1
+
     def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
                  mode="train", min_word_freq=50):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be 'NGRAM' or 'SEQ'")
         self.data_type = data_type
         self.window_size = window_size
-        if data_file and os.path.exists(data_file):
+        if data_file:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(
+                    f"Imikolov: data_file {data_file!r} does not exist "
+                    "(pass None for the synthetic fallback)")
             self._load_real(data_file, mode, min_word_freq)
         else:
             rng = np.random.RandomState(0 if mode == "train" else 1)
             vocab = 2000
             self.word_idx = {f"w{i}": i for i in range(vocab)}
-            stream = rng.randint(0, vocab, 20000)
-            self.data = [tuple(stream[i:i + window_size])
-                         for i in range(0, len(stream) - window_size,
-                                        window_size)]
+            stream = rng.randint(2, vocab, 20000)
+            if data_type == "SEQ":
+                # variable-length [BOS, ..., EOS] sequences
+                self.data = []
+                i = 0
+                while i < len(stream) - 2:
+                    ln = int(rng.randint(3, 12))
+                    seq = stream[i:i + ln]
+                    self.data.append(tuple([self.BOS, *seq, self.EOS]))
+                    i += ln
+            else:
+                self.data = [tuple(stream[i:i + window_size])
+                             for i in range(0, len(stream) - window_size,
+                                            window_size)]
 
     def _load_real(self, data_file, mode, min_word_freq):
         sub = "train" if mode == "train" else "valid"
@@ -124,11 +142,17 @@ class Imikolov(Dataset):
         self.data = []
         for ln in lines:
             ids = [self.word_idx.get(w, unk) for w in ln.split()]
+            if self.data_type == "SEQ":
+                if ids:
+                    self.data.append(tuple([self.BOS, *ids, self.EOS]))
+                continue
             # +1: a line of exactly window_size tokens yields one n-gram
             for i in range(0, max(len(ids) - self.window_size + 1, 0)):
                 self.data.append(tuple(ids[i:i + self.window_size]))
 
     def __getitem__(self, idx):
+        if self.data_type == "SEQ":
+            return np.asarray(self.data[idx], np.int64)
         return tuple(np.asarray(v, np.int64) for v in self.data[idx])
 
     def __len__(self):
@@ -202,7 +226,7 @@ class WMT14(Dataset):
     BOS, EOS, UNK = 0, 1, 2
 
     def __init__(self, data_file=None, mode="train", dict_size=3000):
-        _no_real_loader("WMT14", data_file)
+        _no_real_loader(type(self).__name__, data_file)
         rng = np.random.RandomState(0 if mode == "train" else 1)
         self.dict_size = max(int(dict_size), 10)
         n = 512

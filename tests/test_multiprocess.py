@@ -27,24 +27,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "tests", "dist_mp_model.py")
 
 
-def _run_cluster(nproc: int, timeout=240):
+def _run_cluster(nproc: int, timeout=240, retries=1):
+    """One retry on a fresh port (reference TestDistBase retries its
+    cluster runs too — rendezvous can flake under parallel CI load)."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # 1 local CPU device per process
-    port = _free_port()
-    proc = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", str(nproc), "--port", str(port), SCRIPT],
-        env=env, capture_output=True, text=True, timeout=timeout)
-    assert proc.returncode == 0, \
-        f"cluster failed\nSTDOUT:\n{proc.stdout}\nSTDERR:\n{proc.stderr}"
-    out = {}
-    for line in proc.stdout.splitlines():
-        if line.startswith("DIST_LOSSES "):
-            rec = json.loads(line[len("DIST_LOSSES "):])
-            out[rec["rank"]] = rec["losses"]
-    return out
+    last = None
+    for _ in range(retries + 1):
+        port = _free_port()
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", str(nproc), "--port", str(port), SCRIPT],
+            env=env, capture_output=True, text=True, timeout=timeout)
+        if proc.returncode == 0:
+            out = {}
+            for line in proc.stdout.splitlines():
+                if line.startswith("DIST_LOSSES "):
+                    rec = json.loads(line[len("DIST_LOSSES "):])
+                    out[rec["rank"]] = rec["losses"]
+            return out
+        last = proc
+    raise AssertionError(
+        f"cluster failed\nSTDOUT:\n{last.stdout}\nSTDERR:\n{last.stderr}")
 
 
 @pytest.mark.slow
