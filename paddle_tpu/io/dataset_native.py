@@ -40,6 +40,7 @@ class InMemoryDataset:
         self._thread_num = 4
         self._handle = None
         self._loaded = False
+        self._released = False
         self._pad_values: Dict[str, float] = {}
 
     # ---------------------------------------------------------------- setup
@@ -96,6 +97,7 @@ class InMemoryDataset:
             raise RuntimeError("dataset load failed: "
                                + lib().df_last_error(h).decode())
         self._loaded = True
+        self._released = False
         return n
 
     def local_shuffle(self, seed: int = 0):
@@ -123,7 +125,9 @@ class InMemoryDataset:
         if self._handle is not None:
             lib().df_release_memory(self._handle)
         self._loaded = False
-        self._filelist = []  # released data is gone; no silent re-read
+        self._released = True  # blocks batches()'s auto-load, but an
+        # explicit load_into_memory() reload still works (reference
+        # InMemoryDataset supports reload-after-release)
 
     def __del__(self):
         try:
@@ -140,7 +144,7 @@ class InMemoryDataset:
         """Yield {slot_name: (padded_values, lengths)} per batch."""
         from ..native import lib
         h = self._ensure_handle()
-        if not self._loaded and self._filelist:
+        if not self._loaded and not self._released and self._filelist:
             # reference QueueDataset streams without an explicit
             # load_into_memory; auto-load ONCE so that usage pattern
             # trains instead of silently yielding zero batches (but never

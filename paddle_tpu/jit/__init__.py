@@ -103,7 +103,9 @@ def _is_traceable_leaf(leaf) -> bool:
     """Arrays trace; python scalars (bool/int/float/str...) specialize the
     trace — the reference re-translates the program per python-scalar
     value, so `if flag:` / `x.reshape([n, -1])` on a python scalar keeps
-    python semantics here too."""
+    python semantics here too. Corollary (also reference behavior): a
+    python scalar that CHANGES every call recompiles every call — pass
+    per-step scalars as paddle.to_tensor(v) to trace them instead."""
     if isinstance(leaf, (bool, np.bool_)):
         return False
     return isinstance(leaf, (jax.Array, jax.core.Tracer, np.ndarray,
@@ -196,9 +198,11 @@ class StaticFunction:
         params, buffers = self._inner.collect_state()
         arr_args = jax.tree_util.tree_map(
             _unwrap, args, is_leaf=lambda t: isinstance(t, Tensor))
+        statics, arr_args, arr_kwargs = _extract_statics(arr_args, {})
         key = jax.random.PRNGKey(0)
-        return self._jitted.lower(self._mode_sig(), params, buffers, key,
-                                  arr_args, {}).as_text()
+        return self._jitted.lower(self._mode_sig(), statics, params,
+                                  buffers, key, arr_args,
+                                  arr_kwargs).as_text()
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

@@ -40,8 +40,23 @@ __all__ = ["convert_to_static", "convert_ifelse", "convert_while"]
 
 
 class _Undef:
+    """Loud sentinel: a name assigned in only the untaken branch must fail
+    on USE like dygraph's UnboundLocalError would — not flow silently."""
+
     def __repr__(self):
         return "<undefined>"
+
+    def _boom(self, *a, **k):
+        raise UnboundLocalError(
+            "variable assigned only in an untaken to_static branch was "
+            "used (dygraph would raise UnboundLocalError here too)")
+
+    __bool__ = __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = _boom
+    __rmul__ = __truediv__ = __call__ = __iter__ = __len__ = _boom
+    __getitem__ = __lt__ = __le__ = __gt__ = __ge__ = _boom
+
+    def __getattr__(self, name):
+        self._boom()
 
 
 _UNDEF = _Undef()
@@ -61,15 +76,26 @@ def _as_bool(x) -> bool:
 # ------------------------------------------------------------ runtime layer
 def convert_ifelse(pred, true_fn, false_fn, names: List[str], cur_vals):
     """reference: convert_operators.convert_ifelse. Branch fns take the
-    pre-statement values of `names` as parameters (so `x += 1` style
-    bodies work) and return the updated tuple; _UNDEF marks names only
-    one branch would create."""
+    pre-statement values of `names` (assigned AND read names) as
+    parameters — reads become explicit cond operands so gradients flow
+    through lax.cond to every tensor the branches touch (the reference's
+    conditional_block registers its inputs the same way)."""
     if not _is_traced(pred):
         return true_fn(*cur_vals) if _as_bool(pred) else false_fn(*cur_vals)
     from ..ops import control_flow as cf
+    t_idx = [i for i, v in enumerate(cur_vals) if isinstance(v, Tensor)]
+    t_vals = [cur_vals[i] for i in t_idx]
+
+    def mk(branch):
+        def g(*tensors):
+            full = list(cur_vals)
+            for i, t in zip(t_idx, tensors):
+                full[i] = t
+            return branch(*full)
+        return g
+
     try:
-        return cf.cond(pred, lambda: true_fn(*cur_vals),
-                       lambda: false_fn(*cur_vals))
+        return cf.cond(pred, mk(true_fn), mk(false_fn), operands=t_vals)
     except (NameError, TypeError) as e:
         undef = [n for n, v in zip(names, cur_vals) if v is _UNDEF]
         if undef:
@@ -141,6 +167,22 @@ def _assigned_names(nodes) -> Set[str]:
     return c.names
 
 
+class _LoadCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _loaded_names(nodes) -> Set[str]:
+    c = _LoadCollector()
+    for n in nodes:
+        c.visit(n)
+    return c.names
+
+
 def _getter_def(uid: int, names: List[str]) -> str:
     """A nested function reading the current values of `names` from the
     enclosing scope, mapping unbound → _UNDEF."""
@@ -183,25 +225,33 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if self._bails(node.body) or self._bails(node.orelse):
             return node
-        names = sorted(_assigned_names(node.body)
-                       | _assigned_names(node.orelse))
-        names = [n for n in names if not n.startswith("__")]
-        if not names:
+        assigned = sorted(n for n in (_assigned_names(node.body)
+                                      | _assigned_names(node.orelse))
+                          if not n.startswith("__"))
+        if not assigned:
             return node
+        # read names become branch parameters too: their tensors ride the
+        # cond as operands so gradients flow (convert_ifelse)
+        loads = sorted(n for n in (_loaded_names(node.body)
+                                   | _loaded_names(node.orelse))
+                       if not n.startswith("__") and n not in assigned)
+        names = assigned + loads
         self.counter += 1
         uid = self.counter
         tup = ", ".join(names)
+        out_tup = ", ".join(assigned)
         tmpl = "\n".join([
             _getter_def(uid, names),
             f"def __jst_true_{uid}({tup}):",
             f"    pass",
             f"def __jst_false_{uid}({tup}):",
             f"    pass",
-            f"({tup},) = __jst_ifelse(__jst_pred_{uid}, __jst_true_{uid}, "
-            f"__jst_false_{uid}, {names!r}, __jst_vals_{uid}())",
+            f"({out_tup},) = __jst_ifelse(__jst_pred_{uid}, "
+            f"__jst_true_{uid}, __jst_false_{uid}, {names!r}, "
+            f"__jst_vals_{uid}())",
         ])
         new = ast.parse(tmpl).body
-        ret = ast.parse(f"return ({tup},)").body[0]
+        ret = ast.parse(f"return ({out_tup},)").body[0]
         new[1].body = (node.body or [ast.Pass()]) + [ret]
         new[2].body = (node.orelse or [ast.Pass()]) + [ret]
         # bind the predicate once, before the branches
@@ -257,7 +307,10 @@ def convert_to_static(fn: Callable) -> Callable:
     func_def = tree.body[0]
     if not isinstance(func_def, ast.FunctionDef):
         return fn if bound_self is None else fn.__get__(bound_self)
-    func_def.decorator_list = []
+    # drop only to_static-ish decorators; other decorators keep wrapping
+    func_def.decorator_list = [
+        d for d in func_def.decorator_list
+        if "to_static" not in ast.unparse(d)]
     tr = _CtrlFlowTransformer()
     new_tree = tr.visit(tree)
     if tr.counter == 0:
@@ -267,7 +320,10 @@ def convert_to_static(fn: Callable) -> Callable:
         code = compile(new_tree, f"<to_static {fn.__name__}>", "exec")
     except (SyntaxError, ValueError):
         return fn if bound_self is None else fn.__get__(bound_self)
-    glb = dict(fn.__globals__)
+    # exec against the LIVE module globals (a snapshot would miss helpers
+    # defined after decoration / monkeypatches); the injected names use the
+    # reserved __jst_ prefix
+    glb = fn.__globals__
     glb["__jst_ifelse"] = convert_ifelse
     glb["__jst_while"] = convert_while
     glb["__jst_undef"] = _UNDEF

@@ -100,7 +100,10 @@ def cond(pred, true_fn=None, false_fn=None, name=None, *, operands=()):
         if bool(_as_bool(p)):
             return true_fn(*ops_) if ops_ else true_fn()
         return false_fn(*ops_) if ops_ else false_fn()
-    return _cond_op(p, [o._value for o in ops_], true_fn, false_fn)
+    # pass TENSORS (not raw arrays): dispatch must see the operands as op
+    # inputs so the eager tape records them and gradients flow through the
+    # cond (reference: conditional_block registers its input vars)
+    return _cond_op(p, ops_, true_fn, false_fn)
 
 
 def case(pred_fn_pairs, default=None, name=None):

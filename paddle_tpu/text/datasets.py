@@ -137,8 +137,9 @@ class Imikolov(Dataset):
                 freq[w] = freq.get(w, 0) + 1
         words = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
                  if c >= min_word_freq]
-        self.word_idx = {w: i for i, w in enumerate(words)}
-        unk = len(self.word_idx)
+        # ids 0/1 reserved for BOS/EOS (same layout as the synthetic path)
+        self.word_idx = {w: i + 2 for i, w in enumerate(words)}
+        unk = len(self.word_idx) + 2
         self.data = []
         for ln in lines:
             ids = [self.word_idx.get(w, unk) for w in ln.split()]

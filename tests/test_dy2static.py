@@ -114,21 +114,28 @@ def test_python_if_on_concrete_values_untouched():
 
 
 def test_grad_through_converted_control_flow():
-    def f(x):
-        if x.sum() > 0:
-            y = x * 3.0
-        else:
-            y = x * 5.0
-        return y.sum()
+    def grad_of_branchy(x):
+        x.stop_gradient = False
+        with paddle.enable_grad():
+            if x.sum() > 0:
+                y = x * 3.0
+            else:
+                y = x * 5.0
+            (g,) = paddle.grad(y.sum(), [x])
+        return g
 
-    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
-    fn = paddle.jit.to_static(f)
+    # eager: python if picks the branch; grad = 3
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    eager = grad_of_branchy(x)
+    np.testing.assert_allclose(eager.numpy(), np.full(3, 3.0))
 
-    # gradient through lax.cond under the tape (enable_grad in trace) —
-    # eager path here since inputs are concrete:
-    out = f(x)
-    out.backward()
-    np.testing.assert_allclose(x.grad.numpy(), np.full(3, 3.0))
+    # static: the SAME function compiles — predicate is traced, so the if
+    # lowers to lax.cond and the gradient flows THROUGH the cond
+    fn = paddle.jit.to_static(grad_of_branchy)
+    static = fn(paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_allclose(static.numpy(), np.full(3, 3.0))
+    static_neg = fn(paddle.to_tensor(-np.ones(3, np.float32)))
+    np.testing.assert_allclose(static_neg.numpy(), np.full(3, 5.0))
 
 
 def test_layer_forward_with_control_flow():
@@ -168,3 +175,46 @@ def test_layer_forward_with_control_flow():
     e = net1(x)
     s = net2(x)
     np.testing.assert_allclose(s.numpy(), e.numpy(), rtol=1e-5)
+
+
+def test_untaken_branch_variable_is_loud():
+    """A name assigned in only one branch of a TRACED if cannot silently
+    flow: lax.cond needs both branches to produce it, so the transform
+    raises a clear error instead of returning garbage."""
+    def f(x):
+        if x.sum() > 100:
+            y = x * 2
+        return y  # noqa: F821  (intentional: y may be unbound)
+
+    fn = paddle.jit.to_static(f)
+    with pytest.raises((ValueError, UnboundLocalError)):
+        fn(paddle.to_tensor(np.zeros(2, np.float32)))
+
+
+def test_late_defined_global_helper_visible():
+    """Helpers defined AFTER decoration must be visible to the converted
+    function (live module globals, not a snapshot)."""
+    fn = paddle.jit.to_static(_uses_late_helper)
+    out = fn(paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(out.numpy(), 4.0)
+
+
+def _uses_late_helper(x):
+    if x.sum() > 0:
+        z = _late_helper(x)
+    else:
+        z = x.sum()
+    return z
+
+
+def _late_helper(x):
+    return x.sum() * 2
+
+
+def test_concrete_program_inspection():
+    def f(x):
+        return (x * 2).sum()
+
+    fn = paddle.jit.to_static(f)
+    txt = fn.concrete_program(paddle.to_tensor(np.ones(3, np.float32)))
+    assert "module" in txt or "stablehlo" in txt
