@@ -163,6 +163,11 @@ class Tensor:
     def tolist(self):
         return self.numpy().tolist()
 
+    # numpy must defer binary ops to Tensor's reflected dunders instead of
+    # converting via __array__ (np.float64(2) * t would otherwise produce
+    # an f64 ndarray, bypassing the framework's promotion rules)
+    __array_priority__ = 100
+
     def __array__(self, dtype=None):
         a = np.asarray(self._value)
         return a.astype(dtype) if dtype is not None else a

@@ -258,13 +258,12 @@ def barrier(group=None):
     complete until each participant has enqueued it).  Single-process:
     flush outstanding work on the default device.
     """
-    import jax.experimental.multihost_utils as mhu
-    try:
-        if jax.process_count() > 1:
-            mhu.sync_global_devices("paddle_tpu.barrier")
-            return
-    except Exception:
-        pass
+    if jax.process_count() > 1:
+        # real cross-process rendezvous; a failure here must propagate — a
+        # silently skipped barrier corrupts the synchronization contract
+        import jax.experimental.multihost_utils as mhu
+        mhu.sync_global_devices("paddle_tpu.barrier")
+        return
     (jnp.zeros(()) + 0).block_until_ready()
 
 

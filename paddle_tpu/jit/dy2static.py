@@ -307,10 +307,16 @@ def convert_to_static(fn: Callable) -> Callable:
     func_def = tree.body[0]
     if not isinstance(func_def, ast.FunctionDef):
         return fn if bound_self is None else fn.__get__(bound_self)
-    # drop only to_static-ish decorators; other decorators keep wrapping
-    func_def.decorator_list = [
-        d for d in func_def.decorator_list
-        if "to_static" not in ast.unparse(d)]
+    # Only to_static-ish decorators can be safely dropped from the
+    # recompiled source. Anything else would either RE-EXECUTE at
+    # conversion time (duplicate side effects) or change semantics
+    # (@staticmethod) — bail to plain tracing so the original decorated
+    # function stays intact.
+    others = [d for d in func_def.decorator_list
+              if "to_static" not in ast.unparse(d)]
+    if others:
+        return fn if bound_self is None else fn.__get__(bound_self)
+    func_def.decorator_list = []
     tr = _CtrlFlowTransformer()
     new_tree = tr.visit(tree)
     if tr.counter == 0:

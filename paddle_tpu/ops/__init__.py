@@ -146,10 +146,15 @@ def _binary_dunder(fn, reverse=False):
     import builtins
 
     def method(self, other):
+        # python int/float stay unwrapped (weak scalars — see math._binop;
+        # np.generic scalars are STRONG-typed and must be wrapped);
         # builtins.complex explicitly: paddle.complex (math.py) shadows the
         # builtin in this star-import namespace, matching paddle's API
-        if isinstance(other, (list, tuple, np.ndarray, int, float, bool,
-                              builtins.complex, np.generic)):
+        if isinstance(other, (int, float)) and not isinstance(
+                other, (bool, np.generic)):
+            pass
+        elif isinstance(other, (list, tuple, np.ndarray, bool,
+                                builtins.complex, np.generic)):
             other = to_tensor(other)
         elif not isinstance(other, Tensor):
             return NotImplemented

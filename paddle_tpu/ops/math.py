@@ -23,11 +23,34 @@ def _wrap(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _weak_scalar(v) -> bool:
+    # python int/float (NOT bool, NOT numpy scalars) stay weak-typed
+    # through jnp so they never promote a bf16/f16 tensor (paddle parity:
+    # bf16_t * 2.0 is bf16). np.float64 subclasses float but is STRONG
+    # f64-typed in jnp — it must go through to_tensor's f32 default.
+    return isinstance(v, (int, float)) and not isinstance(
+        v, (bool, np.generic))
+
+
 def _binop(name, fn):
     wrapped = op(name)(fn)
 
     def api(x, y, name=None):
-        return wrapped(_wrap(x), _wrap(y))
+        xs, ys = _weak_scalar(x), _weak_scalar(y)
+        if xs and ys:
+            return wrapped(_wrap(x), _wrap(y))
+        xv = x if xs else _wrap(x)
+        yv = y if ys else _wrap(y)
+        # int tensor ∘ float scalar promotes via the default float dtype
+        # (paddle semantics), not x64's int64→f64 ladder
+        from ..core.dtypes import get_default_dtype
+        if xs and isinstance(x, float) and jnp.issubdtype(
+                yv._value.dtype, jnp.integer):
+            yv = yv.astype(get_default_dtype())
+        elif ys and isinstance(y, float) and jnp.issubdtype(
+                xv._value.dtype, jnp.integer):
+            xv = xv.astype(get_default_dtype())
+        return wrapped(xv, yv)
     api.__name__ = name
     return api
 

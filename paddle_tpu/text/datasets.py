@@ -137,9 +137,13 @@ class Imikolov(Dataset):
                 freq[w] = freq.get(w, 0) + 1
         words = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
                  if c >= min_word_freq]
-        # ids 0/1 reserved for BOS/EOS (same layout as the synthetic path)
-        self.word_idx = {w: i + 2 for i, w in enumerate(words)}
-        unk = len(self.word_idx) + 2
+        # specials live IN word_idx (reference includes '<unk>' too), so
+        # Embedding(len(ds.word_idx)) covers every emitted id
+        self.word_idx = {"<s>": 0, "<e>": 1}
+        for i, w in enumerate(words):
+            self.word_idx[w] = i + 2
+        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk
         self.data = []
         for ln in lines:
             ids = [self.word_idx.get(w, unk) for w in ln.split()]

@@ -49,6 +49,7 @@ def main():
 
     model, optim = build()
     step = ShardedTrainStep(model, loss_fn, optim)
+    paddle.distributed.barrier()  # real cross-process rendezvous
     losses = []
     per_rank = GLOBAL_BATCH // world
     for i in range(STEPS):
