@@ -235,3 +235,49 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                     "metrics": metrics or []})
     return lst
+
+
+class VisualDL(Callback):
+    """Scalar-logging callback (reference: callbacks.py VisualDL:661 —
+    writes train/eval metrics with a LogWriter). The VisualDL package
+    itself is not available here; the same stream is written as JSONL
+    (one {"tag", "step", "value"} record per line), which any plotting
+    tool ingests and tests can assert on."""
+
+    def __init__(self, log_dir: str = "./log"):
+        self.log_dir = log_dir
+        self._files = {}
+        self._steps = {"train": 0, "eval": 0}
+
+    def _writer(self, mode: str):
+        f = self._files.get(mode)
+        if f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            f = open(os.path.join(self.log_dir, f"{mode}.jsonl"), "a")
+            self._files[mode] = f
+        return f
+
+    def _log(self, mode: str, logs: dict):
+        import json
+        f = self._writer(mode)
+        step = self._steps[mode]
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple, np.ndarray)):
+                v = np.asarray(v).reshape(-1)
+                v = float(v[0]) if v.size else 0.0
+            if isinstance(v, numbers.Number):
+                f.write(json.dumps({"tag": f"{mode}/{k}", "step": step,
+                                    "value": float(v)}) + "\n")
+        f.flush()
+        self._steps[mode] = step + 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        for f in self._files.values():
+            f.close()
+        self._files = {}

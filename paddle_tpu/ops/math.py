@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import op
-from ..core.dtypes import convert_dtype
+from ..core.dtypes import convert_dtype, get_default_dtype
 from ..core.tensor import (Tensor, to_tensor, alias_for_inplace,
                            rebind_inplace, check_inplace_allowed)
 
@@ -41,25 +41,42 @@ def _binop(name, fn):
             return wrapped(_wrap(x), _wrap(y))
         xv = x if xs else _wrap(x)
         yv = y if ys else _wrap(y)
-        # int tensor ∘ float scalar promotes via the default float dtype
-        # (paddle semantics), not x64's int64→f64 ladder
-        from ..core.dtypes import get_default_dtype
-        if xs and isinstance(x, float) and jnp.issubdtype(
-                yv._value.dtype, jnp.integer):
+        # int/bool tensor ∘ float scalar promotes via the default float
+        # dtype (paddle semantics), not x64's int64→f64 ladder
+        if xs and isinstance(x, float) and _int_like(yv):
             yv = yv.astype(get_default_dtype())
-        elif ys and isinstance(y, float) and jnp.issubdtype(
-                xv._value.dtype, jnp.integer):
+        elif ys and isinstance(y, float) and _int_like(xv):
             xv = xv.astype(get_default_dtype())
         return wrapped(xv, yv)
     api.__name__ = name
     return api
 
 
+def _int_like(t) -> bool:
+    d = t._value.dtype
+    return jnp.issubdtype(d, jnp.integer) or jnp.issubdtype(d, jnp.bool_)
+
+
 # -- elementwise binary ------------------------------------------------------
 add = _binop("elementwise_add", lambda x, y: jnp.add(x, y))
 subtract = _binop("elementwise_sub", lambda x, y: jnp.subtract(x, y))
 multiply = _binop("elementwise_mul", lambda x, y: jnp.multiply(x, y))
-divide = _binop("elementwise_div", lambda x, y: jnp.true_divide(x, y))
+_divide_raw = _binop("elementwise_div", lambda x, y: jnp.true_divide(x, y))
+
+
+def divide(x, y, name=None):
+    """True division of integer/bool tensors yields the DEFAULT float
+    dtype (paddle semantics) — without this, x64's int64 ladder would make
+    int_t / 2 come out float64."""
+    if isinstance(x, Tensor) and _int_like(x):
+        x = x.astype(get_default_dtype())
+    if isinstance(y, Tensor) and _int_like(y):
+        y = y.astype(get_default_dtype())
+    if _weak_scalar(x) and isinstance(x, int):
+        x = float(x)
+    if _weak_scalar(y) and isinstance(y, int):
+        y = float(y)
+    return _divide_raw(x, y)
 floor_divide = _binop("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y))
 remainder = _binop("elementwise_mod", lambda x, y: jnp.remainder(x, y))
 mod = remainder

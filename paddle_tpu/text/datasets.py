@@ -135,10 +135,13 @@ class Imikolov(Dataset):
         for ln in lines:
             for w in ln.split():
                 freq[w] = freq.get(w, 0) + 1
-        words = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
-                 if c >= min_word_freq]
         # specials live IN word_idx (reference includes '<unk>' too), so
-        # Embedding(len(ds.word_idx)) covers every emitted id
+        # Embedding(len(ds.word_idx)) covers every emitted id; PTB corpora
+        # contain a literal '<unk>' token — exclude specials from the
+        # frequency ranking so ids stay dense and in-range
+        specials = ("<s>", "<e>", "<unk>")
+        words = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
+                 if c >= min_word_freq and w not in specials]
         self.word_idx = {"<s>": 0, "<e>": 1}
         for i, w in enumerate(words):
             self.word_idx[w] = i + 2

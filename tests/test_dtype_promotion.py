@@ -93,3 +93,13 @@ def test_numpy_scalars_are_not_weak():
     assert (s * x).dtype == paddle.float32
     b = x.astype("bfloat16")
     assert (b * np.float64(2.0)).dtype != paddle.float64
+
+
+def test_bool_and_int_division_promotion():
+    mask = paddle.to_tensor(np.array([True, False]))
+    assert (mask * 0.5).dtype == paddle.float32  # not f64
+    ints = paddle.ones([3], dtype="int64")
+    assert (ints / 2).dtype == paddle.float32
+    assert (ints / 2.0).dtype == paddle.float32
+    assert paddle.divide(ints, paddle.to_tensor(
+        np.array([2, 2, 2]))).dtype == paddle.float32

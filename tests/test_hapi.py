@@ -165,3 +165,28 @@ def test_model_with_lenet_mnist_style():
     model.fit(FakeMNIST(), epochs=1, batch_size=16, verbose=0)
     res = model.evaluate(FakeMNIST(), batch_size=16, verbose=0)
     assert "acc" in res and "loss" in res
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    import json
+    import numpy as np
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Flatten(),
+                               paddle.nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-3, parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    X = paddle.to_tensor(np.random.randn(32, 4, 4).astype("float32"))
+    Y = paddle.to_tensor(np.random.randint(0, 4, (32, 1)))
+    from paddle_tpu.io import DataLoader, TensorDataset
+    loader = DataLoader(TensorDataset([X, Y]), batch_size=16)
+    cb = paddle.callbacks.VisualDL(log_dir=str(tmp_path / "vdl"))
+    model.fit(loader, epochs=2, verbose=0, callbacks=[cb])
+    lines = [json.loads(l) for l in
+             open(tmp_path / "vdl" / "train.jsonl")]
+    assert len(lines) >= 2
+    assert all("tag" in r and "value" in r for r in lines)
+    assert any(r["tag"].startswith("train/") for r in lines)
