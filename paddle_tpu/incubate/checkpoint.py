@@ -178,12 +178,13 @@ def save_sharded_state(state: dict, path: str, process_index: int = None):
         # (internal numpy cache) that must not be taken
         if isinstance(arr, Tensor):
             arr = arr._value
+        # the manifest records ONLY global metadata: per-shard entries
+        # written by process 0 alone would under-describe a multi-host
+        # save (each process sees only its addressable shards). Shard
+        # placement is self-describing in the shard_*.npz keys.
         manifest[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
         for s in arr.addressable_shards:
-            key = f"{name}::{s.index}"
             shards[_flat_key(name, s.index)] = np.asarray(s.data)
-            manifest[name].setdefault("shards", []).append(
-                {"index": _index_json(s.index), "file": pi})
     np.savez(os.path.join(path, f"shard_{pi}.npz"), **shards)
     if pi == 0:
         with open(os.path.join(path, "manifest.json"), "w") as f:
@@ -194,11 +195,6 @@ def _flat_key(name, index):
     parts = [f"{sl.start or 0}:{'' if sl.stop is None else sl.stop}"
              for sl in index]
     return name + "||" + ",".join(parts)
-
-
-def _index_json(index):
-    return [[sl.start or 0, -1 if sl.stop is None else sl.stop]
-            for sl in index]
 
 
 def load_sharded_state(path: str) -> dict:

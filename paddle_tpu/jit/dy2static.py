@@ -110,10 +110,12 @@ def convert_ifelse(pred, true_fn, false_fn, names: List[str], cur_vals):
 def convert_while(test_fn, body_fn, names: List[str], cur_vals):
     """reference: convert_operators.convert_while_loop.
 
-    Loop CARRIES are the assigned names already defined before the loop;
-    names first assigned inside the body are body-local temporaries (the
-    reference's loop_transformer makes the same live-in/live-out split) —
-    they don't survive the loop."""
+    On the TRACED (lax.while_loop) path, loop CARRIES are the assigned
+    names already defined before the loop; names first assigned inside the
+    body are body-local temporaries (the reference's loop_transformer makes
+    the same live-in/live-out split) — they don't survive the loop. On the
+    EAGER path all body-assigned names keep their last-iteration value,
+    matching plain-Python/dygraph semantics."""
     vals = list(cur_vals)
     carry_idx = [i for i, v in enumerate(vals) if v is not _UNDEF]
 
@@ -134,9 +136,9 @@ def convert_while(test_fn, body_fn, names: List[str], cur_vals):
     probe = test2(*carry)
     if not _is_traced(probe) and not any(
             _is_traced(v) for v in carry if isinstance(v, Tensor)):
-        while _as_bool(test2(*carry)):
-            carry = list(body2(*carry))
-        return tuple(rebuild(carry))
+        while _as_bool(test_fn(*vals)):
+            vals = list(body_fn(*vals))
+        return tuple(vals)
     from ..ops import control_flow as cf
     out = cf.while_loop(test2, lambda *a: list(body2(*a)), carry)
     return tuple(rebuild(out))

@@ -41,20 +41,32 @@ def check_finite_and_unscale(x, scale, name=None):
 
 
 @op("update_loss_scaling", differentiable=False)
-def _update_loss_scaling(scale, good_steps, found_inf, incr_every_n,
-                         decr_every_n, incr_ratio, decr_ratio):
+def _update_loss_scaling(scale, good_steps, bad_steps, found_inf,
+                         incr_every_n, decr_every_n, incr_ratio, decr_ratio):
     def on_inf(_):
-        return (jnp.maximum(scale * decr_ratio, 1.0),
-                jnp.zeros_like(good_steps))
+        new_bad = bad_steps + 1
+
+        def decay(_):
+            # reference clamps the decayed scale to 1 so a run of bad
+            # steps can't drive it to 0 (whose 1/scale unscale is inf)
+            return (jnp.maximum(scale * decr_ratio, 1.0),
+                    jnp.zeros_like(good_steps), jnp.zeros_like(bad_steps))
+
+        def hold(_):
+            return scale, jnp.zeros_like(good_steps), new_bad
+        return jax.lax.cond(new_bad >= decr_every_n, decay, hold, None)
 
     def on_ok(_):
         new_good = good_steps + 1
 
         def bump(_):
-            return scale * incr_ratio, jnp.zeros_like(good_steps)
+            # reference keeps the previous scale if the bump overflows
+            grown = scale * incr_ratio
+            return (jnp.where(jnp.isfinite(grown), grown, scale),
+                    jnp.zeros_like(good_steps), jnp.zeros_like(bad_steps))
 
         def keep(_):
-            return scale, new_good
+            return scale, new_good, jnp.zeros_like(bad_steps)
         return jax.lax.cond(new_good >= incr_every_n, bump, keep, None)
 
     return jax.lax.cond(found_inf, on_inf, on_ok, None)
@@ -64,12 +76,21 @@ def update_loss_scaling(x, found_inf, prev_loss_scaling, num_good_steps,
                         num_bad_steps=None, incr_every_n_steps=2000,
                         decr_every_n_nan_or_inf=1, incr_ratio=2.0,
                         decr_ratio=0.5, stop_update=False, name=None):
-    """reference: update_loss_scaling_op.cc — returns (new_scale,
-    new_good_steps). `x` (grads) kept in the signature for parity; the
-    reference zeroes them on overflow, which the scaler does by skipping
-    the step."""
-    scale, good = _update_loss_scaling(
-        _wrap(prev_loss_scaling), _wrap(num_good_steps), _wrap(found_inf),
-        int(incr_every_n_steps), int(decr_every_n_nan_or_inf),
-        float(incr_ratio), float(decr_ratio))
-    return scale, good
+    """reference: update_loss_scaling_op.cc — the full state machine:
+    decay only after `decr_every_n_nan_or_inf` consecutive bad steps (the
+    bad count is reset by any good step), bump after `incr_every_n_steps`
+    consecutive good ones; the decayed scale is floored at 1 and an
+    overflowing bump holds the previous scale, both per the reference
+    kernel (update_loss_scaling_op.h). Returns (new_scale, new_good_steps)
+    when
+    num_bad_steps is None, else (new_scale, new_good_steps, new_bad_steps).
+    `x` (grads) kept in the signature for parity; the reference zeroes
+    them on overflow, which the scaler does by skipping the step."""
+    bad = _wrap(0 if num_bad_steps is None else num_bad_steps)
+    scale, good, bad = _update_loss_scaling(
+        _wrap(prev_loss_scaling), _wrap(num_good_steps), bad,
+        _wrap(found_inf), int(incr_every_n_steps),
+        int(decr_every_n_nan_or_inf), float(incr_ratio), float(decr_ratio))
+    if num_bad_steps is None:
+        return scale, good
+    return scale, good, bad

@@ -39,9 +39,13 @@ def bernoulli(x, name=None):
 def _multinomial(x, key, num_samples, replacement):
     logits = jnp.log(jnp.clip(x, 1e-30, None))
     if replacement:
-        return jax.random.categorical(
-            key, logits, axis=-1,
-            shape=x.shape[:-1] + (num_samples,)).astype(jnp.int64)
+        # categorical's shape must end with the batch dims, so draw with
+        # num_samples leading and move it to the trailing axis.
+        out = jax.random.categorical(
+            key, logits, axis=-1, shape=(num_samples,) + x.shape[:-1])
+        if x.ndim > 1:
+            out = jnp.moveaxis(out, 0, -1)
+        return out.astype(jnp.int64)
     # without replacement: Gumbel top-k trick
     g = jax.random.gumbel(key, x.shape, dtype=logits.dtype)
     _, idx = jax.lax.top_k(logits + g, num_samples)

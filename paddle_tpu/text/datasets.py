@@ -120,9 +120,17 @@ class Imikolov(Dataset):
                     self.data.append(tuple([self.BOS, *seq, self.EOS]))
                     i += ln
             else:
-                self.data = [tuple(stream[i:i + window_size])
-                             for i in range(0, len(stream) - window_size,
-                                            window_size)]
+                # mirror the real reader: pseudo-lines wrapped in <s>/<e>
+                # before the n-gram window (reference builds n-grams over
+                # ['<s>'] + line + ['<e>'])
+                self.data = []
+                i = 0
+                while i < len(stream):
+                    ln = int(rng.randint(3, 12))
+                    ids = [self.BOS, *stream[i:i + ln], self.EOS]
+                    for j in range(0, max(len(ids) - window_size + 1, 0)):
+                        self.data.append(tuple(ids[j:j + window_size]))
+                    i += ln
 
     def _load_real(self, data_file, mode, min_word_freq):
         sub = "train" if mode == "train" else "valid"
@@ -154,6 +162,9 @@ class Imikolov(Dataset):
                 if ids:
                     self.data.append(tuple([self.BOS, *ids, self.EOS]))
                 continue
+            # reference reader builds n-grams over ['<s>'] + line + ['<e>'],
+            # so boundary tokens participate and short lines still emit
+            ids = [self.BOS, *ids, self.EOS]
             # +1: a line of exactly window_size tokens yields one n-gram
             for i in range(0, max(len(ids) - self.window_size + 1, 0)):
                 self.data.append(tuple(ids[i:i + self.window_size]))

@@ -80,6 +80,22 @@ def test_while_with_tensor_bound():
     assert int(e.numpy()) == int(s.numpy()) == 8
 
 
+def test_while_body_local_survives_eager_path():
+    # A name first assigned INSIDE a python-bounded (eager) while must keep
+    # its last-iteration value afterwards, matching plain dygraph.
+    def f(x):
+        i = 0
+        while i < 3:
+            last = x * (i + 1)
+            i = i + 1
+        return last.sum()
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    e, s = _both(f, x)
+    np.testing.assert_allclose(s.numpy(), e.numpy())
+    np.testing.assert_allclose(e.numpy(), 6.0)
+
+
 def test_nested_if_in_while():
     def f(x):
         i = paddle.to_tensor(0)

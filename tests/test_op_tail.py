@@ -104,6 +104,16 @@ def test_random_ops_distributions():
     assert (np.bincount(m.numpy()[0], minlength=3)[0] > 100)
     m2 = random_ops.multinomial(paddle.ones([1, 5]), 5, replacement=False)
     assert sorted(m2.numpy()[0].tolist()) == [0, 1, 2, 3, 4]
+    # batched (>1 row) input with replacement: rows draw from their own
+    # distribution (regression: categorical batch-shape placement)
+    probs3 = paddle.to_tensor(np.array(
+        [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]], np.float32))
+    m3 = random_ops.multinomial(probs3, 50, replacement=True)
+    assert m3.shape == [3, 50]
+    np.testing.assert_array_equal(m3.numpy(), np.repeat(
+        np.array([[0], [1], [2]]), 50, axis=1))
+    m1d = random_ops.multinomial(paddle.ones([4]), 3, replacement=True)
+    assert m1d.shape == [3]
     lam = paddle.full([500], 4.0)
     ps = random_ops.poisson(lam)
     assert 3.0 < float(ps.numpy().mean()) < 5.0
@@ -148,6 +158,29 @@ def test_amp_ops():
         [], paddle.to_tensor(False), paddle.to_tensor(1024.0),
         paddle.to_tensor(1999), incr_every_n_steps=2000, incr_ratio=2.0)
     np.testing.assert_allclose(float(s2.numpy()), 2048.0)
+    # decr_every_n_nan_or_inf > 1: first bad step holds the scale, second
+    # consecutive bad step decays it (reference state machine)
+    s3, good3, bad3 = update_loss_scaling(
+        [], paddle.to_tensor(True), paddle.to_tensor(1024.0),
+        paddle.to_tensor(7), num_bad_steps=paddle.to_tensor(0),
+        decr_every_n_nan_or_inf=2, decr_ratio=0.5)
+    np.testing.assert_allclose(float(s3.numpy()), 1024.0)
+    assert int(good3.numpy()) == 0 and int(bad3.numpy()) == 1
+    s4, good4, bad4 = update_loss_scaling(
+        [], paddle.to_tensor(True), s3, good3, num_bad_steps=bad3,
+        decr_every_n_nan_or_inf=2, decr_ratio=0.5)
+    np.testing.assert_allclose(float(s4.numpy()), 512.0)
+    assert int(bad4.numpy()) == 0
+    # decay floors at 1.0 (reference clamp) so 1/scale never overflows
+    s5, _ = update_loss_scaling(
+        [], paddle.to_tensor(True), paddle.to_tensor(1.0),
+        paddle.to_tensor(0), decr_ratio=0.5)
+    np.testing.assert_allclose(float(s5.numpy()), 1.0)
+    # an overflowing bump holds the previous finite scale
+    s6, _ = update_loss_scaling(
+        [], paddle.to_tensor(False), paddle.to_tensor(3.0e38),
+        paddle.to_tensor(1999), incr_every_n_steps=2000, incr_ratio=2.0)
+    np.testing.assert_allclose(float(s6.numpy()), 3.0e38)
 
 
 # ----------------------------------------------------------- sequence ops
