@@ -1,0 +1,121 @@
+"""LoD (level-of-detail) offsets facade.
+
+Reference: framework/lod_tensor.h:56 — the reference's LoDTensor carries a
+list of offset levels describing ragged sequence boundaries over a flat
+rows-concatenated tensor, e.g. lod=[[0, 2, 5]] means two sequences of
+lengths 2 and 3.
+
+TPU-native substrate: ragged data lives as (dense [B, Tmax, ...], lengths
+[B]) pairs — the static-shape encoding XLA requires (ops/sequence_ops.py).
+This module is the offsets-facing facade over that substrate: a LoDTensor
+holding the flat concatenation + offset levels, with lossless conversion to
+and from the padded form, mirroring the reference API (lod()/set_lod()/
+recursive_sequence_lengths()) so reference-style code ports unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .tensor import Tensor, to_tensor
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _offsets_from_lengths(lengths):
+    off = [0]
+    for n in lengths:
+        off.append(off[-1] + int(n))
+    return off
+
+
+def _lengths_from_offsets(offsets):
+    return [int(b) - int(a) for a, b in zip(offsets[:-1], offsets[1:])]
+
+
+class LoDTensor:
+    """Flat rows-concatenated tensor + offset levels (reference
+    framework/lod_tensor.h). `data` is [total_rows, ...]."""
+
+    def __init__(self, data, lod=None):
+        self.data = _wrap(data)
+        self._lod = [list(map(int, level)) for level in (lod or [])]
+
+    # -- reference API ------------------------------------------------------
+    def lod(self):
+        return [list(level) for level in self._lod]
+
+    def set_lod(self, lod):
+        for level in lod:
+            if list(level) != sorted(map(int, level)) or (level and
+                                                          level[0] != 0):
+                raise ValueError(f"invalid LoD level {level}: offsets must "
+                                 "be ascending and start at 0")
+        self._lod = [list(map(int, level)) for level in lod]
+
+    def recursive_sequence_lengths(self):
+        return [_lengths_from_offsets(level) for level in self._lod]
+
+    def set_recursive_sequence_lengths(self, seq_lens):
+        self._lod = [_offsets_from_lengths(level) for level in seq_lens]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        for upper, lower in zip(self._lod[:-1], self._lod[1:]):
+            if upper[-1] != len(lower) - 1:
+                return False
+        return self._lod[-1][-1] == int(self.data.shape[0])
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    def numpy(self):
+        return self.data.numpy()
+
+    def __repr__(self):
+        return f"LoDTensor(shape={self.data.shape}, lod={self._lod})"
+
+    # -- bridge to the TPU-native (dense, lengths) rep ----------------------
+    def to_padded(self, pad_value=0.0):
+        """Returns (dense [B, Tmax, ...], lengths [B]) from level-(-1)."""
+        if not self._lod:
+            raise ValueError("LoDTensor has no LoD; it is already dense")
+        lengths = _lengths_from_offsets(self._lod[-1])
+        from ..ops.sequence_ops import sequence_pad
+        padded, lens = sequence_pad(self.data,
+                                    to_tensor(np.asarray(lengths, np.int64)),
+                                    pad_value=pad_value)
+        return padded, lens
+
+    @staticmethod
+    def from_padded(dense, lengths):
+        """Build from (dense [B, Tmax, ...], lengths [B]): flat rows +
+        single offset level."""
+        from ..ops.sequence_ops import sequence_unpad
+        lens = [int(v) for v in np.asarray(_wrap(lengths).numpy())]
+        flat = sequence_unpad(dense, _wrap(lengths))
+        return LoDTensor(flat, [_offsets_from_lengths(lens)])
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference: python/paddle/fluid/lod_tensor.py create_lod_tensor."""
+    t = LoDTensor(data)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise ValueError(
+            f"recursive_seq_lens {recursive_seq_lens} inconsistent with "
+            f"data shape {t.shape}")
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """reference: fluid/lod_tensor.py create_random_int_lodtensor."""
+    total = sum(recursive_seq_lens[-1])
+    data = np.random.randint(low, high + 1,
+                             [total] + list(base_shape)).astype(np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)

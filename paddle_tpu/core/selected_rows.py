@@ -141,3 +141,18 @@ def rowwise_update(optimizer, param_arr, sr: SelectedRows, state, lr):
     # densify — correct, costs the dense memory the caller opted out of
     dense = m.to_dense()
     return None, dense  # caller falls back to the dense path
+
+
+def split_selected_rows(x: "SelectedRows", height_sections):
+    """reference: operators/split_selected_rows_op.cc — partition rows into
+    contiguous height ranges (the PS parameter-partition step); rows are
+    re-based to each section's origin."""
+    import numpy as np
+    outs = []
+    start = 0
+    rows = np.asarray(x.rows)
+    for h in height_sections:
+        sel = np.where((rows >= start) & (rows < start + h))[0]
+        outs.append(SelectedRows(rows[sel] - start, x.values[sel], int(h)))
+        start += h
+    return outs

@@ -12,6 +12,8 @@ passed stat tensors when executing eagerly (paddle mutates them in place).
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -24,33 +26,113 @@ def _wrap(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-@op("batch_norm_infer")
-def _bn_infer(x, mean, var, weight, bias, eps, c_axis):
+def _apply_scale_shift(x, mean, var, weight, bias, eps, c_axis):
+    """Fold (mean, var, weight, bias) into per-channel scale/shift computed
+    in fp32, then apply in x's own dtype. For bf16 activations this keeps
+    the full-tensor elementwise in bf16 (HBM-bandwidth bound) while the
+    tiny per-channel math stays fp32 — the cuDNN BN recipe
+    (batch_norm_op.cu keeps saved stats fp32 for __half inputs)."""
+    f32 = jnp.float32
+    inv = jax.lax.rsqrt(var.astype(f32) + eps)
+    scale = inv if weight is None else inv * weight.astype(f32)
+    shift = -mean.astype(f32) * scale
+    if bias is not None:
+        shift = shift + bias.astype(f32)
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]
-    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
-    out = (x - mean.reshape(shape)) * inv
-    if weight is not None:
-        out = out * weight.reshape(shape)
-    if bias is not None:
-        out = out + bias.reshape(shape)
-    return out
+    return (x * scale.astype(x.dtype).reshape(shape)
+            + shift.astype(x.dtype).reshape(shape))
+
+
+@op("batch_norm_infer")
+def _bn_infer(x, mean, var, weight, bias, eps, c_axis):
+    return _apply_scale_shift(x, mean, var, weight, bias, eps, c_axis)
+
+
+def _bn_stats(x, axes):
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        # single-pass E[x^2]-E[x]^2: elementwise stays in bf16, only the
+        # reduction ACCUMULATES in fp32 (dtype=). Materializing an fp32
+        # upcast of x instead (x.astype(f32) shared by both reductions)
+        # makes XLA write a full fp32 copy of every activation — measured
+        # +13 GB/step HBM traffic on ResNet-50 bs=128.
+        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        mean_sq = jnp.mean(jnp.square(x), axis=axes, dtype=jnp.float32)
+        var = jnp.maximum(mean_sq - mean * mean, 0.0)
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    return mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_core(x, weight, bias, eps, c_axis):
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    mean, var = _bn_stats(x, axes)
+    out = _apply_scale_shift(x, mean, var, weight, bias, eps, c_axis)
+    return out, mean, var
+
+
+def _bn_core_fwd(x, weight, bias, eps, c_axis):
+    out, mean, var = _bn_core(x, weight, bias, eps, c_axis)
+    return (out, mean, var), (x, weight, bias, mean, var)
+
+
+def _bn_core_bwd(eps, c_axis, res, cts):
+    """Fused BN backward (the cuDNN/batch_norm_grad recipe, reference
+    batch_norm_op.cu BNBackward): per-channel reductions in fp32, the big
+    elementwise pass kept affine in x so bf16 activations stream at bf16
+    bandwidth:  dx = a*gy + k*x + m  with per-channel a, k, m. The autodiff
+    of the stats formula instead materializes several fp32 copies of the
+    activation — measured 16 ms/step on ResNet-50 bs=128 (v5e) vs ~4 ms for
+    this form."""
+    gy, g_mean, g_var = cts
+    x, weight, bias, mean, var = res
+    f32 = jnp.float32
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    n = 1
+    for i in axes:
+        n *= x.shape[i]
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+
+    inv = jax.lax.rsqrt(var.astype(f32) + eps)            # [C] fp32
+    # products in the activation dtype, fp32 ACCUMULATORS only — an
+    # astype(f32) on gy/x here materializes fp32 activation copies (see
+    # _bn_stats)
+    gy32sum = jnp.sum(gy, axis=axes, dtype=f32)           # dbeta
+    gxsum = jnp.sum(gy * x, axis=axes, dtype=f32)
+    # dgamma = sum(gy * xhat) = (sum(gy*x) - mean*sum(gy)) * inv
+    dgamma = (gxsum - mean.astype(f32) * gy32sum) * inv
+    dbeta = gy32sum
+
+    gamma = jnp.ones_like(inv) if weight is None else weight.astype(f32)
+    a = gamma * inv
+    # dx from out-cotangent: a*gy - a*dbeta/N - xhat * a*dgamma/N, folded
+    # affine in x:  dx = a*gy + k*x + m
+    k = -a * dgamma * inv / n
+    m = -a * dbeta / n - k * mean.astype(f32)
+    # cotangents flowing into the mean/var outputs (running-stat EMAs are
+    # buffers, so these are normally zero, but stay correct if used)
+    if g_var is not None:
+        k = k + 2.0 * g_var.astype(f32) / n
+        m = m - 2.0 * g_var.astype(f32) * mean.astype(f32) / n
+    if g_mean is not None:
+        m = m + g_mean.astype(f32) / n
+    dx = (gy * a.astype(gy.dtype).reshape(shape)
+          + x * k.astype(x.dtype).reshape(shape)
+          + m.astype(x.dtype).reshape(shape)).astype(x.dtype)
+    dw = None if weight is None else dgamma.astype(weight.dtype)
+    db = None if bias is None else dbeta.astype(bias.dtype)
+    return dx, dw, db
+
+
+_bn_core.defvjp(_bn_core_fwd, _bn_core_bwd)
 
 
 @op("batch_norm_train")
 def _bn_train(x, weight, bias, eps, c_axis):
-    axes = tuple(i for i in range(x.ndim) if i != c_axis)
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
-    shape = [1] * x.ndim
-    shape[c_axis] = x.shape[c_axis]
-    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
-    out = (x - mean.reshape(shape)) * inv
-    if weight is not None:
-        out = out * weight.reshape(shape)
-    if bias is not None:
-        out = out + bias.reshape(shape)
-    return out, mean, var
+    return _bn_core(x, weight, bias, eps, c_axis)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

@@ -230,3 +230,51 @@ def array_read(array, i):
 
 def array_length(array):
     return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+@op("tensor_array_to_tensor")
+def _taro(xs, axis, use_stack):
+    return (jnp.stack(xs, axis) if use_stack else
+            jnp.concatenate(xs, axis))
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
+    """reference: operators/tensor_array_to_tensor_op.cc — concat (or
+    stack) a TensorArray into one tensor; also returns the per-item sizes
+    along axis (the OutIndex output)."""
+    xs = [_wrap(x) for x in input]
+    sizes = Tensor(jnp.asarray(
+        [1 if use_stack else x.shape[axis] for x in xs], jnp.int64))
+    return _taro(xs, int(axis), bool(use_stack)), sizes
+
+
+def array_to_lod_tensor(x, table):
+    """reference: operators/array_to_lod_tensor_op.cc — concatenate a
+    TensorArray of per-sequence rows back into a LoDTensor whose level-0
+    lengths come from `table` (the rank-table lengths)."""
+    from ..core.lod import LoDTensor
+    lens = [int(v) for v in np.asarray(_wrap(table).numpy()).reshape(-1)]
+    flat = jnp.concatenate([_wrap(t)._value for t in x], axis=0)
+    off = [0]
+    for n in lens:
+        off.append(off[-1] + n)
+    return LoDTensor(Tensor(flat), [off])
+
+
+def lod_tensor_to_array(x, table=None):
+    """reference: operators/lod_tensor_to_array_op.cc — split a LoDTensor
+    into a TensorArray of per-sequence row blocks (level-0)."""
+    from ..core.lod import LoDTensor
+    if isinstance(x, LoDTensor):
+        offsets = x.lod()[-1]
+        data = x.data
+    else:
+        lens = [int(v) for v in np.asarray(_wrap(table).numpy()).reshape(-1)]
+        offsets = [0]
+        for n in lens:
+            offsets.append(offsets[-1] + n)
+        data = _wrap(x)
+    arr = TensorArray()
+    for a, b in zip(offsets[:-1], offsets[1:]):
+        arr.append(Tensor(data._value[a:b]))
+    return arr

@@ -249,3 +249,62 @@ def randn_like(x, dtype=None, name=None):
 # canonical random/meta implementations live in random_ops/array_ops
 from .random_ops import bernoulli, multinomial, poisson  # noqa: E402,F401
 from .array_ops import meshgrid  # noqa: E402,F401
+
+
+# ------------------------------------------------------------------ legacy
+# *_batch_size_like creators (reference: operators/fill_constant_batch_size_
+# like_op.cc, gaussian_random_batch_size_like_op.cc, uniform_random_batch_
+# size_like_op.cc): shape is `shape` with dim output_dim_idx replaced by
+# input's dim input_dim_idx.
+
+def _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return shape
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    return full(_batch_size_like_shape(input, shape, input_dim_idx,
+                                       output_dim_idx), value, dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    dtype="float32", name=None):
+    return normal(mean, std, _batch_size_like_shape(
+        input, shape, input_dim_idx, output_dim_idx)).astype(dtype)
+
+
+def uniform_random_batch_size_like(input, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   dtype="float32", name=None):
+    return uniform(_batch_size_like_shape(input, shape, input_dim_idx,
+                                          output_dim_idx), dtype, min, max)
+
+
+@op("diag_embed")
+def _diag_embed(x, offset, dim1, dim2):
+    k = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (k, k), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + max(0, -offset)
+    cols = idx + max(0, offset)
+    out = base.at[..., rows, cols].set(x)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    order = sorted([(d1, nd - 2), (d2, nd - 1)])
+    for pos, src in order:
+        perm.insert(pos, src)
+    return jnp.transpose(out, perm)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """reference: operators/diag_embed_op.cc (build a batched diagonal
+    matrix from the last axis)."""
+    t = input if isinstance(input, Tensor) else to_tensor(input)
+    return _diag_embed(t, int(offset), int(dim1), int(dim2))

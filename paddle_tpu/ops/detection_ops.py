@@ -1,0 +1,750 @@
+"""Detection op corpus (reference: operators/detection/*.cc).
+
+TPU-native split: the differentiable training losses (yolov3_loss,
+target_assign) are pure-jnp, vectorized over fixed gt slots so they jit and
+shard. The proposal/assignment machinery with data-dependent output shapes
+(generate_proposals, rpn/retinanet target assign, FPN routing, NMS merges)
+runs host-side — the reference computes these in CPU kernels too
+(detection/*.cc have CPU-only kernels for most), and their outputs feed
+sampling/bookkeeping, not the compiled hot path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+from .vision_ops import bipartite_match  # noqa: F401  (re-export; same op)
+
+__all__ = [
+    "anchor_generator", "bipartite_match", "box_clip",
+    "box_decoder_and_assign", "collect_fpn_proposals", "density_prior_box",
+    "distribute_fpn_proposals", "generate_proposals",
+    "generate_proposal_labels", "generate_mask_labels",
+    "locality_aware_nms", "mine_hard_examples", "polygon_box_transform",
+    "retinanet_detection_output", "retinanet_target_assign",
+    "roi_perspective_transform", "rpn_target_assign", "target_assign",
+    "yolov3_loss",
+]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _np(x):
+    return np.asarray(_wrap(x).numpy())
+
+
+# ---------------------------------------------------------------- anchors
+@op("anchor_generator", differentiable=False)
+def _anchor_generator(feat_h, feat_w, anchor_sizes, aspect_ratios, stride,
+                      variances, offset):
+    """reference: detection/anchor_generator_op.h:38-81."""
+    sw, sh = stride
+    x_ctr = jnp.arange(feat_w) * sw + offset * (sw - 1)
+    y_ctr = jnp.arange(feat_h) * sh + offset * (sh - 1)
+    anchors = []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            area_ratios = area / ar
+            base_w = jnp.round(jnp.sqrt(area_ratios))
+            base_h = jnp.round(base_w * ar)
+            scale_w = size / sw
+            scale_h = size / sh
+            anchors.append((scale_w * base_w, scale_h * base_h))
+    out = jnp.zeros((feat_h, feat_w, len(anchors), 4), jnp.float32)
+    for i, (aw, ah) in enumerate(anchors):
+        out = out.at[:, :, i, 0].set(x_ctr[None, :] - 0.5 * (aw - 1))
+        out = out.at[:, :, i, 1].set(y_ctr[:, None] - 0.5 * (ah - 1))
+        out = out.at[:, :, i, 2].set(x_ctr[None, :] + 0.5 * (aw - 1))
+        out = out.at[:, :, i, 3].set(y_ctr[:, None] + 0.5 * (ah - 1))
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           out.shape)
+    return out, var
+
+
+def anchor_generator(input, anchor_sizes=(64., 128., 256., 512.),
+                     aspect_ratios=(0.5, 1.0, 2.0), stride=(16.0, 16.0),
+                     variances=(0.1, 0.1, 0.2, 0.2), offset=0.5, name=None):
+    x = _wrap(input)
+    return _anchor_generator(int(x.shape[2]), int(x.shape[3]),
+                             tuple(anchor_sizes), tuple(aspect_ratios),
+                             tuple(stride), tuple(variances), float(offset))
+
+
+@op("box_clip")
+def _box_clip(boxes, im_h, im_w):
+    x1 = jnp.clip(boxes[..., 0], 0, im_w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, im_h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, im_w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, im_h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def box_clip(input, im_info, name=None):
+    """reference: detection/box_clip_op.cc — clamp boxes to the image."""
+    info = _np(im_info).reshape(-1)
+    return _box_clip(_wrap(input), float(info[0]), float(info[1]))
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip_value=4.135, name=None):
+    """reference: detection/box_decoder_and_assign_op.cc — decode per-class
+    deltas against priors, then pick each roi's best-scoring class box."""
+    prior = _np(prior_box)
+    var = _np(prior_box_var)
+    deltas = _np(target_box)          # [N, C*4]
+    scores = _np(box_score)           # [N, C]
+    N, C = scores.shape
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
+    px = prior[:, 0] + 0.5 * pw
+    py = prior[:, 1] + 0.5 * ph
+    out = np.zeros_like(deltas)
+    for c in range(C):
+        d = deltas[:, 4 * c:4 * c + 4] * var
+        cx = d[:, 0] * pw + px
+        cy = d[:, 1] * ph + py
+        w = np.exp(np.minimum(d[:, 2], box_clip_value)) * pw
+        h = np.exp(np.minimum(d[:, 3], box_clip_value)) * ph
+        out[:, 4 * c + 0] = cx - 0.5 * w
+        out[:, 4 * c + 1] = cy - 0.5 * h
+        out[:, 4 * c + 2] = cx + 0.5 * w - 1
+        out[:, 4 * c + 3] = cy + 0.5 * h - 1
+    best = scores.argmax(axis=1)
+    assigned = np.stack([out[i, 4 * b:4 * b + 4]
+                         for i, b in enumerate(best)])
+    return to_tensor(out), to_tensor(assigned)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n,
+                          name=None):
+    """reference: detection/collect_fpn_proposals_op.cc — concat per-level
+    RoIs, keep global top-k by score."""
+    rois = np.concatenate([_np(r) for r in multi_rois], axis=0)
+    scores = np.concatenate([_np(s).reshape(-1) for s in multi_scores])
+    k = min(post_nms_top_n, len(scores))
+    keep = np.argsort(-scores, kind="stable")[:k]
+    return to_tensor(rois[keep]), to_tensor(scores[keep])
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variances=(0.1, 0.1, 0.2, 0.2), clip=False, step=0.0,
+                      offset=0.5, name=None):
+    """reference: detection/density_prior_box_op.h — SSD densified priors:
+    for each (density, fixed_size), a density×density sub-grid of boxes of
+    size fixed_size*sqrt(ratio) per cell."""
+    x = _wrap(input)
+    img = _wrap(image)
+    H, W = int(x.shape[2]), int(x.shape[3])
+    img_h, img_w = int(img.shape[2]), int(img.shape[3])
+    step_w = img_w / W if step == 0 else step
+    step_h = img_h / H if step == 0 else step
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for density, fs in zip(densities, fixed_sizes):
+                for ratio in fixed_ratios:
+                    bw = fs * np.sqrt(ratio)
+                    bh = fs / np.sqrt(ratio)
+                    shift = fs / density
+                    for di in range(density):
+                        for dj in range(density):
+                            ccx = cx - fs / 2 + shift / 2 + dj * shift
+                            ccy = cy - fs / 2 + shift / 2 + di * shift
+                            boxes.append([(ccx - bw / 2) / img_w,
+                                          (ccy - bh / 2) / img_h,
+                                          (ccx + bw / 2) / img_w,
+                                          (ccy + bh / 2) / img_h])
+    arr = np.asarray(boxes, np.float32).reshape(H, W, -1, 4)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          arr.shape).copy()
+    return to_tensor(arr), to_tensor(var)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """reference: detection/distribute_fpn_proposals_op.h — route each RoI
+    to level = refer + log2(sqrt(area)/refer_scale), clamped."""
+    rois = _np(fpn_rois)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, restore = [], np.zeros(len(rois), np.int64)
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        outs.append(to_tensor(rois[idx] if len(idx) else
+                              np.zeros((0, rois.shape[1]), rois.dtype)))
+        order.extend(idx.tolist())
+    restore[np.asarray(order, np.int64)] = np.arange(len(rois))
+    return outs, to_tensor(restore.reshape(-1, 1))
+
+
+def _decode_deltas(anchors, deltas, variances=None):
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        deltas = deltas * variances
+    cx = deltas[:, 0] * aw + ax
+    cy = deltas[:, 1] * ah + ay
+    w = np.exp(np.minimum(deltas[:, 2], 10.0)) * aw
+    h = np.exp(np.minimum(deltas[:, 3], 10.0)) * ah
+    return np.stack([cx - 0.5 * w, cy - 0.5 * h,
+                     cx + 0.5 * w - 1, cy + 0.5 * h - 1], axis=1)
+
+
+def _nms_np(boxes, scores, thresh):
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(i)
+        if len(order) == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        iw = np.maximum(xx2 - xx1 + 1, 0)
+        ih = np.maximum(yy2 - yy1 + 1, 0)
+        inter = iw * ih
+        a1 = ((boxes[i, 2] - boxes[i, 0] + 1)
+              * (boxes[i, 3] - boxes[i, 1] + 1))
+        a2 = ((boxes[order[1:], 2] - boxes[order[1:], 0] + 1)
+              * (boxes[order[1:], 3] - boxes[order[1:], 1] + 1))
+        iou = inter / (a1 + a2 - inter)
+        order = order[1:][iou <= thresh]
+    return np.asarray(keep, np.int64)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances=None,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.7, min_size=0.1, eta=1.0, name=None):
+    """reference: detection/generate_proposals_op.cc — RPN proposal
+    generation: decode deltas on anchors, clip, drop small, pre-NMS top-k,
+    NMS, post-NMS top-k. Host-side (data-dependent shapes)."""
+    sc = _np(scores)           # [N, A, H, W]
+    dl = _np(bbox_deltas)      # [N, A*4, H, W]
+    info = _np(im_info).reshape(-1, 3)
+    anc = _np(anchors).reshape(-1, 4)
+    var = None if variances is None else _np(variances).reshape(-1, 4)
+    N = sc.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = dl[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_nms_top_n, len(s))
+        top = np.argsort(-s, kind="stable")[:k]
+        props = _decode_deltas(anc[top], d[top], None if var is None
+                               else var[top])
+        h_im, w_im = info[n, 0], info[n, 1]
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, w_im - 1)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, h_im - 1)
+        ws = props[:, 2] - props[:, 0] + 1
+        hs = props[:, 3] - props[:, 1] + 1
+        ok = (ws >= min_size) & (hs >= min_size)
+        props, ss = props[ok], s[top][ok]
+        keep = _nms_np(props, ss, nms_thresh)[:post_nms_top_n]
+        all_rois.append(props[keep])
+        all_scores.append(ss[keep])
+        nums.append(len(keep))
+    return (to_tensor(np.concatenate(all_rois, 0).astype(np.float32)),
+            to_tensor(np.concatenate(all_scores, 0).astype(np.float32)),
+            to_tensor(np.asarray(nums, np.int32)))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0, class_nums=81, seed=0,
+                             name=None):
+    """reference: detection/generate_proposal_labels_op.cc — sample fg/bg
+    RoIs against gt for Fast R-CNN heads. Returns (rois, labels,
+    bbox_targets, inside_weights, outside_weights)."""
+    rng = np.random.RandomState(seed)
+    rois = np.concatenate([_np(rpn_rois), _np(gt_boxes)], axis=0)
+    gts = _np(gt_boxes)
+    gtc = _np(gt_classes).reshape(-1)
+
+    def iou_mat(a, b):
+        inter_x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+        inter_y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+        inter_x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+        inter_y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+        iw = np.maximum(inter_x2 - inter_x1 + 1, 0)
+        ih = np.maximum(inter_y2 - inter_y1 + 1, 0)
+        inter = iw * ih
+        aa = ((a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1))[:, None]
+        bb = ((b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1))[None, :]
+        return inter / (aa + bb - inter)
+
+    ious = iou_mat(rois, gts) if len(gts) else np.zeros((len(rois), 1))
+    max_iou = ious.max(axis=1) if ious.size else np.zeros(len(rois))
+    gt_idx = ious.argmax(axis=1) if ious.size else np.zeros(len(rois), int)
+    fg = np.where(max_iou >= fg_thresh)[0]
+    bg = np.where((max_iou < bg_thresh_hi) & (max_iou >= bg_thresh_lo))[0]
+    n_fg = min(int(batch_size_per_im * fg_fraction), len(fg))
+    fg = rng.choice(fg, n_fg, replace=False) if n_fg else fg[:0]
+    n_bg = min(batch_size_per_im - n_fg, len(bg))
+    bg = rng.choice(bg, n_bg, replace=False) if n_bg else bg[:0]
+    keep = np.concatenate([fg, bg]).astype(int)
+    labels = np.where(np.arange(len(keep)) < n_fg,
+                      gtc[gt_idx[keep]], 0).astype(np.int64)
+    sel = rois[keep]
+    tgt = np.zeros((len(keep), 4 * class_nums), np.float32)
+    inw = np.zeros_like(tgt)
+    for i in range(n_fg):
+        g = gts[gt_idx[keep[i]]]
+        pw = sel[i, 2] - sel[i, 0] + 1
+        ph = sel[i, 3] - sel[i, 1] + 1
+        gw = g[2] - g[0] + 1
+        gh = g[3] - g[1] + 1
+        d = [((g[0] + gw / 2) - (sel[i, 0] + pw / 2)) / pw,
+             ((g[1] + gh / 2) - (sel[i, 1] + ph / 2)) / ph,
+             np.log(gw / pw), np.log(gh / ph)]
+        c = int(labels[i])
+        tgt[i, 4 * c:4 * c + 4] = d
+        inw[i, 4 * c:4 * c + 4] = 1.0
+    return (to_tensor(sel.astype(np.float32)), to_tensor(labels),
+            to_tensor(tgt), to_tensor(inw), to_tensor(inw.copy()))
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         label_int32, num_classes, resolution, name=None):
+    """reference: detection/generate_mask_labels_op.cc. Departure from the
+    reference documented here: gt_segms are binary masks [G, H, W] (the
+    reference consumes COCO polygon lists, a host-format detail); targets
+    are the roi-cropped, resolution-resized gt masks."""
+    segs = _np(gt_segms).astype(np.float32)
+    roi = _np(rois)
+    labels = _np(label_int32).reshape(-1)
+    G = segs.shape[0]
+    out = np.full((len(roi), num_classes * resolution * resolution), -1.0,
+                  np.float32)
+    for i, r in enumerate(roi):
+        c = int(labels[i])
+        if c <= 0 or G == 0:
+            continue
+        g = segs[min(i, G - 1)]
+        x1, y1, x2, y2 = [int(max(v, 0)) for v in r[:4]]
+        crop = g[y1:max(y2, y1 + 1), x1:max(x2, x1 + 1)]
+        ys = np.linspace(0, crop.shape[0] - 1, resolution).astype(int)
+        xs = np.linspace(0, crop.shape[1] - 1, resolution).astype(int)
+        m = crop[np.ix_(ys, xs)]
+        out[i, c * resolution * resolution:(c + 1) * resolution
+            * resolution] = (m > 0.5).astype(np.float32).reshape(-1)
+    return to_tensor(out)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_threshold,
+                       post_threshold=0.0, nms_top_k=-1, keep_top_k=-1,
+                       normalized=True, name=None):
+    """reference: detection/locality_aware_nms_op.cc (EAST): score-weighted
+    merge of consecutive overlapping boxes, then standard NMS."""
+    boxes = _np(bboxes).reshape(-1, 4).copy()
+    sc = _np(scores).reshape(-1).copy()
+    ok = sc >= score_threshold
+    boxes, sc = boxes[ok], sc[ok]
+    merged_b, merged_s = [], []
+    for b, s in zip(boxes, sc):
+        if merged_b:
+            last = merged_b[-1]
+            x1 = max(last[0], b[0]); y1 = max(last[1], b[1])
+            x2 = min(last[2], b[2]); y2 = min(last[3], b[3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            a1 = (last[2] - last[0]) * (last[3] - last[1])
+            a2 = (b[2] - b[0]) * (b[3] - b[1])
+            iou = inter / max(a1 + a2 - inter, 1e-12)
+            if iou > nms_threshold:
+                w = merged_s[-1] + s
+                merged_b[-1] = (last * merged_s[-1] + b * s) / w
+                merged_s[-1] = w
+                continue
+        merged_b.append(b.astype(np.float64))
+        merged_s.append(float(s))
+    if not merged_b:
+        return to_tensor(np.zeros((0, 6), np.float32))
+    mb = np.asarray(merged_b, np.float32)
+    ms = np.asarray(merged_s, np.float32)
+    keep = _nms_np(mb, ms, nms_threshold)
+    if keep_top_k > 0:
+        keep = keep[:keep_top_k]
+    out = np.concatenate([np.zeros((len(keep), 1), np.float32),
+                          ms[keep, None], mb[keep]], axis=1)
+    return to_tensor(out)
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       neg_dist_threshold=0.5, mining_type="max_negative",
+                       loc_loss=None, sample_size=None, name=None):
+    """reference: detection/mine_hard_examples_op.cc — per image, keep the
+    top-loss negatives up to ratio*num_pos; returns updated negative
+    indices (ragged → per-row list padded with -1)."""
+    loss = _np(cls_loss)
+    if loc_loss is not None:
+        loss = loss + _np(loc_loss)
+    match = _np(match_indices)
+    N, P = match.shape
+    neg_rows = []
+    for n in range(N):
+        pos = match[n] >= 0
+        n_pos = int(pos.sum())
+        limit = (int(n_pos * neg_pos_ratio) if mining_type == "max_negative"
+                 else int(sample_size or P))
+        cand = np.where(~pos)[0]
+        order = cand[np.argsort(-loss[n, cand], kind="stable")][:limit]
+        neg_rows.append(sorted(order.tolist()))
+    width = max((len(r) for r in neg_rows), default=0)
+    out = np.full((N, max(width, 1)), -1, np.int64)
+    for n, r in enumerate(neg_rows):
+        out[n, :len(r)] = r
+    return to_tensor(out)
+
+
+@op("polygon_box_transform", differentiable=False)
+def _polygon_box_transform(x):
+    N, C, H, W = x.shape
+    w_idx = jnp.arange(W)[None, None, None, :]
+    h_idx = jnp.arange(H)[None, None, :, None]
+    even = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+    return jnp.where(even, w_idx * 4 - x, h_idx * 4 - x)
+
+
+def polygon_box_transform(input, name=None):
+    """reference: detection/polygon_box_transform_op.cc:44-48 — EAST quad
+    geo map decode: even channels 4*w - v, odd channels 4*h - v."""
+    return _polygon_box_transform(_wrap(input))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               nms_threshold=0.3, keep_top_k=100,
+                               nms_eta=1.0, name=None):
+    """reference: detection/retinanet_detection_output_op.cc — per level:
+    decode deltas on anchors, threshold, top-k; then cross-level NMS per
+    class."""
+    info = _np(im_info).reshape(-1, 3)[0]
+    all_boxes, all_scores, all_cls = [], [], []
+    for deltas_t, scores_t, anchors_t in zip(bboxes, scores, anchors):
+        deltas = _np(deltas_t).reshape(-1, 4)
+        sc = _np(scores_t)
+        sc = sc.reshape(-1, sc.shape[-1])
+        anc = _np(anchors_t).reshape(-1, 4)
+        flat = sc.max(axis=1)
+        cls = sc.argmax(axis=1)
+        ok = flat >= score_threshold
+        idx = np.where(ok)[0][:nms_top_k]
+        dec = _decode_deltas(anc[idx], deltas[idx])
+        dec[:, 0::2] = np.clip(dec[:, 0::2], 0, info[1] - 1)
+        dec[:, 1::2] = np.clip(dec[:, 1::2], 0, info[0] - 1)
+        all_boxes.append(dec)
+        all_scores.append(flat[idx])
+        all_cls.append(cls[idx])
+    boxes = np.concatenate(all_boxes)
+    sc = np.concatenate(all_scores)
+    cls = np.concatenate(all_cls)
+    outs = []
+    for c in np.unique(cls):
+        m = cls == c
+        keep = _nms_np(boxes[m], sc[m], nms_threshold)
+        bm, sm = boxes[m][keep], sc[m][keep]
+        outs.extend([np.concatenate([[c + 1.0], [s], b])
+                     for b, s in zip(bm, sm)])
+    outs.sort(key=lambda r: -r[1])
+    out = np.asarray(outs[:keep_top_k], np.float32) if outs else \
+        np.zeros((0, 6), np.float32)
+    return to_tensor(out)
+
+
+def _assign_by_iou(anchors, gts, pos_thresh, neg_thresh):
+    inter_x1 = np.maximum(anchors[:, None, 0], gts[None, :, 0])
+    inter_y1 = np.maximum(anchors[:, None, 1], gts[None, :, 1])
+    inter_x2 = np.minimum(anchors[:, None, 2], gts[None, :, 2])
+    inter_y2 = np.minimum(anchors[:, None, 3], gts[None, :, 3])
+    iw = np.maximum(inter_x2 - inter_x1 + 1, 0)
+    ih = np.maximum(inter_y2 - inter_y1 + 1, 0)
+    inter = iw * ih
+    aa = ((anchors[:, 2] - anchors[:, 0] + 1)
+          * (anchors[:, 3] - anchors[:, 1] + 1))[:, None]
+    bb = ((gts[:, 2] - gts[:, 0] + 1) * (gts[:, 3] - gts[:, 1] + 1))[None, :]
+    iou = inter / (aa + bb - inter)
+    best_gt = iou.argmax(axis=1)
+    best_iou = iou.max(axis=1)
+    labels = np.full(len(anchors), -1, np.int64)      # -1 = ignore
+    labels[best_iou >= pos_thresh] = 1
+    labels[best_iou < neg_thresh] = 0
+    # each gt's best anchor is positive (RPN rule)
+    labels[iou.argmax(axis=0)] = 1
+    return labels, best_gt
+
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, seed=0, name=None):
+    """reference: detection/rpn_target_assign_op.cc — sampled RPN
+    cls/bbox targets. Returns (loc_index, score_index, tgt_label,
+    tgt_bbox, bbox_inside_weight)."""
+    rng = np.random.RandomState(seed)
+    anc = _np(anchors).reshape(-1, 4)
+    gts = _np(gt_boxes).reshape(-1, 4)
+    labels, best_gt = _assign_by_iou(anc, gts, rpn_positive_overlap,
+                                     rpn_negative_overlap)
+    fg = np.where(labels == 1)[0]
+    n_fg = min(int(rpn_batch_size_per_im * rpn_fg_fraction), len(fg))
+    if len(fg) > n_fg:
+        fg = rng.choice(fg, n_fg, replace=False)
+    bg = np.where(labels == 0)[0]
+    n_bg = min(rpn_batch_size_per_im - n_fg, len(bg))
+    if len(bg) > n_bg:
+        bg = rng.choice(bg, n_bg, replace=False)
+    score_idx = np.concatenate([fg, bg])
+    tgt_label = np.concatenate([np.ones(len(fg), np.int32),
+                                np.zeros(len(bg), np.int32)])
+    tgt = np.zeros((len(fg), 4), np.float32)
+    for i, a in enumerate(fg):
+        g = gts[best_gt[a]]
+        aw = anc[a, 2] - anc[a, 0] + 1
+        ah = anc[a, 3] - anc[a, 1] + 1
+        gw = g[2] - g[0] + 1
+        gh = g[3] - g[1] + 1
+        tgt[i] = [((g[0] + gw / 2) - (anc[a, 0] + aw / 2)) / aw,
+                  ((g[1] + gh / 2) - (anc[a, 1] + ah / 2)) / ah,
+                  np.log(gw / aw), np.log(gh / ah)]
+    return (to_tensor(fg.astype(np.int64)),
+            to_tensor(score_idx.astype(np.int64)),
+            to_tensor(tgt_label.reshape(-1, 1)), to_tensor(tgt),
+            to_tensor(np.ones_like(tgt)))
+
+
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, positive_overlap=0.5,
+                            negative_overlap=0.4, name=None):
+    """reference: detection/retinanet_target_assign (rpn_target_assign_op.cc
+    sibling) — focal-loss flavored: all positives kept, no sampling;
+    returns (loc_index, score_index, tgt_label, tgt_bbox, inside_weight,
+    fg_num)."""
+    anc = _np(anchors).reshape(-1, 4)
+    gts = _np(gt_boxes).reshape(-1, 4)
+    glab = _np(gt_labels).reshape(-1)
+    labels, best_gt = _assign_by_iou(anc, gts, positive_overlap,
+                                     negative_overlap)
+    fg = np.where(labels == 1)[0]
+    bg = np.where(labels == 0)[0]
+    score_idx = np.concatenate([fg, bg])
+    tgt_label = np.concatenate([glab[best_gt[fg]].astype(np.int32),
+                                np.zeros(len(bg), np.int32)])
+    tgt = np.zeros((len(fg), 4), np.float32)
+    for i, a in enumerate(fg):
+        g = gts[best_gt[a]]
+        aw = anc[a, 2] - anc[a, 0] + 1
+        ah = anc[a, 3] - anc[a, 1] + 1
+        gw = g[2] - g[0] + 1
+        gh = g[3] - g[1] + 1
+        tgt[i] = [((g[0] + gw / 2) - (anc[a, 0] + aw / 2)) / aw,
+                  ((g[1] + gh / 2) - (anc[a, 1] + ah / 2)) / ah,
+                  np.log(gw / aw), np.log(gh / ah)]
+    return (to_tensor(fg.astype(np.int64)),
+            to_tensor(score_idx.astype(np.int64)),
+            to_tensor(tgt_label.reshape(-1, 1)), to_tensor(tgt),
+            to_tensor(np.ones_like(tgt)),
+            to_tensor(np.asarray([max(len(fg), 1)], np.int32)))
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """reference: detection/roi_perspective_transform_op.cc — warp each
+    quad RoI ([x1..y4], 8 values) to a fixed rectangle by the perspective
+    transform mapping the output grid onto the quad, bilinear sampling."""
+    x = _np(input)
+    quads = _np(rois).reshape(-1, 8) * spatial_scale
+    N, C, H, W = x.shape
+    oh, ow = transformed_height, transformed_width
+    out = np.zeros((len(quads), C, oh, ow), np.float32)
+    dst = np.asarray([[0, 0], [ow - 1, 0], [ow - 1, oh - 1], [0, oh - 1]],
+                     np.float64)
+    for r, q in enumerate(quads):
+        src = q.reshape(4, 2).astype(np.float64)
+        # solve homography dst -> src
+        A, b = [], []
+        for (dx, dy), (sx, sy) in zip(dst, src):
+            A.append([dx, dy, 1, 0, 0, 0, -sx * dx, -sx * dy])
+            b.append(sx)
+            A.append([0, 0, 0, dx, dy, 1, -sy * dx, -sy * dy])
+            b.append(sy)
+        h8 = np.linalg.solve(np.asarray(A), np.asarray(b))
+        Hm = np.append(h8, 1.0).reshape(3, 3)
+        ys, xs = np.mgrid[0:oh, 0:ow]
+        pts = np.stack([xs.ravel(), ys.ravel(), np.ones(oh * ow)])
+        mapped = Hm @ pts
+        mx = mapped[0] / mapped[2]
+        my = mapped[1] / mapped[2]
+        x0 = np.clip(np.floor(mx).astype(int), 0, W - 1)
+        y0 = np.clip(np.floor(my).astype(int), 0, H - 1)
+        x1 = np.clip(x0 + 1, 0, W - 1)
+        y1 = np.clip(y0 + 1, 0, H - 1)
+        fx = np.clip(mx - x0, 0, 1)
+        fy = np.clip(my - y0, 0, 1)
+        inside = ((mx >= -0.5) & (mx <= W - 0.5)
+                  & (my >= -0.5) & (my <= H - 0.5))
+        for c in range(C):
+            img = x[0, c]
+            v = (img[y0, x0] * (1 - fy) * (1 - fx)
+                 + img[y0, x1] * (1 - fy) * fx
+                 + img[y1, x0] * fy * (1 - fx)
+                 + img[y1, x1] * fy * fx)
+            out[r, c] = np.where(inside, v, 0).reshape(oh, ow)
+    return to_tensor(out)
+
+
+@op("target_assign")
+def _target_assign(x, match_indices, default_value):
+    # out[i, j] = x[i, match[i, j]] when matched else default
+    B, P = match_indices.shape
+    safe = jnp.maximum(match_indices, 0)
+    rows = jnp.arange(B)[:, None]
+    gathered = x[rows, safe]
+    matched = (match_indices >= 0)
+    shape = matched.shape + (1,) * (gathered.ndim - 2)
+    out = jnp.where(matched.reshape(shape), gathered, default_value)
+    weight = matched.astype(x.dtype)
+    return out, weight
+
+
+def target_assign(x, match_indices, negative_indices=None, mismatch_value=0.0,
+                  name=None):
+    """reference: detection/target_assign_op.cc — gather per-prior targets
+    by match index; mismatches take mismatch_value, weights mark matches."""
+    return _target_assign(_wrap(x), _wrap(match_indices),
+                          float(mismatch_value))
+
+
+@op("yolov3_loss")
+def _yolov3_loss(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                 class_num, ignore_thresh, downsample_ratio,
+                 use_label_smooth):
+    """reference: detection/yolov3_loss_op.h:77-160 — vectorized over the
+    fixed gt-slot axis so it jits: per gt, best anchor by wh-IoU; location
+    SCE/L1 with (2-wh) scale, objectness with ignore region, class SCE."""
+    N, C, H, W = x.shape
+    na = len(anchor_mask)
+    stride = H * W
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    input_size = downsample_ratio * H
+    xr = x.reshape(N, na, 5 + class_num, H, W)
+    px, py = xr[:, :, 0], xr[:, :, 1]          # [N, na, H, W]
+    pw, ph = xr[:, :, 2], xr[:, :, 3]
+    obj_logit = xr[:, :, 4]
+    cls_logit = xr[:, :, 5:]                   # [N, na, nc, H, W]
+
+    B = gt_box.shape[1]
+    gx, gy = gt_box[..., 0], gt_box[..., 1]    # [N, B] (normalized)
+    gw, gh = gt_box[..., 2], gt_box[..., 3]
+    valid = (gw > 0) & (gh > 0)
+
+    # best anchor per gt by centered wh IoU against ALL anchors
+    inter = (jnp.minimum(gw[..., None] * input_size, an_all[None, None, :, 0])
+             * jnp.minimum(gh[..., None] * input_size,
+                           an_all[None, None, :, 1]))
+    union = (gw[..., None] * input_size * gh[..., None] * input_size
+             + an_all[None, None, :, 0] * an_all[None, None, :, 1] - inter)
+    an_iou = inter / jnp.maximum(union, 1e-10)
+    best_an = jnp.argmax(an_iou, axis=-1)      # [N, B] in all-anchor idx
+    mask_arr = jnp.asarray(anchor_mask)
+    in_mask = (best_an[..., None] == mask_arr[None, None, :])  # [N,B,na]
+    mask_pos = jnp.argmax(in_mask, axis=-1)    # local anchor index
+    responsible = valid & jnp.any(in_mask, axis=-1)
+
+    gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+    tx = gx * W - gi
+    ty = gy * H - gj
+    aw = an_all[best_an, 0]
+    ah = an_all[best_an, 1]
+    tw = jnp.log(jnp.maximum(gw * input_size / aw, 1e-9))
+    th = jnp.log(jnp.maximum(gh * input_size / ah, 1e-9))
+    score = gt_score if gt_score is not None else jnp.ones_like(gx)
+    scale = (2.0 - gw * gh) * score
+
+    def sce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label \
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    bidx = jnp.arange(N)[:, None].repeat(B, 1)
+    sel = (bidx, mask_pos, gj, gi)
+    loc = (sce(px[sel], tx) + sce(py[sel], ty)
+           + jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th)) * scale
+    loc_loss = jnp.sum(jnp.where(responsible, loc, 0.0), axis=1)
+
+    # objectness: positive at responsible cells; negative elsewhere unless
+    # pred-gt IoU > ignore_thresh
+    cx = (jnp.arange(W)[None, None, None, :] + jax.nn.sigmoid(px)) / W
+    cy = (jnp.arange(H)[None, None, :, None] + jax.nn.sigmoid(py)) / H
+    an_l = an_all[mask_arr]                    # [na, 2]
+    bw = jnp.exp(pw) * an_l[None, :, 0, None, None] / input_size
+    bh = jnp.exp(ph) * an_l[None, :, 1, None, None] / input_size
+
+    def box_iou_pred_gt(b):
+        # pred [N, na, H, W] vs gt slot b [N]
+        gx_, gy_, gw_, gh_ = (gt_box[:, b, 0], gt_box[:, b, 1],
+                              gt_box[:, b, 2], gt_box[:, b, 3])
+        e = (None, None, None)
+        ix = (jnp.minimum(cx + bw / 2, (gx_ + gw_ / 2)[(slice(None),) + e])
+              - jnp.maximum(cx - bw / 2, (gx_ - gw_ / 2)[(slice(None),) + e]))
+        iy = (jnp.minimum(cy + bh / 2, (gy_ + gh_ / 2)[(slice(None),) + e])
+              - jnp.maximum(cy - bh / 2, (gy_ - gh_ / 2)[(slice(None),) + e]))
+        inter = jnp.maximum(ix, 0) * jnp.maximum(iy, 0)
+        union = (bw * bh + (gw_ * gh_)[(slice(None),) + e] - inter)
+        return inter / jnp.maximum(union, 1e-10)
+
+    best_pred_iou = jnp.zeros_like(obj_logit)
+    for b in range(B):
+        iou_b = jnp.where(valid[:, b][:, None, None, None],
+                          box_iou_pred_gt(b), 0.0)
+        best_pred_iou = jnp.maximum(best_pred_iou, iou_b)
+
+    obj_target = jnp.zeros_like(obj_logit)
+    obj_score = jnp.zeros_like(obj_logit)
+    resp_f = responsible.astype(x.dtype) * score
+    obj_target = obj_target.at[sel].max(responsible.astype(x.dtype))
+    obj_score = obj_score.at[sel].max(resp_f)
+    ignore = (best_pred_iou > ignore_thresh) & (obj_target == 0)
+    obj_w = jnp.where(obj_target > 0, obj_score,
+                      jnp.where(ignore, 0.0, 1.0))
+    obj_loss = jnp.sum(sce(obj_logit, obj_target) * obj_w, axis=(1, 2, 3))
+
+    # class loss at responsible cells
+    delta = 1.0 / class_num if use_label_smooth else 0.0
+    onehot = jax.nn.one_hot(gt_label, class_num, dtype=x.dtype)
+    onehot = onehot * (1 - delta) + delta * (use_label_smooth * 1.0)
+    cls_at = jnp.moveaxis(cls_logit, 2, -1)[sel]       # [N, B, nc]
+    cls = jnp.sum(sce(cls_at, onehot), axis=-1) * score
+    cls_loss = jnp.sum(jnp.where(responsible, cls, 0.0), axis=1)
+    return loc_loss + obj_loss + cls_loss
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32, gt_score=None,
+                use_label_smooth=True, name=None):
+    """reference: detection/yolov3_loss_op.cc (+ .h kernel). Returns per-
+    image loss [N]."""
+    return _yolov3_loss(_wrap(x), _wrap(gt_box), _wrap(gt_label),
+                        None if gt_score is None else _wrap(gt_score),
+                        tuple(anchors), tuple(anchor_mask), int(class_num),
+                        float(ignore_thresh), int(downsample_ratio),
+                        bool(use_label_smooth))

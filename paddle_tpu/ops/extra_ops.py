@@ -656,3 +656,543 @@ def register_legacy_aliases():
     its ops (conv2d/interpolate/ctc_loss/... live there)."""
     for _new, _old in _ALIASES:
         _alias(_new, _old)
+
+
+# ---------------------------------------------------------------------------
+# round-3 op-tail batch (VERDICT item 2)
+
+@op("add_position_encoding")
+def _add_pos_enc(x, alpha, beta):
+    B, T, D = x.shape
+    half = D // 2
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    k = jnp.arange(half, dtype=jnp.float32)[None, :]
+    denom = jnp.power(10000.0, k / max(half - 1, 1))
+    val = pos / denom
+    pe = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=1)  # [T, D]
+    return alpha * x + beta * pe[None].astype(x.dtype)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """reference: operators/add_position_encoding_op.h:77-89 (first half
+    sin, second half cos, exponent k/(half-1))."""
+    return _add_pos_enc(_wrap(input), float(alpha), float(beta))
+
+
+@op("affine_channel")
+def _affine_channel(x, scale, bias, c_axis):
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """reference: operators/affine_channel_op.cc."""
+    xt = _wrap(x)
+    c_axis = xt.ndim - 1 if data_format == "NHWC" else 1
+    return _affine_channel(xt, _wrap(scale), _wrap(bias), c_axis)
+
+
+@op("bilinear_tensor_product")
+def _bilinear_tp(x, y, w, bias):
+    out = jnp.einsum("bm,omn,bn->bo", x, w, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    """reference: operators/bilinear_tensor_product_op.h:53-68 —
+    out_o = x W_o y^T (+ bias)."""
+    return _bilinear_tp(_wrap(x), _wrap(y), _wrap(weight),
+                        None if bias is None else _wrap(bias))
+
+
+@op("squared_l2_distance")
+def _sq_l2_dist(x, y):
+    d = x - y
+    return jnp.sum(d * d, axis=tuple(range(1, x.ndim)),
+                   keepdims=False)[:, None], d
+
+
+def squared_l2_distance(x, y, name=None):
+    """reference: operators/squared_l2_distance_op.h — rowwise ||x-y||²;
+    returns (distance [B,1], sub) like the reference's (Out, sub_result)."""
+    return _sq_l2_dist(_wrap(x), _wrap(y))
+
+
+@op("modified_huber_loss")
+def _modified_huber(x, y):
+    # y in {0, 1} → {-1, +1}
+    s = 2.0 * y - 1.0
+    z = x * s
+    return jnp.where(z >= 1.0, 0.0,
+                     jnp.where(z >= -1.0, jnp.square(1.0 - z), -4.0 * z))
+
+
+def modified_huber_loss(input, label, name=None):
+    """reference: operators/modified_huber_loss_op.h (classification
+    variant: quadratic in [-1,1), linear below)."""
+    return _modified_huber(_wrap(input), _wrap(label))
+
+
+@op("teacher_student_sigmoid_loss")
+def _ts_sigmoid_loss(x, label, soft_max_up_bound, soft_max_lo_bound):
+    # reference: teacher_student_sigmoid_loss_op.h:43-63 — label encodes
+    # (teacher score z', click z):  -2 → (none, 0); -1 → (none, 1);
+    # [0,1) → (z'=label, 0); [1,2) → (z'=label-1, 1).
+    xc = jnp.clip(x, soft_max_lo_bound, soft_max_up_bound)
+
+    def sce(z):
+        return jnp.maximum(xc, 0.0) - xc * z + jnp.log1p(
+            jnp.exp(-jnp.abs(xc)))
+
+    return jnp.where(
+        label < -1.0, sce(0.0),
+        jnp.where(label < 0.0, sce(1.0),
+                  jnp.where(label < 1.0, sce(0.0) + sce(label),
+                            sce(1.0) + sce(label - 1.0))))
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lo_bound=-15.0, name=None):
+    """reference: operators/teacher_student_sigmoid_loss_op.cc (distill
+    CTR loss; full piecewise hard+soft formula, clamped logits)."""
+    return _ts_sigmoid_loss(_wrap(input), _wrap(label),
+                            float(soft_max_up_bound),
+                            float(soft_max_lo_bound))
+
+
+@op("batch_fc")
+def _batch_fc(x, w, bias):
+    out = jnp.einsum("sbi,sio->sbo", x, w)
+    if bias is not None:
+        out = out + bias[:, None, :]
+    return out
+
+
+def batch_fc(input, w, bias=None, name=None):
+    """reference: operators/batch_fc_op.cc — per-slot FC: input
+    [slot, B, in] @ w [slot, in, out] + bias [slot, out]."""
+    return _batch_fc(_wrap(input), _wrap(w),
+                     None if bias is None else _wrap(bias))
+
+
+@op("nce")
+def _nce(x, label, weight, bias, sampled, num_total_classes):
+    """Sampled classes fixed per batch (uniform sampler): the standard NCE
+    objective with q(y) = 1/num_classes."""
+    num_neg = sampled.shape[0]
+    q = num_neg / num_total_classes
+    true_logit = jnp.sum(x * weight[label], axis=-1)
+    if bias is not None:
+        true_logit = true_logit + bias[label]
+    neg_logit = x @ weight[sampled].T
+    if bias is not None:
+        neg_logit = neg_logit + bias[sampled]
+    # P(data|x) = sigmoid(logit - log(k*q))
+    true_cost = jax.nn.softplus(-(true_logit - jnp.log(q)))
+    neg_cost = jnp.sum(jax.nn.softplus(neg_logit - jnp.log(q)), axis=-1)
+    return true_cost + neg_cost
+
+
+def nce(input, label, weight, bias=None, num_neg_samples=10,
+        num_total_classes=None, sampler="uniform", seed=0, name=None):
+    """reference: operators/nce_op.h — noise-contrastive estimation with a
+    uniform negative sampler (log-uniform/custom samplers of the reference
+    reduce to adjusting q; uniform is the default here). Returns per-sample
+    cost [B]."""
+    from ..core import random as _random
+    if num_total_classes is None:
+        num_total_classes = int(_wrap(weight).shape[0])
+    key = _random.next_key()
+    sampled = jax.random.randint(key, (int(num_neg_samples),), 0,
+                                 num_total_classes)
+    lab = _wrap(label)
+    lab_flat = lab._value.reshape(-1)
+    return _nce(_wrap(input), Tensor(lab_flat), _wrap(weight),
+                None if bias is None else _wrap(bias), Tensor(sampled),
+                int(num_total_classes))
+
+
+@op("hierarchical_sigmoid")
+def _hsigmoid(x, w, label, path_table, path_code, bias):
+    # gather per-sample path node weights: path_table [B, L] node ids
+    # (-1 padding), path_code [B, L] in {0,1}
+    valid = path_table >= 0
+    safe = jnp.maximum(path_table, 0)
+    wn = w[safe]                       # [B, L, D]
+    logit = jnp.einsum("bd,bld->bl", x, wn)
+    if bias is not None:
+        logit = logit + bias[safe]
+    # P(code) = sigmoid(±logit): cost = softplus(logit) - code*logit
+    cost = jax.nn.softplus(logit) - path_code * logit
+    return jnp.sum(jnp.where(valid, cost, 0.0), axis=-1, keepdims=True)
+
+
+def hierarchical_sigmoid(input, weight, label, path_table=None,
+                         path_code=None, bias=None, num_classes=None,
+                         name=None):
+    """reference: operators/hierarchical_sigmoid_op.h — binary-tree softmax.
+    Custom trees come in as (path_table, path_code); the default complete
+    binary tree over num_classes is built from the label's bit path
+    (matching the reference's SimpleCode: node = (id+C)/2^(d+1)-1, code =
+    ((id+C)>>d) & 1)."""
+    x, w = _wrap(input), _wrap(weight)
+    lab = _wrap(label)
+    if path_table is None:
+        C = int(num_classes)
+        L = max(1, int(np.ceil(np.log2(max(C, 2)))))
+        ids = np.asarray(lab.numpy()).reshape(-1).astype(np.int64) + C
+        tbl = np.full((len(ids), L), -1, np.int64)
+        code = np.zeros((len(ids), L), np.float32)
+        for b, v in enumerate(ids):
+            d = 0
+            while (v >> (d + 1)) > 1:
+                tbl[b, d] = (v >> (d + 1)) - 1
+                code[b, d] = float((v >> d) & 1)
+                d += 1
+            tbl[b, d] = (v >> (d + 1)) - 1
+            code[b, d] = float((v >> d) & 1)
+        path_table, path_code = to_tensor(tbl), to_tensor(code)
+    return _hsigmoid(x, w, lab, _wrap(path_table), _wrap(path_code),
+                     None if bias is None else _wrap(bias))
+
+
+@op("hash", differentiable=False)
+def _hash_op(x, mod_by, num_hash):
+    # xxhash-style avalanche over each row of ints, one seed per hash
+    x = x.astype(jnp.uint32)
+    outs = []
+    for seed in range(num_hash):
+        h = jnp.full(x.shape[:-1], 2166136261 ^ (seed * 0x9E3779B1),
+                     jnp.uint32)
+        for j in range(x.shape[-1]):
+            v = x[..., j]
+            h = (h ^ v) * jnp.uint32(16777619)
+            h = h ^ (h >> 15)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return jnp.stack(outs, axis=-1)
+
+
+def hash_op(input, mod_by=100000, num_hash=1, name=None):
+    """reference: operators/hash_op.cc (XXH64 % mod per row, num_hash
+    seeds; here an FNV/xxhash-style avalanche — deterministic and jittable,
+    the contract the reference provides)."""
+    return _hash_op(_wrap(input), int(mod_by), int(num_hash))
+
+
+def pyramid_hash(input, emb_table, min_win=2, max_win=4, mod_by=None,
+                 name=None):
+    """reference: operators/pyramid_hash_op.cc — for every n-gram window
+    (sizes min_win..max_win) hash the id window into the embedding space
+    and sum the gathered rows. input [B, T] ids; emb_table [space, D]."""
+    x = _wrap(input)
+    emb = _wrap(emb_table)
+    space = int(emb.shape[0]) if mod_by is None else int(mod_by)
+    B, T = x.shape
+    total = jnp.zeros((B, T, int(emb.shape[1])), emb._value.dtype)
+    for win in range(min_win, max_win + 1):
+        if win > T:
+            break
+        for start_off in range(T - win + 1):
+            ids = _hash_op(Tensor(x._value[:, start_off:start_off + win]),
+                           space, 1)
+            total = total.at[:, start_off].add(
+                emb._value[ids._value[..., 0]])
+    return Tensor(total)
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    """reference: operators/unique_with_counts_op.cc — (out, index, count)."""
+    from .array_ops import unique
+    out, inverse, counts = unique(x, return_inverse=True, return_counts=True)
+    return out, inverse, counts
+
+
+def py_func(func, x, out_template=None, name=None):
+    """reference: operators/py_func_op.cc — run an arbitrary Python callable
+    as an op. Eagerly it just calls func; under a jit trace it lowers to
+    jax.pure_callback with out_template supplying shape/dtype."""
+    xs = [_wrap(v) for v in (x if isinstance(x, (list, tuple)) else [x])]
+    vals = [v._value for v in xs]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        if out_template is None:
+            raise ValueError("py_func under jit needs out_template "
+                             "(shape/dtype example output)")
+        tmpl = jax.ShapeDtypeStruct(tuple(out_template.shape),
+                                    _wrap(out_template)._value.dtype)
+        out = jax.pure_callback(
+            lambda *a: np.asarray(func(*a)), tmpl, *vals)
+        return Tensor(out)
+    out = func(*[np.asarray(v) for v in vals])
+    return to_tensor(np.asarray(out))
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference: operators/similarity_focus_op.h — per batch item and each
+    selected channel along `axis`, greedily walk positions by descending
+    value, marking each not-yet-used row and column; the output mask sets
+    the full crossing rows/cols across every channel. Host-side (the
+    reference kernel is CPU-only and inherently sequential)."""
+    x = np.asarray(_wrap(input).numpy())
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis=1 only "
+                                  "(the reference supports 1..3; 1 is the "
+                                  "documented use)")
+    N, C, H, W = x.shape
+    out = np.zeros_like(x)
+    for b in range(N):
+        for c in indexes:
+            plane = x[b, c]
+            order = np.argsort(-plane.ravel(), kind="stable")
+            used_r = np.zeros(H, bool)
+            used_c = np.zeros(W, bool)
+            for pos in order:
+                i, j = divmod(int(pos), W)
+                if used_r[i] or used_c[j]:
+                    continue
+                used_r[i] = used_c[j] = True
+                out[b, :, i, :] = 1.0
+                out[b, :, :, j] = 1.0
+                if used_r.all() or used_c.all():
+                    break
+    return to_tensor(out)
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3,
+                   name=None):
+    """reference: operators/rank_attention_op.cc (ads ranking): each
+    instance carries its own rank r_i and the ranks of up to max_rank
+    interacting items; for slot k with rank r_k present, the parameter
+    block at (r_i*max_rank + r_k) multiplies the input row, blocks are
+    summed. input [B, D]; rank_offset [B, 1+2*max_rank]
+    (col0 = own rank, then (index, rank) pairs, -1 = absent);
+    rank_param [max_rank*max_rank*D, out]."""
+    x = _wrap(input)._value
+    ro = np.asarray(_wrap(rank_offset).numpy()).astype(np.int64)
+    p = _wrap(rank_param)._value
+    B, D = x.shape
+    out_dim = p.shape[1]
+    p_blocks = p.reshape(-1, D, out_dim)
+    outs = jnp.zeros((B, out_dim), x.dtype)
+    counts = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        r_i = int(ro[b, 0])
+        if r_i < 0:
+            continue
+        for k in range((ro.shape[1] - 1) // 2):
+            r_k = int(ro[b, 2 + 2 * k]) if 2 + 2 * k < ro.shape[1] else -1
+            if r_k < 0:
+                continue
+            block = (r_i - 1) * max_rank + (r_k - 1)
+            if 0 <= block < p_blocks.shape[0]:
+                outs = outs.at[b].add(x[b] @ p_blocks[block])
+                counts[b] += 1.0
+    return Tensor(outs / jnp.maximum(jnp.asarray(counts), 1.0))
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, name=None):
+    """reference: operators/filter_by_instag_op.h — keep rows whose tag set
+    intersects filter_tag; returns (filtered rows, loss_weight, index map).
+    Host-side (output shape is data-dependent)."""
+    rows = np.asarray(_wrap(ins).numpy())
+    tags = [set(np.asarray(_wrap(t).numpy()).reshape(-1).tolist())
+            for t in (ins_tag if isinstance(ins_tag, (list, tuple))
+                      else [_wrap(ins_tag)])]
+    if len(tags) == 1 and rows.shape[0] > 1:
+        # tag tensor [B, k]
+        arr = np.asarray(_wrap(ins_tag).numpy()).reshape(rows.shape[0], -1)
+        tags = [set(r.tolist()) for r in arr]
+    want = set(np.asarray(_wrap(filter_tag).numpy()).reshape(-1).tolist())
+    keep = [i for i, t in enumerate(tags) if t & want]
+    if not keep:
+        out = np.zeros((1,) + rows.shape[1:], rows.dtype)
+        return (to_tensor(out), to_tensor(np.zeros((1, 1), np.float32)),
+                to_tensor(np.asarray([[-1]], np.int64)))
+    sel = rows[keep]
+    return (to_tensor(sel),
+            to_tensor(np.ones((len(keep), 1), np.float32)),
+            to_tensor(np.asarray(keep, np.int64).reshape(-1, 1)))
+
+
+def beam_search_decode(ids, parents, scores=None, end_id=1, name=None):
+    """reference: operators/beam_search_decode_op.cc — backtrack beam
+    paths into full sentences. ids/parents [T, B, beam] (TensorArray
+    stacked); returns (sentences [T, B, beam], final scores)."""
+    full = gather_tree(ids, parents)
+    if scores is None:
+        return full, None
+    sc = _wrap(scores)
+    return full, (sc if sc._value.ndim == 2 else Tensor(sc._value[-1]))
+
+
+def tdm_child(x, tree_info, child_nums, name=None):
+    """reference: operators/tdm_child_op.cc — gather each node's children
+    from the tree-info table [N, 3 + child_nums] rows
+    (id, layer, parent, children...); returns (child ids, leaf mask)."""
+    ids = _wrap(x)._value.astype(jnp.int32)
+    info = _wrap(tree_info)._value
+    children = info[ids][..., 3:3 + child_nums].astype(jnp.int64)
+    # leaf = child id != 0 and that child has no children itself
+    child_children = info[children.astype(jnp.int32)][..., 3:3 + child_nums]
+    is_leaf = ((children != 0)
+               & (jnp.sum(child_children, axis=-1) == 0)).astype(jnp.int64)
+    return Tensor(children), Tensor(is_leaf)
+
+
+def tdm_sampler(x, travel_list, layer_list, neg_samples_num_list,
+                output_positive=True, seed=0, name=None):
+    """reference: operators/tdm_sampler_op.cc — per positive leaf, walk its
+    ancestor path (travel_list row) and draw negatives from each tree
+    layer (layer_list). Host-side sampling. Returns (out ids, labels,
+    mask) each [B, sum(neg+pos per layer)]."""
+    rng = np.random.RandomState(seed)
+    ids = np.asarray(_wrap(x).numpy()).reshape(-1).astype(np.int64)
+    travel = np.asarray(_wrap(travel_list).numpy())
+    layers = [np.asarray(_wrap(l).numpy()).reshape(-1) for l in layer_list]
+    outs, labels, masks = [], [], []
+    for v in ids:
+        row_o, row_l, row_m = [], [], []
+        path = travel[v]
+        for li, (layer_nodes, n_neg) in enumerate(
+                zip(layers, neg_samples_num_list)):
+            pos = path[li] if li < len(path) else 0
+            if output_positive:
+                row_o.append(int(pos)), row_l.append(1), row_m.append(
+                    0 if pos == 0 else 1)
+            cand = layer_nodes[layer_nodes != pos]
+            # always emit exactly n_neg slots so rows stay rectangular
+            # (reference pads with node 0 / mask 0 when a layer is small)
+            if len(cand) >= n_neg:
+                take = rng.choice(cand, size=n_neg, replace=False)
+                pad = 0
+            else:
+                take = cand
+                pad = n_neg - len(cand)
+            for t in take:
+                row_o.append(int(t)), row_l.append(0), row_m.append(1)
+            for _ in range(pad):
+                row_o.append(0), row_l.append(0), row_m.append(0)
+        outs.append(row_o), labels.append(row_l), masks.append(row_m)
+    return (to_tensor(np.asarray(outs, np.int64)),
+            to_tensor(np.asarray(labels, np.int64)),
+            to_tensor(np.asarray(masks, np.int64)))
+
+
+@op("correlation")
+def _correlation(x1, x2, max_displacement, stride2):
+    d = max_displacement
+    disps = range(-d, d + 1, stride2)
+    planes = []
+    for dy in disps:
+        for dx in disps:
+            shifted = jnp.roll(x2, (-dy, -dx), axis=(2, 3))
+            # zero out wrapped regions
+            H, W = x2.shape[2], x2.shape[3]
+            ii = jnp.arange(H)[:, None] + dy
+            jj = jnp.arange(W)[None, :] + dx
+            ok = ((ii >= 0) & (ii < H) & (jj >= 0) & (jj < W))
+            planes.append(jnp.mean(x1 * jnp.where(ok[None, None], shifted,
+                                                  0.0), axis=1))
+    return jnp.stack(planes, axis=1)
+
+
+def correlation(x1, x2, pad_size=0, kernel_size=1, max_displacement=4,
+                stride1=1, stride2=1, corr_type_multiply=1, name=None):
+    """reference: operators/correlation_op.cc (FlowNet cost volume):
+    out[b, (dy,dx), h, w] = mean_c x1[b,c,h,w] * x2[b,c,h+dy,w+dx].
+    kernel_size=1/stride1=1 (the FlowNet-C configuration)."""
+    if kernel_size != 1 or stride1 != 1:
+        raise NotImplementedError("correlation: kernel_size=1, stride1=1 "
+                                  "(FlowNet-C config) supported")
+    return _correlation(_wrap(x1), _wrap(x2), int(max_displacement),
+                        int(stride2))
+
+
+@op("bilateral_slice")
+def _bilateral_slice(x, grid, guide, has_offset):
+    N, C, H, W = x.shape
+    _, GC, gd, gh, gw = grid.shape
+    # sample grid at (gx, gy, gz) with trilinear interpolation
+    hg = (jnp.arange(H) + 0.5) * gh / H - 0.5
+    wg = (jnp.arange(W) + 0.5) * gw / W - 0.5
+    zg = guide * gd - 0.5                          # [N, H, W]
+    hh = jnp.broadcast_to(hg[:, None], (H, W))
+    ww = jnp.broadcast_to(wg[None, :], (H, W))
+
+    def gather(n, ci, z0, y0, x0):
+        z0 = jnp.clip(z0, 0, gd - 1)
+        y0 = jnp.clip(y0, 0, gh - 1)
+        x0 = jnp.clip(x0, 0, gw - 1)
+        return grid[n, ci, z0, y0, x0]
+
+    def sample(n, ci):
+        z, y, xx_ = zg[n], hh, ww
+        z0, y0, x0 = (jnp.floor(z).astype(jnp.int32),
+                      jnp.floor(y).astype(jnp.int32),
+                      jnp.floor(xx_).astype(jnp.int32))
+        fz, fy, fx = z - z0, y - y0, xx_ - x0
+        out = 0.0
+        for dz in (0, 1):
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    wgt = (jnp.abs(1 - dz - fz) * jnp.abs(1 - dy - fy)
+                           * jnp.abs(1 - dx - fx))
+                    out = out + wgt * gather(n, ci, z0 + dz, y0 + dy,
+                                             x0 + dx)
+        return out
+
+    n_out = GC // (C + 1) if has_offset else GC // C
+    outs = []
+    for n in range(N):
+        ch_outs = []
+        for oc in range(n_out):
+            acc = 0.0
+            for ic in range(C):
+                coef = sample(n, oc * (C + (1 if has_offset else 0)) + ic)
+                acc = acc + coef * x[n, ic]
+            if has_offset:
+                acc = acc + sample(n, oc * (C + 1) + C)
+            ch_outs.append(acc)
+        outs.append(jnp.stack(ch_outs))
+    return jnp.stack(outs)
+
+
+def bilateral_slice(x, grid, guide, has_offset=True, name=None):
+    """reference: operators/bilateral_slice_op.cc (HDRNet): per-pixel
+    affine coefficients trilinearly sliced from a bilateral grid at
+    (x/W, y/H, guide) and applied to the input channels."""
+    return _bilateral_slice(_wrap(x), _wrap(grid), _wrap(guide),
+                            bool(has_offset))
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2, name=None):
+    """reference: operators/tree_conv_op.cc (TBCNN, math/tree2col.cc):
+    each node aggregates its continuous-weighted children patch with three
+    weight matrices (top/left/right mixed by position η). nodes_vector
+    [B, N, D]; edge_set [B, E, 2] (parent, child) int, 0-padded; filter
+    [D, out, 3] packing (W_t, W_l, W_r)."""
+    xs = _wrap(nodes_vector)._value
+    edges = np.asarray(_wrap(edge_set).numpy()).astype(np.int64)
+    w = _wrap(filter)._value
+    B, N, D = xs.shape
+    out_dim = w.shape[1]
+    w_t, w_l, w_r = w[:, :, 0], w[:, :, 1], w[:, :, 2]
+    outs = []
+    for b in range(B):
+        children = {}
+        for p, c in edges[b]:
+            if p == 0 and c == 0:
+                continue
+            children.setdefault(int(p), []).append(int(c))
+        acc = xs[b] @ w_t                     # self/top term
+        upd = jnp.zeros((N, out_dim), xs.dtype)
+        for p, cs in children.items():
+            k = len(cs)
+            for pos, c in enumerate(cs):
+                eta_l = (k - 1 - pos) / max(k - 1, 1)
+                eta_r = 1.0 - eta_l
+                upd = upd.at[p].add(xs[b, c] @ (eta_l * w_l + eta_r * w_r))
+        outs.append(acc + upd)
+    return Tensor(jnp.stack(outs))

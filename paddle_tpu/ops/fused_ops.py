@@ -344,3 +344,198 @@ def _coalesce_tensor(xs):
 def coalesce_tensor(inputs, dtype=None, name=None):
     out = _coalesce_tensor([_wrap(x) for x in inputs])
     return list(out[1:]), out[0]
+
+
+# ---------------------------------------------------------------------------
+# round-3 fusion-surface tail. On TPU these are name-parity compositions:
+# XLA's fusion pass is the mechanism that makes the composed form run as one
+# kernel, which is exactly what the reference's hand-fused CUDA/mkldnn
+# kernels buy (SURVEY.md C18 collapse).
+
+def fc(input, w, bias=None, in_num_col_dims=1, activation=None, name=None):
+    """reference: operators/fc_op.cc — flatten leading dims, xW+b, optional
+    relu."""
+    x = _wrap(input)
+    lead = x.shape[:in_num_col_dims]
+    flat = x._value.reshape(int(np.prod(lead)), -1)
+    out = flat @ _wrap(w)._value
+    if bias is not None:
+        out = out + _wrap(bias)._value
+    if activation == "relu":
+        out = jax.nn.relu(out)
+    return Tensor(out.reshape(tuple(lead) + (out.shape[-1],)))
+
+
+def conv2d_fusion(input, filter, bias=None, residual=None, stride=1,
+                  padding=0, dilation=1, groups=1, activation="relu",
+                  name=None):
+    """reference: operators/fused/conv2d_fusion_op.cc (cudnn conv+bias+
+    (residual add)+activation)."""
+    from ..nn.functional.conv import conv2d
+    out = conv2d(input, filter, bias, stride, padding, dilation, groups)
+    if residual is not None:
+        out = Tensor(_wrap(out)._value + _wrap(residual)._value)
+    if activation == "relu":
+        out = Tensor(jax.nn.relu(_wrap(out)._value))
+    return out
+
+
+def conv2d_inception_fusion(input, filters, biases=None, name=None):
+    """reference: operators/fused/conv2d_inception_fusion_op.cc — four
+    parallel conv branches concatenated on channels (the inception block
+    fusion)."""
+    from ..nn.functional.conv import conv2d
+    outs = []
+    biases = biases or [None] * len(filters)
+    for f, b in zip(filters, biases):
+        k = _wrap(f).shape[-1]
+        outs.append(_wrap(conv2d(input, f, b, padding=k // 2))._value)
+    return Tensor(jnp.concatenate(outs, axis=1))
+
+
+def fused_bn_add_activation(x, y, running_mean, running_var, weight, bias,
+                            momentum=0.9, epsilon=1e-5, activation="relu",
+                            name=None):
+    """reference: operators/fused/fused_bn_add_activation_op.cc —
+    act(BN(x) + y)."""
+    from ..nn.functional.norm import batch_norm
+    out = batch_norm(x, running_mean, running_var, weight, bias,
+                     training=True, momentum=momentum, epsilon=epsilon)
+    z = _wrap(out)._value + _wrap(y)._value
+    if activation == "relu":
+        z = jax.nn.relu(z)
+    return Tensor(z)
+
+
+def fused_embedding_eltwise_layernorm(ids_list, tables, ln_scale, ln_bias,
+                                      epsilon=1e-5, name=None):
+    """reference: operators/fused/fused_embedding_eltwise_layernorm_op.cc —
+    sum of several embedding lookups, layer-normed (the BERT input block)."""
+    acc = None
+    for ids, tbl in zip(ids_list, tables):
+        e = _wrap(tbl)._value[_wrap(ids)._value.astype(jnp.int32)]
+        acc = e if acc is None else acc + e
+    from ..nn.functional.norm import layer_norm
+    return layer_norm(Tensor(acc), acc.shape[-1], ln_scale, ln_bias,
+                      epsilon)
+
+
+def fused_fc_elementwise_layernorm(x, w, y, bias0=None, scale=None,
+                                   bias1=None, epsilon=1e-5, name=None):
+    """reference: operators/fused/fused_fc_elementwise_layernorm_op.cc —
+    layer_norm(fc(x) + y)."""
+    out = fc(x, w, bias0)
+    z = _wrap(out)._value + _wrap(y)._value
+    from ..nn.functional.norm import layer_norm
+    return layer_norm(Tensor(z), z.shape[-1], scale, bias1, epsilon)
+
+
+def fusion_seqconv_eltadd_relu(x, length, filter, bias, context_start=None,
+                               context_length=3, name=None):
+    """reference: operators/fused/fusion_seqconv_eltadd_relu_op.cc."""
+    from .sequence_ops import sequence_conv
+    out = sequence_conv(x, length, filter, context_start, context_length)
+    return Tensor(jax.nn.relu(_wrap(out)._value + _wrap(bias)._value))
+
+
+def fusion_seqexpand_concat_fc(x_list, y_length, w, bias=None,
+                               activation="relu", name=None):
+    """reference: operators/fused/fusion_seqexpand_concat_fc_op.cc — expand
+    the per-sequence rows to y's lengths, concat features, FC."""
+    from .sequence_ops import sequence_expand_as
+    ref = _wrap(x_list[0])._value
+    feats = [ref]
+    for x in x_list[1:]:
+        feats.append(_wrap(sequence_expand_as(x, y_length))._value)
+    cat = jnp.concatenate(feats, axis=-1)
+    out = cat @ _wrap(w)._value
+    if bias is not None:
+        out = out + _wrap(bias)._value
+    if activation == "relu":
+        out = jax.nn.relu(out)
+    return Tensor(out)
+
+
+def fusion_seqpool_cvm_concat(inputs, lengths, cvm, pooltype="sum",
+                              use_cvm=True, name=None):
+    """reference: operators/fused/fusion_seqpool_cvm_concat_op.cc —
+    sequence-pool each input, apply the CVM show/click transform, concat."""
+    from .sequence_ops import sequence_pool
+    from .extra_ops import cvm as cvm_op
+    outs = []
+    for x, ln in zip(inputs, lengths):
+        p = sequence_pool(x, ln, pooltype)
+        outs.append(_wrap(cvm_op(p, cvm, use_cvm))._value)
+    return Tensor(jnp.concatenate(outs, axis=-1))
+
+
+@op("fusion_squared_mat_sub")
+def _fusion_sq_mat_sub(x, y, scalar):
+    return scalar * ((x @ y) ** 2 - (x * x) @ (y * y))
+
+
+def fusion_squared_mat_sub(x, y, scalar=1.0, name=None):
+    """reference: operators/fused/fusion_squared_mat_sub_op.cc —
+    s*((XY)^2 - X^2 Y^2), the FM second-order interaction trick."""
+    return _fusion_sq_mat_sub(_wrap(x), _wrap(y), float(scalar))
+
+
+def fusion_transpose_flatten_concat(inputs, trans_axis, flatten_axis,
+                                    concat_axis=0, name=None):
+    """reference: operators/fused/fusion_transpose_flatten_concat_op.cc."""
+    outs = []
+    for x in inputs:
+        v = jnp.transpose(_wrap(x)._value, trans_axis)
+        lead = int(np.prod(v.shape[:flatten_axis]))
+        outs.append(v.reshape(lead, -1))
+    return Tensor(jnp.concatenate(outs, axis=concat_axis))
+
+
+@op("multihead_matmul")
+def _multihead_matmul(x, w, bias, bias_qk, num_heads, scale):
+    B, T, D = x.shape
+    qkv = x @ w + bias                       # [B, T, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return jnp.moveaxis(t.reshape(B, T, num_heads, D // num_heads),
+                            1, 2)
+    q, k, v = heads(q), heads(k), heads(v)
+    att = (q @ jnp.moveaxis(k, -1, -2)) * scale
+    if bias_qk is not None:
+        att = att + bias_qk
+    att = jax.nn.softmax(att, axis=-1)
+    out = jnp.moveaxis(att @ v, 1, 2).reshape(B, T, D)
+    return out
+
+
+def multihead_matmul(input, w, bias, bias_qk=None, num_heads=1,
+                     scale=1.0, name=None):
+    """reference: operators/fused/multihead_matmul_op.cu — packed-QKV
+    attention (the TRT BERT fusion): one [D, 3D] matmul then scaled
+    dot-product attention."""
+    return _multihead_matmul(_wrap(input), _wrap(w), _wrap(bias),
+                             None if bias_qk is None else _wrap(bias_qk),
+                             int(num_heads), float(scale))
+
+
+@op("skip_layernorm")
+def _skip_layernorm(x, y, scale, bias, eps):
+    z = x + y
+    mean = jnp.mean(z, axis=-1, keepdims=True)
+    var = jnp.var(z, axis=-1, keepdims=True)
+    out = (z - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def skip_layernorm(x, y, scale=None, bias=None, epsilon=1e-5, name=None):
+    """reference: operators/fused/skip_layernorm_op.cc —
+    layer_norm(x + y), the transformer residual fusion."""
+    return _skip_layernorm(_wrap(x), _wrap(y),
+                           None if scale is None else _wrap(scale),
+                           None if bias is None else _wrap(bias),
+                           float(epsilon))

@@ -60,3 +60,231 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
     """reference: metrics/auc_op.cc (batch AUC; the streaming stat
     accumulation lives in paddle_tpu.metric.Auc)."""
     return _auc(_wrap(input), _wrap(label), int(num_thresholds))
+
+
+@op("mean_iou", differentiable=False)
+def _mean_iou(pred, label, num_classes):
+    """reference: operators/mean_iou_op.h — per-class IoU averaged over
+    classes that appear (denominator > 0)."""
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    pred_hist = jnp.bincount(pred, length=num_classes)
+    label_hist = jnp.bincount(label, length=num_classes)
+    correct = jnp.bincount(jnp.where(pred == label, pred, num_classes),
+                           length=num_classes + 1)[:num_classes]
+    denom = pred_hist + label_hist - correct
+    valid = denom > 0
+    iou = jnp.where(valid, correct / jnp.maximum(denom, 1), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    wrong = pred_hist + label_hist - 2 * correct
+    return mean.astype(jnp.float32), wrong, correct
+
+
+def mean_iou(pred, label, num_classes, name=None):
+    return _mean_iou(_wrap(pred), _wrap(label), int(num_classes))
+
+
+@op("precision_recall", differentiable=False)
+def _precision_recall(idx, label, num_classes):
+    """reference: operators/precision_recall_op.h — per-class TP/FP/FN and
+    the 6 batch metrics [macroP, macroR, macroF1, microP, microR, microF1]."""
+    idx = idx.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    tp = jnp.bincount(jnp.where(idx == label, idx, num_classes),
+                      length=num_classes + 1)[:num_classes].astype(jnp.float32)
+    pred_c = jnp.bincount(idx, length=num_classes).astype(jnp.float32)
+    label_c = jnp.bincount(label, length=num_classes).astype(jnp.float32)
+    fp = pred_c - tp
+    fn = label_c - tp
+    prec = jnp.where(pred_c > 0, tp / jnp.maximum(pred_c, 1.0), 0.0)
+    rec = jnp.where(label_c > 0, tp / jnp.maximum(label_c, 1.0), 0.0)
+    f1 = jnp.where(prec + rec > 0, 2 * prec * rec
+                   / jnp.maximum(prec + rec, 1e-12), 0.0)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    tps, fps, fns = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    micro_p = jnp.where(tps + fps > 0, tps / jnp.maximum(tps + fps, 1.0), 0.0)
+    micro_r = jnp.where(tps + fns > 0, tps / jnp.maximum(tps + fns, 1.0), 0.0)
+    micro_f = jnp.where(micro_p + micro_r > 0, 2 * micro_p * micro_r
+                        / jnp.maximum(micro_p + micro_r, 1e-12), 0.0)
+    metrics = jnp.concatenate([macro, jnp.stack([micro_p, micro_r, micro_f])])
+    states = jnp.stack([tp, fp, fn], axis=1)  # [C, 3]
+    return metrics, states
+
+
+def precision_recall(max_ids, labels, num_classes, states=None, name=None):
+    metrics, batch_states = _precision_recall(_wrap(max_ids), _wrap(labels),
+                                              int(num_classes))
+    if states is not None:
+        batch_states = batch_states + _wrap(states)
+    return metrics, batch_states
+
+
+def _extract_chunks(tags, scheme, num_types):
+    """Decode a tag sequence into {(start, end, type)} chunks. Tag layout
+    follows the reference: tag = type_index * num_tag_types + tag_type with
+    IOB: B=0, I=1 / IOE: I=0, E=1 / IOBES: B,I,E,S = 0..3; the 'other' tag
+    is num_types * num_tag_types (chunk_eval_op.h)."""
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    chunks, start, ctype = [], None, None
+    for i, t in enumerate(tags):
+        t = int(t)
+        if t >= num_types * n_tag:  # outside
+            if start is not None:
+                chunks.append((start, i - 1, ctype))
+                start = None
+            continue
+        typ, pos = divmod(t, n_tag)
+        if scheme == "plain":
+            is_begin = ctype != typ or start is None
+            is_end = False
+        elif scheme == "IOB":
+            is_begin = pos == 0
+            is_end = False
+        elif scheme == "IOE":
+            is_begin = False
+            is_end = pos == 1
+        else:  # IOBES
+            is_begin = pos in (0, 3)
+            is_end = pos in (2, 3)
+        if start is None or is_begin or typ != ctype:
+            if start is not None:
+                chunks.append((start, i - 1, ctype))
+            start, ctype = i, typ
+        if scheme in ("IOE", "IOBES") and is_end:
+            chunks.append((start, i, ctype))
+            start = None
+    if start is not None:
+        chunks.append((start, len(tags) - 1, ctype))
+    return set(chunks)
+
+
+def chunk_eval(inference, label, num_chunk_types, chunk_scheme="IOB",
+               seq_length=None, excluded_chunk_types=(), name=None):
+    """reference: operators/chunk_eval_op.h — precision/recall/F1 of chunk
+    extraction from tag sequences. Host-side metric (the reference kernel
+    is CPU-only too). Returns (precision, recall, f1, num_infer, num_label,
+    num_correct)."""
+    import numpy as np
+    inf = np.asarray(_wrap(inference).numpy())
+    lab = np.asarray(_wrap(label).numpy()).reshape(inf.shape)
+    if inf.ndim == 1:
+        # a flat input is ONE sequence; batched [B, T] keeps its rows
+        # (flattening would merge chunks across row boundaries)
+        inf, lab = inf[None], lab[None]
+    if seq_length is not None:
+        inf = inf.reshape(len(seq_length), -1)
+        lab = lab.reshape(inf.shape)
+    lens = ([inf.shape[1]] * inf.shape[0] if seq_length is None
+            else [int(s) for s in np.asarray(seq_length)])
+    n_inf = n_lab = n_cor = 0
+    for row_i, row_l, ln in zip(inf, lab, lens):
+        ci = {c for c in _extract_chunks(row_i[:ln], chunk_scheme,
+                                         num_chunk_types)
+              if c[2] not in excluded_chunk_types}
+        cl = {c for c in _extract_chunks(row_l[:ln], chunk_scheme,
+                                         num_chunk_types)
+              if c[2] not in excluded_chunk_types}
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    tt = to_tensor
+    return (tt(np.float32(p)), tt(np.float32(r)), tt(np.float32(f1)),
+            tt(np.int64(n_inf)), tt(np.int64(n_lab)), tt(np.int64(n_cor)))
+
+
+def positive_negative_pair(score, label, query_id, name=None):
+    """reference: operators/positive_negative_pair_op.h — within each query,
+    count item pairs ordered correctly (positive), wrongly (negative), or
+    tied (neutral) by score vs label. Host-side metric."""
+    import numpy as np
+    s = np.asarray(_wrap(score).numpy()).reshape(-1)
+    l = np.asarray(_wrap(label).numpy()).reshape(-1)
+    q = np.asarray(_wrap(query_id).numpy()).reshape(-1)
+    pos = neg = neu = 0
+    for qid in np.unique(q):
+        idx = np.where(q == qid)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                if l[i] == l[j]:
+                    continue
+                ds = s[i] - s[j]
+                dl = l[i] - l[j]
+                if ds == 0:
+                    neu += 1
+                elif (ds > 0) == (dl > 0):
+                    pos += 1
+                else:
+                    neg += 1
+    return (to_tensor(np.float32(pos)), to_tensor(np.float32(neg)),
+            to_tensor(np.float32(neu)))
+
+
+def detection_map(detect_res, label, num_classes, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_type="integral", name=None):
+    """reference: operators/detection_map_op.h — mean average precision
+    over detection results. detect_res: [M, 6] (class, score, x1, y1, x2,
+    y2); label: [N, 6] (class, x1, y1, x2, y2, difficult) or [N, 5] when
+    every gt is easy. Host-side metric (CPU kernel in the reference too)."""
+    import numpy as np
+    det = np.asarray(_wrap(detect_res).numpy()).reshape(-1, 6)
+    gt = np.asarray(_wrap(label).numpy())
+    gt = gt.reshape(-1, gt.shape[-1])
+
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    aps = []
+    for c in range(num_classes):
+        if c == background_label:
+            continue
+        gts = [g for g in gt if int(g[0]) == c]
+        difficult = [bool(g[5]) if g.shape[0] > 5 else False for g in gts]
+        n_pos = sum(1 for d in difficult if not d) if not evaluate_difficult \
+            else len(gts)
+        dets = sorted([d for d in det if int(d[0]) == c],
+                      key=lambda d: -d[1])
+        if not gts and not dets:
+            continue
+        matched = [False] * len(gts)
+        tps, fps = [], []
+        for d in dets:
+            best, best_i = 0.0, -1
+            for gi, g in enumerate(gts):
+                ov = iou(d[2:6], g[1:5])
+                if ov > best:
+                    best, best_i = ov, gi
+            if best >= overlap_threshold and best_i >= 0:
+                if not evaluate_difficult and difficult[best_i]:
+                    continue  # ignore difficult matches entirely
+                if not matched[best_i]:
+                    matched[best_i] = True
+                    tps.append(1.0), fps.append(0.0)
+                else:
+                    tps.append(0.0), fps.append(1.0)
+            else:
+                tps.append(0.0), fps.append(1.0)
+        if n_pos == 0:
+            continue
+        tp_cum = np.cumsum(tps)
+        fp_cum = np.cumsum(fps)
+        rec = tp_cum / n_pos
+        prec = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+        if ap_type == "11point":
+            ap = float(np.mean([prec[rec >= t].max() if (rec >= t).any()
+                                else 0.0 for t in np.linspace(0, 1, 11)]))
+        else:  # integral
+            ap = float(np.sum(np.diff(np.concatenate([[0.0], rec]))
+                              * prec))
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return to_tensor(np.float32(m))

@@ -12,6 +12,7 @@ and what the OpTest suite verifies against the optimizer classes.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import op
@@ -150,3 +151,78 @@ def decayed_adagrad_step(param, grad, moment, lr, decay=0.95, eps=1e-6):
     """reference: optimizers/decayed_adagrad_op.cc."""
     m2 = decay * moment + (1 - decay) * grad * grad
     return param - lr * grad / (jnp.sqrt(m2) + eps), m2
+
+
+@op("proximal_gd", differentiable=False)
+def proximal_gd_step(param, grad, lr, l1=0.0, l2=0.0):
+    """reference: optimizers/proximal_gd_op.h:47-56 (soft-threshold prox)."""
+    prox = param - lr * grad
+    if l1 > 0:
+        return (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox / (1.0 + lr * l2)
+
+
+@op("proximal_adagrad", differentiable=False)
+def proximal_adagrad_step(param, grad, moment, lr, l1=0.0, l2=0.0):
+    """reference: optimizers/proximal_adagrad_op.h:44-60."""
+    m2 = moment + grad * grad
+    prox = param - lr * grad / jnp.sqrt(m2)
+    if l1 > 0:
+        new_p = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                 / (1.0 + lr * l2))
+    else:
+        new_p = prox / (1.0 + lr * l2)
+    return new_p, m2
+
+
+@op("dpsgd", differentiable=False)
+def dpsgd_step(param, grad, key, lr, clip=10.0, batch_size=16.0, sigma=1.0):
+    """reference: optimizers/dpsgd_op.h — DP-SGD: global-norm clip of the
+    grad plus gaussian noise (the reference draws Box-Muller on CPU; here
+    jax.random over the passed key, which is the TPU-native RNG contract)."""
+    l2 = jnp.sqrt(jnp.sum(grad * grad))
+    scale = jnp.where(l2 > clip, l2 / clip, 1.0)
+    noise = jax.random.normal(key, grad.shape, grad.dtype) * sigma
+    return param - lr * (grad / scale + noise) / batch_size
+
+
+@op("average_accumulates", differentiable=False)
+def _average_accumulates(param, sum_1, sum_2, sum_3, num_updates,
+                         num_accumulates, old_num_accumulates,
+                         average_window, max_average_window,
+                         min_average_window):
+    """reference: average_accumulates_op.h:80-105 (ModelAverage shifting
+    buffers; kMaxNumAccumulates=16384)."""
+    k_max = 16384
+    nu = num_updates + 1
+    na = num_accumulates + 1
+    s1 = sum_1 + param
+    s2 = sum_2
+    s3 = sum_3
+    roll = (nu % k_max) == 0
+    s2 = jnp.where(roll, s2 + s1, s2)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(jnp.asarray(max_average_window, nu.dtype),
+                         nu * average_window)
+    discard = jnp.logical_and(na >= min_average_window, na >= window)
+    s3 = jnp.where(discard, s1 + s2, s3)
+    s1 = jnp.where(discard, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(discard, jnp.zeros_like(s2), s2)
+    ona = jnp.where(discard, na, old_num_accumulates)
+    na = jnp.where(discard, 0, na)
+    return s1, s2, s3, nu, na, ona
+
+
+def average_accumulates(param, in_sum_1, in_sum_2, in_sum_3, num_updates,
+                        num_accumulates, old_num_accumulates,
+                        average_window=0, max_average_window=2 ** 63 - 1,
+                        min_average_window=10000, name=None):
+    from ..core.tensor import Tensor, to_tensor
+
+    def w(x):
+        return x if isinstance(x, Tensor) else to_tensor(x)
+    return _average_accumulates(
+        w(param), w(in_sum_1), w(in_sum_2), w(in_sum_3),
+        w(num_updates), w(num_accumulates), w(old_num_accumulates),
+        average_window, max_average_window, min_average_window)

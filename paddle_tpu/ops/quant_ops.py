@@ -13,6 +13,7 @@ needs under jit.
 """
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -158,3 +159,115 @@ def _dequantize_linear(q, scale, zero_point):
 
 def dequantize_linear(x, scale, zero_point=0.0, name=None):
     return _dequantize_linear(_wrap(x), _wrap(scale), float(zero_point))
+
+
+# ---------------------------------------------------------------------------
+# INT8 transfer ops (reference: operators/quantize_op.cc, dequantize_op.cc,
+# requantize_op.cc — the mkldnn INT8 inference boundary) and the remaining
+# fake_* training-quant tail (fake_quantize_op.cc).
+
+@op("quantize", differentiable=False)
+def _quantize(x, scale, shift):
+    return jnp.round(x * scale + shift).astype(jnp.int32)
+
+
+def quantize(x, scale, shift=0.0, name=None):
+    """reference: operators/quantize_op.cc (fp32 → int with scale/shift)."""
+    return _quantize(_wrap(x), float(scale), float(shift))
+
+
+@op("dequantize", differentiable=False)
+def _dequantize(x, scale, shift):
+    return (x.astype(jnp.float32) - shift) / scale
+
+
+def dequantize(x, scale, shift=0.0, name=None):
+    """reference: operators/dequantize_op.cc."""
+    return _dequantize(_wrap(x), float(scale), float(shift))
+
+
+@op("requantize", differentiable=False)
+def _requantize(x, scale_in, scale_out, shift_in, shift_out):
+    return jnp.round((x.astype(jnp.float32) - shift_in)
+                     * (scale_out / scale_in) + shift_out).astype(jnp.int32)
+
+
+def requantize(x, scale_in, scale_out, shift_in=0.0, shift_out=0.0,
+               name=None):
+    """reference: operators/requantize_op.cc."""
+    return _requantize(_wrap(x), float(scale_in), float(scale_out),
+                       float(shift_in), float(shift_out))
+
+
+@op("dequantize_abs_max", differentiable=False)
+def _dequantize_abs_max(x, scale, max_range):
+    return x.astype(jnp.float32) * (scale / max_range)
+
+
+def dequantize_abs_max(x, scale, max_range=127.0, name=None):
+    """reference: operators/dequantize_abs_max_op.cc (int8 weights back to
+    float via out = in * scale / max_range)."""
+    s = _wrap(scale)._value if not isinstance(scale, float) else scale
+    return _dequantize_abs_max(_wrap(x), s, float(max_range))
+
+
+@op("dequantize_log", differentiable=False)
+def _dequantize_log(x, table):
+    idx = jnp.where(x < 0, x + 128, x).astype(jnp.int32)
+    val = table[idx]
+    return jnp.where(x < 0, -val, val)
+
+
+def dequantize_log(x, dict_table, name=None):
+    """reference: operators/dequantize_log_op.cc (log-table int8 decode:
+    out = sign * dict[|code|])."""
+    return _dequantize_log(_wrap(x), _wrap(dict_table))
+
+
+def fake_dequantize_max_abs(x, scale, max_range=127.0, name=None):
+    """reference: operators/fake_dequantize_op.cc."""
+    return dequantize_abs_max(x, scale, max_range)
+
+
+@op("fake_channel_wise_dequantize_max_abs", differentiable=False)
+def _fcdq_max_abs(x, scales, quant_bits, quant_axis):
+    max_range = float(2 ** (quant_bits - 1) - 1)
+    shape = [1] * x.ndim
+    shape[quant_axis] = x.shape[quant_axis]
+    return x.astype(jnp.float32) * scales.reshape(shape) / max_range
+
+
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=8,
+                                         quant_axis=0, name=None):
+    """reference: operators/fake_dequantize_op.cc (channel-wise variant)."""
+    return _fcdq_max_abs(_wrap(x), _wrap(scales), int(quant_bits),
+                         int(quant_axis))
+
+
+@op("fake_quantize_range_abs_max", differentiable=False)
+def _fq_range_abs_max(x, in_scale, it, window_size, bit_length):
+    bound = float(2 ** (bit_length - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    # window restart every window_size steps, else running max
+    restart = (it % window_size) == 0
+    out_scale = jnp.where(restart, cur, jnp.maximum(in_scale, cur))
+    q = jnp.clip(jnp.round(x / out_scale * bound), -bound, bound)
+    return q, out_scale, it + 1
+
+
+def fake_quantize_range_abs_max(x, in_scale, iter=0, window_size=10000,
+                                bit_length=8, name=None):
+    """reference: fake_quantize_op.cc FakeQuantizeRangeAbsMax — windowed
+    running abs-max scale. Functional: returns (q, new_scale, new_iter)."""
+    it = iter if not isinstance(iter, int) else to_tensor(
+        np.asarray(iter, np.int32))
+    return _fq_range_abs_max(_wrap(x), _wrap(in_scale), _wrap(it),
+                             int(window_size), int(bit_length))
+
+
+def fake_init(shape, value=0.0, dtype="float32", name=None):
+    """reference: operators/fill_constant_op.cc sibling fake_init_op.cc —
+    placeholder init for large-scale-kv tables (PS workers create the var
+    without materializing it; here a full() suffices)."""
+    from .creation import full
+    return full(shape, value, dtype)

@@ -1,0 +1,238 @@
+"""Single-step / fused RNN cell ops.
+
+Reference: operators/gru_unit_op.h (gate layout [update, reset, candidate],
+final combine h = u*(c-h_p)+h_p, origin_mode c+u*(h_p-c)), lstm_unit_op.cc
+(c = sigmoid(f+forget_bias)*c_prev + sigmoid(i)*tanh(g); h = sigmoid(o)*
+tanh(c)), lstmp_op.cc (LSTM with recurrent projection), fused/multi_gru_op.cc
+(stacked bidirectional GRU, an mkldnn fusion), attention_lstm_op.cc,
+fused/fused_embedding_fc_lstm_op.cc.
+
+TPU-native: each unit is a pure jnp function; the sequence-level fusions are
+lax.scan loops — XLA fuses the gate math per step, which is what the
+reference's hand-fused kernels buy on CPU/GPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["gru_unit", "lstm_unit", "lstmp", "multi_gru", "attention_lstm",
+           "fused_embedding_fc_lstm"]
+
+
+def _wrap(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+_ACT = {"identity": lambda x: x, "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh, "relu": jax.nn.relu}
+
+
+@op("gru_unit")
+def _gru_unit(x, h_prev, weight, bias, gate_act, act, origin_mode):
+    d = h_prev.shape[1]
+    gates = x if bias is None else x + bias
+    uh = gates[:, :2 * d] + h_prev @ weight[:, :2 * d]
+    u = _ACT[gate_act](uh[:, :d])
+    r = _ACT[gate_act](uh[:, d:])
+    rhp = r * h_prev
+    c = _ACT[act](gates[:, 2 * d:] + rhp @ weight[:, 2 * d:].reshape(d, d))
+    if origin_mode:
+        h = c + u * (h_prev - c)
+    else:
+        h = u * (c - h_prev) + h_prev
+    return h, rhp, jnp.concatenate([u, r, c], axis=1)
+
+
+def gru_unit(input, hidden_prev, weight, bias=None, activation="tanh",
+             gate_activation="sigmoid", origin_mode=False, name=None):
+    """reference: operators/gru_unit_op.h. input [B, 3D] (x already
+    projected), weight [D, 3D]; returns (hidden, reset_hidden_prev, gate)."""
+    return _gru_unit(_wrap(input), _wrap(hidden_prev), _wrap(weight),
+                     None if bias is None else _wrap(bias),
+                     gate_activation, activation, bool(origin_mode))
+
+
+@op("lstm_unit")
+def _lstm_unit(x, c_prev, forget_bias):
+    d = c_prev.shape[1]
+    i, g, f, o = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev \
+        + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return c, h
+
+
+def lstm_unit(x, c_prev, forget_bias=0.0, name=None):
+    """reference: operators/lstm_unit_op.cc (gate order i, g, f, o in the
+    packed [B, 4D] input)."""
+    return _lstm_unit(_wrap(x), _wrap(c_prev), float(forget_bias))
+
+
+@op("lstmp")
+def _lstmp(x, w, wp, bias, h0, c0, cell_act, gate_act, proj_act):
+    """x [B, T, 4D] (pre-projected input), w [P, 4D] recurrent weight over
+    the projection, wp [D, P] projection weight."""
+    B, T, fourD = x.shape
+    d = fourD // 4
+    p = wp.shape[1]
+    h0 = jnp.zeros((B, p), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((B, d), x.dtype) if c0 is None else c0
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + h @ w
+        if bias is not None:
+            gates = gates + bias
+        i = _ACT[gate_act](gates[:, :d])
+        f = _ACT[gate_act](gates[:, d:2 * d])
+        g = _ACT[cell_act](gates[:, 2 * d:3 * d])
+        o = _ACT[gate_act](gates[:, 3 * d:])
+        c_new = f * c + i * g
+        h_full = o * _ACT[cell_act](c_new)
+        h_proj = _ACT[proj_act](h_full @ wp)
+        return (h_proj, c_new), (h_proj, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0),
+                                    jnp.moveaxis(x, 1, 0))
+    return jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1)
+
+
+def lstmp(input, weight, proj_weight, bias=None, h0=None, c0=None,
+          cell_activation="tanh", gate_activation="sigmoid",
+          proj_activation="identity", name=None):
+    """reference: operators/lstmp_op.cc — LSTM with recurrent projection
+    (Sak et al.); returns (projection [B,T,P], cell [B,T,D])."""
+    return _lstmp(_wrap(input), _wrap(weight), _wrap(proj_weight),
+                  None if bias is None else _wrap(bias),
+                  None if h0 is None else _wrap(h0),
+                  None if c0 is None else _wrap(c0),
+                  cell_activation, gate_activation, proj_activation)
+
+
+def _gru_seq(x, w_ih, w_hh, b, h0, reverse=False):
+    """One GRU direction over [B, T, D_in] with packed weights
+    w_ih [D_in, 3D], w_hh [D, 3D] (update|reset|candidate layout)."""
+    B, T, _ = x.shape
+    d = w_hh.shape[0]
+    h0 = jnp.zeros((B, d), x.dtype) if h0 is None else h0
+    xs = jnp.moveaxis(x, 1, 0)
+    if reverse:
+        xs = xs[::-1]
+
+    def step(h, xt):
+        gates = xt @ w_ih
+        if b is not None:
+            gates = gates + b
+        uh = gates[:, :2 * d] + h @ w_hh[:, :2 * d]
+        u = jax.nn.sigmoid(uh[:, :d])
+        r = jax.nn.sigmoid(uh[:, d:])
+        c = jnp.tanh(gates[:, 2 * d:] + (r * h) @ w_hh[:, 2 * d:]
+                     .reshape(d, d))
+        h_new = u * (c - h) + h
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(step, h0, xs)
+    if reverse:
+        hs = hs[::-1]
+    return jnp.moveaxis(hs, 0, 1)
+
+
+def multi_gru(x, weights_ih, weights_hh, biases=None, layers=1,
+              bidirectional=True, name=None):
+    """reference: operators/fused/multi_gru_op.cc — stacked (optionally
+    bidirectional, outputs concatenated) GRU layers fused over the whole
+    sequence. weights_*: one entry per direction per layer."""
+    x = _wrap(x)._value
+    weights_ih = [_wrap(w)._value for w in weights_ih]
+    weights_hh = [_wrap(w)._value for w in weights_hh]
+    biases = ([None] * len(weights_ih) if biases is None
+              else [None if b is None else _wrap(b)._value for b in biases])
+    per_layer = 2 if bidirectional else 1
+    out = x
+    for layer in range(layers):
+        i = layer * per_layer
+        fwd = _gru_seq(out, weights_ih[i], weights_hh[i], biases[i], None)
+        if bidirectional:
+            bwd = _gru_seq(out, weights_ih[i + 1], weights_hh[i + 1],
+                           biases[i + 1], None, reverse=True)
+            out = jnp.concatenate([fwd, bwd], axis=-1)
+        else:
+            out = fwd
+    return Tensor(out)
+
+
+def attention_lstm(x, lengths, attention_weight, lstm_weight, lstm_bias,
+                   attention_bias=None, name=None):
+    """reference: operators/attention_lstm_op.cc — at each step, attention
+    scores over the whole (masked) sequence pool a context vector that is
+    concatenated with h_prev to drive an LSTM step. x [B, T, D];
+    attention_weight [D + D_h, 1]; lstm_weight [D + P, 4D_h]."""
+    x = _wrap(x)._value
+    lengths = _wrap(lengths)._value
+    aw = _wrap(attention_weight)._value
+    lw = _wrap(lstm_weight)._value
+    lb = _wrap(lstm_bias)._value
+    ab = None if attention_bias is None else _wrap(attention_bias)._value
+    B, T, D = x.shape
+    d4 = lw.shape[1]
+    d = d4 // 4
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])
+
+    def step(carry, _):
+        h, c = carry
+        # attention over all T positions conditioned on current h
+        hx = jnp.concatenate(
+            [x, jnp.broadcast_to(h[:, None, :], (B, T, h.shape[1]))], -1)
+        score = (hx @ aw).squeeze(-1)
+        if ab is not None:
+            score = score + ab.reshape(-1)[0]
+        score = jnp.where(mask, score, -jnp.inf)
+        alpha = jax.nn.softmax(score, axis=-1)
+        ctx = jnp.einsum("bt,btd->bd", alpha, x)
+        gates = jnp.concatenate([ctx, h], -1) @ lw + lb
+        i, f, g, o = jnp.split(jax.nn.sigmoid(gates[:, :2 * d]), 2, 1) + \
+            [jnp.tanh(gates[:, 2 * d:3 * d]),
+             jax.nn.sigmoid(gates[:, 3 * d:])]
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    h0 = jnp.zeros((B, d), x.dtype)
+    c0 = jnp.zeros((B, d), x.dtype)
+    (h, c), hs = jax.lax.scan(step, (h0, c0), None, length=T)
+    return Tensor(jnp.moveaxis(hs, 0, 1)), Tensor(h), Tensor(c)
+
+
+def fused_embedding_fc_lstm(ids, embeddings, lstm_weight, lstm_bias,
+                            h0=None, c0=None, name=None):
+    """reference: operators/fused/fused_embedding_fc_lstm_op.cc — embedding
+    lookup + input projection folded into the embedding table (the fusion's
+    trick), then an LSTM over the sequence. ids [B, T] int; embeddings
+    [V, 4D] (already FC-projected rows); lstm_weight [D, 4D]."""
+    ids = _wrap(ids)._value.astype(jnp.int32)
+    emb = _wrap(embeddings)._value
+    lw = _wrap(lstm_weight)._value
+    lb = _wrap(lstm_bias)._value
+    x = emb[ids]  # [B, T, 4D]
+    B, T, d4 = x.shape
+    d = d4 // 4
+    h = jnp.zeros((B, d), x.dtype) if h0 is None else _wrap(h0)._value
+    c = jnp.zeros((B, d), x.dtype) if c0 is None else _wrap(c0)._value
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + h @ lw + lb
+        i = jax.nn.sigmoid(gates[:, :d])
+        g = jnp.tanh(gates[:, d:2 * d])
+        f = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+        o = jax.nn.sigmoid(gates[:, 3 * d:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (h, c), hs = jax.lax.scan(step, (h, c), jnp.moveaxis(x, 1, 0))
+    return Tensor(jnp.moveaxis(hs, 0, 1)), Tensor(h), Tensor(c)

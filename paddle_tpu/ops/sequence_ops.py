@@ -219,3 +219,261 @@ def edit_distance(input, label, normalized=True, input_length=None,
         out[k, 0] = d / max(len(t), 1) if normalized else d
     seq_num = Tensor(jnp.asarray(np.int64(a.shape[0])))
     return Tensor(jnp.asarray(out)), seq_num
+
+
+# ---------------------------------------------------------------------------
+# sequence tail (reference: operators/sequence_ops/*.cc). All take the
+# (dense [B, T, ...], lengths [B]) ragged rep; LoDTensor-facade callers
+# bridge via core/lod.py to_padded()/from_padded().
+
+@op("sequence_concat")
+def _sequence_concat(xs, lengths):
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + feat, xs[0].dtype)
+    offset = jnp.zeros((B,), lengths[0].dtype)
+    for x, ln in zip(xs, lengths):
+        T = x.shape[1]
+        pos = jnp.arange(T)
+        cols = offset[:, None] + pos[None, :]          # [B, T] target col
+        valid = pos[None, :] < ln[:, None]
+        rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+        cols_c = jnp.where(valid, cols, T_out)         # drop pads
+        out = out.at[rows.reshape(-1), cols_c.reshape(-1)].set(
+            x.reshape((B * T,) + feat), mode="drop")
+        offset = offset + ln
+    return out, offset
+
+
+def sequence_concat(input, lengths, name=None):
+    """reference: sequence_ops/sequence_concat_op.cc — per-sequence
+    concatenation: out_i = concat(x1_i, x2_i, ...). Returns (dense,
+    new_lengths)."""
+    xs = [_wrap(x) for x in input]
+    lns = [_wrap(l) for l in lengths]
+    return _sequence_concat(xs, lns)
+
+
+@op("sequence_conv")
+def _sequence_conv(x, length, w, context_start, context_length):
+    B, T, D = x.shape
+    cols = []
+    for k in range(context_length):
+        shift = context_start + k
+        rolled = jnp.roll(x, -shift, axis=1)
+        pos = jnp.arange(T)
+        src = pos + shift
+        ok = (src >= 0) & (src < length[:, None])
+        cols.append(jnp.where(ok[..., None], rolled, 0.0))
+    ctx = jnp.concatenate(cols, axis=-1)           # [B, T, ctx*D]
+    out = ctx @ w                                  # [B, T, out]
+    mask = jnp.arange(T)[None, :] < length[:, None]
+    return jnp.where(mask[..., None], out, 0.0)
+
+
+def sequence_conv(input, length, filter, context_start=None,
+                  context_length=3, name=None):
+    """reference: sequence_ops/sequence_conv_op.h:37-63 — per-position
+    context window [t+start, t+start+len) (zero pad outside the sequence)
+    times filter [ctx_len*D, out]."""
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    return _sequence_conv(_wrap(input), _wrap(length), _wrap(filter),
+                          int(context_start), int(context_length))
+
+
+@op("sequence_enumerate", differentiable=False)
+def _sequence_enumerate(x, length, win_size, pad_value):
+    B, T = x.shape
+    outs = []
+    pos = jnp.arange(T)
+    for k in range(win_size):
+        rolled = jnp.roll(x, -k, axis=1)
+        ok = (pos[None, :] + k) < length[:, None]
+        outs.append(jnp.where(ok, rolled, pad_value))
+    out = jnp.stack(outs, axis=-1)                 # [B, T, win]
+    valid = pos[None, :] < length[:, None]
+    return jnp.where(valid[..., None], out, pad_value)
+
+
+def sequence_enumerate(input, length, win_size, pad_value=0, name=None):
+    """reference: sequence_ops/sequence_enumerate_op.cc — sliding id
+    windows per position, padded with pad_value past the end."""
+    return _sequence_enumerate(_wrap(input), _wrap(length), int(win_size),
+                               int(pad_value))
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """reference: sequence_ops/sequence_reshape_op.cc — reinterpret each
+    sequence's rows with width new_dim; lengths scale by D/new_dim. Operates
+    on the LoD facade (flat rows) since that is where row-width
+    reinterpretation is exact."""
+    from ..core.lod import LoDTensor
+    if not isinstance(input, LoDTensor):
+        raise TypeError("sequence_reshape expects a LoDTensor "
+                        "(use LoDTensor.from_padded for the dense rep)")
+    flat = input.data
+    D = int(flat.shape[-1])
+    lens = input.recursive_sequence_lengths()[-1]
+    if any((l * D) % new_dim for l in lens):
+        raise ValueError(f"sequence lengths {lens} * width {D} not "
+                         f"divisible by new_dim {new_dim}")
+    new_flat = Tensor(flat._value.reshape(-1, new_dim))
+    new_lens = [l * D // new_dim for l in lens]
+    out = LoDTensor(new_flat)
+    out.set_recursive_sequence_lengths([new_lens])
+    return out
+
+
+@op("sequence_scatter")
+def _sequence_scatter(x, ids, updates, length):
+    B, S = ids.shape
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, S))
+    valid = jnp.arange(S)[None, :] < length[:, None]
+    cols = jnp.where(valid, ids, x.shape[1])
+    return x.at[rows.reshape(-1), cols.reshape(-1)].add(
+        updates.reshape(B * S, *updates.shape[2:]), mode="drop")
+
+
+def sequence_scatter(input, index, updates, length, name=None):
+    """reference: sequence_ops/sequence_scatter_op.cc — per-sequence
+    scatter-add of updates at index positions (index/updates ragged with
+    `length`)."""
+    return _sequence_scatter(_wrap(input), _wrap(index), _wrap(updates),
+                             _wrap(length))
+
+
+def sequence_expand_as(x, y_length, name=None):
+    """reference: sequence_ops/sequence_expand_as_op.cc — row i of x is
+    repeated y_length[i] times: dense [B, maxlen, ...] masked output."""
+    xt = _wrap(x)
+    ln = _wrap(y_length)
+    maxlen = int(np.asarray(jnp.max(ln._value)))
+    out = jnp.repeat(xt._value[:, None], maxlen, axis=1)
+    mask = jnp.arange(maxlen)[None, :] < ln._value[:, None]
+    shape = mask.shape + (1,) * (out.ndim - 2)
+    return Tensor(jnp.where(mask.reshape(shape), out, 0))
+
+
+@op("sequence_topk_avg_pooling")
+def _seq_topk_avg(x, length, topks):
+    B, C, T = x.shape
+    masked = jnp.where(jnp.arange(T)[None, None, :] < length[:, None, None],
+                       x, -jnp.inf)
+    k_max = max(topks)
+    vals = jax.lax.top_k(masked, min(k_max, T))[0]     # [B, C, k_max]
+    vals = jnp.where(jnp.isfinite(vals), vals, 0.0)
+    outs = []
+    for k in topks:
+        kk = min(k, T)
+        # average over min(k, len) valid entries
+        n = jnp.minimum(length, kk).astype(x.dtype)[:, None]
+        outs.append(jnp.sum(vals[:, :, :kk], axis=-1)
+                    / jnp.maximum(n, 1.0))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def sequence_topk_avg_pooling(input, length, topks, name=None):
+    """reference: sequence_ops/sequence_topk_avg_pooling_op.cc — per
+    (batch, channel), mean of the top-k valid values, one block per k."""
+    return _seq_topk_avg(_wrap(input), _wrap(length), tuple(topks))
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """reference: operators/im2sequence_op.cc — image to patch-row
+    sequence: [N, C, H, W] → rows [N, outH*outW, C*kh*kw] with length
+    outH*outW per image (dense rep; every image yields the same length)."""
+    from ..nn.functional.common import unfold as _unfold
+    cols = _unfold(input, kernel_sizes=filter_size, strides=stride,
+                   paddings=padding)                   # [N, C*kh*kw, L]
+    cols = _wrap(cols)
+    out = jnp.moveaxis(cols._value, 1, 2)              # [N, L, C*kh*kw]
+    L = out.shape[1]
+    return Tensor(out), Tensor(jnp.full((out.shape[0],), L, jnp.int64))
+
+
+@op("ctc_align", differentiable=False)
+def _ctc_align(x, length, blank, merge_repeated):
+    B, T = x.shape
+    pos = jnp.arange(T)
+    valid = pos[None, :] < length[:, None]
+    keep = valid & (x != blank)
+    if merge_repeated:
+        prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, x.dtype), x[:, :-1]], axis=1)
+        keep = keep & (x != prev)
+    # stable compaction: target position = #kept before me
+    tgt = jnp.cumsum(keep, axis=1) - 1
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    cols = jnp.where(keep, tgt, T)
+    out = jnp.zeros((B, T), x.dtype).at[
+        rows.reshape(-1), cols.reshape(-1)].set(x.reshape(-1), mode="drop")
+    new_len = jnp.sum(keep, axis=1)
+    return out, new_len
+
+
+def ctc_align(input, length, blank=0, merge_repeated=True, name=None):
+    """reference: operators/ctc_align_op.cc — merge repeats then strip
+    blanks; returns (aligned [B, T] zero-padded, new lengths)."""
+    return _ctc_align(_wrap(input), _wrap(length), int(blank),
+                      bool(merge_repeated))
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    """reference: operators/lod_reset_op.cc — replace the LoD of x with
+    y's LoD (or an explicit offsets list), keeping the data."""
+    from ..core.lod import LoDTensor
+    if y is not None:
+        lod = y.lod()[-1] if isinstance(y, LoDTensor) else \
+            [int(v) for v in np.asarray(_wrap(y).numpy()).reshape(-1)]
+    elif target_lod is not None:
+        lod = [int(v) for v in target_lod]
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    data = x.data if isinstance(x, LoDTensor) else _wrap(x)
+    if lod[0] != 0 or lod[-1] != int(data.shape[0]):
+        raise ValueError(f"target lod {lod} does not cover {data.shape[0]} "
+                         "rows")
+    return LoDTensor(data, [lod])
+
+
+@op("var_conv_2d")
+def _var_conv_2d(x, row_len, col_len, w, stride):
+    N = x.shape[0]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh = jnp.ceil(row_len / stride).astype(jnp.int32)
+    ow = jnp.ceil(col_len / stride).astype(jnp.int32)
+    H, W = out.shape[2], out.shape[3]
+    rmask = jnp.arange(H)[None, :] < oh[:, None]
+    cmask = jnp.arange(W)[None, :] < ow[:, None]
+    mask = rmask[:, None, :, None] & cmask[:, None, None, :]
+    return jnp.where(mask, out, 0.0)
+
+
+def var_conv_2d(input, row_length, col_length, filter, stride=1, name=None):
+    """reference: operators/var_conv_2d_op.cc — conv over per-item
+    variable-size images; dense-padded [N, C, Hmax, Wmax] with per-item
+    (row, col) valid extents, output masked to the strided valid region."""
+    return _var_conv_2d(_wrap(input), _wrap(row_length), _wrap(col_length),
+                        _wrap(filter), int(stride))
+
+
+@op("match_matrix_tensor")
+def _match_matrix(x, x_len, y, y_len, w):
+    # out[b, t, i, j] = x[b,i] @ w[:,t,:] @ y[b,j]
+    xw = jnp.einsum("bid,dte->bite", x, w)
+    out = jnp.einsum("bite,bje->btij", xw, y)
+    mi = jnp.arange(x.shape[1])[None, :] < x_len[:, None]
+    mj = jnp.arange(y.shape[1])[None, :] < y_len[:, None]
+    mask = mi[:, None, :, None] & mj[:, None, None, :]
+    return jnp.where(mask, out, 0.0)
+
+
+def match_matrix_tensor(x, x_length, y, y_length, w, dim_t=None, name=None):
+    """reference: operators/match_matrix_tensor_op.cc — bilinear match
+    planes between two ragged sequences: out[b, t, i, j] = x_i^T W_t y_j."""
+    return _match_matrix(_wrap(x), _wrap(x_length), _wrap(y),
+                         _wrap(y_length), _wrap(w))

@@ -627,3 +627,291 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         out = _shuffle_channel(t, int(groups))
         return Tensor(jnp.transpose(out._value, (0, 2, 3, 1)))
     return _shuffle_channel(t, int(groups))
+
+
+# ---------------------------------------------------------------------------
+# round-3 vision tail
+
+@op("psroi_pool")
+def _psroi_pool(x, rois, roi_batch_id, out_c, out_h, out_w, spatial_scale):
+    """reference: psroi_pool_op.cc — position-sensitive RoI average pool:
+    bin (ph, pw) reads channel group ph*out_w+pw."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+
+    def per_roi(r):
+        box = rois[r] * spatial_scale
+        x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / out_h
+        bin_w = rw / out_w
+        img = x[roi_batch_id[r]]
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        out = jnp.zeros((out_c, out_h, out_w), x.dtype)
+        for ph in range(out_h):
+            for pw in range(out_w):
+                hstart = y1 + ph * bin_h
+                hend = y1 + (ph + 1) * bin_h
+                wstart = x1 + pw * bin_w
+                wend = x1 + (pw + 1) * bin_w
+                m = ((ys[:, None] >= jnp.floor(hstart))
+                     & (ys[:, None] < jnp.ceil(hend))
+                     & (xs[None, :] >= jnp.floor(wstart))
+                     & (xs[None, :] < jnp.ceil(wend)))
+                cnt = jnp.maximum(jnp.sum(m), 1.0)
+                grp = img[(ph * out_w + pw) * out_c:(ph * out_w + pw + 1)
+                          * out_c]
+                out = out.at[:, ph, pw].set(
+                    jnp.sum(jnp.where(m[None], grp, 0.0), axis=(1, 2))
+                    / cnt)
+        return out
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def psroi_pool(x, boxes, boxes_num, output_channels, spatial_scale,
+               pooled_height, pooled_width, name=None):
+    """reference: operators/psroi_pool_op.cc."""
+    xt, bt = _wrap(x), _wrap(boxes)
+    num = _wrap(boxes_num)
+    rid = jnp.asarray(np.repeat(np.arange(num.shape[0]),
+                                np.asarray(num.numpy())))
+    return _psroi_pool(xt, bt, Tensor(rid), int(output_channels),
+                       int(pooled_height), int(pooled_width),
+                       float(spatial_scale))
+
+
+@op("prroi_pool")
+def _prroi_pool(x, rois, roi_batch_id, out_h, out_w, spatial_scale):
+    """reference: prroi_pool_op.cc — Precise RoI pooling: exact integral of
+    the bilinear surface over each bin (here a dense 4x supersampled
+    midpoint quadrature of that integral — differentiable wrt both input
+    and roi coords like the reference)."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    S = 4
+
+    def bilinear(img, yy, xx):
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+        x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+        y1i = jnp.clip(y0i + 1, 0, H - 1)
+        x1i = jnp.clip(x0i + 1, 0, W - 1)
+        ly = jnp.clip(yy - y0, 0.0, 1.0)
+        lx = jnp.clip(xx - x0, 0.0, 1.0)
+        return (img[:, y0i, x0i] * (1 - ly) * (1 - lx)
+                + img[:, y0i, x1i] * (1 - ly) * lx
+                + img[:, y1i, x0i] * ly * (1 - lx)
+                + img[:, y1i, x1i] * ly * lx)
+
+    def per_roi(r):
+        box = rois[r] * spatial_scale
+        x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+        rw = jnp.maximum(x2 - x1, 1e-6)
+        rh = jnp.maximum(y2 - y1, 1e-6)
+        iy = (jnp.arange(out_h * S) + 0.5) / S
+        ix = (jnp.arange(out_w * S) + 0.5) / S
+        ys = y1 + rh / out_h * iy
+        xs = x1 + rw / out_w * ix
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        samp = bilinear(x[roi_batch_id[r]], yy, xx)
+        return samp.reshape(C, out_h, S, out_w, S).mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def prroi_pool(x, boxes, boxes_num, pooled_height, pooled_width,
+               spatial_scale=1.0, name=None):
+    xt, bt = _wrap(x), _wrap(boxes)
+    num = _wrap(boxes_num)
+    rid = jnp.asarray(np.repeat(np.arange(num.shape[0]),
+                                np.asarray(num.numpy())))
+    return _prroi_pool(xt, bt, Tensor(rid), int(pooled_height),
+                       int(pooled_width), float(spatial_scale))
+
+
+@op("deformable_conv")
+def _deformable_conv(x, offset, mask, weight, stride, padding, dilation,
+                     groups, deformable_groups):
+    """reference: deformable_conv_op.cc (v2, modulated) / deformable_conv
+    _v1: for each kernel tap k and output site p, sample the input at
+    p*stride - pad + k*dilation + offset_k(p) bilinearly, scale by the
+    modulation mask, then contract taps x channels with the weight — the
+    im2col-free TPU formulation (gathers + one einsum on the MXU)."""
+    N, C, H, W = x.shape
+    out_c, in_c_per_g, kh, kw = weight.shape
+    _, _, out_h, out_w = offset.shape  # offset [N, 2*dg*kh*kw, oh, ow]
+    dg = deformable_groups
+    off = offset.reshape(N, dg, kh * kw, 2, out_h, out_w)
+    msk = (jnp.ones((N, dg, kh * kw, out_h, out_w), x.dtype)
+           if mask is None else mask.reshape(N, dg, kh * kw, out_h, out_w))
+    base_y = (jnp.arange(out_h) * stride[0] - padding[0])[:, None]
+    base_x = (jnp.arange(out_w) * stride[1] - padding[1])[None, :]
+    cpg = C // dg
+
+    def sample(img, yy, xx):
+        # img [C', H, W]; yy/xx [oh, ow] float. Out-of-bounds corners
+        # contribute zero (per-corner masking, matching the reference's
+        # DmcnIm2colBilinear boundary handling).
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        ly = yy - y0
+        lx = xx - x0
+        acc = 0.0
+        for dy, wy in ((0, (1 - ly)), (1, ly)):
+            for dx, wx in ((0, (1 - lx)), (1, lx)):
+                yi = y0.astype(jnp.int32) + dy
+                xi = x0.astype(jnp.int32) + dx
+                ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                yc = jnp.clip(yi, 0, H - 1)
+                xc = jnp.clip(xi, 0, W - 1)
+                acc = acc + jnp.where(ok[None], img[:, yc, xc], 0.0) \
+                    * (wy * wx)[None]
+        return acc
+
+    def per_image(n):
+        cols = []
+        for g in range(dg):
+            img = x[n, g * cpg:(g + 1) * cpg]
+            taps = []
+            for k in range(kh * kw):
+                ky, kx = divmod(k, kw)
+                yy = base_y + ky * dilation[0] + off[n, g, k, 0]
+                xx = base_x + kx * dilation[1] + off[n, g, k, 1]
+                taps.append(sample(img, yy, xx) * msk[n, g, k][None])
+            cols.append(jnp.stack(taps, axis=1))  # [C', K, oh, ow]
+        return jnp.concatenate(cols, axis=0)      # [C, K, oh, ow]
+
+    col = jax.vmap(per_image)(jnp.arange(N))      # [N, C, K, oh, ow]
+    wg = weight.reshape(groups, out_c // groups, in_c_per_g, kh * kw)
+    colg = col.reshape(N, groups, in_c_per_g, kh * kw, out_h, out_w)
+    out = jnp.einsum("goik,ngikhw->ngohw", wg, colg)
+    return out.reshape(N, out_c, out_h, out_w)
+
+
+def deformable_conv(x, offset, weight, mask=None, bias=None, stride=1,
+                    padding=0, dilation=1, deformable_groups=1, groups=1,
+                    name=None):
+    """reference: operators/deformable_conv_op.cc (+ _v1 when mask=None)."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    out = _deformable_conv(_wrap(x), _wrap(offset),
+                           None if mask is None else _wrap(mask),
+                           _wrap(weight), s, p, d, int(groups),
+                           int(deformable_groups))
+    if bias is not None:
+        out = Tensor(_wrap(out)._value
+                     + _wrap(bias)._value.reshape(1, -1, 1, 1))
+    return out
+
+
+@op("deformable_psroi_pooling")
+def _deform_psroi(x, rois, trans, roi_batch_id, out_c, out_h, out_w,
+                  spatial_scale, trans_std):
+    """Per-BIN deformation: bin (ph, pw) is shifted by its own normalized
+    offset trans[r, :, part_y, part_x] * trans_std * roi_size
+    (deformable_psroi_pooling_op.cu DeformablePSROIPoolForwardKernel)."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    part_h, part_w = trans.shape[2], trans.shape[3]
+
+    def per_roi(r):
+        box = rois[r] * spatial_scale
+        x1, y1 = box[0], box[1]
+        rw = jnp.maximum(box[2] - box[0], 0.1)
+        rh = jnp.maximum(box[3] - box[1], 0.1)
+        bin_h = rh / out_h
+        bin_w = rw / out_w
+        img = x[roi_batch_id[r]]
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        out = jnp.zeros((out_c, out_h, out_w), x.dtype)
+        for ph in range(out_h):
+            for pw in range(out_w):
+                py = min(ph * part_h // out_h, part_h - 1)
+                px = min(pw * part_w // out_w, part_w - 1)
+                dy = trans[r, 0, py, px] * trans_std * rh
+                dx = trans[r, 1, py, px] * trans_std * rw
+                hstart = y1 + ph * bin_h + dy
+                hend = hstart + bin_h
+                wstart = x1 + pw * bin_w + dx
+                wend = wstart + bin_w
+                m = ((ys[:, None] >= jnp.floor(hstart))
+                     & (ys[:, None] < jnp.ceil(hend))
+                     & (xs[None, :] >= jnp.floor(wstart))
+                     & (xs[None, :] < jnp.ceil(wend)))
+                cnt = jnp.maximum(jnp.sum(m), 1.0)
+                grp = img[(ph * out_w + pw) * out_c:(ph * out_w + pw + 1)
+                          * out_c]
+                out = out.at[:, ph, pw].set(
+                    jnp.sum(jnp.where(m[None], grp, 0.0), axis=(1, 2))
+                    / cnt)
+        return out
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def deformable_psroi_pooling(x, rois, trans, boxes_num=None, no_trans=False,
+                             spatial_scale=1.0, output_channels=None,
+                             group_size=1, pooled_height=7, pooled_width=7,
+                             part_size=None, sample_per_part=4,
+                             trans_std=0.1, name=None):
+    """reference: operators/deformable_psroi_pooling_op.cc — PS RoI pooling
+    whose bins are shifted by learned normalized offsets (trans)."""
+    xt = _wrap(x)
+    rt = _wrap(rois)
+    R = int(rt.shape[0])
+    C = int(xt.shape[1])
+    oc = output_channels or C // (pooled_height * pooled_width)
+    if boxes_num is None:
+        rid = jnp.zeros((R,), jnp.int32)
+    else:
+        num = _wrap(boxes_num)
+        rid = jnp.asarray(np.repeat(np.arange(num.shape[0]),
+                                    np.asarray(num.numpy())))
+    if no_trans or trans is None:
+        return _psroi_pool(xt, rt, Tensor(rid), oc, pooled_height,
+                           pooled_width, float(spatial_scale))
+    return _deform_psroi(xt, rt, _wrap(trans), Tensor(rid), oc,
+                         pooled_height, pooled_width, float(spatial_scale),
+                         float(trans_std))
+
+
+def random_crop(x, shape, seed=None, name=None):
+    """reference: operators/random_crop_op.cc — crop the trailing dims to
+    `shape` at a random offset."""
+    from ..core import random as _random
+    xt = _wrap(x)
+    key = _random.next_key()
+    nd = len(shape)
+    lead = xt.shape[:xt._value.ndim - nd]
+    maxs = [int(xt.shape[xt._value.ndim - nd + i]) - int(shape[i])
+            for i in range(nd)]
+    keys = jax.random.split(key, nd)
+    starts = [jax.random.randint(keys[i], (), 0, m + 1) for i, m in
+              enumerate(maxs)]
+    out = jax.lax.dynamic_slice(
+        xt._value,
+        [0] * len(lead) + [s for s in starts],
+        list(lead) + [int(s) for s in shape])
+    return Tensor(out)
+
+
+def spp(x, pyramid_height=3, pool_type="max", name=None):
+    """reference: operators/spp_op.cc — spatial pyramid pooling: levels
+    0..h-1 pool to (2^l x 2^l) bins, flattened and concatenated."""
+    from ..nn.functional.pooling import adaptive_avg_pool2d, \
+        adaptive_max_pool2d
+    xt = _wrap(x)
+    N, C = int(xt.shape[0]), int(xt.shape[1])
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        pooled = (adaptive_max_pool2d(xt, bins) if pool_type == "max"
+                  else adaptive_avg_pool2d(xt, bins))
+        outs.append(_wrap(pooled)._value.reshape(N, C * bins * bins))
+    return Tensor(jnp.concatenate(outs, axis=1))

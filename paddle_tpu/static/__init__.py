@@ -56,3 +56,4 @@ class BuildStrategy:
     fuse_all_optimizer_ops = True
     fuse_elewise_add_act_ops = True
     enable_inplace = True
+from .debug_ops import Print, Assert  # noqa: F401

@@ -18,6 +18,10 @@ os.environ.setdefault("XLA_FLAGS",
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# This JAX build's DEFAULT matmul precision emulates TPU bf16 passes even on
+# the CPU backend (~1e-2 abs error on O(1) f32 matmuls). Tests compare
+# against f64 oracles, so pin the test harness to true f32 dots.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

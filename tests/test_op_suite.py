@@ -566,6 +566,22 @@ TAIL_COVERED = {
     'complex', 'polar', 'logit', 'diff', 'trapezoid',
     'cumulative_trapezoid', 'vander', 'renorm', 'take', 'nan_to_num',
     'signbit', 'ldexp', 'frexp', 'sync_batch_norm',
+    # round-3 op-tail (tests/test_op_tail3.py + test_op_coverage.py gate)
+    'add_position_encoding', 'affine_channel', 'anchor_generator',
+    'average_accumulates', 'batch_fc', 'bilateral_slice',
+    'bilinear_tensor_product', 'box_clip', 'correlation', 'ctc_align',
+    'deformable_conv', 'dequantize', 'dequantize_abs_max',
+    'dequantize_log', 'diag_embed', 'dpsgd',
+    'fake_channel_wise_dequantize_max_abs', 'fake_quantize_range_abs_max',
+    'fusion_squared_mat_sub', 'gru_unit', 'hash',
+    'hierarchical_sigmoid', 'lstm_unit', 'lstmp', 'match_matrix_tensor',
+    'mean_iou', 'modified_huber_loss', 'multihead_matmul', 'nce',
+    'polygon_box_transform', 'precision_recall', 'proximal_adagrad',
+    'proximal_gd', 'prroi_pool', 'psroi_pool', 'quantize', 'requantize',
+    'sequence_concat', 'sequence_conv', 'sequence_enumerate',
+    'sequence_scatter', 'sequence_topk_avg_pooling', 'skip_layernorm',
+    'squared_l2_distance', 'target_assign', 'teacher_student_sigmoid_loss',
+    'tensor_array_to_tensor', 'var_conv_2d', 'yolov3_loss',
 }
 
 

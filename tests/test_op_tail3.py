@@ -1,0 +1,604 @@
+"""Round-3 op-tail tests: the coverage-gate closure batch.
+
+Oracles: numpy/torch manual formulas (the reference verifies these families
+through OpTest CPU kernels); FD grad checks for the differentiable ops via
+the declarative harness.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.testing import OpTestCase, run_case
+from paddle_tpu.ops import (creation, detection_ops, extra_ops, fused_ops,
+                            metrics_ops, optimizer_ops, quant_ops,
+                            rnn_unit_ops, sequence_ops, vision_ops)
+from paddle_tpu.ops import array_ops
+
+rng = np.random.RandomState(11)
+
+
+def r(*shape, lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, size=shape).astype("float32")
+
+
+def t(x, **kw):
+    return paddle.to_tensor(x, **kw)
+
+
+C = OpTestCase
+
+CASES = [
+    C(extra_ops.add_position_encoding, (r(2, 4, 6),), dict(alpha=1.0,
+      beta=1.0), grad=(0,), op_types=["add_position_encoding"]),
+    C(extra_ops.affine_channel, (r(2, 3, 2, 2), r(3), r(3)), ref=lambda x,
+      s, b: x * s[None, :, None, None] + b[None, :, None, None],
+      grad=(0, 1, 2), op_types=["affine_channel"]),
+    C(extra_ops.bilinear_tensor_product, (r(2, 3), r(2, 4), r(5, 3, 4)),
+      ref=lambda x, y, w: np.einsum("bm,omn,bn->bo", x, w, y),
+      grad=(0, 1, 2), atol=1e-2, rtol=1e-2,
+      op_types=["bilinear_tensor_product"]),
+    C(extra_ops.modified_huber_loss,
+      (np.array([2.0, 0.5, -2.0], np.float32),
+       np.array([1.0, 1.0, 1.0], np.float32)),
+      ref=lambda x, y: np.array([0.0, 0.25, 8.0]),
+      op_types=["modified_huber_loss"]),
+    C(extra_ops.batch_fc, (r(2, 3, 4), r(2, 4, 5), r(2, 5)),
+      ref=lambda x, w, b: np.einsum("sbi,sio->sbo", x, w) + b[:, None],
+      grad=(0, 1, 2), atol=1e-2, rtol=1e-2, op_types=["batch_fc"]),
+    C(extra_ops.squared_l2_distance, (r(3, 4), r(3, 4)),
+      ref=lambda x, y: ((x - y) ** 2).sum(1)[:, None], grad=(0, 1),
+      op_types=["squared_l2_distance"]),
+    C(fused_ops.fusion_squared_mat_sub, (r(2, 3), r(3, 4)),
+      ref=lambda x, y: (x @ y) ** 2 - (x ** 2) @ (y ** 2),
+      atol=5e-2, rtol=5e-2, grad=(0, 1), grad_atol=5e-2,
+      op_types=["fusion_squared_mat_sub"]),
+    C(fused_ops.skip_layernorm, (r(2, 3, 8), r(2, 3, 8)),
+      grad=(0, 1), op_types=["skip_layernorm"]),
+    C(creation.diag_embed, (r(2, 3),),
+      ref=lambda x: torch.diag_embed(torch.tensor(x)).numpy(),
+      grad=(0,), op_types=["diag_embed"]),
+    C(detection_ops.polygon_box_transform, (r(1, 2, 2, 3),),
+      op_types=["polygon_box_transform"]),
+    C(detection_ops.box_clip,
+      (np.array([[-5., -5., 300., 200.]], np.float32),
+       np.array([100., 150., 1.], np.float32)),
+      ref=lambda b, i: np.array([[0., 0., 149., 99.]]),
+      op_types=["box_clip"]),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.name)
+def test_tail3_case(case):
+    run_case(case)
+
+
+def test_sequence_tail_round3():
+    x1 = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    x2 = 100 + np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+    out, lens = sequence_ops.sequence_concat(
+        [t(x1), t(x2)], [t(np.array([2, 3])), t(np.array([1, 2]))])
+    np.testing.assert_array_equal(lens.numpy(), [3, 5])
+    np.testing.assert_allclose(out.numpy()[0][:3],
+                               np.concatenate([x1[0, :2], x2[0, :1]]))
+    np.testing.assert_allclose(out.numpy()[1][:5],
+                               np.concatenate([x1[1, :3], x2[1, :2]]))
+
+    # sequence_conv vs manual context-window matmul
+    x = r(1, 4, 2)
+    w = r(6, 3)
+    out = sequence_ops.sequence_conv(t(x), t(np.array([4])), t(w),
+                                     context_start=-1, context_length=3)
+    ctx = np.zeros((4, 6), np.float32)
+    for i in range(4):
+        for k in range(3):
+            j = i - 1 + k
+            if 0 <= j < 4:
+                ctx[i, k * 2:(k + 1) * 2] = x[0, j]
+    np.testing.assert_allclose(out.numpy()[0], ctx @ w, rtol=1e-4,
+                               atol=1e-4)
+
+    e = sequence_ops.sequence_enumerate(t(np.array([[1, 2, 3, 0]])),
+                                        t(np.array([3])), 2, pad_value=0)
+    np.testing.assert_array_equal(e.numpy()[0],
+                                  [[1, 2], [2, 3], [3, 0], [0, 0]])
+
+    sc = sequence_ops.sequence_scatter(
+        t(np.zeros((2, 5), np.float32)), t(np.array([[1, 3], [0, 0]])),
+        t(np.array([[1., 2.], [5., 9.]], np.float32)), t(np.array([2, 1])))
+    np.testing.assert_allclose(sc.numpy(), [[0, 1, 0, 2, 0],
+                                            [5, 0, 0, 0, 0]])
+
+    ea = sequence_ops.sequence_expand_as(
+        t(np.array([[7.], [8.]], np.float32)), t(np.array([2, 3])))
+    np.testing.assert_allclose(ea.numpy()[:, :, 0],
+                               [[7, 7, 0], [8, 8, 8]])
+
+    tk = sequence_ops.sequence_topk_avg_pooling(
+        t(np.array([[[5., 1., 3., 0.]]], np.float32)), t(np.array([3])),
+        [1, 2])
+    np.testing.assert_allclose(tk.numpy()[0], [5.0, 4.0])
+
+    al, ln = sequence_ops.ctc_align(t(np.array([[1, 1, 0, 2, 2]])),
+                                    t(np.array([5])), blank=0)
+    np.testing.assert_array_equal(al.numpy()[0][:2], [1, 2])
+    assert int(ln.numpy()[0]) == 2
+
+    rows, lens = sequence_ops.im2sequence(t(r(1, 1, 4, 4)), 2, 2)
+    assert rows.shape == [1, 4, 4] and int(lens.numpy()[0]) == 4
+
+    vc = sequence_ops.var_conv_2d(t(r(2, 1, 4, 4)), t(np.array([4, 2])),
+                                  t(np.array([4, 3])), t(r(2, 1, 3, 3)))
+    assert vc.shape == [2, 2, 4, 4]
+    # masked region beyond valid extent is zero
+    assert float(np.abs(vc.numpy()[1, :, 2:, :]).max()) == 0.0
+
+    mm = sequence_ops.match_matrix_tensor(
+        t(r(2, 3, 4)), t(np.array([3, 2])), t(r(2, 5, 4)),
+        t(np.array([5, 4])), t(r(4, 2, 4)))
+    assert mm.shape == [2, 2, 3, 5]
+
+
+def test_lod_facade_roundtrip():
+    from paddle_tpu.core.lod import LoDTensor, create_lod_tensor
+    lt = create_lod_tensor(np.arange(10, dtype=np.float32).reshape(5, 2),
+                           [[2, 3]])
+    assert lt.lod() == [[0, 2, 5]]
+    assert lt.recursive_sequence_lengths() == [[2, 3]]
+    dense, lens = lt.to_padded()
+    back = LoDTensor.from_padded(dense, lens)
+    np.testing.assert_allclose(back.numpy(), lt.numpy())
+    assert back.lod() == [[0, 2, 5]]
+    # invalid lod rejected
+    with pytest.raises(ValueError):
+        lt.set_lod([[1, 2]])
+
+    # lod_reset + sequence_reshape on the facade
+    reset = sequence_ops.lod_reset(lt, target_lod=[0, 1, 5])
+    assert reset.lod() == [[0, 1, 5]]
+    with pytest.raises(ValueError):
+        sequence_ops.lod_reset(lt, target_lod=[0, 2])
+    rs = sequence_ops.sequence_reshape(lt, 1)
+    assert rs.shape[0] == 10 and rs.recursive_sequence_lengths() == [[4, 6]]
+
+    # array <-> lod conversions
+    arr = array_ops.lod_tensor_to_array(lt)
+    assert len(arr) == 2 and arr[0].shape == [2, 2]
+    lt2 = array_ops.array_to_lod_tensor(arr, t(np.array([2, 3])))
+    np.testing.assert_allclose(lt2.numpy(), lt.numpy())
+    assert lt2.lod() == [[0, 2, 5]]
+    full, sizes = array_ops.tensor_array_to_tensor(arr, axis=0)
+    assert full.shape == [5, 2]
+    np.testing.assert_array_equal(sizes.numpy(), [2, 3])
+
+
+def test_rnn_units():
+    B, D = 3, 4
+    x = r(B, 3 * D)
+    hp = r(B, D)
+    w = r(D, 3 * D)
+    h, rhp, g = rnn_unit_ops.gru_unit(t(x), t(hp), t(w))
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    uh = x[:, :2 * D] + hp @ w[:, :2 * D]
+    u, rr = sig(uh[:, :D]), sig(uh[:, D:])
+    c = np.tanh(x[:, 2 * D:] + (rr * hp) @ w[:, 2 * D:].reshape(D, D))
+    np.testing.assert_allclose(h.numpy(), u * (c - hp) + hp, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(rhp.numpy(), rr * hp, rtol=1e-4, atol=1e-4)
+
+    x4, cp = r(B, 4 * D), r(B, D)
+    c2, h2 = rnn_unit_ops.lstm_unit(t(x4), t(cp), forget_bias=1.0)
+    i, gg, f, o = np.split(x4, 4, 1)
+    cref = sig(f + 1.0) * cp + sig(i) * np.tanh(gg)
+    np.testing.assert_allclose(c2.numpy(), cref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(h2.numpy(), sig(o) * np.tanh(cref),
+                               rtol=1e-4, atol=1e-4)
+
+    T, P = 5, 3
+    proj, cell = rnn_unit_ops.lstmp(t(r(B, T, 4 * D)), t(r(P, 4 * D)),
+                                    t(r(D, P)))
+    assert proj.shape == [B, T, P] and cell.shape == [B, T, D]
+
+    out = rnn_unit_ops.multi_gru(t(r(B, T, 4)),
+                                 [r(4, 3 * D), r(4, 3 * D)],
+                                 [r(D, 3 * D), r(D, 3 * D)])
+    assert out.shape == [B, T, 2 * D]
+
+    hs, h, c = rnn_unit_ops.attention_lstm(
+        t(r(B, T, D)), t(np.array([5, 3, 4])), t(r(2 * D, 1)),
+        t(r(2 * D, 4 * D)), t(r(4 * D)))
+    assert hs.shape == [B, T, D] and np.isfinite(hs.numpy()).all()
+
+    ids = rng.randint(0, 7, (B, T))
+    hs, h, c = rnn_unit_ops.fused_embedding_fc_lstm(
+        t(ids), t(r(7, 4 * D)), t(r(D, 4 * D)), t(r(4 * D)))
+    assert hs.shape == [B, T, D]
+
+
+def test_optimizer_tail_round3():
+    import jax.numpy as jnp
+    import jax
+    p = jnp.asarray(np.array([1.0, -2.0], np.float32))
+    g = jnp.asarray(np.array([0.5, 0.5], np.float32))
+    out = optimizer_ops.proximal_gd_step(p, g, 0.1, l1=1.0, l2=0.1)
+    prox = np.array([0.95, -2.05])
+    ref = np.sign(prox) * np.maximum(np.abs(prox) - 0.1, 0) / 1.01
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    newp, m2 = optimizer_ops.proximal_adagrad_step(p, g, jnp.zeros(2), 0.1,
+                                                   l1=0.5)
+    np.testing.assert_allclose(m2.numpy(), [0.25, 0.25], rtol=1e-6)
+    prox = np.asarray(p) - 0.1 * np.asarray(g) / np.sqrt([0.25, 0.25])
+    ref = np.sign(prox) * np.maximum(np.abs(prox) - 0.05, 0)
+    np.testing.assert_allclose(newp.numpy(), ref, rtol=1e-5)
+
+    d = optimizer_ops.dpsgd_step(p, g, jax.random.PRNGKey(0), 0.1)
+    assert d.shape == [2] and np.isfinite(d.numpy()).all()
+
+    z = jnp.zeros(2)
+    i64 = lambda v: jnp.asarray(v, jnp.int64)
+    s1, s2, s3, nu, na, ona = optimizer_ops.average_accumulates(
+        p, z, z, z, i64(0), i64(0), i64(0), average_window=1,
+        min_average_window=1)
+    # first step: na=1 >= min and >= nu*window → discard into sum_3
+    np.testing.assert_allclose(s3.numpy(), np.asarray(p))
+    assert int(na.numpy()) == 0 and int(ona.numpy()) == 1
+
+
+def test_metrics_tail_round3():
+    pred = np.array([0, 0, 1, 1, 2])
+    lab = np.array([0, 1, 1, 1, 2])
+    miou, wrong, correct = metrics_ops.mean_iou(t(pred), t(lab), 3)
+    np.testing.assert_allclose(float(miou.numpy()),
+                               np.mean([0.5, 2 / 3, 1.0]), rtol=1e-5)
+
+    met, st = metrics_ops.precision_recall(t(np.array([0, 1, 1])),
+                                           t(np.array([0, 1, 0])), 2)
+    # class0: p=1, r=1/2; class1: p=1/2, r=1 → macro p .75 r .75
+    np.testing.assert_allclose(met.numpy()[:2], [0.75, 0.75], rtol=1e-5)
+    assert st.shape == [2, 3]
+
+    p, rr, f1, ni, nl, nc = metrics_ops.chunk_eval(
+        t(np.array([[0, 1, 2, 0]])), t(np.array([[0, 1, 2, 2]])), 1)
+    assert (float(p.numpy()), float(rr.numpy())) == (0.5, 1.0)
+    assert (int(ni.numpy()), int(nl.numpy()), int(nc.numpy())) == (2, 1, 1)
+
+    pos, neg, neu = metrics_ops.positive_negative_pair(
+        t(np.array([0.9, 0.5, 0.3], np.float32)),
+        t(np.array([2, 1, 0], np.float32)), t(np.array([1, 1, 1])))
+    assert (float(pos.numpy()), float(neg.numpy()),
+            float(neu.numpy())) == (3.0, 0.0, 0.0)
+
+    det = np.array([[1, 0.9, 0, 0, 2, 2]], np.float32)
+    gt = np.array([[1, 0, 0, 2, 2, 0]], np.float32)
+    assert float(metrics_ops.detection_map(t(det), t(gt), 2).numpy()) == 1.0
+
+
+def test_quant_tail_round3():
+    x = r(3, 4)
+    q = quant_ops.quantize(t(x), 127.0)
+    dq = quant_ops.dequantize(q, 127.0)
+    np.testing.assert_allclose(dq.numpy(), x, atol=1 / 127)
+    rq = quant_ops.requantize(q, 127.0, 63.0)
+    assert rq.numpy().dtype == np.int32
+
+    w8 = rng.randint(-127, 128, (3, 4)).astype(np.int8)
+    d = quant_ops.dequantize_abs_max(t(w8.astype(np.int32)), 2.0, 127.0)
+    np.testing.assert_allclose(d.numpy(), w8 * 2.0 / 127.0, rtol=1e-6)
+
+    table = np.exp2(np.arange(128)).astype(np.float32)
+    dl = quant_ops.dequantize_log(t(np.array([3, -2], np.int32)), t(table))
+    np.testing.assert_allclose(dl.numpy(), [8.0, -np.exp2(126)])
+
+    scales = np.array([1.0, 2.0], np.float32)
+    fc = quant_ops.fake_channel_wise_dequantize_max_abs(
+        t(np.array([[127, 127], [64, 64]], np.int32).T), t(scales),
+        quant_bits=8, quant_axis=0)
+    np.testing.assert_allclose(fc.numpy()[:, 0], [1.0, 2.0], rtol=1e-5)
+    np.testing.assert_allclose(fc.numpy()[:, 1],
+                               [64 / 127, 2.0 * 64 / 127], rtol=1e-5)
+
+    qq, sc, it = quant_ops.fake_quantize_range_abs_max(
+        t(np.array([1.0, -3.0], np.float32)), t(np.float32(2.0)), iter=0)
+    np.testing.assert_allclose(float(sc.numpy()), 3.0)
+    assert int(it.numpy()) == 1
+
+    fi = quant_ops.fake_init([2, 3], 0.0)
+    assert fi.shape == [2, 3]
+
+
+def test_hash_and_misc_extra():
+    h1 = extra_ops.hash_op(t(np.array([[1, 2], [3, 4]])), 1000, 2)
+    h2 = extra_ops.hash_op(t(np.array([[1, 2], [3, 4]])), 1000, 2)
+    assert h1.shape == [2, 2]
+    np.testing.assert_array_equal(h1.numpy(), h2.numpy())
+    assert (h1.numpy() >= 0).all() and (h1.numpy() < 1000).all()
+    assert not (h1.numpy()[0] == h1.numpy()[1]).all()
+
+    ph = extra_ops.pyramid_hash(t(np.array([[1, 2, 3, 4]])), t(r(50, 6)),
+                                min_win=2, max_win=3)
+    assert ph.shape == [1, 4, 6]
+
+    u, idx, cnt = extra_ops.unique_with_counts(t(np.array([2, 1, 2, 3])))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+    np.testing.assert_array_equal(cnt.numpy(), [1, 2, 1])
+
+    out = extra_ops.py_func(lambda a: a * 2, t(np.ones(3, np.float32)))
+    np.testing.assert_allclose(out.numpy(), 2 * np.ones(3))
+
+    sf = extra_ops.similarity_focus(t(r(1, 3, 4, 4)), 1, [0])
+    assert set(np.unique(sf.numpy())) <= {0.0, 1.0}
+
+    ra = extra_ops.rank_attention(t(r(2, 3)),
+                                  t(np.array([[1, 0, 1], [2, 0, 2]])),
+                                  t(r(9 * 3, 4)), max_rank=3)
+    assert ra.shape == [2, 4]
+
+    rows, lw, im = extra_ops.filter_by_instag(
+        t(r(3, 2)), t(np.array([[1], [2], [3]])), t(np.array([2])))
+    assert rows.shape == [1, 2] and im.numpy().ravel().tolist() == [1]
+
+    info = np.array([[0, 0, 0, 0, 0], [1, 0, 0, 2, 3], [2, 1, 1, 0, 0],
+                     [3, 1, 1, 0, 0]], np.int64)
+    ch, leaf = extra_ops.tdm_child(t(np.array([1])), t(info), 2)
+    np.testing.assert_array_equal(ch.numpy()[0], [2, 3])
+    np.testing.assert_array_equal(leaf.numpy()[0], [1, 1])
+
+    outs, labels, mask = extra_ops.tdm_sampler(
+        t(np.array([2, 3])), t(info[:, 2:3].repeat(2, 1)[:, :1]),
+        [t(np.array([1])), t(np.array([2, 3]))], [0, 1])
+    assert outs.shape[0] == 2
+
+    # nce decreases for the true class direction + grad flows
+    paddle.seed(5)
+    xn = t(r(4, 8), stop_gradient=False)
+    cost = extra_ops.nce(xn, t(np.array([1, 2, 0, 3])), t(r(10, 8)),
+                         num_neg_samples=5)
+    assert cost.shape == [4]
+    cost.sum().backward()
+    assert np.isfinite(xn.grad.numpy()).all()
+
+    hs = extra_ops.hierarchical_sigmoid(t(r(3, 8)), t(r(7, 8)),
+                                        t(np.array([0, 3, 7])),
+                                        num_classes=8)
+    assert hs.shape == [3, 1] and (hs.numpy() > 0).all()
+
+    x1, x2 = r(1, 3, 5, 5), r(1, 3, 5, 5)
+    c = extra_ops.correlation(t(x1), t(x2), max_displacement=1)
+    assert c.shape == [1, 9, 5, 5]
+    np.testing.assert_allclose(c.numpy()[0, 4], (x1[0] * x2[0]).mean(0),
+                               rtol=1e-4, atol=1e-5)
+
+    g = r(1, 8, 2, 3, 3)
+    guide = rng.rand(1, 5, 5).astype(np.float32)
+    bs = extra_ops.bilateral_slice(t(x1), t(g), t(guide), has_offset=True)
+    assert bs.shape == [1, 2, 5, 5] and np.isfinite(bs.numpy()).all()
+
+    tc = extra_ops.tree_conv(t(r(1, 4, 3)),
+                             t(np.array([[[1, 2], [1, 3], [0, 0]]])),
+                             t(r(3, 5, 3)))
+    assert tc.shape == [1, 4, 5]
+
+    full, sc = extra_ops.beam_search_decode(
+        t(np.array([[[1, 2]], [[3, 4]], [[5, 6]]])),
+        t(np.array([[[0, 0]], [[0, 0]], [[1, 0]]])),
+        t(np.zeros((1, 2), np.float32)))
+    np.testing.assert_array_equal(full.numpy()[:, 0, 0], [1, 4, 5])
+
+
+def test_fused_tail_round3():
+    x, w, b = r(2, 3, 4), r(4, 5), r(5)
+    out = fused_ops.fc(t(x), t(w), t(b), in_num_col_dims=2,
+                       activation="relu")
+    ref = np.maximum(x @ w + b, 0)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-2, atol=1e-2)
+
+    img = r(1, 3, 6, 6)
+    cf = fused_ops.conv2d_fusion(t(img), t(r(4, 3, 3, 3)), t(r(4)),
+                                 padding=1)
+    assert cf.shape == [1, 4, 6, 6] and (cf.numpy() >= 0).all()
+
+    ic = fused_ops.conv2d_inception_fusion(
+        t(img), [t(r(2, 3, 1, 1)), t(r(2, 3, 3, 3))])
+    assert ic.shape == [1, 4, 6, 6]
+
+    rm = t(np.zeros(3, np.float32))
+    rv = t(np.ones(3, np.float32))
+    ba = fused_ops.fused_bn_add_activation(t(r(2, 3, 4, 4)), t(r(2, 3, 4,
+                                           4)), rm, rv, t(np.ones(3,
+                                           np.float32)), t(np.zeros(3,
+                                           np.float32)))
+    assert (ba.numpy() >= 0).all()
+
+    e1 = fused_ops.fused_embedding_eltwise_layernorm(
+        [t(np.array([[0, 1]])), t(np.array([[1, 0]]))],
+        [t(r(4, 6)), t(r(4, 6))], t(np.ones(6, np.float32)),
+        t(np.zeros(6, np.float32)))
+    out = np.asarray(e1.numpy())
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+
+    fl = fused_ops.fused_fc_elementwise_layernorm(
+        t(r(2, 4)), t(r(4, 6)), t(r(2, 6)))
+    np.testing.assert_allclose(fl.numpy().mean(-1), 0, atol=1e-5)
+
+    sq = fused_ops.fusion_seqconv_eltadd_relu(
+        t(r(1, 4, 2)), t(np.array([4])), t(r(6, 3)), t(r(3)))
+    assert (sq.numpy() >= 0).all()
+
+    fe = fused_ops.fusion_seqexpand_concat_fc(
+        [t(r(2, 3, 4)), t(np.array([[1.0, 2.0], [3.0, 4.0]],
+                          np.float32))],
+        t(np.array([3, 3])), t(r(6, 5)))
+    assert fe.shape == [2, 3, 5]
+
+    ftc = fused_ops.fusion_transpose_flatten_concat(
+        [t(r(2, 3, 4)), t(r(2, 3, 4))], (0, 2, 1), 1, 1)
+    assert ftc.shape == [2, 24]
+
+    # multihead_matmul == manual attention oracle
+    B, T, D, H = 1, 3, 4, 2
+    xx = r(B, T, D)
+    qkvw = r(D, 3 * D)
+    qkvb = np.zeros(3 * D, np.float32)
+    mh = fused_ops.multihead_matmul(t(xx), t(qkvw), t(qkvb), num_heads=H,
+                                    scale=1.0)
+    qkv = xx @ qkvw
+    q, k, v = np.split(qkv, 3, -1)
+
+    def heads(a):
+        return a.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+    qh, kh, vh = heads(q), heads(k), heads(v)
+    att = qh @ kh.transpose(0, 1, 3, 2)
+    att = np.exp(att - att.max(-1, keepdims=True))
+    att /= att.sum(-1, keepdims=True)
+    ref = (att @ vh).transpose(0, 2, 1, 3).reshape(B, T, D)
+    np.testing.assert_allclose(mh.numpy(), ref, rtol=2e-2, atol=2e-2)
+
+    # first two features are show/click counts (log-transformed by CVM):
+    # must be positive, like the reference's usage
+    sp = fused_ops.fusion_seqpool_cvm_concat(
+        [t(r(2, 3, 4, lo=0.5, hi=2.0))], [t(np.array([2, 3]))],
+        t(np.ones((2, 2), np.float32)))
+    assert np.isfinite(sp.numpy()).all()
+
+
+def test_vision_tail_round3():
+    # deformable conv with zero offsets == plain conv (torch oracle)
+    x = r(1, 4, 8, 8)
+    w = r(6, 4, 3, 3)
+    off = np.zeros((1, 18, 8, 8), np.float32)
+    out = vision_ops.deformable_conv(t(x), t(off), t(w), padding=1)
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     padding=1).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-2, atol=1e-2)
+
+    # fractional offset: 1x1 kernel dy=0.5 → bilinear mean of vertical pair
+    x1 = r(1, 1, 4, 4)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[0, 0] = 0.5
+    o = vision_ops.deformable_conv(t(x1), t(off),
+                                   t(np.ones((1, 1, 1, 1), np.float32)))
+    ref = 0.5 * x1[0, 0] + 0.5 * np.vstack([x1[0, 0, 1:],
+                                            np.zeros((1, 4))])
+    np.testing.assert_allclose(o.numpy()[0, 0], ref, rtol=1e-4, atol=1e-5)
+
+    # grads flow to x, offset, weight
+    xg = t(r(1, 2, 6, 6), stop_gradient=False)
+    og = t(r(1, 18, 6, 6) * 0.3, stop_gradient=False)
+    wg = t(r(3, 2, 3, 3), stop_gradient=False)
+    vision_ops.deformable_conv(xg, og, wg, padding=1).sum().backward()
+    for v in (xg, og, wg):
+        assert np.isfinite(v.grad.numpy()).all()
+        assert float(np.abs(v.grad.numpy()).sum()) > 0
+
+    # psroi: uniform input → every bin equals the value
+    xc = np.ones((1, 8, 6, 6), np.float32)
+    ps = vision_ops.psroi_pool(t(xc), t(np.array([[0., 0., 4., 4.]],
+                               np.float32)), t(np.array([1])), 2, 1.0, 2, 2)
+    np.testing.assert_allclose(ps.numpy(), np.ones((1, 2, 2, 2)))
+
+    pr = vision_ops.prroi_pool(t(r(1, 3, 8, 8)),
+                               t(np.array([[1., 1., 5., 5.]], np.float32)),
+                               t(np.array([1])), 2, 2)
+    assert pr.shape == [1, 3, 2, 2]
+
+    rc = vision_ops.random_crop(t(r(2, 3, 10, 10)), [6, 6])
+    assert rc.shape == [2, 3, 6, 6]
+
+    sp = vision_ops.spp(t(r(2, 3, 8, 8)), 2)
+    assert sp.shape == [2, 15]
+
+    dp = vision_ops.deformable_psroi_pooling(
+        t(xc), t(np.array([[0., 0., 4., 4.]], np.float32)),
+        t(np.zeros((1, 2, 2, 2), np.float32)), t(np.array([1])),
+        output_channels=2, pooled_height=2, pooled_width=2)
+    assert dp.shape == [1, 2, 2, 2]
+
+
+def test_detection_tail_round3():
+    # anchor_generator against the reference formula
+    a, v = detection_ops.anchor_generator(t(r(1, 8, 2, 2)),
+                                          anchor_sizes=[64.],
+                                          aspect_ratios=[1.0],
+                                          stride=[16., 16.])
+    assert a.shape == [2, 2, 1, 4]
+    # cell (0,0): ctr = 0.5*15 = 7.5; w = h = 4*16=64 → [-24, -24, 39, 39]
+    np.testing.assert_allclose(a.numpy()[0, 0, 0],
+                               [7.5 - 31.5, 7.5 - 31.5, 7.5 + 31.5,
+                                7.5 + 31.5])
+
+    outs, restore = detection_ops.distribute_fpn_proposals(
+        t(np.array([[0., 0., 20., 20.], [0., 0., 200., 200.]],
+          np.float32)), 2, 5, 4, 224)
+    assert [o.shape[0] for o in outs] == [1, 1, 0, 0]
+    np.testing.assert_array_equal(restore.numpy().ravel(), [0, 1])
+
+    anchors, _ = detection_ops.anchor_generator(
+        t(np.zeros((1, 8, 4, 4), np.float32)),
+        anchor_sizes=[32., 64., 128.], aspect_ratios=[1.0])
+    scores = rng.rand(1, 3, 4, 4).astype(np.float32)
+    deltas = (rng.randn(1, 12, 4, 4) * 0.1).astype(np.float32)
+    props, pscores, pnum = detection_ops.generate_proposals(
+        t(scores), t(deltas), t(np.array([[64., 64., 1.]], np.float32)),
+        anchors)
+    assert props.shape[0] == int(pnum.numpy()[0]) > 0
+    # scores sorted descending
+    ss = pscores.numpy()
+    assert (np.diff(ss) <= 1e-6).all()
+
+    gt = np.array([[10., 10., 30., 30.]], np.float32)
+    li, si, tl, tb, iw = detection_ops.rpn_target_assign(anchors, t(gt))
+    assert len(li.numpy()) >= 1 and tb.shape[1] == 4
+
+    out, wgt = detection_ops.target_assign(
+        t(r(2, 4, 3)), t(np.array([[0, -1], [2, 1]])))
+    assert out.shape == [2, 2, 3]
+    np.testing.assert_array_equal(wgt.numpy(), [[1, 0], [1, 1]])
+
+    # yolov3_loss: grads flow, loss decreases along negative gradient
+    N, na, nc, H = 1, 3, 4, 8
+    xv = t(r(N, na * (5 + nc), H, H) * 0.1, stop_gradient=False)
+    gt_box = np.zeros((N, 3, 4), np.float32)
+    gt_box[0, 0] = [0.5, 0.5, 0.3, 0.4]
+    gt_lab = np.zeros((N, 3), np.int64)
+    gt_lab[0, 0] = 2
+    kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+              class_num=nc, downsample_ratio=32)
+    loss = detection_ops.yolov3_loss(xv, t(gt_box), t(gt_lab), **kw)
+    assert loss.shape == [N]
+    loss.sum().backward()
+    g = xv.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    stepped = xv.numpy() - 0.05 * g
+    loss2 = detection_ops.yolov3_loss(t(stepped), t(gt_box), t(gt_lab),
+                                      **kw)
+    assert float(loss2.numpy().sum()) < float(loss.numpy().sum())
+
+    rd = detection_ops.retinanet_detection_output(
+        [t(r(48, 4) * 0.1)], [t(rng.rand(48, 3).astype(np.float32))],
+        [anchors], t(np.array([[64., 64., 1.]], np.float32)))
+    assert rd.shape[1] == 6
+
+    pb = detection_ops.polygon_box_transform(t(r(1, 2, 2, 3)))
+    assert pb.shape == [1, 2, 2, 3]
+
+
+def test_static_print_assert():
+    import paddle_tpu.static as S
+    out = S.Print(t(np.arange(3.0)), message="test")
+    np.testing.assert_allclose(out.numpy(), np.arange(3.0))
+    S.Assert(t(True))
+    with pytest.raises(AssertionError):
+        S.Assert(t(False), data=[t(np.arange(2.0))])
+
+
+def test_selected_rows_split():
+    from paddle_tpu.core.selected_rows import SelectedRows, \
+        split_selected_rows
+    import jax.numpy as jnp
+    sr = SelectedRows(np.array([1, 5, 8]), jnp.asarray(r(3, 2)), 10)
+    parts = split_selected_rows(sr, [4, 6])
+    assert list(parts[0].rows) == [1] and list(parts[1].rows) == [1, 4]
+    assert parts[0].height == 4 and parts[1].height == 6
