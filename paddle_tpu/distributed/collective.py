@@ -146,6 +146,109 @@ def _c_ppermute(x, axis, perm):
     return jax.lax.ppermute(x, axis, perm)
 
 
+
+# ------------------------------------------------- host-level multiprocess
+def _multiproc() -> bool:
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def _host_allgather(arr):
+    """Eager cross-process allgather of a local ndarray → [world, ...].
+    Rides jax.experimental.multihost_utils (the coordination-service-backed
+    path the reference covers with Gloo, C10)."""
+    import jax.experimental.multihost_utils as mhu
+    return np.asarray(mhu.process_allgather(np.asarray(arr)))
+
+
+def _group_ranks(g: "Group"):
+    world = jax.process_count()
+    return list(g.ranks) if g.ranks else list(range(world))
+
+
+class _P2PChannel:
+    """Host-level point-to-point transport (reference: dygraph send/recv on
+    NCCL p2p, operators/collective/send_v2_op.cc). CPU analogue: a TCP
+    listener per process, addresses published through the JAX coordination
+    service KV store — the same bootstrap role the reference's gloo HTTP
+    store plays."""
+
+    _inst = None
+
+    @classmethod
+    def get(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+    def __init__(self):
+        import collections
+        import pickle
+        import queue
+        import socket
+        import struct
+        import threading
+
+        self._pickle, self._struct = pickle, struct
+        self._queues = collections.defaultdict(queue.Queue)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(64)
+        self._addr = f"127.0.0.1:{self._sock.getsockname()[1]}"
+        self._rank = get_rank()
+
+        from jax._src.distributed import global_state
+        client = global_state.client
+        if client is None:
+            raise RuntimeError(
+                "send/recv across processes needs init_parallel_env() "
+                "(JAX coordination service not initialised)")
+        self._client = client
+        client.key_value_set(f"paddle_tpu/p2p/{self._rank}", self._addr)
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            conn, _ = self._sock.accept()
+            try:
+                hdr = self._recv_exact(conn, 12)
+                src, length = self._struct.unpack("<iq", hdr)
+                payload = self._recv_exact(conn, length)
+                self._queues[src].put(self._pickle.loads(payload))
+            except Exception:
+                # a crashed/interrupted peer must not kill the accept
+                # loop — later recv() calls would hang undiagnosably
+                pass
+            finally:
+                conn.close()
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("p2p peer closed mid-message")
+            buf += chunk
+        return buf
+
+    def send(self, dst: int, arr):
+        import socket
+        addr = self._client.blocking_key_value_get(
+            f"paddle_tpu/p2p/{dst}", 60_000)
+        host, port = addr.rsplit(":", 1)
+        payload = self._pickle.dumps(np.asarray(arr), protocol=4)
+        with socket.create_connection((host, int(port)), timeout=60) as c:
+            c.sendall(self._struct.pack("<iq", self._rank, len(payload))
+                      + payload)
+
+    def recv(self, src: int, timeout: float = 120.0):
+        return self._queues[src].get(timeout=timeout)
+
+
 # ---------------------------------------------------------------- public api
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=True):
@@ -153,6 +256,20 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     In-place on `tensor`, returns it (paddle semantics)."""
     g = _resolve_group(group)
     if not _axis_in_scope(g.axis):
+        if _multiproc():
+            parts = _host_allgather(tensor.numpy())[_group_ranks(g)]
+            if op == ReduceOp.SUM:
+                red = parts.sum(0)
+            elif op == ReduceOp.MAX:
+                red = parts.max(0)
+            elif op == ReduceOp.MIN:
+                red = parts.min(0)
+            elif op == ReduceOp.AVG:
+                red = parts.mean(0)
+            else:
+                red = parts.prod(0)
+            tensor._value = jnp.asarray(red.astype(parts.dtype))
+            return tensor
         return tensor  # world of one: identity (matches reference nranks==1)
     out = _c_allreduce(tensor, g.axis, op)
     tensor._value = out._value
@@ -164,6 +281,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """reference: collective.py:313 — gathers shards into tensor_list."""
     g = _resolve_group(group)
     if not _axis_in_scope(g.axis):
+        if _multiproc():
+            parts = _host_allgather(tensor.numpy())[_group_ranks(g)]
+            tensor_list.extend(to_tensor(p) for p in parts)
+            return tensor_list
         tensor_list.append(tensor)
         return tensor_list
     gathered = _c_allgather(tensor, g.axis)
@@ -175,6 +296,22 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def all_gather_object(obj_list, obj, group=None):
+    """reference: collective.py all_gather_object — arbitrary picklable
+    objects; multiprocess via two host allgathers (lengths, then padded
+    bytes)."""
+    if _multiproc():
+        import pickle
+        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        lens = _host_allgather(np.asarray([blob.size], np.int64))
+        width = int(lens.max())
+        padded = np.zeros(width, np.uint8)
+        padded[:blob.size] = blob
+        blobs = _host_allgather(padded)
+        g = _resolve_group(group)
+        for r in _group_ranks(g):
+            n = int(lens[r][0])
+            obj_list.append(pickle.loads(blobs[r][:n].tobytes()))
+        return obj_list
     obj_list.append(obj)
     return obj_list
 
@@ -186,6 +323,13 @@ def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None):
         from ..ops import manipulation as M
         src = M.concat(list(src), axis=0)
     if not _axis_in_scope(g.axis):
+        if _multiproc():
+            ranks = _group_ranks(g)
+            parts = _host_allgather(src.numpy())[ranks]   # [n, total]
+            summed = parts.sum(0)
+            chunks = np.split(summed, len(ranks), axis=0)
+            tensor._value = jnp.asarray(chunks[ranks.index(get_rank())])
+            return tensor
         tensor._value = src._value
         return tensor
     out = _c_reducescatter(src, g.axis)
@@ -198,6 +342,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     """reference: collective.py:101."""
     g = _resolve_group(group)
     if not _axis_in_scope(g.axis):
+        if _multiproc():
+            ranks = _group_ranks(g)
+            parts = _host_allgather(tensor.numpy())[ranks]
+            tensor._value = jnp.asarray(parts[ranks.index(src)])
+            return tensor
         return tensor
     out = _c_broadcast(tensor, g.axis, src)
     tensor._value = out._value
@@ -214,6 +363,21 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = _resolve_group(group)
     if not _axis_in_scope(g.axis):
+        if _multiproc():
+            # EVERY process must join the allgather (paddle convention:
+            # only src passes tensor_list; others contribute zeros of the
+            # same [w, *tensor.shape] so the collective shapes agree)
+            ranks = _group_ranks(g)
+            base = np.asarray(tensor.numpy())
+            if tensor_list:
+                stacked = np.stack([np.asarray(t.numpy())
+                                    for t in tensor_list])
+            else:
+                stacked = np.zeros((len(ranks),) + base.shape, base.dtype)
+            parts = _host_allgather(stacked)[ranks]
+            me = ranks.index(get_rank())
+            tensor._value = jnp.asarray(parts[ranks.index(src)][me])
+            return tensor
         if tensor_list:
             tensor._value = tensor_list[src]._value
         return tensor
@@ -268,13 +432,30 @@ def barrier(group=None):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv map onto lax.ppermute inside sharded "
-        "programs (see paddle_tpu.parallel.pipeline); host-level p2p is "
-        "not part of the SPMD model")
+    """reference: collective.py send / operators/collective/send_v2_op.cc.
+    Host-level p2p over the coordination-bootstrapped TCP channel. (Inside
+    sharded programs, p2p maps onto lax.ppermute instead — see
+    paddle_tpu.parallel.pipeline.)"""
+    if not _multiproc():
+        raise RuntimeError("send(): single-process world has no peer "
+                           f"rank {dst}")
+    _P2PChannel.get().send(int(dst), tensor.numpy())
+    return tensor
 
 
-recv = send
+def recv(tensor=None, src=0, group=None, sync_op=True, shape=None,
+         dtype=None):
+    """reference: collective.py recv / recv_v2_op.cc. Blocks for the next
+    message from `src`; fills `tensor` in place when given, else returns a
+    fresh Tensor (shape/dtype hints accepted for API parity)."""
+    if not _multiproc():
+        raise RuntimeError("recv(): single-process world has no peer "
+                           f"rank {src}")
+    arr = _P2PChannel.get().recv(int(src))
+    if tensor is not None and not isinstance(tensor, (list, tuple)):
+        tensor._value = jnp.asarray(arr)
+        return tensor
+    return to_tensor(arr)
 
 
 def get_world_size(group=None):

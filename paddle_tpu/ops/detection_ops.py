@@ -295,6 +295,8 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     ious = iou_mat(rois, gts) if len(gts) else np.zeros((len(rois), 1))
     max_iou = ious.max(axis=1) if ious.size else np.zeros(len(rois))
     gt_idx = ious.argmax(axis=1) if ious.size else np.zeros(len(rois), int)
+    if len(gtc) == 0:
+        gtc = np.zeros(1, np.int64)  # all RoIs become background (label 0)
     fg = np.where(max_iou >= fg_thresh)[0]
     bg = np.where((max_iou < bg_thresh_hi) & (max_iou >= bg_thresh_lo))[0]
     n_fg = min(int(batch_size_per_im * fg_fraction), len(fg))
@@ -477,6 +479,9 @@ def _assign_by_iou(anchors, gts, pos_thresh, neg_thresh):
     inter_y2 = np.minimum(anchors[:, None, 3], gts[None, :, 3])
     iw = np.maximum(inter_x2 - inter_x1 + 1, 0)
     ih = np.maximum(inter_y2 - inter_y1 + 1, 0)
+    if len(gts) == 0:
+        # no annotations: every anchor is a negative
+        return np.zeros(len(anchors), np.int64), np.zeros(len(anchors), int)
     inter = iw * ih
     aa = ((anchors[:, 2] - anchors[:, 0] + 1)
           * (anchors[:, 3] - anchors[:, 1] + 1))[:, None]
@@ -567,13 +572,18 @@ def retinanet_target_assign(anchors, gt_boxes, gt_labels, is_crowd=None,
 
 def roi_perspective_transform(input, rois, transformed_height,
                               transformed_width, spatial_scale=1.0,
-                              name=None):
+                              boxes_num=None, name=None):
     """reference: detection/roi_perspective_transform_op.cc — warp each
     quad RoI ([x1..y4], 8 values) to a fixed rectangle by the perspective
     transform mapping the output grid onto the quad, bilinear sampling."""
     x = _np(input)
     quads = _np(rois).reshape(-1, 8) * spatial_scale
     N, C, H, W = x.shape
+    if boxes_num is not None:
+        rid = np.repeat(np.arange(len(_np(boxes_num))),
+                        _np(boxes_num).astype(int))
+    else:
+        rid = np.zeros(len(quads), int)
     oh, ow = transformed_height, transformed_width
     out = np.zeros((len(quads), C, oh, ow), np.float32)
     dst = np.asarray([[0, 0], [ow - 1, 0], [ow - 1, oh - 1], [0, oh - 1]],
@@ -603,7 +613,7 @@ def roi_perspective_transform(input, rois, transformed_height,
         inside = ((mx >= -0.5) & (mx <= W - 0.5)
                   & (my >= -0.5) & (my <= H - 0.5))
         for c in range(C):
-            img = x[0, c]
+            img = x[rid[r], c]
             v = (img[y0, x0] * (1 - fy) * (1 - fx)
                  + img[y0, x1] * (1 - fy) * fx
                  + img[y1, x0] * fy * (1 - fx)

@@ -80,6 +80,20 @@ def pipeline_spmd(stage_fn, mesh, num_stages: int, num_micro: int):
         mask = (rank == num_stages - 1).astype(outs.dtype)
         return jax.lax.psum(outs * mask, "pp")
 
+    if int(mesh.shape.get("pp", 1)) == 1:
+        # degenerate single-stage pipeline: no manual axis at all. (A
+        # size-1 manual 'pp' subgroup trips an XLA partial-manual
+        # RET_CHECK — spmd_partitioner.cc:3497 — when dp/tp stay in auto
+        # mode, so run the plain layer scan instead.)
+        def no_pp(stacked, xs):
+            def local_stack(x):
+                def one(c, layer_params):
+                    return stage_fn(layer_params, c), None
+                y, _ = jax.lax.scan(one, x, stacked)
+                return y
+            return jax.lax.map(local_stack, xs)
+        return no_pp
+
     # manual over 'pp' only; dp/tp/sp/sharding stay in GSPMD auto mode so
     # pipeline composes with the other parallelisms
     return shard_map(

@@ -1,10 +1,16 @@
-"""2-process distributed training script (reference:
+"""Multi-process distributed training script (reference:
 fluid/tests/unittests/dist_mnist.py — the model file TestDistBase launches
-in trainer subprocesses). Run via paddle_tpu.distributed.launch; prints one
-JSON line of per-step losses for the parent test to compare."""
+in trainer subprocesses). Run via paddle_tpu.distributed.launch.
+
+Results are written to $DIST_OUT_DIR/rank<r>.json (one file per rank) —
+NOT parsed from stdout: child stdout lines from concurrent ranks interleave
+through the launcher pipe, which made stdout parsing flake under load.
+Also exercises the point-to-point and collective surface (all_gather,
+reduce_scatter, send/recv ring) so the cross-process paths beyond
+allreduce are covered.
+"""
 import json
 import os
-import sys
 
 import jax
 
@@ -42,6 +48,38 @@ def loss_fn(m, x, y):
     return ((m(x) - y) ** 2).mean()
 
 
+def collective_probe(rank, world):
+    """all_gather / reduce_scatter / send+recv ring results for the parent
+    to assert on."""
+    dist = paddle.distributed
+    out = {}
+    # all_gather: every rank contributes [rank, rank+0.5]
+    mine = paddle.to_tensor(np.array([rank, rank + 0.5], np.float32))
+    gathered = []
+    dist.all_gather(gathered, mine)
+    out["all_gather"] = [np.asarray(g.numpy()).tolist() for g in gathered]
+    if world > 1:
+        # reduce_scatter: each rank contributes [rank + 0, ..., rank + w-1];
+        # rank r keeps sum over ranks of chunk r
+        full = paddle.to_tensor(np.arange(world, dtype=np.float32) + rank)
+        rs_out = paddle.to_tensor(np.zeros(1, np.float32))
+        dist.reduce_scatter(rs_out, full)
+        out["reduce_scatter"] = np.asarray(
+            rs_out.numpy()).reshape(-1).tolist()
+        # send/recv ring: rank r sends its id to (r+1) % world
+        nxt = (rank + 1) % world
+        prv = (rank - 1) % world
+        token = paddle.to_tensor(np.array([float(rank)], np.float32))
+        if rank % 2 == 0:
+            dist.send(token, dst=nxt)
+            got = dist.recv(src=prv, shape=[1], dtype="float32")
+        else:
+            got = dist.recv(src=prv, shape=[1], dtype="float32")
+            dist.send(token, dst=nxt)
+        out["ring_recv"] = float(np.asarray(got.numpy())[0])
+    return out
+
+
 def main():
     env = paddle.distributed.init_parallel_env()
     rank, world = env.rank, env.world_size
@@ -58,8 +96,15 @@ def main():
         xs = shard_batch(X[rank * per_rank:(rank + 1) * per_rank])
         ys = shard_batch(Y[rank * per_rank:(rank + 1) * per_rank])
         losses.append(float(step(xs, ys).numpy()))
-    print("DIST_LOSSES " + json.dumps({"rank": rank, "losses": losses}),
-          flush=True)
+    rec = {"rank": rank, "losses": losses}
+    rec.update(collective_probe(rank, world))
+    out_dir = os.environ.get("DIST_OUT_DIR")
+    if out_dir:
+        path = os.path.join(out_dir, f"rank{rank}.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(rec, f)
+        os.replace(path + ".tmp", path)  # atomic publish
+    print("DIST_LOSSES " + json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,17 +1,23 @@
-"""Multi-process distributed test — the TestDistBase analogue.
+"""Multi-process distributed tests — the TestDistBase analogue.
 
-Reference: fluid/tests/unittests/test_dist_base.py:660 — spawn 2 trainer
+Reference: fluid/tests/unittests/test_dist_base.py:660 — spawn trainer
 subprocesses with the PADDLE_TRAINER_* env contract on free local ports,
 then assert their per-step losses match a single-rank run of the same model
 on the full batch. Here the subprocesses bootstrap via the JAX coordination
-service (init_parallel_env) and the dp allreduce rides Gloo on CPU —
-exercising launch.py's env contract end to end.
+service (init_parallel_env); the dp allreduce rides the compiled SPMD path
+and the host-level collective/p2p surface (all_gather, reduce_scatter,
+send/recv) is asserted from each rank's result file.
+
+Results come back through per-rank JSON files (atomic rename), not stdout:
+concurrent children interleave stdout lines through the launcher pipe,
+which made line-parsing flake under load.
 """
 import json
 import os
 import socket
 import subprocess
 import sys
+import tempfile
 
 import numpy as np
 import pytest
@@ -27,44 +33,78 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "tests", "dist_mp_model.py")
 
 
-def _run_cluster(nproc: int, timeout=240, retries=1):
-    """One retry on a fresh port (reference TestDistBase retries its
-    cluster runs too — rendezvous can flake under parallel CI load)."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # 1 local CPU device per process
+def _run_cluster(nproc: int, timeout=300, retries=2):
+    """Retries on fresh ports (reference TestDistBase retries its cluster
+    runs too — rendezvous can flake under parallel CI load)."""
     last = None
     for _ in range(retries + 1):
-        port = _free_port()
-        proc = subprocess.run(
-            [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--nproc_per_node", str(nproc), "--port", str(port), SCRIPT],
-            env=env, capture_output=True, text=True, timeout=timeout)
-        if proc.returncode == 0:
+        with tempfile.TemporaryDirectory() as out_dir:
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            env["JAX_PLATFORMS"] = "cpu"
+            env["DIST_OUT_DIR"] = out_dir
+            env.pop("XLA_FLAGS", None)  # 1 local CPU device per process
+            port = _free_port()
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                     "--nproc_per_node", str(nproc), "--port", str(port),
+                     SCRIPT],
+                    env=env, capture_output=True, text=True,
+                    timeout=timeout)
+            except subprocess.TimeoutExpired as e:
+                last = e
+                continue
             out = {}
-            for line in proc.stdout.splitlines():
-                if line.startswith("DIST_LOSSES "):
-                    rec = json.loads(line[len("DIST_LOSSES "):])
-                    out[rec["rank"]] = rec["losses"]
-            return out
-        last = proc
-    raise AssertionError(
-        f"cluster failed\nSTDOUT:\n{last.stdout}\nSTDERR:\n{last.stderr}")
+            for fn in os.listdir(out_dir):
+                if fn.startswith("rank") and fn.endswith(".json"):
+                    with open(os.path.join(out_dir, fn)) as f:
+                        rec = json.load(f)
+                    out[rec["rank"]] = rec
+            if proc.returncode == 0 and len(out) == nproc:
+                return out
+            last = proc
+    msg = (f"cluster failed\nSTDOUT:\n{last.stdout}\nSTDERR:\n{last.stderr}"
+           if isinstance(last, subprocess.CompletedProcess)
+           else f"cluster timed out: {last}")
+    raise AssertionError(msg)
+
+
+def _assert_cluster(nproc: int):
+    ref = _run_cluster(1)[0]["losses"]
+    result = _run_cluster(nproc)
+    assert sorted(result) == list(range(nproc)), \
+        f"missing ranks: {sorted(result)}"
+    # every rank sees the same (replicated) loss sequence
+    for r in range(1, nproc):
+        np.testing.assert_allclose(result[0]["losses"],
+                                   result[r]["losses"], rtol=1e-6)
+    # distributed loss sequence == single-rank full-batch sequence
+    np.testing.assert_allclose(result[0]["losses"], ref, rtol=1e-4,
+                               atol=1e-6)
+    # host-level collective surface (real cross-process exchanges)
+    expect_gather = [[float(r), r + 0.5] for r in range(nproc)]
+    for r in range(nproc):
+        assert result[r]["all_gather"] == expect_gather, \
+            (r, result[r]["all_gather"])
+        if nproc > 1:
+            # each rank contributed arange(w)+rank; chunk r of the sum is
+            # w*r + sum(ranks)
+            expect_rs = nproc * r + nproc * (nproc - 1) / 2
+            np.testing.assert_allclose(result[r]["reduce_scatter"],
+                                       [expect_rs])
+            # ring: rank r hears from (r-1) % w
+            assert result[r]["ring_recv"] == float((r - 1) % nproc)
 
 
 @pytest.mark.slow
 def test_two_process_losses_match_single_rank():
-    # single-rank oracle: the SAME script as a 1-process cluster (fresh
-    # interpreter, like the reference's TestDistBase which subprocesses
-    # both sides — keeps the oracle hermetic from suite-global state)
-    ref = _run_cluster(1)[0]
-    result = _run_cluster(2)
-    assert sorted(result) == [0, 1], f"missing ranks: {result}"
-    # both ranks see the same (replicated) loss
-    np.testing.assert_allclose(result[0], result[1], rtol=1e-6)
-    # distributed loss sequence == single-rank full-batch sequence
-    np.testing.assert_allclose(result[0], ref, rtol=1e-4, atol=1e-6)
+    _assert_cluster(2)
+
+
+@pytest.mark.slow
+def test_four_process_losses_and_collectives():
+    _assert_cluster(4)
 
 
 @pytest.mark.slow
