@@ -165,7 +165,18 @@ def _host_allgather(arr):
 
 def _group_ranks(g: "Group"):
     world = jax.process_count()
-    return list(g.ranks) if g.ranks else list(range(world))
+    ranks = list(g.ranks) if g.ranks else list(range(world))
+    if set(ranks) != set(range(world)):
+        # host fallbacks ride mhu.process_allgather, a WORLD collective:
+        # a subgroup call would deadlock waiting for non-members. Loud
+        # failure instead (compiled SPMD subgroups via mesh axes still
+        # work — this is only the eager host path).
+        raise NotImplementedError(
+            f"host-level eager collectives over a strict subgroup "
+            f"{ranks} of the {world}-process world are not supported; "
+            "run the collective inside a compiled sharded step "
+            "(mesh-axis group) or use the full world group")
+    return ranks
 
 
 class _P2PChannel:
@@ -326,8 +337,17 @@ def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None):
         if _multiproc():
             ranks = _group_ranks(g)
             parts = _host_allgather(src.numpy())[ranks]   # [n, total]
-            summed = parts.sum(0)
-            chunks = np.split(summed, len(ranks), axis=0)
+            if op == ReduceOp.SUM:
+                red = parts.sum(0)
+            elif op == ReduceOp.MAX:
+                red = parts.max(0)
+            elif op == ReduceOp.MIN:
+                red = parts.min(0)
+            elif op == ReduceOp.AVG:
+                red = parts.mean(0)
+            else:
+                red = parts.prod(0)
+            chunks = np.split(red, len(ranks), axis=0)
             tensor._value = jnp.asarray(chunks[ranks.index(get_rank())])
             return tensor
         tensor._value = src._value
@@ -395,6 +415,15 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     g = _resolve_group(group)
     from ..ops import manipulation as M
     if not _axis_in_scope(g.axis):
+        if _multiproc():
+            ranks = _group_ranks(g)
+            stacked = np.stack([np.asarray(t.numpy())
+                                for t in in_tensor_list])  # [w, ...]
+            allparts = _host_allgather(stacked)[ranks]     # [w, w, ...]
+            me = ranks.index(get_rank())
+            out_tensor_list.extend(
+                to_tensor(allparts[s][me]) for s in range(len(ranks)))
+            return out_tensor_list
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list
     x = M.concat(list(in_tensor_list), axis=0)

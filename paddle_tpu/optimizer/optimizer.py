@@ -170,7 +170,8 @@ class Optimizer:
         return None, [(p, p.grad) for p in (self._parameter_list or [])]
 
     def _static_minimize(self, loss, startup_program=None, parameters=None,
-                         no_grad_set=None):
+                         no_grad_set=None, params_grads=None,
+                         found_inf=None):
         """Static-graph minimize (reference: optimizer.py minimize →
         append_backward + _create_optimization_pass appending per-param
         update ops; accumulator vars initialized in startup,
@@ -183,7 +184,8 @@ class Optimizer:
         prog = loss.block.program
         blk = prog.global_block
         startup = startup_program or default_startup_program()
-        params_grads = _B.append_backward(loss, parameters, no_grad_set)
+        if params_grads is None:
+            params_grads = _B.append_backward(loss, parameters, no_grad_set)
 
         if self._grad_clip is not None:
             gnames = [g.name for _, g in params_grads]
@@ -225,19 +227,34 @@ class Optimizer:
             reg = getattr(p, "regularizer", None) or self.regularization
             mult = self._param_lr(p).get("learning_rate", 1.0)
 
-            def upd(pv, gv, lr, *svals, _self=self, _skeys=tuple(skeys),
-                    _reg=reg, _mult=mult, _pname=p.name):
+            def upd(pv, gv, lr, *rest, _self=self, _skeys=tuple(skeys),
+                    _reg=reg, _mult=mult, _pname=p.name,
+                    _gated=found_inf is not None):
+                if _gated:
+                    finf, svals = rest[0], rest[1:]
+                else:
+                    finf, svals = None, rest
                 if _reg is not None:
                     gv = _reg.apply(pv, gv)
                 _self._current_param_name = _pname
                 new_p, new_s = _self._update(
                     pv, gv, dict(zip(_skeys, svals)),
                     (lr * _mult).astype(pv.dtype))
+                if _gated:
+                    # AMP dynamic loss scaling: skip the whole update when
+                    # any grad overflowed (reference fp16_utils.py:415
+                    # decorate + update_loss_scaling gating)
+                    import jax.numpy as _jnp
+                    new_p = _jnp.where(finf, pv, new_p)
+                    new_s = {k: _jnp.where(finf, sv, new_s[k])
+                             for k, sv in zip(_skeys, svals)}
                 return (new_p,) + tuple(new_s[k] for k in _skeys)
 
+            extra_in = [found_inf.name] if found_inf is not None else []
             od = blk.append_op(OpDesc(
                 "op", "optimize.update", upd,
-                [p.name, g.name, lr_name] + snames, [p.name] + snames))
+                [p.name, g.name, lr_name] + extra_in + snames,
+                [p.name] + snames))
             update_ops.append(od)
         return update_ops, params_grads
 

@@ -35,14 +35,24 @@ from . import mesh as _mesh
 from jax import shard_map  # jax>=0.8 public API (kw-only, axis_names)
 
 
-def pipeline_spmd(stage_fn, mesh, num_stages: int, num_micro: int):
+def pipeline_spmd(stage_fn, mesh, num_stages: int, num_micro: int,
+                  remat_stages: bool = True):
     """Build f(stacked_params, xs) -> ys running the GPipe schedule.
 
     stage_fn(layer_params, x) -> x : ONE layer's forward; layer_params
     leaves have a leading [num_layers] dim in `stacked_params`.
     xs: [num_micro, micro_batch, ...] activations entering the stack.
     Returns ys of the same shape having passed through all layers.
+
+    remat_stages: jax.checkpoint around each per-layer application — the
+    backward pipeline recomputes layer internals per microbatch step, so
+    stored residuals are bounded by the inter-stage activations (one
+    [micro_batch, ...] carry per schedule step) instead of every layer's
+    attention/MLP internals × num_micro (the reference bounds this with
+    per-microbatch scopes in SectionWorker, section_worker.cc:34-105).
     """
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
     other_axes = frozenset(ax for ax in mesh.axis_names if ax != "pp")
 
     def per_rank(stacked_local, xs):
@@ -268,3 +278,144 @@ class PipelinedGPT:
 
 def pipelined_gpt_loss_fn(model, input_ids, labels):
     return model.loss(input_ids, labels)
+
+
+# ---------------------------------------------------------------------------
+# Generic pipeline container: stack ANY same-shaped Layer blocks
+# ---------------------------------------------------------------------------
+class PipelineLayer:
+    """Pipeline-parallel container over arbitrary same-structured blocks
+    (reference: distributed/fleet/meta_parallel PipelineLayer +
+    fluid/optimizer.py:3718 PipelineOptimizer's program slicer; the
+    capability, redesigned: blocks' parameters are STACKED on a leading
+    [num_layers] dim sharded over the mesh 'pp' axis and run under the
+    shard_map GPipe schedule of pipeline_spmd).
+
+    Every block must have the same parameter tree (names/shapes) and map
+    [micro_batch, ...] -> same shape. Blocks with buffers (e.g. BatchNorm
+    running stats) are rejected — stat updates are not functional across
+    microbatches in a pipeline; use buffer-free blocks (LayerNorm etc.).
+    """
+
+    def __init__(self, layers, mesh=None, num_micro=None,
+                 remat_stages=True):
+        from ..jit import _FunctionalizedLayer
+        from .api import mark_sharding
+
+        self.blocks = list(layers)
+        if not self.blocks:
+            raise ValueError("PipelineLayer needs at least one block")
+        self.mesh = mesh or _mesh.ensure_global_mesh()
+        self._pp = int(self.mesh.shape.get("pp", 1))
+        L = len(self.blocks)
+        if L % max(self._pp, 1) != 0:
+            raise ValueError(f"{L} blocks do not divide over pp="
+                             f"{self._pp} stages")
+        self.num_micro = num_micro
+        self.remat_stages = remat_stages
+        self.training = True
+
+        names = [k for k, _ in self.blocks[0].named_parameters()]
+        for b in self.blocks[1:]:
+            other = [k for k, _ in b.named_parameters()]
+            if other != names:
+                raise ValueError(
+                    "PipelineLayer blocks must share one parameter "
+                    f"structure; got {names} vs {other}")
+        for b in self.blocks:
+            if any(True for _ in b.named_buffers()):
+                raise ValueError(
+                    "PipelineLayer blocks must be buffer-free (running "
+                    "stats cannot update functionally across microbatches)")
+        from ..nn import layer as _nl
+        rng_types = tuple(
+            t for t in (getattr(_nl.common, n, None)
+                        for n in ("Dropout", "Dropout2D", "Dropout3D",
+                                  "AlphaDropout"))
+            if t is not None)
+        for b in self.blocks:
+            for sub in b.sublayers(include_self=True):
+                if rng_types and isinstance(sub, rng_types):
+                    raise ValueError(
+                        f"PipelineLayer blocks may not contain RNG layers "
+                        f"({type(sub).__name__}): the staged schedule "
+                        "replays one stage function with a fixed key, so "
+                        "dropout masks would repeat across layers and "
+                        "microbatches")
+
+        self._params = {}
+        for name in names:
+            vals = [dict(b.named_parameters())[name]._value
+                    for b in self.blocks]
+            t = Tensor(jnp.stack(vals, axis=0), stop_gradient=False,
+                       name=f"pipe.{name}", persistable=True)
+            t.is_parameter = True
+            t.trainable = True
+            mark_sharding(t, *(("pp",) + (None,) * (t._value.ndim - 1)))
+            self._params[f"pipe.{name}"] = t
+        self._names = names
+        self._inner = _FunctionalizedLayer(self.blocks[0].forward,
+                                           self.blocks[0])
+        self._pipeline = None
+
+    # --- Layer-protocol subset used by train steps ----------------------
+    def named_parameters(self, *a, **k):
+        return list(self._params.items())
+
+    def parameters(self, include_sublayers=True):
+        return list(self._params.values())
+
+    def named_buffers(self, *a, **k):
+        return []
+
+    def buffers(self, *a, **k):
+        return []
+
+    def sublayers(self, include_self=False):
+        return [self] if include_self else []
+
+    def train(self):
+        self.training = True
+        for b in self.blocks:
+            b.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for b in self.blocks:
+            b.eval()
+        return self
+
+    def state_dict(self):
+        return dict(self._params)
+
+    def _stage_fn(self, layer_params, x):
+        per_layer = {n: layer_params[f"pipe.{n}"] for n in self._names}
+        out, _ = self._inner.pure_call(per_layer, {},
+                                       jax.random.PRNGKey(0),
+                                       (Tensor(x),), {})
+        out = out[0] if isinstance(out, (tuple, list)) else out
+        return out._value if isinstance(out, Tensor) else out
+
+    def forward(self, x, num_micro=None):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        M = num_micro or self.num_micro or max(self._pp, 1)
+        B = xv.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} must divide into {M} microbatches")
+        xs = xv.reshape((M, B // M) + xv.shape[1:])
+        if self._pipeline is None:
+            fn = pipeline_spmd(
+                lambda lp, a: self._stage_fn(lp, a), self.mesh,
+                self._pp, M, remat_stages=self.remat_stages)
+            # partial-manual shard_map (manual 'pp', auto dp/tp/...) only
+            # lowers under jit; eager calls go through a cached jit wrapper
+            self._pipeline = jax.jit(fn)
+        params = {k: v._value for k, v in self._params.items()}
+        under_trace = isinstance(xv, jax.core.Tracer) or any(
+            isinstance(v, jax.core.Tracer) for v in params.values())
+        ys = (self._pipeline.__wrapped__(params, xs) if under_trace
+              else self._pipeline(params, xs))
+        return Tensor(ys.reshape((B,) + ys.shape[2:]))
+
+    __call__ = forward

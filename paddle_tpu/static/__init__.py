@@ -57,3 +57,4 @@ class BuildStrategy:
     fuse_elewise_add_act_ops = True
     enable_inplace = True
 from .debug_ops import Print, Assert  # noqa: F401
+from . import amp  # noqa: F401

@@ -1,0 +1,212 @@
+"""Static-graph automatic mixed precision.
+
+Reference: python/paddle/fluid/contrib/mixed_precision/fp16_utils.py
+(rewrite_program:468 — walks the program inserting cast ops around
+white/black-listed ops) and decorator.py decorate:415
+(OptimizerWithMixedPrecision: scaled loss, check_finite_and_unscale,
+update_loss_scaling, gated parameter update with fp32 master weights).
+
+TPU-native redesign: recorded ops are pure jnp closures, so "inserting
+casts" is wrapping each closure — white-listed ops compute in bf16 (the
+MXU dtype), black-listed ops are pinned to fp32. Parameters stay fp32 in
+the scope (that IS the master-weight scheme: fp32 master + bf16 compute),
+the whole rewritten program still compiles to one XLA module, and the
+dynamic-loss-scaling state machine runs as three persistables updated by a
+recorded op.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .program import OpDesc, default_startup_program
+
+__all__ = ["AutoMixedPrecisionLists", "bf16_lists", "rewrite_program",
+           "decorate", "OptimizerWithMixedPrecision"]
+
+
+class AutoMixedPrecisionLists:
+    """reference: fp16_lists.py AutoMixedPrecisionLists."""
+
+    white_list = {
+        "matmul", "matmul_v2", "mul", "bmm", "einsum", "linear", "fc",
+        "conv2d", "conv3d", "depthwise_conv2d", "conv2d_transpose",
+        "scaled_dot_product_attention", "lookup_table", "lookup_table_v2",
+    }
+    black_list = {
+        "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+        "softmax_with_cross_entropy", "softmax_with_cross_entropy_keepdim",
+        "sigmoid_cross_entropy_with_logits", "cross_entropy",
+        "cross_entropy2", "cross_entropy_probs", "reduce_mean",
+        "reduce_sum", "layer_norm", "batch_norm_train", "batch_norm_infer",
+        "log_softmax", "nll_loss", "bce_loss", "bce_with_logits",
+        "mse_loss", "l1_loss",
+    }
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(self.white_list)
+        self.black_list = set(self.black_list)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+        overlap = self.white_list & self.black_list
+        if overlap:
+            raise ValueError(f"ops in both white and black lists: {overlap}")
+
+
+bf16_lists = AutoMixedPrecisionLists  # alias (paddle.static.amp.bf16)
+
+
+def _cast_leaves(args, src, dst):
+    def cast(a):
+        if hasattr(a, "dtype") and a.dtype == src:
+            return a.astype(dst)
+        return a
+    return [jax.tree_util.tree_map(cast, a) for a in args]
+
+
+def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
+    """reference: fp16_utils.py:468 rewrite_program — every already-recorded
+    forward op is rewrapped: white-listed ops run in dest_dtype, black-listed
+    ops are pinned to fp32; other ops run on whatever dtypes arrive (the
+    framework's promotion rules resolve mixes, like the reference's gray
+    list following its inputs)."""
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    low = jnp.bfloat16 if dest_dtype in ("bfloat16", "bf16") \
+        else jnp.float16
+    for od in program.global_block.ops:
+        if od.kind != "op" or od.fn is None:
+            continue
+        if od.op_type in amp_lists.white_list:
+            od.fn = _wrap_cast(od.fn, jnp.float32, low)
+        elif od.op_type in amp_lists.black_list:
+            od.fn = _wrap_cast(od.fn, low, jnp.float32)
+    return program
+
+
+def _wrap_cast(fn, src, dst):
+    @functools.wraps(fn)
+    def wrapped(*xs):
+        return fn(*_cast_leaves(xs, src, dst))
+    return wrapped
+
+
+class OptimizerWithMixedPrecision:
+    """reference: decorator.py:52 — wraps an optimizer with loss scaling
+    and the rewritten program."""
+
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                 dest_dtype):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._use_dynamic = bool(use_dynamic_loss_scaling)
+        self._incr_every_n = int(incr_every_n_steps)
+        self._decr_every_n = int(decr_every_n_nan_or_inf)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._dest_dtype = dest_dtype
+        self._loss_scaling_var = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling_var
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from . import backward as _B
+        prog = loss.block.program
+        blk = prog.global_block
+        startup = startup_program or default_startup_program()
+
+        # 1. bf16 rewrite of the recorded forward
+        rewrite_program(prog, self._amp_lists, self._dest_dtype)
+
+        # 2. loss-scaling persistables
+        def mk_persist(name, value, dtype):
+            v = blk.create_var(name=name, shape=(), dtype=dtype,
+                               persistable=True)
+            startup.global_block.create_var(name=name, shape=(),
+                                            dtype=dtype, persistable=True)
+            startup.global_block.append_op(OpDesc(
+                "init", "fill_constant", lambda _v=value, _d=dtype:
+                jnp.asarray(_v, _d), [], [name]))
+            return v
+
+        scale_v = mk_persist(prog.unique_name("loss_scaling"),
+                             self._init_loss_scaling, jnp.float32)
+        good_v = mk_persist(prog.unique_name("good_steps"), 0, jnp.int32)
+        bad_v = mk_persist(prog.unique_name("bad_steps"), 0, jnp.int32)
+        self._loss_scaling_var = scale_v
+
+        # 3. scaled loss (fp32)
+        scaled = blk.create_var(name=prog.unique_name("scaled_loss"),
+                                shape=loss.shape, dtype="float32",
+                                stop_gradient=False)
+        blk.append_op(OpDesc(
+            "op", "elementwise_mul", lambda l, s:
+            l.astype(jnp.float32) * s, [loss.name, scale_v.name],
+            [scaled.name]))
+
+        # 4. backward on the scaled loss
+        params_grads = _B.append_backward(scaled, parameters, no_grad_set)
+
+        # 5. unscale + overflow check (reference
+        # check_finite_and_unscale_op.cc): grads back to fp32 masters
+        gnames = [g.name for _, g in params_grads]
+        found_v = blk.create_var(name=prog.unique_name("found_inf"),
+                                 shape=(), dtype="bool")
+
+        from ..ops.amp_ops import _check_finite_and_unscale as _cfu
+
+        def unscale(*vals, _fn=_cfu.raw_fn):
+            gs, scale = list(vals[:-1]), vals[-1]
+            # grads back to fp32 before the shared op body: the masters
+            # are fp32 and the overflow scan must see the cast values
+            gs32 = [g.astype(jnp.float32) for g in gs]
+            outs, found = _fn(gs32, scale.astype(jnp.float32))
+            return tuple(outs) + (found,)
+
+        blk.append_op(OpDesc("op", "check_finite_and_unscale", unscale,
+                             gnames + [scale_v.name],
+                             gnames + [found_v.name]))
+
+        # 6. dynamic loss-scaling state machine
+        if self._use_dynamic:
+            from ..ops.amp_ops import _update_loss_scaling as _uls
+
+            def update_scale(found, scale, good, bad, _fn=_uls.raw_fn):
+                return _fn(scale, good, bad, found, self._incr_every_n,
+                           self._decr_every_n, self._incr_ratio,
+                           self._decr_ratio)
+
+            blk.append_op(OpDesc(
+                "op", "update_loss_scaling", update_scale,
+                [found_v.name, scale_v.name, good_v.name, bad_v.name],
+                [scale_v.name, good_v.name, bad_v.name]))
+
+        # 7. gated fp32-master update
+        update_ops = self._optimizer._static_minimize(
+            scaled, startup_program=startup, parameters=parameters,
+            no_grad_set=no_grad_set, params_grads=params_grads,
+            found_inf=found_v)
+        return update_ops
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.5,
+             use_dynamic_loss_scaling=True, dest_dtype="bfloat16",
+             use_pure_fp16=False, use_fp16_guard=None):
+    """reference: decorator.py decorate:415. Returns the wrapped optimizer;
+    call .minimize(loss) as usual."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio,
+        decr_ratio, dest_dtype)

@@ -56,23 +56,49 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """reference: backward.py gradients (grads of targets w.r.t. arbitrary
-    inputs, not just parameters)."""
-    targets = targets if isinstance(targets, (list, tuple)) else [targets]
-    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    if len(targets) != 1:
-        raise NotImplementedError("gradients: exactly one scalar target")
-    loss = targets[0]
-    prog = loss.block.program
+    """reference: backward.py:1795 calc_gradient — grads of (multiple,
+    possibly non-scalar) targets w.r.t. arbitrary inputs. target_gradients
+    supplies the output cotangents (ones_like when None, matching the
+    reference); multiple targets accumulate through one vjp."""
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    target_gradients = list(target_gradients) if isinstance(
+        target_gradients, (list, tuple)) else [target_gradients]
+    if len(target_gradients) != len(targets):
+        raise ValueError(
+            f"target_gradients length {len(target_gradients)} != "
+            f"targets length {len(targets)} (reference calc_gradient "
+            "same contract)")
+    prog = targets[0].block.program
     blk = prog.global_block
+    drop = ({n if isinstance(n, str) else n.name for n in no_grad_set}
+            if no_grad_set else set())
+    # result stays ALIGNED with `inputs` (None for blocked vars, like the
+    # reference calc_gradient); blocked vars are also treated as constants
+    # so no gradient flows through them
+    diff_inputs = [v for v in inputs if v.name not in drop]
     fwd_ops = list(blk.ops)
-    inames = [v.name for v in inputs]
+    inames = [v.name for v in diff_inputs]
+    tnames = [t.name for t in targets]
+    tg_names = [None if tg is None else tg.name for tg in target_gradients]
     grad_vars = []
-    for v in inputs:
-        g = blk.create_var(name=v.name + "@GRAD", shape=v.shape,
+    for v in diff_inputs:
+        gname = v.name + "@GRAD"
+        n = 0
+        while blk.has_var(gname):  # repeated gradients() calls must not
+            gname = f"{v.name}@GRAD_{n}"  # clobber earlier grad vars
+            n += 1
+        g = blk.create_var(name=gname, shape=v.shape,
                            dtype=v._value.dtype, stop_gradient=True)
         grad_vars.append(g)
-    blk.append_op(OpDesc("backward", "backward", None, [loss.name] + inames,
-                         [g.name for g in grad_vars],
-                         payload=(fwd_ops, loss.name, inames)))
-    return grad_vars
+    dep_tgs = [n for n in tg_names if n is not None]
+    blk.append_op(OpDesc(
+        "backward", "backward", None, tnames + inames + dep_tgs,
+        [g.name for g in grad_vars],
+        payload=("vjp", fwd_ops, tnames, inames, tg_names,
+                 sorted(drop))))
+    by_name = dict(zip(inames, grad_vars))
+    return [by_name.get(v.name) for v in inputs]

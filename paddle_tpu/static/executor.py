@@ -61,6 +61,27 @@ def _interpret(ops: List[OpDesc], env: Dict[str, jax.Array],
     for od in ops:
         if od.kind == "init":
             env[od.output_names[0]] = od.fn()
+        elif od.kind == "backward" and od.payload[0] == "vjp":
+            # gradients(): multiple / non-scalar targets with optional
+            # target_gradients cotangents (reference backward.py:1795)
+            _, fwd_ops, tnames, inames, tg_names, stop_set = od.payload
+
+            def fwd_fn(ivals, fwd_ops=fwd_ops, tnames=tnames,
+                       inames=inames, stop_set=stop_set):
+                e2 = dict(init_env)
+                for sname in stop_set:       # no_grad_set: constants
+                    if sname in e2:
+                        e2[sname] = jax.lax.stop_gradient(e2[sname])
+                e2.update(zip(inames, ivals))
+                _interpret(fwd_ops, e2, init_env)
+                return [e2[t] for t in tnames]
+
+            outs, vjp = jax.vjp(fwd_fn, [env[n] for n in inames])
+            cots = [env[tg] if tg is not None else jnp.ones_like(o)
+                    for tg, o in zip(tg_names, outs)]
+            (grads,) = vjp(cots)
+            for n, g in zip(od.output_names, grads):
+                env[n] = g
         elif od.kind == "backward":
             fwd_ops, loss_name, pnames = od.payload
 
@@ -117,7 +138,10 @@ def _analyze_program(program: Program):
                     feeds.append(n)
                     produced.add(n)
         if od.kind == "backward":
-            fwd, loss_name, pnames = od.payload
+            if od.payload[0] == "vjp":
+                pnames = od.payload[3]
+            else:
+                _fwd, _loss, pnames = od.payload
             for p in pnames:
                 if p in persistable and p not in reads and p not in writes:
                     reads.append(p)

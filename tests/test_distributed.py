@@ -261,3 +261,102 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     assert out.shape[0] == 2
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_pipeline_layer_generic_parity_pp4_micro16():
+    """Generic PipelineLayer (pp=4, num_micro=16) must match running the
+    same blocks sequentially on one device (VERDICT r2 item 4)."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import nn
+    from paddle_tpu.parallel import build_mesh, set_global_mesh, \
+        ShardedTrainStep
+    from paddle_tpu.parallel.pipeline import PipelineLayer
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.fc2 = nn.Linear(32, 16)
+            self.ln = nn.LayerNorm(16)
+
+        def forward(self, x):
+            return self.ln(x + self.fc2(paddle.tanh(self.fc1(x))))
+
+    paddle.seed(7)
+    blocks = [Block() for _ in range(4)]
+    x = np.random.RandomState(0).randn(16, 3, 16).astype(np.float32)
+
+    # sequential oracle on plain eager
+    ref = paddle.to_tensor(x)
+    for b in blocks:
+        ref = b(ref)
+    ref = ref.numpy()
+
+    mesh = build_mesh(dp=1, pp=4, tp=1, sp=1, sharding=1,
+                      devices=jax.devices()[:4])
+    set_global_mesh(mesh)
+    pipe = PipelineLayer(blocks, mesh=mesh, num_micro=16)
+    out = pipe(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+    # and it trains through ShardedTrainStep (loss decreases)
+    y = np.random.RandomState(1).randn(16, 3, 16).astype(np.float32)
+    optim = opt.AdamW(1e-2, parameters=pipe.parameters())
+    step = ShardedTrainStep(
+        pipe, lambda m, a, b: ((m(a) - b) ** 2).mean(), optim, mesh=mesh)
+    l0 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+    for _ in range(4):
+        l1 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+    assert l1 < l0
+
+
+def test_pipeline_remat_bounds_activation_memory():
+    """Residuals stored for the pipeline backward must be bounded by the
+    inter-stage carries, not scale with the per-layer internals x
+    num_micro: with remat, growing num_micro 4 -> 16 at FIXED global batch
+    must not grow saved-residual bytes materially, and the remat build
+    must store far less than the no-remat build (reference analogue:
+    SectionWorker's per-microbatch scopes, section_worker.cc:34-105)."""
+    import jax
+    import jax.numpy as jnp
+    from jax._src.ad_checkpoint import saved_residuals
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import build_mesh, set_global_mesh
+    from paddle_tpu.parallel.pipeline import pipeline_spmd
+
+    mesh = build_mesh(dp=1, pp=4, tp=1, sp=1, sharding=1,
+                      devices=jax.devices()[:4])
+    set_global_mesh(mesh)
+
+    H, inner = 16, 64
+
+    def stage(p, x):
+        # 1 matmul up, gelu, matmul down: internals (x@w1 pre-gelu) are
+        # the memory hogs a pipeline must NOT store per microbatch
+        h = jax.nn.gelu(x @ p["w1"])
+        return x + h @ p["w2"]
+
+    rs = np.random.RandomState(0)
+    stacked = {"w1": jnp.asarray(rs.randn(4, H, inner), jnp.float32),
+               "w2": jnp.asarray(rs.randn(4, inner, H), jnp.float32)}
+    GLOBAL = 32
+
+    def residual_bytes(num_micro, remat):
+        fn = pipeline_spmd(stage, mesh, 4, num_micro, remat_stages=remat)
+        xs = jnp.zeros((num_micro, GLOBAL // num_micro, H), jnp.float32)
+
+        def loss(params):
+            return jnp.sum(fn(params, xs) ** 2)
+        res = saved_residuals(loss, stacked)
+        return sum(int(np.prod(aval.shape)) * aval.dtype.itemsize
+                   for aval, _ in res)
+
+    remat_4 = residual_bytes(4, True)
+    remat_16 = residual_bytes(16, True)
+    plain_16 = residual_bytes(16, False)
+    # bounded in num_micro (fixed global batch): within 2x across 4 -> 16
+    assert remat_16 < 2 * remat_4, (remat_4, remat_16)
+    # and materially below the store-everything build
+    assert remat_16 < plain_16 / 2, (remat_16, plain_16)

@@ -301,3 +301,116 @@ def test_gradients_api():
     xs = np.array([1.0, 2.0, 3.0], np.float32)
     outs = exe.run(feed={"x": xs}, fetch_list=[gx])
     np.testing.assert_allclose(outs[0], 2 * xs)
+
+
+# ------------------------------------------------- round-3 completeness
+def test_gradients_multiple_and_nonscalar_targets():
+    """reference backward.py:1795 calc_gradient: multiple targets and
+    explicit target_gradients."""
+    static = paddle.static
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [3, 4], "float32")
+        w = static.create_global_var([4, 2], 0.5, "float32", name="w",
+                                     persistable=True)
+        y1 = paddle.matmul(x, w)              # non-scalar target
+        y2 = (x ** 2).sum()                   # scalar target
+        tg = static.data("tg", [3, 2], "float32")
+        g_tg = static.gradients([y1], [x], target_gradients=[tg])
+        g_multi = static.gradients([y1, y2], [x])
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    tgv = np.random.RandomState(1).randn(3, 2).astype(np.float32)
+    outs = exe.run(main, feed={"x": xv, "tg": tgv},
+                   fetch_list=[g_tg[0], g_multi[0]])
+    wv = np.full((4, 2), 0.5, np.float32)
+    np.testing.assert_allclose(outs[0], tgv @ wv.T, rtol=1e-4)
+    np.testing.assert_allclose(
+        outs[1], np.ones((3, 2), np.float32) @ wv.T + 2 * xv,
+        rtol=1e-4)
+
+
+def test_static_amp_lenet_converges():
+    """reference fp16_utils.py:468 rewrite_program + decorator.py:415:
+    bf16 compute, fp32 masters, dynamic loss scaling — LeNet-class conv
+    net must converge on a separable task."""
+    static = paddle.static
+    main = static.Program()
+    startup = static.Program()
+    rs = np.random.RandomState(0)
+    with static.program_guard(main, startup):
+        x = static.data("x", [32, 1, 12, 12], "float32")
+        y = static.data("y", [32, 1], "int64")
+        h = static.nn.conv2d(x, 6, 3, act="relu")
+        net = static.nn.fc(h, 10)
+        loss = paddle.nn.functional.cross_entropy(net, y)
+        opt = paddle.optimizer.Momentum(0.05)
+        mp = static.amp.decorate(opt, init_loss_scaling=1024.0)
+        mp.minimize(loss)
+    # the rewritten program really runs white-listed ops in bf16
+    types = [od.op_type for od in main.ops]
+    assert "conv2d" in types and "backward" in types
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for i in range(15):
+        xv = rs.randn(32, 1, 12, 12).astype(np.float32)
+        yv = ((xv.mean(axis=(1, 2, 3)) > 0) * 3).astype(
+            np.int64).reshape(-1, 1)
+        out = exe.run(main, feed={"x": xv, "y": yv},
+                      fetch_list=[loss, mp.get_loss_scaling()])
+        losses.append(float(out[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+    assert float(out[1]) > 0  # scale alive
+
+
+def test_program_persistence_roundtrip(tmp_path):
+    """reference fluid/io.py:621 + program_desc.cc: save a recorded
+    Program + persistables, rebuild from code, load, training continues
+    bit-identically; structural mismatch is rejected."""
+    from paddle_tpu.static.io import save_program, load_program
+    static = paddle.static
+
+    def build():
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 4], "float32")
+            y = static.data("y", [8, 1], "float32")
+            h = static.nn.fc(x, 8, activation="tanh")
+            out = static.nn.fc(h, 1)
+            loss = ((out - y) ** 2).mean()
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    main, startup, loss = build()
+    exe = static.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    xv = rs.randn(8, 4).astype(np.float32)
+    yv = rs.randn(8, 1).astype(np.float32)
+    for _ in range(3):
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    save_program(main, str(tmp_path / "model"))
+    expected = exe.run(main, feed={"x": xv, "y": yv},
+                       fetch_list=[loss])[0]
+
+    static.global_scope().drop_kids()
+    paddle.utils.unique_name.switch()
+    main2, startup2, loss2 = build()
+    load_program(main2, str(tmp_path / "model"))
+    resumed = exe.run(main2, feed={"x": xv, "y": yv},
+                      fetch_list=[loss2])[0]
+    np.testing.assert_allclose(resumed, expected, rtol=1e-6)
+
+    # different model code → loud structural rejection
+    main3 = static.Program()
+    startup3 = static.Program()
+    with static.program_guard(main3, startup3):
+        x = static.data("x", [8, 4], "float32")
+        static.nn.fc(x, 2)
+    with pytest.raises(ValueError):
+        load_program(main3, str(tmp_path / "model"))
