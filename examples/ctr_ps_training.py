@@ -1,8 +1,11 @@
-"""CTR-style training with the native DataFeed + parameter server.
+"""CTR-style training: data_generator → streaming DataFeed → parameter
+server.
 
-Generates slot-format files, loads them with the C++ multi-threaded
-DataFeed, and trains embeddings held in a (in-process) parameter server —
-the reference's sparse-PS workflow on this framework.
+Raw click logs are authored into the MultiSlot format with
+fleet.MultiSlotDataGenerator, streamed through the C++ QueueDataset
+(bounded record queue filled by parser threads — host memory stays flat
+however large the filelist), and train sparse embeddings held in a
+parameter server — the reference's CTR workflow on this framework.
 Run: python examples/ctr_ps_training.py
 """
 import os
@@ -12,24 +15,36 @@ import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.ps import ParameterServer, PsClient
-from paddle_tpu.io import InMemoryDataset
+from paddle_tpu.io import QueueDataset
 from paddle_tpu.ops import sequence_ops
 
 
+class CtrDataGenerator(fleet.MultiSlotDataGenerator):
+    """Raw log line "id1 id2 ...,label" → MultiSlot sample (reference:
+    fleet data_generator user subclass)."""
+
+    def generate_sample(self, line):
+        def gen():
+            ids_part, label = line.strip().split(",")
+            ids = [int(v) for v in ids_part.split()]
+            yield [("ids", ids), ("label", [float(label)])]
+        return gen
+
+
 def write_data(d, files=4, rows=2000, vocab=5000):
+    """Author the dataset: raw logs run through the data generator."""
     rng = np.random.RandomState(0)
     paths = []
     for i in range(files):
-        p = os.path.join(d, f"part-{i}")
-        with open(p, "w") as f:
-            for _ in range(rows):
-                n = rng.randint(1, 10)
-                ids = rng.randint(0, vocab, n)
-                label = float(ids.sum() % 2)
-                f.write(f"{n} " + " ".join(map(str, ids))
-                        + f" 1 {label}\n")
-        paths.append(p)
+        raw = []
+        for _ in range(rows):
+            n = rng.randint(1, 10)
+            ids = rng.randint(0, vocab, n)
+            raw.append(" ".join(map(str, ids)) + f",{float(ids.sum() % 2)}")
+        paths.append(CtrDataGenerator().run_to_file(
+            raw, os.path.join(d, f"part-{i}")))
     return paths
 
 
@@ -38,14 +53,11 @@ def main():
     d = tempfile.mkdtemp()
     paths = write_data(d, vocab=vocab)
 
-    ds = InMemoryDataset()
+    ds = QueueDataset(queue_capacity=2048)   # host memory bound: 2048 recs
     ds.set_use_var([("ids", "int64"), ("label", "float32")])
     ds.set_filelist(paths)
     ds.set_batch_size(512)
     ds.set_thread(4)
-    print("loaded", ds.load_into_memory(), "records,",
-          ds.memory_bytes() // 1024, "KiB")
-    ds.local_shuffle(seed=1)
 
     server = ParameterServer(port=0)
     server.add_sparse_table(0, dim=dim, optimizer="adagrad", lr=0.1)
@@ -79,7 +91,8 @@ def main():
             losses.append(float(loss.numpy()))
         st = client.stats()[0]
         print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
-              f"(PS rows {st['rows']}, pushes {st['push_count']})")
+              f"(PS rows {st['rows']}, pushes {st['push_count']}, "
+              f"queue peak {ds.queue_peak_depth()} recs)")
 
     client.stop_server()
     client.close()

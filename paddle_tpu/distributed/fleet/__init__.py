@@ -29,3 +29,7 @@ init_server = fleet.init_server
 run_server = fleet.run_server
 stop_worker = fleet.stop_worker
 save_persistables = fleet.save_persistables
+from . import data_generator  # noqa: F401
+from .data_generator import (DataGenerator, MultiSlotDataGenerator,  # noqa: F401
+                             MultiSlotStringDataGenerator)
+from . import metrics  # noqa: F401

@@ -1,0 +1,95 @@
+"""fleet.metrics — distributed (allreduced) evaluation metrics.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py:1 — every
+trainer holds LOCAL statistic tensors (correct counts, abs error sums, AUC
+stat arrays); these helpers allreduce the statistics across the process
+group and compute the global metric, so the result equals a single-process
+evaluation over the union of the data.
+
+TPU-native: rides paddle.distributed.all_reduce — inside a compiled SPMD
+step that is an XLA psum over the mesh; on the eager multi-process path it
+rides the coordination-service host allreduce. Single process: identity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["sum", "max", "min", "acc", "mae", "mse", "rmse", "auc"]
+
+
+
+def _allreduce(arr, op="sum"):
+    from .. import collective as C
+    t = to_tensor(np.asarray(arr))
+    red = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+           "min": C.ReduceOp.MIN}[op]
+    C.all_reduce(t, op=red)
+    return np.asarray(t.numpy())
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def sum(input, scope=None, util=None):
+    """reference: metric.py sum — global sum of a local statistic."""
+    return _allreduce(_np(input), "sum")
+
+
+def max(input, scope=None, util=None):
+    return _allreduce(_np(input), "max")
+
+
+def min(input, scope=None, util=None):
+    return _allreduce(_np(input), "min")
+
+
+def acc(correct, total, scope=None, util=None):
+    """reference: metric.py acc — global accuracy from local
+    (correct, total) counts."""
+    c = float(_allreduce(_np(correct), "sum"))
+    t = float(_allreduce(_np(total), "sum"))
+    return c / t if t else 0.0
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """reference: metric.py mae — global mean absolute error from the
+    local |err| sum and instance count."""
+    e = float(_allreduce(_np(abserr).sum(), "sum"))
+    n = float(_allreduce(_np(total_ins_num), "sum"))
+    return e / n if n else 0.0
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = float(_allreduce(_np(sqrerr).sum(), "sum"))
+    n = float(_allreduce(_np(total_ins_num), "sum"))
+    return e / n if n else 0.0
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """reference: metric.py auc — global AUC from per-trainer threshold
+    histograms (stat_pos/stat_neg: positive/negative counts per score
+    bucket, the same layout paddle_tpu.metric.Auc accumulates)."""
+    pos = _allreduce(_np(stat_pos).astype(np.float64), "sum").reshape(-1)
+    neg = _allreduce(_np(stat_neg).astype(np.float64), "sum").reshape(-1)
+    # walk buckets from high score to low accumulating TP/FP (trapezoid)
+    tot_pos = pos.sum()
+    tot_neg = neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    return float(area / (tot_pos * tot_neg))

@@ -173,7 +173,74 @@ class InMemoryDataset:
 
 
 class QueueDataset(InMemoryDataset):
-    """reference: QueueDataset — streaming variant. This build shares the
-    in-memory engine (files are parsed up front by load_into_memory); the
-    API surface is identical, only the memory profile differs from the
-    reference's true streaming mode."""
+    """reference: QueueDataset (framework/data_set.cc) — TRUE streaming:
+    C++ parser threads fill a bounded record queue while batches() drains
+    it, so host memory is bounded by `queue_capacity` records (+ one
+    staged batch), not the dataset size. local_shuffle is unavailable in
+    streaming mode (the reference QueueDataset doesn't shuffle either —
+    shuffling needs the data in memory)."""
+
+    def __init__(self, queue_capacity: int = 4096):
+        super().__init__()
+        self._queue_capacity = int(queue_capacity)
+        self._stream_gen = 0  # ties each batches() generator to ITS stream
+
+    def set_queue_num(self, n):  # reference API name for capacity tuning
+        self._queue_capacity = max(int(n), 1)
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from the filelist; use InMemoryDataset "
+            "for load_into_memory/local_shuffle (reference dataset.py "
+            "raises the same way)")
+
+    def local_shuffle(self, seed: int = 0):
+        raise RuntimeError("QueueDataset cannot shuffle a stream; use "
+                           "InMemoryDataset.local_shuffle")
+
+    def queue_peak_depth(self) -> int:
+        """High-water mark (records) of the bounded queue — the bounded-
+        memory evidence."""
+        from ..native import lib
+        return int(lib().df_stream_queue_peak(self._ensure_handle()))
+
+    def batches(self, drop_last: bool = None):
+        """Stream {slot: (padded, lengths)} batches off the parser queue."""
+        import ctypes as _ct
+        from ..native import lib
+        h = self._ensure_handle()
+        L = lib()
+        paths = "\n".join(self._filelist).encode()
+        dl = self._drop_last if drop_last is None else drop_last
+        self._stream_gen += 1
+        my_gen = self._stream_gen
+        L.df_stream_begin(h, paths, self._thread_num, self._batch_size,
+                          1 if dl else 0, self._queue_capacity)
+        try:
+            while True:
+                if self._stream_gen != my_gen:
+                    raise RuntimeError(
+                        "a newer batches() stream was started on this "
+                        "QueueDataset; this generator is stale (one "
+                        "active stream per dataset)")
+                n = L.df_stream_next_batch(h)
+                if n < 0:
+                    raise RuntimeError("stream failed: "
+                                       + L.df_last_error(h).decode())
+                if n == 0:
+                    return
+                out = {}
+                for si, spec in enumerate(self._slots):
+                    maxlen = max(int(L.df_batch_maxlen(h, si)), 1)
+                    dtype = np.int64 if spec.dtype == "u" else np.float32
+                    buf = np.empty((n, maxlen), dtype=dtype)
+                    lens = np.zeros(n, np.int64)
+                    L.df_batch_fill(
+                        h, si, buf.ctypes.data_as(_ct.c_void_p),
+                        lens.ctypes.data_as(_ct.POINTER(_ct.c_int64)),
+                        maxlen, float(self._pad_values.get(spec.name, 0.0)))
+                    out[spec.name] = (buf, lens)
+                yield out
+        finally:
+            if self._stream_gen == my_gen:   # don't tear down a newer stream
+                L.df_stream_end(h)

@@ -76,6 +76,15 @@ def _load():
                                       ctypes.POINTER(ctypes.c_int64),
                                       ctypes.c_int64, ctypes.c_double]
         lib.df_release_memory.argtypes = [ctypes.c_void_p]
+        lib.df_stream_begin.restype = ctypes.c_int
+        lib.df_stream_begin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_int64]
+        lib.df_stream_next_batch.restype = ctypes.c_int
+        lib.df_stream_next_batch.argtypes = [ctypes.c_void_p]
+        lib.df_stream_queue_peak.restype = ctypes.c_int64
+        lib.df_stream_queue_peak.argtypes = [ctypes.c_void_p]
+        lib.df_stream_end.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 

@@ -20,7 +20,10 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <condition_variable>
+#include <deque>
 #include <fstream>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <string>
@@ -38,6 +41,29 @@ struct SlotColumn {
   int64_t len(int64_t rec) const { return offsets[rec + 1] - offsets[rec]; }
 };
 
+struct StreamRecord {
+  // one parsed record: typed storage sized by the number of slots of each
+  // type (not nslots of both — the queue is the memory bound, keep it lean)
+  std::vector<std::vector<float>> f;    // [n_float_slots]
+  std::vector<std::vector<int64_t>> i;  // [n_int_slots]
+};
+
+struct StreamState {
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<StreamRecord> q;
+  size_t cap = 1024;
+  size_t peak = 0;          // high-water mark of the record queue
+  int eof_workers = 0;      // workers finished
+  int n_workers = 0;
+  bool stop = false;
+  bool failed = false;
+  std::string err;
+  std::vector<std::string> files;
+  std::atomic<size_t> next_file{0};
+  std::vector<std::thread> workers;
+};
+
 struct DataFeed {
   std::vector<SlotColumn> slots;
   int64_t n_records = 0;
@@ -48,6 +74,8 @@ struct DataFeed {
   bool drop_last = false;
   // current batch record ids
   std::vector<int64_t> cur;
+  std::unique_ptr<StreamState> stream;
+  int64_t last_stream_peak = 0;
   std::mutex mu;
   std::string last_error;
 };
@@ -96,16 +124,17 @@ struct ParsedShard {
   int64_t n_records = 0;
 };
 
-bool parse_file(const std::string& path, const DataFeed* proto,
-                ParsedShard* out, std::string* err) {
+// Shared per-line read loop: parse each record and hand the per-slot
+// vectors to `sink`; sink returns false to abort (e.g. stream shutdown).
+template <typename Sink>
+bool for_each_record(const std::string& path, const DataFeed* proto,
+                     std::string* err, Sink&& sink) {
   std::ifstream in(path);
   if (!in) {
     *err = "cannot open " + path;
     return false;
   }
   size_t ns = proto->slots.size();
-  out->slots.resize(ns);
-  for (size_t s = 0; s < ns; ++s) out->slots[s].type = proto->slots[s].type;
   std::vector<std::vector<float>> frec(ns);
   std::vector<std::vector<int64_t>> irec(ns);
   std::string line;
@@ -118,19 +147,35 @@ bool parse_file(const std::string& path, const DataFeed* proto,
       *err = path + ":" + std::to_string(lineno) + ": malformed record";
       return false;
     }
-    for (size_t s = 0; s < ns; ++s) {
-      auto& col = out->slots[s];
-      if (col.type == 'f') {
-        col.fvals.insert(col.fvals.end(), frec[s].begin(), frec[s].end());
-        col.offsets.push_back((int64_t)col.fvals.size());
-      } else {
-        col.ivals.insert(col.ivals.end(), irec[s].begin(), irec[s].end());
-        col.offsets.push_back((int64_t)col.ivals.size());
-      }
-    }
-    ++out->n_records;
+    if (!sink(frec, irec)) return true;  // sink asked to stop (not an error)
   }
   return true;
+}
+
+bool parse_file(const std::string& path, const DataFeed* proto,
+                ParsedShard* out, std::string* err) {
+  size_t ns = proto->slots.size();
+  out->slots.resize(ns);
+  for (size_t s = 0; s < ns; ++s) out->slots[s].type = proto->slots[s].type;
+  return for_each_record(
+      path, proto, err,
+      [&](const std::vector<std::vector<float>>& frec,
+          const std::vector<std::vector<int64_t>>& irec) {
+        for (size_t s = 0; s < ns; ++s) {
+          auto& col = out->slots[s];
+          if (col.type == 'f') {
+            col.fvals.insert(col.fvals.end(), frec[s].begin(),
+                             frec[s].end());
+            col.offsets.push_back((int64_t)col.fvals.size());
+          } else {
+            col.ivals.insert(col.ivals.end(), irec[s].begin(),
+                             irec[s].end());
+            col.offsets.push_back((int64_t)col.ivals.size());
+          }
+        }
+        ++out->n_records;
+        return true;
+      });
 }
 
 void append_shard(DataFeed* df, ParsedShard&& sh) {
@@ -164,7 +209,18 @@ void* df_create(const char* slot_types) {
   return df;
 }
 
-void df_destroy(void* h) { delete (DataFeed*)h; }
+void df_destroy(void* h) {
+  auto* df = (DataFeed*)h;
+  if (df->stream) {  // stop parser threads before tearing down
+    {
+      std::lock_guard<std::mutex> g(df->stream->mu);
+      df->stream->stop = true;
+      df->stream->cv_push.notify_all();
+    }
+    for (auto& t : df->stream->workers) t.join();
+  }
+  delete df;
+}
 
 const char* df_last_error(void* h) {
   return ((DataFeed*)h)->last_error.c_str();
@@ -296,6 +352,177 @@ void df_batch_fill(void* h, int slot, void* out, int64_t* lens,
       lens[b] = n;
     }
   }
+}
+
+// ---------------------------------------------------------------------
+// True streaming mode (reference: framework/data_set.cc QueueDataset —
+// parser threads feed a bounded blocking queue consumed batch-by-batch;
+// memory is bounded by the queue capacity, not the dataset size).
+
+static void stream_worker(DataFeed* df) {
+  auto* st = df->stream.get();
+  size_t ns = df->slots.size();
+  // typed slot index: slot s -> position among slots of its type
+  std::vector<size_t> tidx(ns);
+  size_t nf = 0, ni = 0;
+  for (size_t s = 0; s < ns; ++s)
+    tidx[s] = (df->slots[s].type == 'f') ? nf++ : ni++;
+  bool aborted = false;
+  while (!aborted) {
+    size_t fi = st->next_file.fetch_add(1);
+    if (fi >= st->files.size()) break;
+    std::string err;
+    bool ok = for_each_record(
+        st->files[fi], df, &err,
+        [&](const std::vector<std::vector<float>>& frec,
+            const std::vector<std::vector<int64_t>>& irec) {
+          StreamRecord rec;
+          rec.f.resize(nf);
+          rec.i.resize(ni);
+          for (size_t s = 0; s < ns; ++s) {
+            if (df->slots[s].type == 'f') rec.f[tidx[s]] = frec[s];
+            else rec.i[tidx[s]] = irec[s];
+          }
+          std::unique_lock<std::mutex> lk(st->mu);
+          st->cv_push.wait(lk, [st] {
+            return st->q.size() < st->cap || st->stop || st->failed;
+          });
+          if (st->stop || st->failed) {
+            aborted = true;
+            return false;  // stop reading this file
+          }
+          st->q.push_back(std::move(rec));
+          st->peak = std::max(st->peak, st->q.size());
+          st->cv_pop.notify_one();
+          return true;
+        });
+    if (!ok) {
+      std::lock_guard<std::mutex> g(st->mu);
+      st->failed = true;
+      st->err = err;
+      st->cv_pop.notify_all();
+      break;
+    }
+  }
+  std::lock_guard<std::mutex> g(st->mu);
+  if (++st->eof_workers == st->n_workers) st->cv_pop.notify_all();
+}
+
+// begin a streaming pass; queue capacity is in RECORDS
+int df_stream_begin(void* h, const char* paths, int nthreads,
+                    int batch_size, int drop_last, int64_t queue_cap) {
+  auto* df = (DataFeed*)h;
+  if (df->stream) {  // end any previous pass
+    {
+      std::lock_guard<std::mutex> g(df->stream->mu);
+      df->stream->stop = true;
+      df->stream->cv_push.notify_all();
+    }
+    for (auto& t : df->stream->workers) t.join();
+  }
+  df->stream.reset(new StreamState());
+  auto* st = df->stream.get();
+  {
+    std::string all(paths), cur;
+    for (char c : all) {
+      if (c == '\n') {
+        if (!cur.empty()) st->files.push_back(cur);
+        cur.clear();
+      } else {
+        cur += c;
+      }
+    }
+    if (!cur.empty()) st->files.push_back(cur);
+  }
+  st->cap = queue_cap < 1 ? 1 : (size_t)queue_cap;
+  df->batch_size = batch_size < 1 ? 1 : batch_size;
+  df->drop_last = drop_last != 0;
+  if (nthreads < 1) nthreads = 1;
+  nthreads = std::min<int>(nthreads, std::max<int>(1, (int)st->files.size()));
+  st->n_workers = nthreads;
+  for (int t = 0; t < nthreads; ++t)
+    st->workers.emplace_back(stream_worker, df);
+  return 0;
+}
+
+// pop the next batch off the queue into the staging columns; returns its
+// size (0 = stream done, -1 = error). Memory stays bounded: the staging
+// columns hold ONE batch.
+int df_stream_next_batch(void* h) {
+  auto* df = (DataFeed*)h;
+  auto* st = df->stream.get();
+  if (!st) return -1;
+  std::vector<StreamRecord> batch;
+  {
+    std::unique_lock<std::mutex> lk(st->mu);
+    while ((int)batch.size() < df->batch_size) {
+      st->cv_pop.wait(lk, [st] {
+        return !st->q.empty() || st->failed ||
+               st->eof_workers == st->n_workers;
+      });
+      if (st->failed) {
+        df->last_error = st->err;
+        return -1;
+      }
+      if (st->q.empty()) break;  // all workers done and queue drained
+      batch.push_back(std::move(st->q.front()));
+      st->q.pop_front();
+      st->cv_push.notify_one();
+    }
+  }
+  int n = (int)batch.size();
+  if (n == 0 || (df->drop_last && n < df->batch_size)) return 0;
+  // stage into the columns (cleared: bounded by one batch)
+  for (auto& col : df->slots) {
+    col.fvals.clear();
+    col.ivals.clear();
+    col.offsets.assign(1, 0);
+  }
+  {
+    std::vector<size_t> tidx(df->slots.size());
+    size_t nf = 0, ni = 0;
+    for (size_t s = 0; s < df->slots.size(); ++s)
+      tidx[s] = (df->slots[s].type == 'f') ? nf++ : ni++;
+    for (auto& rec : batch) {
+      for (size_t s = 0; s < df->slots.size(); ++s) {
+        auto& col = df->slots[s];
+        if (col.type == 'f') {
+          auto& src = rec.f[tidx[s]];
+          col.fvals.insert(col.fvals.end(), src.begin(), src.end());
+          col.offsets.push_back((int64_t)col.fvals.size());
+        } else {
+          auto& src = rec.i[tidx[s]];
+          col.ivals.insert(col.ivals.end(), src.begin(), src.end());
+          col.offsets.push_back((int64_t)col.ivals.size());
+        }
+      }
+    }
+  }
+  df->cur.resize(n);
+  for (int i = 0; i < n; ++i) df->cur[i] = i;
+  return n;
+}
+
+int64_t df_stream_queue_peak(void* h) {
+  auto* df = (DataFeed*)h;
+  if (!df->stream) return df->last_stream_peak;
+  std::lock_guard<std::mutex> g(df->stream->mu);
+  return std::max<int64_t>((int64_t)df->stream->peak,
+                           df->last_stream_peak);
+}
+
+void df_stream_end(void* h) {
+  auto* df = (DataFeed*)h;
+  if (!df->stream) return;
+  {
+    std::lock_guard<std::mutex> g(df->stream->mu);
+    df->last_stream_peak = std::max<int64_t>(df->last_stream_peak,
+                                             (int64_t)df->stream->peak);
+    df->stream->stop = true;
+    df->stream->cv_push.notify_all();
+  }
+  for (auto& t : df->stream->workers) t.join();
+  df->stream.reset();
 }
 
 void df_release_memory(void* h) {

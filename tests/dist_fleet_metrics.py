@@ -1,0 +1,58 @@
+"""Per-rank half of an eval set; fleet metrics must equal the single-rank
+metric over the union (reference fleet/metrics contract)."""
+import json
+import os
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+
+N_BUCKETS = 256
+
+
+def full_data():
+    rs = np.random.RandomState(7)
+    scores = rs.rand(400)
+    labels = (rs.rand(400) < scores * 0.8).astype(np.int64)  # correlated
+    preds = (scores > 0.5).astype(np.int64)
+    return scores, labels, preds
+
+
+def stats(scores, labels, preds):
+    buckets = np.minimum((scores * N_BUCKETS).astype(int), N_BUCKETS - 1)
+    pos = np.bincount(buckets[labels == 1], minlength=N_BUCKETS)
+    neg = np.bincount(buckets[labels == 0], minlength=N_BUCKETS)
+    correct = float((preds == labels).sum())
+    total = float(len(labels))
+    abserr = np.abs(scores - labels).sum()
+    sqrerr = ((scores - labels) ** 2).sum()
+    return pos, neg, correct, total, abserr, sqrerr
+
+
+def main():
+    env = paddle.distributed.init_parallel_env()
+    r, w = env.rank, env.world_size
+    scores, labels, preds = full_data()
+    per = len(scores) // w
+    sl = slice(r * per, (r + 1) * per)
+    pos, neg, correct, total, abserr, sqrerr = stats(
+        scores[sl], labels[sl], preds[sl])
+    rec = {
+        "rank": r,
+        "auc": fleet.metrics.auc(pos, neg),
+        "acc": fleet.metrics.acc(correct, total),
+        "mae": fleet.metrics.mae(abserr, total),
+        "rmse": fleet.metrics.rmse(sqrerr, total),
+        "sum": float(fleet.metrics.sum(np.asarray([correct]))[0]),
+    }
+    out_dir = os.environ.get("DIST_OUT_DIR")
+    path = os.path.join(out_dir, f"rank{r}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(rec, f)
+    os.replace(path + ".tmp", path)
+
+
+if __name__ == "__main__":
+    main()

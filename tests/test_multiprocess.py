@@ -118,3 +118,47 @@ def test_launcher_propagates_child_failure(tmp_path):
          "--nproc_per_node", "2", "--port", str(_free_port()), str(bad)],
         env=env, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 3
+
+
+@pytest.mark.slow
+def test_fleet_metrics_match_single_rank():
+    """Each rank evaluates half the data; fleet.metrics must equal the
+    single-process metric over the full set (VERDICT r2 item 8,
+    reference fleet/metrics/metric.py:1)."""
+    script = os.path.join(REPO, "tests", "dist_fleet_metrics.py")
+
+    def run(nproc):
+        last = None
+        for _ in range(3):
+            with tempfile.TemporaryDirectory() as out_dir:
+                env = dict(os.environ)
+                env["PYTHONPATH"] = REPO + os.pathsep + env.get(
+                    "PYTHONPATH", "")
+                env["JAX_PLATFORMS"] = "cpu"
+                env["DIST_OUT_DIR"] = out_dir
+                env.pop("XLA_FLAGS", None)
+                proc = subprocess.run(
+                    [sys.executable, "-m",
+                     "paddle_tpu.distributed.launch",
+                     "--nproc_per_node", str(nproc),
+                     "--port", str(_free_port()), script],
+                    env=env, capture_output=True, text=True, timeout=240)
+                recs = {}
+                for fn in os.listdir(out_dir):
+                    if fn.endswith(".json"):
+                        with open(os.path.join(out_dir, fn)) as f:
+                            rec = json.load(f)
+                        recs[rec["rank"]] = rec
+                if proc.returncode == 0 and len(recs) == nproc:
+                    return recs
+                last = proc
+        raise AssertionError(f"metrics cluster failed:\n{last.stderr}")
+
+    single = run(1)[0]
+    dist = run(2)
+    for metric in ("auc", "acc", "mae", "rmse", "sum"):
+        # both ranks agree, and equal the single-rank full-set value
+        np.testing.assert_allclose(dist[0][metric], dist[1][metric],
+                                   rtol=1e-9, err_msg=metric)
+        np.testing.assert_allclose(dist[0][metric], single[metric],
+                                   rtol=1e-6, err_msg=metric)
