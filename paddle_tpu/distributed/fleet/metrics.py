@@ -48,26 +48,27 @@ def min(input, scope=None, util=None):
     return _allreduce(_np(input), "min")
 
 
+def _ratio(num, den):
+    # ONE packed allreduce for (numerator, denominator): halves the host
+    # collective round trips per metric call
+    s = _allreduce(np.asarray([float(num), float(den)], np.float64), "sum")
+    return float(s[0]) / float(s[1]) if s[1] else 0.0
+
+
 def acc(correct, total, scope=None, util=None):
     """reference: metric.py acc — global accuracy from local
     (correct, total) counts."""
-    c = float(_allreduce(_np(correct), "sum"))
-    t = float(_allreduce(_np(total), "sum"))
-    return c / t if t else 0.0
+    return _ratio(_np(correct).sum(), _np(total).sum())
 
 
 def mae(abserr, total_ins_num, scope=None, util=None):
     """reference: metric.py mae — global mean absolute error from the
     local |err| sum and instance count."""
-    e = float(_allreduce(_np(abserr).sum(), "sum"))
-    n = float(_allreduce(_np(total_ins_num), "sum"))
-    return e / n if n else 0.0
+    return _ratio(_np(abserr).sum(), _np(total_ins_num).sum())
 
 
 def mse(sqrerr, total_ins_num, scope=None, util=None):
-    e = float(_allreduce(_np(sqrerr).sum(), "sum"))
-    n = float(_allreduce(_np(total_ins_num), "sum"))
-    return e / n if n else 0.0
+    return _ratio(_np(sqrerr).sum(), _np(total_ins_num).sum())
 
 
 def rmse(sqrerr, total_ins_num, scope=None, util=None):

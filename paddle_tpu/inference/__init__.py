@@ -270,3 +270,12 @@ class PredictorPool:
 
     def retrieve(self, idx: int) -> Predictor:
         return self._preds[idx]
+
+
+def capi_so_path() -> str:
+    """Path to the C predictor shared library (built on demand).
+    Reference: inference/capi/pd_predictor.cc — PD_NewPredictor /
+    PD_PredictorRun / PD_GetOutput; see tests/test_inference.py for the
+    ctypes binding pattern (Go/Rust/C bind the same symbols)."""
+    from ..native import capi_so_path as _p
+    return _p()

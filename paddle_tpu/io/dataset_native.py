@@ -139,6 +139,23 @@ class InMemoryDataset:
             pass
 
     # ---------------------------------------------------------------- batch
+    def _fill_batch(self, L, h, n) -> Dict[str, Tuple[np.ndarray,
+                                                      np.ndarray]]:
+        """Extract the staged native batch (shared by the in-memory and
+        streaming paths)."""
+        out = {}
+        for si, spec in enumerate(self._slots):
+            maxlen = max(int(L.df_batch_maxlen(h, si)), 1)
+            dtype = np.int64 if spec.dtype == "u" else np.float32
+            buf = np.empty((n, maxlen), dtype=dtype)
+            lens = np.zeros(n, np.int64)
+            L.df_batch_fill(
+                h, si, buf.ctypes.data_as(ctypes.c_void_p),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                maxlen, float(self._pad_values.get(spec.name, 0.0)))
+            out[spec.name] = (buf, lens)
+        return out
+
     def batches(self, drop_last: bool = None
                 ) -> Iterator[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
         """Yield {slot_name: (padded_values, lengths)} per batch."""
@@ -158,18 +175,7 @@ class InMemoryDataset:
             n = L.df_next_batch(h)
             if n == 0:
                 return
-            out = {}
-            for si, spec in enumerate(self._slots):
-                maxlen = max(int(L.df_batch_maxlen(h, si)), 1)
-                dtype = np.int64 if spec.dtype == "u" else np.float32
-                buf = np.empty((n, maxlen), dtype=dtype)
-                lens = np.zeros(n, np.int64)
-                L.df_batch_fill(
-                    h, si, buf.ctypes.data_as(ctypes.c_void_p),
-                    lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                    maxlen, float(self._pad_values.get(spec.name, 0.0)))
-                out[spec.name] = (buf, lens)
-            yield out
+            yield self._fill_batch(L, h, n)
 
 
 class QueueDataset(InMemoryDataset):
@@ -206,7 +212,6 @@ class QueueDataset(InMemoryDataset):
 
     def batches(self, drop_last: bool = None):
         """Stream {slot: (padded, lengths)} batches off the parser queue."""
-        import ctypes as _ct
         from ..native import lib
         h = self._ensure_handle()
         L = lib()
@@ -229,18 +234,7 @@ class QueueDataset(InMemoryDataset):
                                        + L.df_last_error(h).decode())
                 if n == 0:
                     return
-                out = {}
-                for si, spec in enumerate(self._slots):
-                    maxlen = max(int(L.df_batch_maxlen(h, si)), 1)
-                    dtype = np.int64 if spec.dtype == "u" else np.float32
-                    buf = np.empty((n, maxlen), dtype=dtype)
-                    lens = np.zeros(n, np.int64)
-                    L.df_batch_fill(
-                        h, si, buf.ctypes.data_as(_ct.c_void_p),
-                        lens.ctypes.data_as(_ct.POINTER(_ct.c_int64)),
-                        maxlen, float(self._pad_values.get(spec.name, 0.0)))
-                    out[spec.name] = (buf, lens)
-                yield out
+                yield self._fill_batch(L, h, n)
         finally:
             if self._stream_gen == my_gen:   # don't tear down a newer stream
                 L.df_stream_end(h)

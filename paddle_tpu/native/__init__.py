@@ -29,18 +29,28 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _compile():
+def _build_so(src: str, so: str, extra_flags=()):
+    """g++ compile-and-install. pid-unique temp: two processes building
+    concurrently must not write the same file (os.replace makes the final
+    install atomic either way)."""
     os.makedirs(_BUILD, exist_ok=True)
-    # pid-unique temp: two processes building concurrently must not write
-    # the same file (os.replace makes the final install atomic either way)
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", tmp]
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            src, "-o", tmp] + list(extra_flags))
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(
             f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
-    os.replace(tmp, _SO)
+    os.replace(tmp, so)
+
+
+def _stale(so: str, src: str) -> bool:
+    return (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src))
+
+
+def _compile():
+    _build_so(_SRC, _SO, ["-O3"])
 
 
 def _load():
@@ -48,8 +58,7 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        if _stale(_SO, _SRC):
             _compile()
         lib = ctypes.CDLL(_SO)
         lib.df_create.restype = ctypes.c_void_p
@@ -92,3 +101,29 @@ def _load():
 def lib():
     """The loaded native library (compiles on first use)."""
     return _load()
+
+
+# ---------------------------------------------------------------- C API
+_CAPI_SRC = os.path.join(_DIR, "src", "pd_capi.cc")
+_CAPI_SO = os.path.join(_BUILD, "_pd_capi.so")
+_capi_lock = threading.Lock()
+
+
+def _capi_compile():
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = f"python{sysconfig.get_config_var('py_version_short')}"
+    _build_so(_CAPI_SRC, _CAPI_SO,
+              [f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+               f"-l{pyver}"])
+
+
+def capi_so_path() -> str:
+    """Build (if stale) and return the pd_capi shared library path — the
+    C predictor surface (reference: inference/capi/pd_predictor.cc)
+    multi-language consumers dlopen/bind."""
+    with _capi_lock:
+        if _stale(_CAPI_SO, _CAPI_SRC):
+            _capi_compile()
+        return _CAPI_SO

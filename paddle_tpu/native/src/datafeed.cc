@@ -412,9 +412,11 @@ static void stream_worker(DataFeed* df) {
 int df_stream_begin(void* h, const char* paths, int nthreads,
                     int batch_size, int drop_last, int64_t queue_cap) {
   auto* df = (DataFeed*)h;
-  if (df->stream) {  // end any previous pass
+  if (df->stream) {  // end any previous pass (keep its high-water mark)
     {
       std::lock_guard<std::mutex> g(df->stream->mu);
+      df->last_stream_peak = std::max<int64_t>(
+          df->last_stream_peak, (int64_t)df->stream->peak);
       df->stream->stop = true;
       df->stream->cv_push.notify_all();
     }

@@ -1,0 +1,272 @@
+// C inference API.
+//
+// Reference: paddle/fluid/inference/capi/pd_predictor.cc (+ pd_config.cc,
+// c_api.h) — the C surface multi-language consumers bind (the Go binding
+// go/paddle/predictor.go is a cgo wrapper over exactly this API; binding
+// this .so from Go/Rust/C works the same way here).
+//
+// TPU-native design: the executable artifact is save_inference_model's
+// StableHLO export; execution needs the PJRT runtime, which lives behind
+// the Python package. So this .so embeds CPython the way the reference's
+// capi wraps its C++ AnalysisPredictor: C calls marshal raw buffers
+// (addresses + shapes, zero-copy in) into the embedded interpreter, which
+// runs the deserialized program and memmoves results into caller buffers.
+// Loaded from an existing Python process (ctypes), it reuses that
+// interpreter via PyGILState; loaded from a plain C program, it
+// initializes one.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <mutex>
+#include <vector>
+#include <string>
+
+namespace {
+
+const char* kEmbedded = R"PY(
+import ctypes
+import numpy as np
+
+_predictors = {}
+_next_id = [1]
+
+def _create(prefix):
+    from paddle_tpu.static.io import load_inference_model
+    prog, feeds, fetches = load_inference_model(prefix)
+    pid = _next_id[0]
+    _next_id[0] += 1
+    _predictors[pid] = {"prog": prog, "feeds": feeds, "fetches": fetches,
+                        "outputs": None}
+    return pid, feeds, fetches
+
+def _run(pid, specs):
+    # specs: list of (addr, shape tuple, dtype str) for each input
+    p = _predictors[pid]
+    args = []
+    for addr, shape, dtype in specs:
+        n = int(np.prod(shape)) if shape else 1
+        ct = {"float32": ctypes.c_float, "int64": ctypes.c_int64,
+              "int32": ctypes.c_int32}[dtype]
+        buf = (ct * n).from_address(addr)
+        args.append(np.ctypeslib.as_array(buf).reshape(shape)
+                    .astype(dtype, copy=True))
+    outs = p["prog"](*args)
+    p["outputs"] = [np.ascontiguousarray(np.asarray(o)) for o in outs]
+    return len(p["outputs"])
+
+def _output_meta(pid, idx):
+    o = _predictors[pid]["outputs"][idx]
+    return str(o.dtype), list(o.shape), int(o.nbytes)
+
+def _output_copy(pid, idx, addr, capacity):
+    o = _predictors[pid]["outputs"][idx]
+    if o.nbytes > capacity:
+        return -1
+    ctypes.memmove(addr, o.ctypes.data, o.nbytes)
+    return o.nbytes
+
+def _destroy(pid):
+    _predictors.pop(pid, None)
+)PY";
+
+std::mutex g_mu;
+bool g_ready = false;
+bool g_we_initialized = false;
+PyObject* g_ns = nullptr;  // module dict holding the embedded helpers
+std::string g_last_error;
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* msg = PyUnicode_AsUTF8(s);
+      if (msg) g_last_error = msg;
+      else PyErr_Clear();  // unencodable message: keep the generic text
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+bool ensure_runtime() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_ready) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  Gil gil;
+  PyObject* mod = PyImport_AddModule("__pd_capi__");  // borrowed
+  if (!mod) {
+    capture_py_error();
+    return false;
+  }
+  g_ns = PyModule_GetDict(mod);  // borrowed, lives with the module
+  PyObject* r = PyRun_String(kEmbedded, Py_file_input, g_ns, g_ns);
+  if (!r) {
+    capture_py_error();
+    return false;
+  }
+  Py_DECREF(r);
+  g_ready = true;
+  return true;
+}
+
+// Called once, outside the Gil RAII scope: a freshly-initialized
+// interpreter leaves the initializing thread holding the GIL, which would
+// deadlock PyGILState_Ensure from any OTHER consumer thread.
+void release_init_gil() {
+  if (g_we_initialized) {
+    PyEval_SaveThread();
+    g_we_initialized = false;
+  }
+}
+
+struct Predictor {
+  long pid = 0;
+  std::vector<std::string> feeds, fetches;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* PD_LastError() { return g_last_error.c_str(); }
+
+// ---- lifetime ------------------------------------------------------------
+void* PD_NewPredictor(const char* model_prefix) {
+  if (!ensure_runtime()) return nullptr;
+  release_init_gil();
+  Gil gil;
+  PyObject* fn = PyDict_GetItemString(g_ns, "_create");  // borrowed
+  PyObject* res = PyObject_CallFunction(fn, "s", model_prefix);
+  if (!res) {
+    capture_py_error();
+    return nullptr;
+  }
+  auto* p = new Predictor();
+  PyObject* pid = PyTuple_GetItem(res, 0);
+  PyObject* feeds = PyTuple_GetItem(res, 1);
+  PyObject* fetches = PyTuple_GetItem(res, 2);
+  p->pid = PyLong_AsLong(pid);
+  for (Py_ssize_t i = 0; i < PyList_Size(feeds); ++i)
+    p->feeds.push_back(PyUnicode_AsUTF8(PyList_GetItem(feeds, i)));
+  for (Py_ssize_t i = 0; i < PyList_Size(fetches); ++i)
+    p->fetches.push_back(PyUnicode_AsUTF8(PyList_GetItem(fetches, i)));
+  Py_DECREF(res);
+  return p;
+}
+
+void PD_DeletePredictor(void* h) {
+  if (!h) return;
+  auto* p = (Predictor*)h;
+  {
+    Gil gil;
+    PyObject* fn = PyDict_GetItemString(g_ns, "_destroy");
+    PyObject* r = PyObject_CallFunction(fn, "l", p->pid);
+    Py_XDECREF(r);
+  }
+  delete p;
+}
+
+// ---- introspection (reference: PD_GetInputNum/PD_GetInputName) -----------
+int PD_GetInputNum(void* h) { return (int)((Predictor*)h)->feeds.size(); }
+int PD_GetOutputNum(void* h) { return (int)((Predictor*)h)->fetches.size(); }
+const char* PD_GetInputName(void* h, int i) {
+  return ((Predictor*)h)->feeds[i].c_str();
+}
+const char* PD_GetOutputName(void* h, int i) {
+  return ((Predictor*)h)->fetches[i].c_str();
+}
+
+// ---- run (reference: PD_PredictorRun) ------------------------------------
+// inputs: n_inputs buffers; dtypes: per input, one of "float32"/"int64"/
+// "int32"; shapes: flattened dims; ndims: dims per input. Zero-copy in.
+int PD_PredictorRun(void* h, const void** buffers, const char** dtypes,
+                    const int64_t* shapes, const int* ndims, int n_inputs) {
+  auto* p = (Predictor*)h;
+  if (!g_ready) {
+    g_last_error = "runtime not initialized";
+    return -1;
+  }
+  Gil gil;
+  PyObject* specs = PyList_New(n_inputs);
+  const int64_t* sp = shapes;
+  for (int i = 0; i < n_inputs; ++i) {
+    PyObject* shape = PyTuple_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d)
+      PyTuple_SetItem(shape, d, PyLong_FromLongLong(sp[d]));
+    sp += ndims[i];
+    PyObject* spec = Py_BuildValue("(kNs)", (unsigned long)(uintptr_t)
+                                   buffers[i], shape, dtypes[i]);
+    PyList_SetItem(specs, i, spec);
+  }
+  PyObject* fn = PyDict_GetItemString(g_ns, "_run");
+  PyObject* res = PyObject_CallFunction(fn, "lN", p->pid, specs);
+  if (!res) {
+    capture_py_error();
+    return -1;
+  }
+  int n = (int)PyLong_AsLong(res);
+  Py_DECREF(res);
+  return n;
+}
+
+// ---- outputs (reference: PD_GetZeroCopyOutput) ---------------------------
+// Writes dtype name into dtype_buf, dims into shape (cap shape_cap),
+// returns ndim; nbytes receives the payload size.
+int PD_GetOutputMeta(void* h, int idx, char* dtype_buf, int dtype_cap,
+                     int64_t* shape, int shape_cap, int64_t* nbytes) {
+  auto* p = (Predictor*)h;
+  Gil gil;
+  PyObject* fn = PyDict_GetItemString(g_ns, "_output_meta");
+  PyObject* res = PyObject_CallFunction(fn, "li", p->pid, idx);
+  if (!res) {
+    capture_py_error();
+    return -1;
+  }
+  const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(res, 0));
+  std::snprintf(dtype_buf, dtype_cap, "%s", dt ? dt : "unknown");
+  if (!dt) PyErr_Clear();
+  PyObject* dims = PyTuple_GetItem(res, 1);
+  int nd = (int)PyList_Size(dims);
+  for (int d = 0; d < nd && d < shape_cap; ++d)
+    shape[d] = PyLong_AsLongLong(PyList_GetItem(dims, d));
+  *nbytes = PyLong_AsLongLong(PyTuple_GetItem(res, 2));
+  Py_DECREF(res);
+  return nd;
+}
+
+// Copies output idx into out (capacity bytes); returns bytes written or -1.
+int64_t PD_GetOutput(void* h, int idx, void* out, int64_t capacity) {
+  auto* p = (Predictor*)h;
+  Gil gil;
+  PyObject* fn = PyDict_GetItemString(g_ns, "_output_copy");
+  PyObject* res = PyObject_CallFunction(
+      fn, "likL", p->pid, idx, (unsigned long)(uintptr_t)out,
+      (long long)capacity);
+  if (!res) {
+    capture_py_error();
+    return -1;
+  }
+  int64_t n = PyLong_AsLongLong(res);
+  Py_DECREF(res);
+  if (n < 0) g_last_error = "output buffer too small";
+  return n;
+}
+
+}  // extern "C"

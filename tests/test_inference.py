@@ -90,3 +90,91 @@ def test_config_knobs_and_errors(tmp_path):
     pred = paddle_infer.create_predictor(c)
     with pytest.raises(RuntimeError):
         pred.run()  # inputs never set
+
+
+def test_c_api_predictor_roundtrip(tmp_path):
+    """VERDICT r2 item 10: a jit-saved model served through the C surface
+    ONLY (reference: inference/capi/pd_predictor.cc; the Go binding
+    go/paddle/predictor.go binds this same API)."""
+    import ctypes
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.native import capi_so_path
+
+    # build + save a model through the normal python surface
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            w = static.create_global_var([3, 2], 0.0, "float32", name="cw",
+                                         persistable=True)
+            out = paddle.matmul(x, w) + 1.5
+        exe = static.Executor()
+        exe.run(startup)
+        static.global_scope().set("cw", np.arange(6, dtype=np.float32)
+                                  .reshape(3, 2))
+        from paddle_tpu.static.io import save_inference_model
+        prefix = str(tmp_path / "cmodel")
+        save_inference_model(prefix, [x], [out], program=main)
+    finally:
+        paddle.disable_static()
+
+    # serve it through the C ABI only
+    L = ctypes.CDLL(capi_so_path())
+    L.PD_NewPredictor.restype = ctypes.c_void_p
+    L.PD_NewPredictor.argtypes = [ctypes.c_char_p]
+    L.PD_LastError.restype = ctypes.c_char_p
+    L.PD_GetInputNum.argtypes = [ctypes.c_void_p]
+    L.PD_GetOutputNum.argtypes = [ctypes.c_void_p]
+    L.PD_GetInputName.restype = ctypes.c_char_p
+    L.PD_GetInputName.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    L.PD_PredictorRun.restype = ctypes.c_int
+    L.PD_PredictorRun.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    L.PD_GetOutputMeta.restype = ctypes.c_int
+    L.PD_GetOutputMeta.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64)]
+    L.PD_GetOutput.restype = ctypes.c_int64
+    L.PD_GetOutput.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                               ctypes.c_void_p, ctypes.c_int64]
+    L.PD_DeletePredictor.argtypes = [ctypes.c_void_p]
+
+    h = L.PD_NewPredictor(prefix.encode())
+    assert h, L.PD_LastError().decode()
+    assert L.PD_GetInputNum(h) == 1 and L.PD_GetOutputNum(h) == 1
+    assert L.PD_GetInputName(h, 0) == b"x"
+
+    xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    bufs = (ctypes.c_void_p * 1)(xv.ctypes.data)
+    dts = (ctypes.c_char_p * 1)(b"float32")
+    shapes = (ctypes.c_int64 * 2)(4, 3)
+    nds = (ctypes.c_int * 1)(2)
+    n_out = L.PD_PredictorRun(h, bufs, dts, shapes, nds, 1)
+    assert n_out == 1, L.PD_LastError().decode()
+
+    dtype_buf = ctypes.create_string_buffer(16)
+    shape_out = (ctypes.c_int64 * 8)()
+    nbytes = ctypes.c_int64()
+    nd = L.PD_GetOutputMeta(h, 0, dtype_buf, 16, shape_out, 8,
+                            ctypes.byref(nbytes))
+    assert nd == 2 and dtype_buf.value == b"float32"
+    assert list(shape_out[:2]) == [4, 2]
+
+    result = np.empty((4, 2), np.float32)
+    wrote = L.PD_GetOutput(h, 0, result.ctypes.data, nbytes.value)
+    assert wrote == result.nbytes
+
+    wv = np.arange(6, dtype=np.float32).reshape(3, 2)
+    np.testing.assert_allclose(result, xv @ wv + 1.5, rtol=1e-5)
+
+    # error path: too-small buffer reports instead of corrupting
+    tiny = np.empty(1, np.float32)
+    assert L.PD_GetOutput(h, 0, tiny.ctypes.data, 4) == -1
+    L.PD_DeletePredictor(h)
