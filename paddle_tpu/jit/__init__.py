@@ -245,12 +245,32 @@ class TranslatedLayer(Layer):
 def save(layer, path, input_spec=None, **configs):
     """paddle.jit.save (reference: fluid/dygraph/jit.py:507 — saves
     __model__ ProgramDesc + params). Artifact: StableHLO (jax.export) +
-    pickled params; loadable without the model's Python class."""
+    pickled params; loadable without the model's Python class.
+
+    Dims given as -1/None in input_spec are exported SYMBOLIC
+    (jax.export symbolic_shape), so the saved model serves any batch size
+    — the reference's polymorphic batch dim. Falls back to concrete dims
+    (with a warning) if the model doesn't trace symbolically."""
     if input_spec is None:
-        raise ValueError("paddle.jit.save requires input_spec (shapes are "
-                         "static under XLA)")
-    specs = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
-             for s in input_spec]
+        raise ValueError("paddle.jit.save requires input_spec")
+
+    # ONE symbolic scope for all inputs (independent scopes fail export
+    # with 'invalid mixing of symbolic scopes'); dynamic dim 0 shares the
+    # symbol "b" across inputs — the paddle contract where -1 leading dims
+    # are one batch — while other dynamic dims get unique symbols.
+    scope = jax.export.SymbolicScope()
+
+    def _spec(i, sp):
+        dims = list(sp.shape)
+        if any(d in (-1, None) for d in dims):
+            expr = ",".join(
+                ("b" if j == 0 else f"d{i}_{j}") if d in (-1, None)
+                else str(d) for j, d in enumerate(dims))
+            return jax.ShapeDtypeStruct(
+                jax.export.symbolic_shape(expr, scope=scope), sp.dtype)
+        return jax.ShapeDtypeStruct(tuple(dims), sp.dtype)
+
+    specs = [_spec(i, s) for i, s in enumerate(input_spec)]
     fn = layer.forward if isinstance(layer, Layer) else layer
     if isinstance(fn, StaticFunction):
         fn = fn.forward_fn
@@ -259,24 +279,41 @@ def save(layer, path, input_spec=None, **configs):
                if b is not None}
     was_training = layer.training
     layer.eval()
+    try:
+        def pure(state, *arrs):
+            inner = _FunctionalizedLayer(fn, layer)
+            out, _ = inner.pure_call(state["params"], state["buffers"],
+                                     jax.random.PRNGKey(0), arrs, {})
+            return out
 
-    def pure(state, *arrs):
-        inner = _FunctionalizedLayer(fn, layer)
-        out, _ = inner.pure_call(state["params"], state["buffers"],
-                                 jax.random.PRNGKey(0), arrs, {})
-        return out
-
-    state = {"params": params, "buffers": buffers}
-    exported = jax.export.export(jax.jit(pure))(
-        jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state), *specs)
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path + ".pdmodel", "wb") as f:
-        f.write(exported.serialize())
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(jax.tree_util.tree_map(np.asarray, state), f)
-    if was_training:
-        layer.train()
+        state = {"params": params, "buffers": buffers}
+        state_spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+        try:
+            exported = jax.export.export(jax.jit(pure))(state_spec, *specs)
+        except Exception:
+            if not any(any(d in (-1, None) for d in s.shape)
+                       for s in input_spec):
+                raise
+            import warnings
+            warnings.warn(
+                "jit.save: symbolic-batch export failed (a shape-dependent "
+                "op in the model); re-exporting with dynamic dims pinned "
+                "to 1 — the artifact will only serve that batch size",
+                stacklevel=2)
+            concrete = [jax.ShapeDtypeStruct(
+                tuple(1 if d in (-1, None) else d for d in s.shape),
+                s.dtype) for s in input_spec]
+            exported = jax.export.export(jax.jit(pure))(state_spec,
+                                                        *concrete)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(jax.tree_util.tree_map(np.asarray, state), f)
+    finally:
+        if was_training:
+            layer.train()
 
 
 def load(path, **configs):

@@ -304,7 +304,15 @@ def convert_to_static(fn: Callable) -> Callable:
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
-    except (OSError, TypeError, SyntaxError):
+    except (OSError, TypeError, SyntaxError) as e:
+        import warnings
+        warnings.warn(
+            f"to_static: source for {getattr(fn, '__qualname__', fn)} is "
+            f"unavailable ({type(e).__name__}); python if/while on tensors "
+            "will be hard-staged by the tracer instead of converted to "
+            "lax control flow (REPL/exec-defined functions hit this — "
+            "define the function in a file to enable conversion)",
+            stacklevel=3)
         return fn if bound_self is None else fn.__get__(bound_self)
     func_def = tree.body[0]
     if not isinstance(func_def, ast.FunctionDef):

@@ -219,3 +219,37 @@ def test_data_generator_authors_native_format(tmp_path):
             return gen
     with pytest.raises(ValueError):
         BadGen().run_to_file(["x", "y"], str(tmp_path / "bad.txt"))
+
+
+def test_cpp_train_demo_builds_and_converges(tmp_path):
+    """C40 (reference fluid/train/demo/demo_trainer.cc): training driven
+    entirely from a standalone C++ program embedding the runtime."""
+    import os
+    import shutil
+    import subprocess
+    import sysconfig
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    if not sysconfig.get_config_var("Py_ENABLE_SHARED") \
+            or not sysconfig.get_config_var("LIBDIR"):
+        pytest.skip("python built without a shared libpython")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "paddle_tpu", "native", "demo",
+                       "train_demo.cc")
+    exe = str(tmp_path / "train_demo")
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = f"python{sysconfig.get_config_var('py_version_short')}"
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17", src, f"-I{inc}", f"-L{libdir}",
+         f"-Wl,-rpath,{libdir}", f"-l{pyver}", "-o", exe],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    run = subprocess.run([exe], capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "C++ train demo OK" in run.stdout

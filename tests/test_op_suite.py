@@ -570,7 +570,8 @@ TAIL_COVERED = {
     'add_position_encoding', 'affine_channel', 'anchor_generator',
     'average_accumulates', 'batch_fc', 'bilateral_slice',
     'bilinear_tensor_product', 'box_clip', 'correlation', 'ctc_align',
-    'deformable_conv', 'dequantize', 'dequantize_abs_max',
+    'deformable_conv', 'deformable_psroi_pooling', 'dequantize',
+    'dequantize_abs_max',
     'dequantize_log', 'diag_embed', 'dpsgd',
     'fake_channel_wise_dequantize_max_abs', 'fake_quantize_range_abs_max',
     'fusion_squared_mat_sub', 'gru_unit', 'hash',
