@@ -255,22 +255,24 @@ def save(layer, path, input_spec=None, **configs):
         raise ValueError("paddle.jit.save requires input_spec")
 
     # ONE symbolic scope for all inputs (independent scopes fail export
-    # with 'invalid mixing of symbolic scopes'); dynamic dim 0 shares the
-    # symbol "b" across inputs — the paddle contract where -1 leading dims
-    # are one batch — while other dynamic dims get unique symbols.
+    # with 'invalid mixing of symbolic scopes'), and dynamic dims share a
+    # symbol BY POSITION across inputs ("b" for dim 0, "d<j>" beyond): the
+    # (batch, seq, ...) convention where a tensor and its mask must agree.
+    # Inputs whose same-position dynamic dims genuinely differ fail the
+    # symbolic export and take the pinned-shape fallback below.
     scope = jax.export.SymbolicScope()
 
-    def _spec(i, sp):
+    def _spec(sp):
         dims = list(sp.shape)
         if any(d in (-1, None) for d in dims):
             expr = ",".join(
-                ("b" if j == 0 else f"d{i}_{j}") if d in (-1, None)
+                ("b" if j == 0 else f"d{j}") if d in (-1, None)
                 else str(d) for j, d in enumerate(dims))
             return jax.ShapeDtypeStruct(
                 jax.export.symbolic_shape(expr, scope=scope), sp.dtype)
         return jax.ShapeDtypeStruct(tuple(dims), sp.dtype)
 
-    specs = [_spec(i, s) for i, s in enumerate(input_spec)]
+    specs = [_spec(s) for s in input_spec]
     fn = layer.forward if isinstance(layer, Layer) else layer
     if isinstance(fn, StaticFunction):
         fn = fn.forward_fn

@@ -119,6 +119,19 @@ def _capi_compile():
                f"-l{pyver}"])
 
 
+_CRYPTO_SRC = os.path.join(_DIR, "src", "crypto.cc")
+_CRYPTO_SO = os.path.join(_BUILD, "_crypto.so")
+
+
+def crypto_so_path() -> str:
+    """Build (if stale) and return the AES cipher library (reference:
+    framework/io/crypto)."""
+    with _capi_lock:
+        if _stale(_CRYPTO_SO, _CRYPTO_SRC):
+            _build_so(_CRYPTO_SRC, _CRYPTO_SO, ["-O3"])
+        return _CRYPTO_SO
+
+
 def capi_so_path() -> str:
     """Build (if stale) and return the pd_capi shared library path — the
     C predictor surface (reference: inference/capi/pd_predictor.cc)

@@ -120,3 +120,4 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     from ..hapi.model_summary import flops as _flops
     return _flops(net, input_size, custom_ops=custom_ops,
                   print_detail=print_detail)
+from . import crypto  # noqa: F401

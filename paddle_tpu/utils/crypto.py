@@ -1,0 +1,101 @@
+"""Model-file encryption (AES-CTR).
+
+Reference: paddle/fluid/pybind/crypto.cc + framework/io/crypto/
+(Cipher/CipherFactory/AESCipher — encrypt model artifacts at rest so
+save/load round-trips ciphertext). The cipher core is native C++
+(native/src/crypto.cc, FIPS-197 AES in CTR mode) bound via ctypes like the
+rest of the native runtime.
+"""
+from __future__ import annotations
+
+import ctypes
+import functools
+import hashlib
+import os
+
+__all__ = ["AESCipher", "CipherFactory", "encrypt_file", "decrypt_file"]
+
+_MAGIC = b"PTPUAES1"
+
+
+@functools.lru_cache(maxsize=1)
+def _lib():
+    from ..native import crypto_so_path
+    L = ctypes.CDLL(crypto_so_path())
+    L.aes_ctr_xcrypt.restype = ctypes.c_int
+    L.aes_ctr_xcrypt.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.c_char_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int64]
+    L.aes_encrypt_block.restype = ctypes.c_int
+    L.aes_encrypt_block.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_char_p, ctypes.c_char_p]
+    return L
+
+
+class AESCipher:
+    """AES-CTR cipher (reference: framework/io/crypto/aes_cipher.cc).
+    Accepts a 16/24/32-byte key, or any passphrase (SHA-256 derived to a
+    32-byte key, like the reference's key file contract)."""
+
+    def __init__(self, key):
+        if isinstance(key, str):
+            key = key.encode()
+        if len(key) not in (16, 24, 32):
+            key = hashlib.sha256(key).digest()
+        self._key = bytes(key)
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        iv = os.urandom(16)
+        out = ctypes.create_string_buffer(len(plaintext))
+        rc = _lib().aes_ctr_xcrypt(self._key, len(self._key), iv,
+                                   bytes(plaintext), out, len(plaintext))
+        if rc != 0:
+            raise ValueError("bad AES key length")
+        return _MAGIC + iv + out.raw
+
+    def decrypt(self, blob: bytes) -> bytes:
+        if blob[:len(_MAGIC)] != _MAGIC:
+            raise ValueError(
+                "not a paddle_tpu AES artifact (missing magic header)")
+        if len(blob) < len(_MAGIC) + 16:
+            raise ValueError("truncated AES artifact (shorter than the "
+                             "header + IV)")
+        iv = blob[len(_MAGIC):len(_MAGIC) + 16]
+        body = blob[len(_MAGIC) + 16:]
+        out = ctypes.create_string_buffer(len(body))
+        rc = _lib().aes_ctr_xcrypt(self._key, len(self._key), iv, body,
+                                   out, len(body))
+        if rc != 0:
+            raise ValueError("bad AES key length")
+        return out.raw
+
+    def encrypt_to_file(self, plaintext: bytes, path: str):
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext))
+
+    def decrypt_from_file(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return self.decrypt(f.read())
+
+
+class CipherFactory:
+    """reference: crypto.cc CipherFactory::CreateCipher."""
+
+    @staticmethod
+    def create_cipher(config_fname: str = "", key=None) -> AESCipher:
+        if key is None:
+            raise ValueError("CipherFactory needs a key (config files "
+                             "carried only the cipher name in the "
+                             "reference; AES-CTR is the one cipher here)")
+        return AESCipher(key)
+
+
+def encrypt_file(src: str, dst: str, key):
+    with open(src, "rb") as f:
+        AESCipher(key).encrypt_to_file(f.read(), dst)
+
+
+def decrypt_file(src: str, dst: str, key):
+    data = AESCipher(key).decrypt_from_file(src)
+    with open(dst, "wb") as f:
+        f.write(data)
