@@ -28,7 +28,11 @@ class _RNGState:
     @classmethod
     def get_root_key(cls):
         if cls._root_key is None:
-            cls._root_key = jax.random.PRNGKey(cls.seed)
+            # The first use may be INSIDE a jit trace (e.g. a static
+            # startup program's initializer ops); the cached key must be a
+            # concrete array, not that trace's tracer.
+            with jax.ensure_compile_time_eval():
+                cls._root_key = jax.random.PRNGKey(cls.seed)
         return cls._root_key
 
 

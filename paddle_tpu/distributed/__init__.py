@@ -22,3 +22,6 @@ from .launch import launch  # noqa: F401
 class meta_parallel:
     from .tp_layers import (ColumnParallelLinear, RowParallelLinear,
                             VocabParallelEmbedding, ParallelCrossEntropy)
+from . import transpiler  # noqa: F401
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig)

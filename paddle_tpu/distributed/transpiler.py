@@ -1,0 +1,199 @@
+"""DistributeTranspiler — the legacy parameter-server transpile API.
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py:1 —
+rewrites a single-process training program into a trainer program (updates
+replaced by send/recv against pservers) plus per-endpoint pserver programs
+(listen_and_serv + the moved optimizer ops).
+
+TPU-native redesign: the transport and tables are the modern
+`distributed/ps` runtime (threaded TCP, server-side optimizers). transpile()
+splits the recorded static Program at its backward op: the trainer side
+keeps forward+backward (+grad clip) and fetches gradients, the Executor
+pushes them to the pservers and pulls fresh parameters each step; each
+pserver program hosts the dense tables routed to its endpoint
+(table_id % n_endpoints, the client's routing rule) and applies the
+server-side optimizer — the role the reference's listen_and_serv +
+moved-optimizer ops play.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class DistributeTranspilerConfig:
+    """reference: transpiler config knobs. slice_var_up/split sizes concern
+    the reference's row-sliced send; tables here route whole params (the
+    modern ps client's rule), so they are accepted and recorded only."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.min_block_size = 8192
+        self.split_method = None
+        self.sync_mode = True
+
+
+class _PServerProgram:
+    """Runnable pserver side. Executor.run() on this object serves forever
+    (like exe.run(pserver_program) on the reference's listen_and_serv)."""
+
+    def __init__(self, endpoint: str, tables: Dict[int, dict]):
+        self.endpoint = endpoint
+        self.tables = tables
+        self._server = None
+
+    def serve(self, block: bool = True):
+        from .ps import ParameterServer
+        host, port = self.endpoint.rsplit(":", 1)
+        self._server = ParameterServer(host=host, port=int(port))
+        for tid, spec in self.tables.items():
+            self._server.add_dense_table(
+                tid, spec["shape"], optimizer=spec["optimizer"],
+                lr=spec["lr"])
+        self._server.start()
+        if block:
+            import threading
+            threading.Event().wait()  # listen_and_serv never returns
+        return self._server
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop()
+
+
+class _TrainerProgram:
+    """Trainer side: forward+backward program + the push/pull protocol the
+    Executor drives around each step."""
+
+    def __init__(self, program, param_names: List[str],
+                 grad_names: List[str], endpoints: List[str],
+                 trainer_id: int, trainers: int, sync_mode: bool):
+        self.program = program            # update ops stripped
+        self.param_names = param_names
+        self.grad_names = grad_names
+        self.endpoints = endpoints
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.sync_mode = sync_mode
+        self._client = None
+
+    # -- protocol ---------------------------------------------------------
+    def _ensure_client(self, scope):
+        if self._client is not None:
+            return self._client
+        from .ps import PsClient
+        self._client = PsClient(self.endpoints)
+        if self.trainer_id == 0:
+            # trainer 0 seeds the tables from its initialized scope
+            # (reference: startup program runs on the pserver; the modern
+            # tables initialize server-side, so push the real init values)
+            for tid, name in enumerate(self.param_names):
+                self._client.set_dense(tid, np.asarray(scope.find_var(name)))
+        if self.trainers > 1:
+            self._client.barrier(self.trainers)
+        return self._client
+
+    def run_step(self, executor, feed, fetch_list, scope):
+        import jax.numpy as jnp
+        client = self._ensure_client(scope)
+        # pull fresh parameters into the scope
+        for tid, name in enumerate(self.param_names):
+            scope.set(name, jnp.asarray(client.pull_dense(tid)))
+        fetch_list = list(fetch_list or [])
+        outs = executor.run(self.program, feed=feed,
+                            fetch_list=fetch_list + self.grad_names)
+        user_outs = outs[:len(fetch_list)]
+        grads = outs[len(fetch_list):]
+        for tid, g in enumerate(grads):
+            client.push_dense(tid, np.asarray(g))
+        if self.sync_mode and self.trainers > 1:
+            client.barrier(self.trainers)
+        return user_outs
+
+
+class DistributeTranspiler:
+    """reference: distribute_transpiler.py DistributeTranspiler."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_prog = None
+        self._tables = None
+        self._endpoints = None
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=None):
+        from ..static.program import default_main_program
+        program = program or default_main_program()
+        endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        if not endpoints:
+            raise ValueError("transpile needs pserver endpoints "
+                             "(pservers='ip:port,ip:port')")
+
+        backward_ops = [od for od in program.ops
+                        if od.kind == "backward" and od.payload
+                        and not (isinstance(od.payload[0], str)
+                                 and od.payload[0] == "vjp")]
+        if not backward_ops:
+            raise ValueError(
+                "transpile: the program has no backward op — call "
+                "optimizer.minimize(loss) first (reference transpiler has "
+                "the same requirement)")
+        bw = backward_ops[-1]
+        _fwd, _loss, param_names = bw.payload
+        grad_names = list(bw.output_names)
+
+        # the server-side optimizer replaces the trainer's update ops
+        # (reference: optimizer ops move into the pserver program). Tables
+        # run SGD with the trainer program's learning rate; richer
+        # optimizers keep their accumulators trainer-side only in the
+        # modern fleet path (distributed/ps geo/async workers).
+        lr = 0.01
+        for key, fn in program._runtime_scalars.items():
+            if key.startswith("learning_rate"):
+                lr = float(np.asarray(fn()))
+                break
+        scope_shapes = {}
+        for name in param_names:
+            v = program.global_block.vars[name]
+            scope_shapes[name] = tuple(int(d) for d in v.shape)
+
+        # trainer program: strip the update tail (keep fwd+bwd+clip)
+        trainer = program.clone()
+        trainer.global_block.ops = [
+            od for od in trainer.global_block.ops
+            if not od.op_type.startswith("optimize.update")]
+
+        self._endpoints = endpoints
+        self._tables = {
+            tid: {"shape": scope_shapes[name], "optimizer": "sgd",
+                  "lr": lr, "param": name}
+            for tid, name in enumerate(param_names)}
+        self._trainer_prog = _TrainerProgram(
+            trainer, list(param_names), grad_names, endpoints,
+            int(trainer_id), int(trainers), bool(sync_mode))
+        return self
+
+    # -- reference API ----------------------------------------------------
+    def get_trainer_program(self, wait_port=True):
+        if self._trainer_prog is None:
+            raise RuntimeError("call transpile() first")
+        return self._trainer_prog
+
+    def get_pserver_program(self, endpoint):
+        if self._tables is None:
+            raise RuntimeError("call transpile() first")
+        idx = self._endpoints.index(endpoint)
+        mine = {tid: spec for tid, spec in self._tables.items()
+                if tid % len(self._endpoints) == idx}
+        return _PServerProgram(endpoint, mine)
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), \
+            self.get_startup_program(endpoint)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        from ..static.program import Program
+        return Program()  # tables initialize server-side; nothing to run

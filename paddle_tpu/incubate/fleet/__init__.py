@@ -1,0 +1,100 @@
+"""incubate.fleet — the fleet v1 API namespace.
+
+Reference: python/paddle/fluid/incubate/fleet/ (base/fleet_base.py Fleet/
+DistributedOptimizer, collective/, parameter_server/) — the pre-2.0 fleet
+surface. v1 was itself a wrapper layer; here it is a compat shim delegating
+to the fleet 2.0 implementation (distributed/fleet) so v1-era scripts run:
+`fleet.init(role)` / `fleet.distributed_optimizer(opt).minimize(loss)` /
+`is_worker`/`is_server`/`worker_num` keep their meanings.
+"""
+from __future__ import annotations
+
+from ...distributed.fleet import (  # noqa: F401
+    DistributedStrategy, PaddleCloudRoleMaker, UserDefinedRoleMaker)
+from ...distributed.fleet import fleet_base as _fb
+
+
+class _FleetV1:
+    """reference: incubate/fleet/base/fleet_base.py Fleet (v1 singleton)."""
+
+    def __init__(self):
+        self._fleet2 = _fb.Fleet()
+        self._inited = False
+
+    # -- lifecycle -------------------------------------------------------
+    def init(self, role_maker=None, is_collective=False):
+        self._fleet2.init(role_maker=role_maker,
+                          is_collective=is_collective)
+        self._inited = True
+        return self
+
+    def init_worker(self):
+        return self._fleet2.init_worker()
+
+    def init_server(self, model_dir=None, **kwargs):
+        return self._fleet2.init_server(model_dir, **kwargs)
+
+    def run_server(self):
+        return self._fleet2.run_server()
+
+    def stop_worker(self):
+        return self._fleet2.stop_worker()
+
+    # -- topology --------------------------------------------------------
+    def is_worker(self):
+        return self._fleet2.is_worker()
+
+    def is_server(self):
+        return self._fleet2.is_server()
+
+    def is_first_worker(self):
+        return self._fleet2.is_first_worker()
+
+    def worker_num(self):
+        return self._fleet2.worker_num()
+
+    def server_num(self):
+        return self._fleet2.server_num()
+
+    def worker_index(self):
+        return self._fleet2.worker_index()
+
+    def server_index(self):
+        rm = getattr(self._fleet2, "_role_maker", None)
+        if rm is not None and hasattr(rm, "server_index"):
+            return rm.server_index()
+        return 0  # single-server / collective roles
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._fleet2.worker_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._fleet2.server_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    # -- optimizer -------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference: fleet_base.py:255 — returns a DistributedOptimizer
+        whose minimize() applies the strategy's meta-optimizers (the v2
+        path underneath)."""
+        return self._fleet2.distributed_optimizer(optimizer, strategy)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ...static.io import save_inference_model as sim
+        import os
+        feed_vars = [main_program.global_block.var(n)
+                     for n in feeded_var_names]
+        return sim(os.path.join(dirname, "model"), feed_vars, target_vars,
+                   executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ...static.io import save
+        import os
+        return save(main_program, os.path.join(dirname, "persistables"))
+
+
+fleet = _FleetV1()
+DistributedOptimizer = _fb._FleetOptimizer  # v1 name for the wrapper

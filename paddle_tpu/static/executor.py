@@ -189,6 +189,14 @@ class Executor:
             return_numpy: bool = True, **kwargs):
         """reference: executor.py run:916 (feed dict in, fetched ndarrays
         out)."""
+        from ..distributed.transpiler import (_PServerProgram,
+                                              _TrainerProgram)
+        if isinstance(program, _PServerProgram):
+            # reference: exe.run(pserver_program) == listen_and_serv
+            return program.serve(block=True)
+        if isinstance(program, _TrainerProgram):
+            return program.run_step(self, feed, fetch_list,
+                                    scope or global_scope())
         program = program if program is not None else default_main_program()
         scope = scope or global_scope()
         feed = feed or {}

@@ -1,0 +1,112 @@
+"""DistributeTranspiler (legacy PS transpile API) over the modern ps
+runtime (reference: fluid/transpiler/distribute_transpiler.py:1)."""
+import socket
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.distributed import DistributeTranspiler
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    static.global_scope().drop_kids()
+    with paddle.utils.unique_name.guard():
+        paddle.enable_static()
+        yield
+        paddle.disable_static()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _build():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [16, 4], "float32")
+        y = static.data("y", [16, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = ((pred - y) ** 2).mean()
+        paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def test_transpiled_training_matches_local_sgd():
+    """Trainer+pserver split must reproduce the local program's loss
+    sequence step for step (server-side SGD == the stripped update)."""
+    w_true = np.array([[1.], [2.], [-1.], [0.5]], np.float32)
+    rs = np.random.RandomState(0)
+    data = [(xv, xv @ w_true) for xv in
+            (rs.randn(16, 4).astype(np.float32) for _ in range(10))]
+
+    paddle.seed(11)
+    main, startup, loss = _build()
+    exe = static.Executor()
+    exe.run(startup)
+    local = [float(exe.run(main, feed={"x": xv, "y": yv},
+                           fetch_list=[loss])[0]) for xv, yv in data]
+
+    static.global_scope().drop_kids()
+    paddle.seed(11)
+    main2, startup2, loss2 = _build()
+    exe2 = static.Executor()
+    exe2.run(startup2)
+    eps = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main2, pservers=",".join(eps),
+                trainers=1)
+    servers = [t.get_pserver_program(ep) for ep in eps]
+    for s in servers:
+        s.serve(block=False)  # in-thread for the test
+    try:
+        tp = t.get_trainer_program()
+        dist = [float(exe2.run(tp, feed={"x": xv, "y": yv},
+                               fetch_list=[loss2])[0]) for xv, yv in data]
+        np.testing.assert_allclose(dist, local, rtol=1e-4)
+        assert dist[-1] < dist[0] * 0.25
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_transpile_requires_backward():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 2], "float32")
+        static.nn.fc(x, 1)
+    with pytest.raises(ValueError):
+        DistributeTranspiler().transpile(0, program=main,
+                                         pservers="127.0.0.1:1")
+
+
+def test_fleet_v1_compat_namespace():
+    """incubate.fleet (fleet v1, reference incubate/fleet/base/
+    fleet_base.py) delegates to fleet 2.0: init/topology/
+    distributed_optimizer keep the v1 meanings."""
+    from paddle_tpu.incubate.fleet import fleet
+    paddle.disable_static()
+    fleet.init(is_collective=True)
+    assert fleet.is_worker() and not fleet.is_server()
+    assert fleet.worker_num() >= 1 and fleet.worker_index() == 0
+    assert fleet.is_first_worker()
+    assert isinstance(fleet.worker_endpoints(to_string=True), str)
+
+    m = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+    dopt = fleet.distributed_optimizer(opt)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+    l0 = float((((m(x) - y) ** 2).mean()).numpy())
+    for _ in range(5):
+        loss = ((m(x) - y) ** 2).mean()
+        dopt.minimize(loss)
+        m.clear_gradients()
+    assert float(loss.numpy()) < l0
