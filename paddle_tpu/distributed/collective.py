@@ -100,12 +100,23 @@ def _pmin(x, axis):
     return jax.lax.pmin(x, axis)
 
 
+def _pprod(x, axis):
+    """Product-allreduce correct for any reals and exact for ints
+    (reference c_allreduce_prod, operators/collective/c_allreduce_op.h:123
+    — NCCL prod handles sign and zero; exp(psum(log)) NaNs on negatives,
+    -infs on zeros, and truncates integer products). all_gather + local
+    product is exact; PROD traffic is rare enough that the world-size
+    gather is acceptable."""
+    gathered = jax.lax.all_gather(x, axis)  # [world, ...]
+    return jnp.prod(gathered, axis=0).astype(x.dtype)
+
+
 _REDUCERS = {
     ReduceOp.SUM: _psum,
     ReduceOp.MAX: _pmax,
     ReduceOp.MIN: _pmin,
     ReduceOp.AVG: lambda x, a: jax.lax.pmean(x, a),
-    ReduceOp.PROD: lambda x, a: jnp.exp(jax.lax.psum(jnp.log(x), a)),
+    ReduceOp.PROD: _pprod,
 }
 
 
@@ -196,13 +207,14 @@ class _P2PChannel:
 
     def __init__(self):
         import collections
-        import pickle
+        import hmac
         import queue
+        import secrets
         import socket
         import struct
         import threading
 
-        self._pickle, self._struct = pickle, struct
+        self._hmac, self._struct = hmac, struct
         self._queues = collections.defaultdict(queue.Queue)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -218,17 +230,44 @@ class _P2PChannel:
                 "send/recv across processes needs init_parallel_env() "
                 "(JAX coordination service not initialised)")
         self._client = client
-        client.key_value_set(f"paddle_tpu/p2p/{self._rank}", self._addr)
+        # per-listener random token published with the address via the
+        # coordination KV store: only processes bootstrapped by the same
+        # coordinator learn it, so a rogue local connection is dropped (the
+        # reference's NCCL p2p is gated the same way by the comm id).
+        # Per-rank (not rank-0-published) so p2p between any pair works
+        # even when rank 0 never opens a channel.
+        self._token = secrets.token_hex(16).encode()
+        client.key_value_set(f"paddle_tpu/p2p/{self._rank}",
+                             f"{self._addr}|{self._token.decode()}")
         threading.Thread(target=self._serve, daemon=True).start()
 
+    # wire format: token(32) | src i32 | dtype_len u8 | dtype | ndim u8 |
+    # shape i64*ndim | nbytes i64 | raw buffer. Raw ndarray bytes, never
+    # pickle — a rogue local connection must not get code execution
+    # (reference p2p moves raw NCCL buffers, send_v2_op.cc).
     def _serve(self):
         while True:
             conn, _ = self._sock.accept()
             try:
-                hdr = self._recv_exact(conn, 12)
-                src, length = self._struct.unpack("<iq", hdr)
-                payload = self._recv_exact(conn, length)
-                self._queues[src].put(self._pickle.loads(payload))
+                # bound each connection: a rogue peer that connects and
+                # stalls must not wedge the single-threaded accept loop
+                conn.settimeout(30)
+                token = self._recv_exact(conn, len(self._token))
+                if not self._hmac.compare_digest(token, self._token):
+                    continue  # unauthenticated peer: drop silently
+                src, dlen = self._struct.unpack(
+                    "<iB", self._recv_exact(conn, 5))
+                dtype = np.dtype(self._recv_exact(conn, dlen).decode("ascii"))
+                ndim, = self._struct.unpack("<B", self._recv_exact(conn, 1))
+                shape = self._struct.unpack(
+                    f"<{ndim}q", self._recv_exact(conn, 8 * ndim))
+                nbytes, = self._struct.unpack(
+                    "<q", self._recv_exact(conn, 8))
+                if nbytes != dtype.itemsize * int(np.prod(shape, dtype=np.int64)):
+                    continue  # malformed frame
+                payload = self._recv_exact(conn, nbytes)
+                arr = np.frombuffer(payload, dtype=dtype).reshape(shape)
+                self._queues[src].put(arr.copy())
             except Exception:
                 # a crashed/interrupted peer must not kill the accept
                 # loop — later recv() calls would hang undiagnosably
@@ -248,13 +287,20 @@ class _P2PChannel:
 
     def send(self, dst: int, arr):
         import socket
-        addr = self._client.blocking_key_value_get(
+        addr_tok = self._client.blocking_key_value_get(
             f"paddle_tpu/p2p/{dst}", 60_000)
+        addr, dst_token = addr_tok.rsplit("|", 1)
         host, port = addr.rsplit(":", 1)
-        payload = self._pickle.dumps(np.asarray(arr), protocol=4)
+        a = np.ascontiguousarray(np.asarray(arr))
+        dtype_b = a.dtype.str.encode("ascii")
+        hdr = (dst_token.encode()
+               + self._struct.pack("<iB", self._rank, len(dtype_b))
+               + dtype_b
+               + self._struct.pack("<B", a.ndim)
+               + self._struct.pack(f"<{a.ndim}q", *a.shape)
+               + self._struct.pack("<q", a.nbytes))
         with socket.create_connection((host, int(port)), timeout=60) as c:
-            c.sendall(self._struct.pack("<iq", self._rank, len(payload))
-                      + payload)
+            c.sendall(hdr + a.tobytes())
 
     def recv(self, src: int, timeout: float = 120.0):
         return self._queues[src].get(timeout=timeout)

@@ -100,13 +100,25 @@ class _TrainerProgram:
         # pull fresh parameters into the scope
         for tid, name in enumerate(self.param_names):
             scope.set(name, jnp.asarray(client.pull_dense(tid)))
+        if self.sync_mode and self.trainers > 1:
+            # end-of-pull barrier (reference: recv barrier) — without it a
+            # fast trainer's push of step N races a slow trainer's pull of
+            # step N, which would read half-updated parameters
+            client.barrier(self.trainers)
         fetch_list = list(fetch_list or [])
         outs = executor.run(self.program, feed=feed,
-                            fetch_list=fetch_list + self.grad_names)
+                            fetch_list=fetch_list + self.grad_names,
+                            scope=scope)
         user_outs = outs[:len(fetch_list)]
         grads = outs[len(fetch_list):]
+        # sync mode: each trainer pushes its gradient and the pserver applies
+        # an SGD step per push, so scale by 1/trainers to make the combined
+        # update lr*mean(grads) (reference: transpiler inserts a
+        # scale 1.0/trainer_num op on the pserver, distribute_transpiler.py:2237)
+        scale = (1.0 / self.trainers
+                 if (self.sync_mode and self.trainers > 1) else 1.0)
         for tid, g in enumerate(grads):
-            client.push_dense(tid, np.asarray(g))
+            client.push_dense(tid, np.asarray(g) * scale)
         if self.sync_mode and self.trainers > 1:
             client.barrier(self.trainers)
         return user_outs

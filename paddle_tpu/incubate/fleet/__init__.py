@@ -84,7 +84,10 @@ class _FleetV1:
                              target_vars, main_program=None,
                              export_for_deployment=True):
         from ...static.io import save_inference_model as sim
+        from ...static.program import default_main_program
         import os
+        if main_program is None:  # v1 callers usually omit it (fleet_base)
+            main_program = default_main_program()
         feed_vars = [main_program.global_block.var(n)
                      for n in feeded_var_names]
         return sim(os.path.join(dirname, "model"), feed_vars, target_vars,
@@ -92,7 +95,10 @@ class _FleetV1:
 
     def save_persistables(self, executor, dirname, main_program=None):
         from ...static.io import save
+        from ...static.program import default_main_program
         import os
+        if main_program is None:
+            main_program = default_main_program()
         return save(main_program, os.path.join(dirname, "persistables"))
 
 

@@ -29,6 +29,24 @@ def _wrap(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+# observability: did the last eligible call take the flash path? benches
+# assert on this; the first fallback warns once.
+LAST_PATH = None  # "flash" | "composed"
+_warned_fallback = False
+
+
+def _note_flash(ok: bool, err: Exception = None):
+    global LAST_PATH, _warned_fallback
+    LAST_PATH = "flash" if ok else "composed"
+    if not ok and not _warned_fallback:
+        _warned_fallback = True
+        import warnings
+        warnings.warn(
+            f"flash attention kernel unavailable, falling back to composed "
+            f"attention (~1.5x slower on the attention block): {err!r}",
+            RuntimeWarning, stacklevel=3)
+
+
 @op("scaled_dot_product_attention")
 def _sdpa(q, k, v, mask, causal, scale, drop_mask, dropout_p):
     # q,k,v: [B, T, H, D] (paddle layout) -> compute in [B, H, T, D]
@@ -70,9 +88,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if use_flash:
         try:
             from ...ops.pallas.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=is_causal, scale=sc)
-        except Exception:
-            pass  # fall back to composed path (e.g. odd shapes, CPU quirks)
+            out = flash_attention(q, k, v, causal=is_causal, scale=sc)
+            _note_flash(True)
+            return out
+        except Exception as e:
+            # fall back to composed path (e.g. odd shapes, CPU quirks) —
+            # but LOUDLY: a silent fallback costs ~1.5x attention time with
+            # green tests (round-3 verdict weak #4)
+            _note_flash(False, e)
     m = None if attn_mask is None else _wrap(attn_mask)
     drop_mask = None
     if dropout_active:

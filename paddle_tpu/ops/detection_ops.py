@@ -254,9 +254,14 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances=None,
         h_im, w_im = info[n, 0], info[n, 1]
         props[:, 0::2] = np.clip(props[:, 0::2], 0, w_im - 1)
         props[:, 1::2] = np.clip(props[:, 1::2], 0, h_im - 1)
-        ws = props[:, 2] - props[:, 0] + 1
-        hs = props[:, 3] - props[:, 1] + 1
-        ok = (ws >= min_size) & (hs >= min_size)
+        # reference FilterBoxes (detection/bbox_util.h): min_size clamped to
+        # >=1 and (is_scale) extents (x2-x1) rescaled to the original image
+        # via im_info[n, 2] before the +1 original-pixel convention
+        ms = max(min_size, 1.0)
+        im_scale = info[n, 2] if info.shape[1] > 2 and info[n, 2] > 0 else 1.0
+        ws = (props[:, 2] - props[:, 0]) / im_scale + 1
+        hs = (props[:, 3] - props[:, 1]) / im_scale + 1
+        ok = (ws >= ms) & (hs >= ms)
         props, ss = props[ok], s[top][ok]
         keep = _nms_np(props, ss, nms_thresh)[:post_nms_top_n]
         all_rois.append(props[keep])

@@ -667,16 +667,27 @@ def _add_pos_enc(x, alpha, beta):
     half = D // 2
     pos = jnp.arange(T, dtype=jnp.float32)[:, None]
     k = jnp.arange(half, dtype=jnp.float32)[None, :]
-    denom = jnp.power(10000.0, k / max(half - 1, 1))
-    val = pos / denom
+    if half == 1:
+        # reference add_position_encoding_op.h: half_size==1 uses
+        # val = pos / 10000.0 (the k/(half-1) exponent is undefined)
+        val = pos / 10000.0 * jnp.ones_like(k)
+    else:
+        denom = jnp.power(10000.0, k / (half - 1))
+        val = pos / denom
     pe = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=1)  # [T, D]
     return alpha * x + beta * pe[None].astype(x.dtype)
 
 
 def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
     """reference: operators/add_position_encoding_op.h:77-89 (first half
-    sin, second half cos, exponent k/(half-1))."""
-    return _add_pos_enc(_wrap(input), float(alpha), float(beta))
+    sin, second half cos, exponent k/(half-1); enforces even feature
+    size)."""
+    x = _wrap(input)
+    if x.shape[-1] % 2 != 0:
+        raise ValueError(
+            f"add_position_encoding requires an even feature size, got "
+            f"{x.shape[-1]} (reference enforces emb_dim % 2 == 0)")
+    return _add_pos_enc(x, float(alpha), float(beta))
 
 
 @op("affine_channel")

@@ -55,8 +55,6 @@ def _roi_align(x, boxes, boxes_num, out_h, out_w, spatial_scale,
     ys = y0[:, None] + bin_h[:, None] * iy[None, :]
     xs = x0[:, None] + bin_w[:, None] * ix[None, :]
 
-    # batch index per roi
-    ridx = jnp.repeat(jnp.arange(boxes_num.shape[0]), 0)  # placeholder
     # boxes_num: rois per image, cumulative mapping
     img_of_roi = jnp.searchsorted(jnp.cumsum(boxes_num), jnp.arange(R),
                                   side="right")

@@ -54,10 +54,8 @@ class NoamDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        if self.last_epoch == 0:
-            return self.base_lr * (self.d_model ** -0.5) * \
-                (self.warmup_steps ** -1.5) * 0  # step0 defined as 0 in ref? keep tiny
-        a = self.last_epoch ** -0.5
+        # reference lr.py:278: a=1 at step 0, so min(a, b)=b=0 -> lr 0
+        a = 1.0 if self.last_epoch == 0 else self.last_epoch ** -0.5
         b = self.warmup_steps ** -1.5 * self.last_epoch
         return self.base_lr * (self.d_model ** -0.5) * min(a, b)
 

@@ -1,0 +1,150 @@
+"""Round-4 correctness fixes (round-3 VERDICT weak #3/#5 + ADVICE items).
+
+Oracles: closed-form math (prod/sign, Noam formula) and the reference
+kernels' documented semantics (add_position_encoding_op.h, bbox_util.h
+FilterBoxes).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_allreduce_prod_handles_negatives_and_zeros():
+    """reference c_allreduce_prod (c_allreduce_op.h:123): NCCL prod is
+    sign-correct and zero-correct; exp(psum(log)) is not."""
+    from jax import shard_map
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import ReduceOp
+    from paddle_tpu.parallel.mesh import build_mesh, set_global_mesh
+
+    mesh = build_mesh(dp=8, pp=1, tp=1, sp=1, sharding=1)
+    set_global_mesh(mesh)
+
+    def body(x):
+        t = paddle.Tensor(x)
+        dist.all_reduce(t, op=ReduceOp.PROD)
+        return t._value
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                  check_vma=False)
+    # per-device columns: negatives, a zero, positives
+    x = jnp.asarray([[-2.0, 1.0, 3.0],
+                     [1.5, -1.0, 2.0],
+                     [1.0, 2.0, 0.0],
+                     [-1.0, 1.0, 1.0],
+                     [2.0, 1.0, 1.0],
+                     [1.0, -3.0, 2.0],
+                     [1.0, 1.0, 1.0],
+                     [-0.5, 2.0, 4.0]])
+    out = np.asarray(f(x))
+    expect = np.prod(np.asarray(x), axis=0)  # [-3.0, 12.0, 0.0] pattern
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-5)
+    assert expect[0] < 0 and expect[2] == 0  # the case actually exercises it
+    assert not np.any(np.isnan(out))
+
+    # integer PROD must be exact (NCCL prod is; exp(psum(log)) truncates)
+    xi = jnp.full((8, 1), 3, dtype=jnp.int32)
+    outi = np.asarray(f(xi))
+    assert outi.dtype == np.int32 and np.all(outi == 3 ** 8)
+
+
+def test_add_position_encoding_small_feature_sizes():
+    """reference add_position_encoding_op.h: half_size==1 uses pos/10000;
+    odd feature size is rejected."""
+    from paddle_tpu.ops import extra_ops
+
+    x = np.zeros((1, 3, 2), np.float32)
+    out = extra_ops.add_position_encoding(x, alpha=0.0, beta=1.0).numpy()
+    pos = np.arange(3) / 10000.0
+    np.testing.assert_allclose(out[0, :, 0], np.sin(pos), rtol=1e-6)
+    np.testing.assert_allclose(out[0, :, 1], np.cos(pos), rtol=1e-6)
+
+    with pytest.raises(ValueError):
+        extra_ops.add_position_encoding(np.zeros((1, 2, 3), np.float32))
+
+
+def test_noam_decay_matches_reference_formula():
+    """reference lr.py:278 — a=1 at step 0 so lr(0)=0; thereafter
+    min(step^-0.5, step*warmup^-1.5)."""
+    sched = paddle.optimizer.lr.NoamDecay(d_model=64, warmup_steps=10,
+                                          learning_rate=2.0)
+    assert sched.get_lr() == 0.0
+    vals = []
+    for _ in range(15):
+        sched.step()
+        vals.append(sched.get_lr())
+    for i, v in enumerate(vals, start=1):
+        expect = 2.0 * 64 ** -0.5 * min(i ** -0.5, i * 10 ** -1.5)
+        np.testing.assert_allclose(v, expect, rtol=1e-12)
+    # warmup is increasing then decaying
+    assert vals[0] < vals[8] and vals[14] < max(vals)
+
+
+def test_generate_proposals_min_size_scaled():
+    """reference bbox_util.h FilterBoxes: min_size clamped to >=1, widths
+    compared rescaled by im_info[2]. A box of width 8 at im_scale 4 maps to
+    original width 2+1=3 and must be DROPPED at min_size 5 even though its
+    scaled width 8 would pass the naive check."""
+    from paddle_tpu.ops.detection_ops import generate_proposals
+
+    H = W = 4
+    A = 1
+    scores = np.full((1, A, H, W), 0.5, np.float32)
+    deltas = np.zeros((1, A * 4, H, W), np.float32)
+    # anchors: one 8x8 box everywhere (decoded ~= anchor at zero deltas)
+    anchors = np.tile(np.array([0, 0, 8, 8], np.float32), (H * W * A, 1))
+    im_info = np.array([[64.0, 64.0, 4.0]], np.float32)  # scale 4
+
+    _, _, n_keep = generate_proposals(
+        scores, deltas, im_info, anchors, min_size=5.0, nms_thresh=0.9)
+    assert int(n_keep.numpy()[0]) == 0
+
+    # at im_scale 1 the same boxes (orig extent 8/1+1=9 >= 5) are kept
+    im_info1 = np.array([[64.0, 64.0, 1.0]], np.float32)
+    _, _, n_keep1 = generate_proposals(
+        scores, deltas, im_info1, anchors, min_size=5.0, nms_thresh=0.9)
+    assert int(n_keep1.numpy()[0]) > 0
+
+
+def test_fleet_v1_save_defaults_to_main_program(tmp_path):
+    """ADVICE: v1 save_persistables(main_program=None) must fall back to the
+    default main program like the reference fleet_base."""
+    import paddle_tpu.static as static
+    from paddle_tpu.incubate.fleet import fleet
+
+    paddle.enable_static()
+    try:
+        with paddle.utils.unique_name.guard():
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 2], "float32")
+                static.nn.fc(x, 1)
+                exe = static.Executor()
+                exe.run(startup)
+                fleet.init(is_collective=True)
+                # documented v1 call pattern: no explicit program
+                fleet.save_persistables(exe, str(tmp_path / "persist"))
+    finally:
+        paddle.disable_static()
+
+
+def test_flash_fallback_warns_once_and_records_path():
+    """round-3 VERDICT weak #4: a flash-attention fallback must be loud."""
+    import warnings
+    import paddle_tpu.nn.functional.attention as attn
+
+    attn._warned_fallback = False
+    attn.LAST_PATH = None
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        attn._note_flash(False, RuntimeError("boom"))
+        attn._note_flash(False, RuntimeError("boom"))  # only one warning
+    assert attn.LAST_PATH == "composed"
+    assert sum(issubclass(x.category, RuntimeWarning) for x in w) == 1
+    attn._note_flash(True)
+    assert attn.LAST_PATH == "flash"
