@@ -102,6 +102,13 @@ def bench_gpt(on_tpu: bool, num_heads: int = 6, iters: int = 30):
     sync()
     dt = time.perf_counter() - t0
 
+    # the flash kernel must actually have engaged on TPU — a silent
+    # composed-attention fallback would quietly cost ~1.5x (VERDICT r3 #4)
+    if on_tpu:
+        from paddle_tpu.nn.functional import attention as _attn
+        assert _attn.LAST_PATH == "flash", \
+            f"flash attention did not engage (LAST_PATH={_attn.LAST_PATH})"
+
     tokens_per_sec = batch * seq * iters / dt
     mfu = None
     if on_tpu:
@@ -146,7 +153,10 @@ def bench_lenet():
     step(x, y)
     _drain(model)
     t0 = time.perf_counter()
-    n = 20
+    # 100 iters: the axon-tunnel drain costs ~100ms per synchronous fetch,
+    # which at 20 iters inflated the per-step time ~37% (r4 measurement);
+    # async dispatch is ~0.03ms so the queue depth is harmless
+    n = 100
     for _ in range(n):
         step(x, y)
     _drain(model)
@@ -179,7 +189,12 @@ def bench_resnet(on_tpu: bool):
     step(x, y)  # creates opt state (first trace)
     step(x, y)  # compiles against the settled state signature
     _drain(model)
-    n = 15 if on_tpu else 2
+    # 40 iters amortizes the ~100ms axon-tunnel drain (12% distortion at
+    # the old n=15). ResNet-50 bs128 bf16 on v5e is HBM-roofline-bound:
+    # the step moves ~28 GB (profiled) at ~740 GB/s sustained of the
+    # chip's 819 GB/s — imgs/s is capped by bytes, not MXU flops (see
+    # BENCH_DETAIL.json resnet_roofline fields)
+    n = 40 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(n):
         step(x, y)

@@ -190,6 +190,16 @@ def _group_ranks(g: "Group"):
     return ranks
 
 
+def _dtype_from_name(name: str) -> np.dtype:
+    """np.dtype from a dtype NAME, covering the ml_dtypes extension types
+    (bfloat16, float8_*) that numpy's own constructor does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 class _P2PChannel:
     """Host-level point-to-point transport (reference: dygraph send/recv on
     NCCL p2p, operators/collective/send_v2_op.cc). CPU analogue: a TCP
@@ -257,7 +267,8 @@ class _P2PChannel:
                     continue  # unauthenticated peer: drop silently
                 src, dlen = self._struct.unpack(
                     "<iB", self._recv_exact(conn, 5))
-                dtype = np.dtype(self._recv_exact(conn, dlen).decode("ascii"))
+                dtype = _dtype_from_name(
+                    self._recv_exact(conn, dlen).decode("ascii"))
                 ndim, = self._struct.unpack("<B", self._recv_exact(conn, 1))
                 shape = self._struct.unpack(
                     f"<{ndim}q", self._recv_exact(conn, 8 * ndim))
@@ -292,7 +303,9 @@ class _P2PChannel:
         addr, dst_token = addr_tok.rsplit("|", 1)
         host, port = addr.rsplit(":", 1)
         a = np.ascontiguousarray(np.asarray(arr))
-        dtype_b = a.dtype.str.encode("ascii")
+        # dtype by NAME ('bfloat16', 'float32', ...): .str is '<V2' for the
+        # ml_dtypes extension types, which does not round-trip
+        dtype_b = a.dtype.name.encode("ascii")
         hdr = (dst_token.encode()
                + self._struct.pack("<iB", self._rank, len(dtype_b))
                + dtype_b
@@ -300,7 +313,11 @@ class _P2PChannel:
                + self._struct.pack(f"<{a.ndim}q", *a.shape)
                + self._struct.pack("<q", a.nbytes))
         with socket.create_connection((host, int(port)), timeout=60) as c:
-            c.sendall(hdr + a.tobytes())
+            c.sendall(hdr)
+            # zero-copy send; the uint8 view (not memoryview(a) directly)
+            # also covers ml_dtypes arrays, whose dtypes ('E' = bfloat16)
+            # the buffer protocol rejects
+            c.sendall(a.reshape(-1).view(np.uint8))
 
     def recv(self, src: int, timeout: float = 120.0):
         return self._queues[src].get(timeout=timeout)

@@ -48,11 +48,16 @@ def _note_flash(ok: bool, err: Exception = None):
 
 
 @op("scaled_dot_product_attention")
-def _sdpa(q, k, v, mask, causal, scale, drop_mask, dropout_p):
-    # q,k,v: [B, T, H, D] (paddle layout) -> compute in [B, H, T, D]
-    qh = jnp.swapaxes(q, 1, 2)
-    kh = jnp.swapaxes(k, 1, 2)
-    vh = jnp.swapaxes(v, 1, 2)
+def _sdpa(q, k, v, mask, causal, scale, drop_mask, dropout_p,
+          heads_major=False):
+    # q,k,v: [B, T, H, D] (paddle layout) -> compute in [B, H, T, D];
+    # heads_major: inputs are already [B, H, T, D] (and the output stays so)
+    if heads_major:
+        qh, kh, vh = q, k, v
+    else:
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
     logits = jnp.einsum("bhtd,bhsd->bhts", qh, kh) * scale
     if causal:
         t, s = logits.shape[-2], logits.shape[-1]
@@ -72,13 +77,19 @@ def _sdpa(q, k, v, mask, causal, scale, drop_mask, dropout_p):
         # pinned to avoid 0/0 -> NaN).
         probs = probs * drop_mask / max(1.0 - dropout_p, 1e-12)
     out = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
-    return jnp.swapaxes(out, 1, 2)
+    return out if heads_major else jnp.swapaxes(out, 1, 2)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, scale=None, name=None):
-    """q/k/v: [batch, seq, num_heads, head_dim] (paddle layout)."""
+                                 training=True, scale=None, name=None,
+                                 _heads_major=False):
+    """q/k/v: [batch, seq, num_heads, head_dim] (paddle layout).
+
+    _heads_major (internal, used by models.gpt): q/k/v arrive as
+    [batch, heads, seq, head_dim] — the pallas kernel's native layout —
+    and the output stays heads-major. Skips six 150 MB swapaxes copies
+    per block at GPT scale (the custom-call boundary materialises them)."""
     q, k, v = _wrap(query), _wrap(key), _wrap(value)
     head_dim = q.shape[-1]
     sc = scale if scale is not None else 1.0 / float(np.sqrt(head_dim))
@@ -88,7 +99,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if use_flash:
         try:
             from ...ops.pallas.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=is_causal, scale=sc)
+            out = flash_attention(q, k, v, causal=is_causal, scale=sc,
+                                  heads_major=_heads_major)
             _note_flash(True)
             return out
         except Exception as e:
@@ -100,9 +112,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     drop_mask = None
     if dropout_active:
         from ...core import random as _random
-        b, t, h = q.shape[0], q.shape[1], q.shape[2]
-        s = k.shape[1]
+        if _heads_major:
+            b, h, t = q.shape[0], q.shape[1], q.shape[2]
+            s = k.shape[2]
+        else:
+            b, t, h = q.shape[0], q.shape[1], q.shape[2]
+            s = k.shape[1]
         keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout_p,
                                     (b, h, t, s))
         drop_mask = Tensor(keep.astype(q._value.dtype))
-    return _sdpa(q, k, v, m, is_causal, sc, drop_mask, float(dropout_p))
+    return _sdpa(q, k, v, m, is_causal, sc, drop_mask, float(dropout_p),
+                 _heads_major)

@@ -76,9 +76,18 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
+    """stem_space_to_depth: compute the 7x7/s2 stem as an arithmetically
+    identical 4x4/s1 conv on a 2x2 space-to-depth folded input (12
+    channels). A 3-channel conv wastes the MXU's 128-deep contraction on
+    TPU; the fold raises stem arithmetic intensity 4x while keeping the
+    7x7 parameter layout (state dicts stay reference-compatible — the fold
+    happens in-graph). The MLPerf-ResNet TPU recipe."""
+
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1, data_format="NCHW"):
+                 with_pool=True, groups=1, data_format="NCHW",
+                 stem_space_to_depth=False):
         super().__init__()
+        self.stem_space_to_depth = stem_space_to_depth
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3],
                      50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
                      152: [3, 8, 36, 3]}
@@ -128,8 +137,42 @@ class ResNet(nn.Layer):
                                 data_format=self.data_format))
         return nn.Sequential(*layers)
 
+    def _stem_s2d(self, x):
+        """7x7/s2/p3 conv == 4x4/s1/VALID conv on the 2x2-folded input with
+        the kernel zero-padded to 8x8 and folded the same way (exact).
+        Built from framework ops so both the eager tape and jit tracing
+        differentiate through it."""
+        from ...ops import manipulation as M
+        from ...ops.math import cast
+        from ...nn import functional as F
+        nhwc = self.data_format == "NHWC"
+        if nhwc:
+            x = M.transpose(x, [0, 3, 1, 2])
+        N, C, H, W = x.shape
+        xp = M.pad(x, [0, 0, 0, 0, 3, 3, 3, 3])
+        xp = M.reshape(xp, [N, C, (H + 6) // 2, 2, (W + 6) // 2, 2])
+        xf = M.reshape(M.transpose(xp, [0, 3, 5, 1, 2, 4]),
+                       [N, 4 * C, (H + 6) // 2, (W + 6) // 2])
+        w = cast(self.conv1.weight, x.dtype)   # [64, C, 7, 7]
+        w8 = M.pad(w, [0, 0, 0, 0, 0, 1, 0, 1])
+        wf = M.reshape(M.transpose(
+            M.reshape(w8, [64, C, 4, 2, 4, 2]), [0, 3, 5, 1, 2, 4]),
+            [64, 4 * C, 4, 4])
+        out = F.conv2d(xf, wf, stride=1, padding="VALID")
+        if nhwc:
+            out = M.transpose(out, [0, 2, 3, 1])
+        return out
+
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        h_ax, w_ax = (1, 2) if self.data_format == "NHWC" else (2, 3)
+        if self.stem_space_to_depth and \
+                x.shape[h_ax] % 2 == 0 and x.shape[w_ax] % 2 == 0:
+            # the 2x2 fold needs even spatial dims; odd inputs take the
+            # plain stem (identical math, no crash)
+            x = self._stem_s2d(x)
+        else:
+            x = self.conv1(x)
+        x = self.relu(self.bn1(x))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

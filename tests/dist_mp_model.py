@@ -77,6 +77,18 @@ def collective_probe(rank, world):
             got = dist.recv(src=prv, shape=[1], dtype="float32")
             dist.send(token, dst=nxt)
         out["ring_recv"] = float(np.asarray(got.numpy())[0])
+        # bf16 ring: the raw-buffer p2p framing must round-trip the
+        # ml_dtypes extension types by NAME ('<V2' .str does not)
+        tok16 = paddle.to_tensor(
+            np.array([float(rank)], np.float32)).astype("bfloat16")
+        if rank % 2 == 0:
+            dist.send(tok16, dst=nxt)
+            got16 = dist.recv(src=prv, shape=[1], dtype="bfloat16")
+        else:
+            got16 = dist.recv(src=prv, shape=[1], dtype="bfloat16")
+            dist.send(tok16, dst=nxt)
+        out["ring_recv_bf16"] = float(np.asarray(
+            got16.astype("float32").numpy())[0])
     return out
 
 

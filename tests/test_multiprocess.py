@@ -95,6 +95,7 @@ def _assert_cluster(nproc: int):
                                        [expect_rs])
             # ring: rank r hears from (r-1) % w
             assert result[r]["ring_recv"] == float((r - 1) % nproc)
+            assert result[r]["ring_recv_bf16"] == float((r - 1) % nproc)
 
 
 @pytest.mark.slow

@@ -512,6 +512,7 @@ EXEMPT = {
     "rnn_scan_gru", "rnn_scan_lstm", "rnn_scan_simple", "gru_cell",
     "lstm_cell", "simple_rnn_cell", "scaled_dot_product_attention",
     "flash_attention",  # registered lazily by ops.pallas; engaged in test_nn
+    "flash_attention_hm",  # heads-major variant; parity in test_nn gpt test
     "batch_norm_train", "batch_norm_infer", "group_norm", "instance_norm",
     "ctc_loss", "cross_entropy_probs",
     # distributed/SPMD ops: test_distributed.py

@@ -133,6 +133,92 @@ def test_fleet_v1_save_defaults_to_main_program(tmp_path):
         paddle.disable_static()
 
 
+def test_resnet_space_to_depth_stem_parity():
+    """stem_space_to_depth folds the 7x7/s2 stem into an arithmetically
+    identical 4x4/s1 conv on a 2x2-folded input (the MLPerf TPU recipe);
+    same parameters, same output."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(3)
+    m = resnet18(num_classes=8)
+    m.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(5).randn(2, 3, 64, 64).astype(np.float32))
+    a = m.conv1(x).numpy()
+    m.stem_space_to_depth = True
+    b = m._stem_s2d(x).numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    # gradients flow through the folded path (tape + fold ops)
+    m.train()
+    out = m(x)
+    loss = (out ** 2).mean()
+    loss.backward()
+    g = m.conv1.weight.grad
+    assert g is not None and float(np.abs(g.numpy()).max()) > 0
+
+
+def test_sdpa_heads_major_parity():
+    """_heads_major=True takes [B,H,T,D] inputs/outputs (the flash kernel's
+    native layout, used by models.gpt to skip swapaxes copies) and must
+    match the standard [B,T,H,D] path bit-for-bit in value and grads."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 16, 4, 8
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+
+    def run(hm):
+        ts = []
+        for a in (q, k, v):
+            arr = a.transpose(0, 2, 1, 3) if hm else a
+            t = paddle.to_tensor(arr)
+            t.stop_gradient = False
+            ts.append(t)
+        out = F.scaled_dot_product_attention(
+            *ts, is_causal=True, _heads_major=hm)
+        o = out.numpy().transpose(0, 2, 1, 3) if hm else out.numpy()
+        (out ** 2).sum().backward()
+        gs = [t.grad.numpy() for t in ts]
+        if hm:
+            gs = [g.transpose(0, 2, 1, 3) for g in gs]
+        return o, gs
+
+    o0, g0 = run(False)
+    o1, g1 = run(True)
+    np.testing.assert_allclose(o0, o1, rtol=1e-6, atol=1e-6)
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_cross_entropy_matches_torch():
+    """The fused custom-vjp hard-label CE (no [N,V] log-prob
+    materialisation) must match torch in value and gradient, including
+    ignore_index rows."""
+    import torch
+    import torch.nn.functional as tF
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 11).astype(np.float32)
+    lab = np.array([1, 0, 10, -100, 4, 7])  # one ignored row
+
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    loss = F.cross_entropy(xt, paddle.to_tensor(lab), ignore_index=-100)
+    loss.backward()
+
+    tx = torch.tensor(x, requires_grad=True)
+    tl = tF.cross_entropy(tx, torch.tensor(lab), ignore_index=-100)
+    tl.backward()
+    np.testing.assert_allclose(float(loss.numpy()), float(tl.detach()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(xt.grad.numpy(), tx.grad.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
 def test_flash_fallback_warns_once_and_records_path():
     """round-3 VERDICT weak #4: a flash-attention fallback must be loud."""
     import warnings
