@@ -19,3 +19,4 @@ embeddings live on host tables while dense compute runs on chips. Here:
 from .table import DenseTable, SparseTable  # noqa: F401
 from .server import ParameterServer  # noqa: F401
 from .client import PsClient  # noqa: F401
+from .device_cache import DeviceEmbeddingCache  # noqa: F401

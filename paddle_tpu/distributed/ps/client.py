@@ -66,6 +66,22 @@ class PsClient:
                              "ids": np.asarray(ids, np.int64),
                              "grads": np.asarray(grads, np.float32)})
 
+    def set_sparse(self, table_id: int, ids, values, states=None):
+        """Direct row assignment (device-cache flush, PSGPU EndPass);
+        optionally carries per-row optimizer state."""
+        msg = {"cmd": "set_sparse", "table": table_id,
+               "ids": np.asarray(ids, np.int64),
+               "values": np.asarray(values, np.float32)}
+        if states is not None:
+            msg["states"] = np.asarray(states, np.float32)
+        self._rpc(table_id, msg)
+
+    def pull_sparse_state(self, table_id: int, ids) -> np.ndarray:
+        """Per-row optimizer state (adagrad g2sum analogue)."""
+        return self._rpc(table_id, {"cmd": "pull_sparse_state",
+                                    "table": table_id,
+                                    "ids": np.asarray(ids, np.int64)})
+
     # ------------------------------------------------------------- misc
     def barrier(self, world: int):
         """reference: ps barrier (service/communicator barrier_worker)."""

@@ -1,0 +1,157 @@
+"""Device-resident embedding cache over the host parameter server.
+
+Reference: paddle/fluid/framework/fleet/ps_gpu_wrapper.cc (PSGPU: hot
+embedding rows cached in device memory, pulled/pushed without leaving the
+accelerator; BuildGPUTask loads the working set from the PS, EndPass dumps
+it back) and heter_wrapper.cc (CPU worker + device worker split). BoxPS
+(box_wrapper.cc) is the same architecture productised.
+
+TPU-native redesign: the hot vocabulary [0, cache_rows) lives as an
+HBM-resident jnp table — shardable row-wise over a mesh axis for
+multi-chip — with the optimizer rule (sgd/adagrad, matching
+distributed/ps/table.py exactly) applied ON DEVICE via a jitted
+scatter update. Only ids >= cache_rows ("cold tail": the trillion-row
+overflow vocabulary in the reference's CTR workloads) ride the PS RPC.
+`flush()` writes the hot rows back to the PS (the EndPass analogue), so
+checkpoints taken from the PS stay complete.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DeviceEmbeddingCache"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sgd_update(table, state, rows, g, lr):
+    return table.at[rows].add(-lr * g), state
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _adagrad_update(table, state, rows, g, lr, eps=1e-6):
+    # identical rule to table.py _AdagradRule: state += g^2;
+    # value -= lr * g / (sqrt(state) + eps)
+    new_acc = state[rows] + g * g
+    state = state.at[rows].set(new_acc)
+    table = table.at[rows].add(-lr * g / (jnp.sqrt(new_acc) + eps))
+    return table, state
+
+
+@jax.jit
+def _gather(table, rows):
+    return table[rows]
+
+
+class DeviceEmbeddingCache:
+    """Hot-vocabulary embedding rows resident in device HBM, cold tail on
+    the host PS (reference: ps_gpu_wrapper.cc PSGPUWrapper).
+
+    client     : distributed.ps.PsClient serving the sparse table
+    table_id   : sparse table id on the PS
+    cache_rows : ids [0, cache_rows) are device-resident
+    dim        : embedding dim
+    optimizer  : 'sgd' | 'adagrad' — must match the PS table's rule so the
+                 hot/cold split is invisible to training semantics
+    mesh/axis  : optional jax Mesh + axis name; the hot table is laid out
+                 row-sharded over that axis (multi-chip HBM pooling, the
+                 way PSGPU shards over NCCL ranks)
+    """
+
+    def __init__(self, client, table_id: int, cache_rows: int, dim: int,
+                 optimizer: str = "adagrad", lr: float = 0.1,
+                 mesh=None, axis: Optional[str] = None):
+        self._client = client
+        self._table_id = table_id
+        self.cache_rows = int(cache_rows)
+        self.dim = int(dim)
+        self._lr = float(lr)
+        if optimizer in ("sgd", "SGD"):
+            self._update = _sgd_update
+        elif optimizer in ("adagrad", "Adagrad"):
+            self._update = _adagrad_update
+        else:
+            raise ValueError(
+                f"DeviceEmbeddingCache supports sgd/adagrad, got "
+                f"{optimizer!r} (match the PS table rule)")
+        # BuildGPUTask analogue: load the working set FROM the PS —
+        # values AND per-row optimizer state (the reference carries g2sum
+        # with the feature, ps_gpu_wrapper.cc), so adagrad step sizes
+        # continue rather than reset across the host/device boundary
+        ids = np.arange(self.cache_rows, dtype=np.int64)
+        hot = client.pull_sparse(table_id, ids)
+        table = jnp.asarray(np.asarray(hot, np.float32))
+        state = jnp.asarray(np.asarray(
+            client.pull_sparse_state(table_id, ids), np.float32))
+        if mesh is not None and axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(mesh, P(axis, None))
+            table = jax.device_put(table, sh)
+            state = jax.device_put(state, sh)
+        self.table = table
+        self._state = state
+        self.device_pulls = 0
+        self.host_pulls = 0
+
+    def _hot_mask(self, uniq_ids: np.ndarray) -> np.ndarray:
+        # negative ids must NOT be hot: jnp's wrap-around indexing would
+        # silently read/train a different row. They go to the host PS,
+        # which keys them as distinct rows (same as the pure-host path).
+        return (uniq_ids >= 0) & (uniq_ids < self.cache_rows)
+
+    # ------------------------------------------------------------- pull
+    def pull(self, uniq_ids: np.ndarray) -> jnp.ndarray:
+        """Rows for UNIQUE ids → [n, dim] device array. Hot rows are a
+        device gather; cold rows ride one pull_sparse RPC."""
+        uniq_ids = np.asarray(uniq_ids, np.int64)
+        hot_mask = self._hot_mask(uniq_ids)
+        if hot_mask.all():
+            self.device_pulls += 1
+            return _gather(self.table, jnp.asarray(uniq_ids))
+        cold_ids = uniq_ids[~hot_mask]
+        cold_rows = np.asarray(
+            self._client.pull_sparse(self._table_id, cold_ids), np.float32)
+        self.host_pulls += 1
+        self.device_pulls += 1
+        out = jnp.zeros((len(uniq_ids), self.dim), jnp.float32)
+        hot_pos = np.nonzero(hot_mask)[0]
+        cold_pos = np.nonzero(~hot_mask)[0]
+        out = out.at[jnp.asarray(hot_pos)].set(
+            _gather(self.table, jnp.asarray(uniq_ids[hot_pos])))
+        return out.at[jnp.asarray(cold_pos)].set(jnp.asarray(cold_rows))
+
+    # ------------------------------------------------------------- push
+    def push(self, uniq_ids: np.ndarray, grads) -> None:
+        """Apply gradients for UNIQUE ids: device scatter-update for hot
+        rows (optimizer rule on device — the PSGPU push path), push_sparse
+        for the cold tail."""
+        uniq_ids = np.asarray(uniq_ids, np.int64)
+        g = grads if isinstance(grads, jnp.ndarray) else jnp.asarray(
+            np.asarray(grads, np.float32))
+        hot_mask = self._hot_mask(uniq_ids)
+        hot_pos = np.nonzero(hot_mask)[0]
+        if hot_pos.size:
+            rows = jnp.asarray(uniq_ids[hot_pos])
+            self.table, self._state = self._update(
+                self.table, self._state, rows, g[jnp.asarray(hot_pos)],
+                self._lr)
+        cold_pos = np.nonzero(~hot_mask)[0]
+        if cold_pos.size:
+            self._client.push_sparse(
+                self._table_id, uniq_ids[cold_pos],
+                np.asarray(g[jnp.asarray(cold_pos)]))
+
+    # ------------------------------------------------------------ flush
+    def flush(self) -> None:
+        """EndPass analogue: write hot rows AND their optimizer state back
+        to the PS (direct row assignment — pushing a delta through the
+        table's own optimizer rule would corrupt it), so a PS-side save()
+        sees the trained values and host-side training can resume with
+        correct adagrad step sizes."""
+        self._client.set_sparse(
+            self._table_id, np.arange(self.cache_rows, dtype=np.int64),
+            np.asarray(self.table), states=np.asarray(self._state))

@@ -109,6 +109,14 @@ class ParameterServer:
         if cmd == "push_sparse":
             self._tables[msg["table"]].push(msg["ids"], msg["grads"])
             return {"status": "ok"}
+        if cmd == "set_sparse":
+            self._tables[msg["table"]].set(msg["ids"], msg["values"],
+                                           msg.get("states"))
+            return {"status": "ok"}
+        if cmd == "pull_sparse_state":
+            return {"status": "ok",
+                    "value": self._tables[msg["table"]].pull_state(
+                        msg["ids"])}
         if cmd == "barrier":
             # generation-counted barrier: predicate loop against spurious
             # wakeups; a timeout is an ERROR (an unsynchronized 'ok' would

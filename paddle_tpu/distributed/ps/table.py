@@ -121,6 +121,27 @@ class SparseTable:
                     self._row(rid), g, self._state.get(rid))
             self.push_count += 1
 
+    def set(self, ids, values, states=None):
+        """Direct row assignment (reference: PSGPU EndPass dumps the
+        device-trained rows AND their per-row optimizer state back,
+        ps_gpu_wrapper.cc — g2sum travels with the feature value)."""
+        values = np.asarray(values, np.float32)
+        with self._lock:
+            for n, (i, v) in enumerate(zip(np.asarray(ids), values)):
+                self._rows[int(i)] = v.copy()
+                if states is not None:
+                    self._state[int(i)] = np.asarray(states[n],
+                                                     np.float32).copy()
+
+    def pull_state(self, ids) -> np.ndarray:
+        """Per-row optimizer state (zeros for rows with none yet) — the
+        device cache loads this so adagrad step sizes continue rather
+        than reset across the host/device boundary."""
+        with self._lock:
+            return np.stack([
+                self._state.get(int(i), np.zeros(self.dim, np.float32))
+                for i in np.asarray(ids)])
+
     @property
     def size(self) -> int:
         with self._lock:

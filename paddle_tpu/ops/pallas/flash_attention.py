@@ -51,6 +51,12 @@ def _patch_dq_di_broadcast():
     good = "di = jnp.broadcast_to(di[..., None], (*di.shape, MIN_BLOCK_SIZE))"
     if bad not in src:
         return False  # upstream fixed; nothing to do
+    # second guard: only patch if the kernel provably reads di through a
+    # MIN_BLOCK_SIZE-wide BlockSpec — if a future jax consumes the full
+    # block_k_major width, shrinking the broadcast would be silently wrong
+    if ("di_spec = pl.BlockSpec((1, 1, block_q_major, MIN_BLOCK_SIZE)"
+            not in src):
+        return False
     # exec into the live module dict so the patched function shares the
     # module's globals (a snapshot copy would freeze later rebinds)
     exec(src.replace(bad, good), m.__dict__)  # noqa: S102 - vendored jax fix
