@@ -154,6 +154,21 @@ class Fleet:
             return LocalSGDStep(real_model, inner_loss_fn, opt,
                                 k_steps=int(cfg.get("k_steps", 1)),
                                 begin_step=int(cfg.get("begin_step", 1)))
+        if strategy.dgc:
+            from .comm_opt import DGCStep
+            if self._hybrid_mesh is not None and any(
+                    self._hybrid_mesh.shape.get(ax, 1) > 1
+                    for ax in ("tp", "pp", "sp", "sharding")):
+                raise NotImplementedError(
+                    "dgc runs per-rank gradient state over a pure dp mesh; "
+                    "tp/pp/sp/sharding degrees do not compose (the "
+                    "reference's dgc_optimizer is DP-collective-only too).")
+            cfg = strategy.dgc_configs
+            return DGCStep(
+                real_model, inner_loss_fn, opt,
+                rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+                rampup_step=int(cfg.get("rampup_step", 1)),
+                sparsity=cfg.get("sparsity", [0.999]))
         if strategy.fp16_allreduce:
             from .comm_opt import Fp16AllReduceStep
             if self._hybrid_mesh is not None and any(
@@ -284,13 +299,16 @@ class _FleetOptimizer:
 def _check_unsupported(strategy: DistributedStrategy):
     """Strategy flags must work or fail loudly — silent no-ops corrupt
     experiments (reference flags: distributed_strategy.proto)."""
-    if strategy.dgc:
+    if strategy.dgc and (strategy.localsgd or strategy.adaptive_localsgd):
         raise NotImplementedError(
-            "DistributedStrategy.dgc (deep gradient compression, reference "
-            "operators/optimizers/dgc_momentum_op) is not supported on the "
-            "TPU backend: ICI bandwidth makes top-k grad sparsification a "
-            "pessimization, and XLA collectives operate on dense buffers. "
-            "Use fp16_allreduce (bf16 comm) or localsgd instead.")
+            "dgc + localsgd cannot compose: LocalSGD does not communicate "
+            "gradients at all, so there is nothing to compress (the "
+            "reference's meta-optimizer graph rejects this pair too).")
+    if strategy.dgc and strategy.fp16_allreduce:
+        raise NotImplementedError(
+            "dgc + fp16_allreduce cannot compose: DGC replaces the dense "
+            "gradient allreduce with top-k sparsified sync (pick one; "
+            "reference dgc_optimizer owns the comm path exclusively).")
 
 
 def _apply_optimizer_strategies(optimizer, strategy: DistributedStrategy):
