@@ -201,22 +201,54 @@ def _desc_signature(desc: dict):
              for v in desc["vars"]})
 
 
-def save_program(program: Program, path_prefix: str):
-    """Program desc (JSON) + persistable values. reference:
-    fluid/io.py:621 + program_desc serialization."""
+def save_program(program: Program, path_prefix: str,
+                 format: str = "json"):
+    """Program desc + persistable values. reference: fluid/io.py:621 +
+    program_desc serialization. format='proto' writes the reference's
+    proto2 `__model__` wire format (framework.proto field numbering) via
+    static/proto_io.py; 'json' keeps the richer structural schema."""
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
+    if format == "proto":
+        from .proto_io import serialize_program_desc
+        blob = serialize_program_desc(program)
+    else:
+        blob = serialize_program(program)
     with open(path_prefix + ".pdmodel", "wb") as f:
-        f.write(serialize_program(program))
+        f.write(blob)
     save(program, path_prefix)
+
+
+def _read_desc(path_prefix: str) -> dict:
+    """Auto-detect desc format: JSON ('{') or proto2 (tag 0x0A for
+    blocks=1 len-delimited)."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        raw = f.read()
+    if raw[:1] == b"{":
+        return deserialize_program(raw)
+    from .proto_io import parse_program_desc
+    pd = parse_program_desc(raw)
+    blk = pd["blocks"][0]
+    # adapt the proto shape to the JSON desc schema for the signature
+    return {
+        "version": 1,
+        "vars": [{"name": v["name"], "shape": v["shape"],
+                  "dtype": v["dtype"], "persistable": v["persistable"],
+                  "is_parameter": False, "stop_gradient": True,
+                  "is_data": v["is_data"]} for v in blk["vars"]],
+        "ops": [{"kind": o["kind"], "type": o["type"],
+                 "inputs": o["inputs"], "outputs": o["outputs"]}
+                for o in blk["ops"]],
+        "runtime_scalars": [],
+        "_proto": True,
+    }
 
 
 def load_program(program: Program, path_prefix: str, strict: bool = True):
     """Verify `program` (rebuilt from the same model code) against the
     saved desc, then restore its persistables. Returns the parsed desc."""
-    with open(path_prefix + ".pdmodel", "rb") as f:
-        desc = deserialize_program(f.read())
+    desc = _read_desc(path_prefix)
     if strict:
         saved_sig = _desc_signature(desc)
         live_sig = _desc_signature(

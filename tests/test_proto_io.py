@@ -1,0 +1,179 @@
+"""ProgramDesc protobuf wire format (reference framework.proto:202).
+
+Three layers of evidence: deterministic golden bytes, round-trip through
+our own parser, and a cross-check with a STOCK protobuf decoder (protoc
+compiles the checked-in compat schema at test time; skipped when protoc
+is unavailable).
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.proto_io import (COMPAT_PROTO, parse_program_desc,
+                                        serialize_program_desc)
+
+
+def _tiny_program():
+    static = paddle.static
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        y = static.data("y", [4, 1], "float32")
+        out = static.nn.fc(x, 1)
+        loss = ((out - y) ** 2).mean()
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.static.global_scope().drop_kids()
+    with paddle.utils.unique_name.guard():
+        paddle.enable_static()
+        yield
+        paddle.disable_static()
+
+
+def test_wire_round_trip_preserves_structure():
+    main, _, _ = _tiny_program()
+    blob = serialize_program_desc(main)
+    desc = parse_program_desc(blob)
+    blk = desc["blocks"][0]
+    live_vars = {v.name: v for v in main.global_block.vars.values()}
+    got_vars = {v["name"]: v for v in blk["vars"]}
+    assert set(got_vars) == set(live_vars)
+    for name, v in live_vars.items():
+        assert got_vars[name]["shape"] == [int(d) for d in v.shape], name
+        assert got_vars[name]["persistable"] == bool(v.persistable), name
+    assert [o["type"] for o in blk["ops"]] == \
+        [od.op_type for od in main.ops]
+    assert [o["kind"] for o in blk["ops"]] == \
+        [od.kind for od in main.ops]
+    for o, od in zip(blk["ops"], main.ops):
+        assert o["inputs"] == list(od.input_names)
+        assert o["outputs"] == list(od.output_names)
+
+
+def test_golden_bytes_deterministic():
+    """Same program → identical bytes (the artifact is content-addressed
+    in downstream caches), and the wire prelude is the ProgramDesc
+    blocks=1 len-delimited tag followed by BlockDesc idx=0/parent=-1."""
+    main, _, _ = _tiny_program()
+    b1 = serialize_program_desc(main)
+    b2 = serialize_program_desc(main)
+    assert b1 == b2
+    assert b1[0] == 0x0A  # field 1 (blocks), wire type 2
+    # BlockDesc starts: idx=0 (08 00), parent_idx=-1 (10 <10-byte varint>)
+    body_start = b1.index(b"\x08\x00\x10")
+    assert body_start > 0
+    # Version message trailer: field 4 len-delim containing version=0
+    assert b1.endswith(b"\x22\x02\x08\x00")
+
+
+def test_stock_protobuf_decoder_reads_our_bytes(tmp_path):
+    """protoc-compile the compat schema and parse our bytes with the
+    official protobuf runtime — field-number-level wire compatibility."""
+    protoc = shutil.which("protoc")
+    if protoc is None:
+        pytest.skip("protoc not available")
+    (tmp_path / "compat.proto").write_text(COMPAT_PROTO)
+    subprocess.run([protoc, f"--python_out={tmp_path}", "compat.proto"],
+                   cwd=tmp_path, check=True)
+    sys.path.insert(0, str(tmp_path))
+    try:
+        os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
+                              "python")
+        import compat_pb2  # noqa: E402
+    finally:
+        sys.path.pop(0)
+
+    main, _, _ = _tiny_program()
+    blob = serialize_program_desc(main)
+    pd = compat_pb2.ProgramDesc()
+    pd.ParseFromString(blob)
+    assert len(pd.blocks) == 1
+    blk = pd.blocks[0]
+    assert blk.idx == 0 and blk.parent_idx == -1
+    live_vars = {v.name for v in main.global_block.vars.values()}
+    assert {v.name for v in blk.vars} == live_vars
+    # shapes/dtypes survive a stock decode
+    by_name = {v.name: v for v in blk.vars}
+    for v in main.global_block.vars.values():
+        tv = by_name[v.name]
+        assert tv.type.type == 7  # LOD_TENSOR
+        assert list(tv.type.lod_tensor.tensor.dims) == \
+            [int(d) for d in v.shape]
+    assert len(blk.ops) == len(main.ops)
+    for op, od in zip(blk.ops, main.ops):
+        assert op.inputs[0].arguments == list(od.input_names)
+        assert op.outputs[0].arguments == list(od.output_names)
+
+
+def test_ref_op_names_on_the_wire():
+    """Ops whose reference name differs are emitted under the REFERENCE
+    name (so reference-side tooling reads familiar types) and mapped back
+    through the rename table on load."""
+    from paddle_tpu.static.proto_io import LOCAL_TO_REF_OP
+    main, _, _ = _tiny_program()
+    blob = serialize_program_desc(main)
+    desc = parse_program_desc(blob)
+    for o in desc["blocks"][0]["ops"]:
+        if o["type"] in LOCAL_TO_REF_OP:
+            assert o["ref_type"] == LOCAL_TO_REF_OP[o["type"]]
+        assert o["type"] != ""  # every op mapped back to a local name
+
+
+def test_checked_in_schema_file_in_sync():
+    """paddle_tpu/static/framework_compat.proto is the reviewable copy of
+    the codec's schema — must match the COMPAT_PROTO the codec is built
+    against."""
+    import paddle_tpu.static.proto_io as m
+    path = os.path.join(os.path.dirname(m.__file__),
+                        "framework_compat.proto")
+    assert open(path).read() == COMPAT_PROTO
+
+
+def test_save_load_retrain_parity_proto_format(tmp_path):
+    """save_program(format='proto') → rebuild → load_program → identical
+    continued training (the JSON-format contract, now over the proto
+    wire)."""
+    from paddle_tpu.static.io import load_program, save_program
+    static = paddle.static
+
+    main, startup, loss = _tiny_program()
+    exe = static.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    xv = rs.randn(4, 3).astype(np.float32)
+    yv = rs.randn(4, 1).astype(np.float32)
+    for _ in range(3):
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    save_program(main, str(tmp_path / "model"), format="proto")
+    # artifact really is proto, not JSON
+    raw = (tmp_path / "model.pdmodel").read_bytes()
+    assert raw[:1] == b"\x0a"
+    expected = exe.run(main, feed={"x": xv, "y": yv},
+                       fetch_list=[loss])[0]
+
+    static.global_scope().drop_kids()
+    paddle.utils.unique_name.switch()
+    main2, startup2, loss2 = _tiny_program()
+    load_program(main2, str(tmp_path / "model"))
+    resumed = exe.run(main2, feed={"x": xv, "y": yv},
+                      fetch_list=[loss2])[0]
+    np.testing.assert_allclose(resumed, expected, rtol=1e-6)
+
+    # structural rejection still works through the proto path
+    main3 = static.Program()
+    startup3 = static.Program()
+    with static.program_guard(main3, startup3):
+        x = static.data("x", [4, 3], "float32")
+        static.nn.fc(x, 2)
+    with pytest.raises(ValueError):
+        load_program(main3, str(tmp_path / "model"))
