@@ -57,4 +57,6 @@ class BuildStrategy:
     fuse_elewise_add_act_ops = True
     enable_inplace = True
 from .debug_ops import Print, Assert  # noqa: F401
+from .rnn_shims import (StaticRNN, DynamicRNN, py_reader,  # noqa: F401
+                        read_file)
 from . import amp  # noqa: F401

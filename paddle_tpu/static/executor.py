@@ -207,6 +207,15 @@ class Executor:
         reads, writes, feed_needed = _analyze_program(program)
         feeds = {k: jnp.asarray(v.numpy() if isinstance(v, Tensor) else v)
                  for k, v in feed.items()}
+        # py_reader (static/rnn_shims.py): when started, it supplies the
+        # missing feeds for its data vars — the reference's async
+        # BufferedReader path; EOFError propagates at exhaustion
+        for reader in getattr(program, "_py_readers", []):
+            if reader._q is not None and any(
+                    n not in feeds for n in reader.names):
+                batch = reader.next_feed()
+                for k, v in batch.items():
+                    feeds.setdefault(k, jnp.asarray(v))
         rt = {k: jnp.asarray(fn()) for k, fn in
               program._runtime_scalars.items()}
 

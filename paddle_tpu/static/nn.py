@@ -329,3 +329,10 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
                     else enumerate(branch_fns)):
         pairs.append((branch_index == idx, fn))
     return case(pairs, default=default)
+
+
+# block-style RNN authoring + async reader (reference: fluid.layers
+# StaticRNN / DynamicRNN / py_reader) — implemented over lax.scan in
+# rnn_shims; re-exported here because fluid.layers was their home
+from .rnn_shims import (StaticRNN, DynamicRNN, py_reader,  # noqa: F401,E402
+                        read_file)

@@ -173,6 +173,9 @@ class Program:
         # them never recompiles
         self._runtime_scalars: Dict[str, Callable[[], np.ndarray]] = {}
         self.random_seed = 0
+        # async feed queues (static/rnn_shims.py py_reader) drained by the
+        # Executor when run() gets no feed dict
+        self._py_readers: list = []
 
     # ------------------------------------------------------------ structure
     @property

@@ -1,0 +1,405 @@
+"""Static-graph RNN authoring APIs + py_reader.
+
+Reference:
+- StaticRNN (python/paddle/fluid/layers/rnn.py:626 usage;
+  control-flow machinery in fluid/layers/control_flow.py): block-style
+  per-timestep authoring over a fixed-length [T, ...] sequence.
+- DynamicRNN (fluid/layers/control_flow.py): the variable-length
+  variant over LoD sequences.
+- py_reader (fluid/layers/reader.py:149 create_py_reader): an async
+  feed queue decoupling the Python producer from exe.run().
+
+TPU-native redesign: both RNNs lower to ONE `lax.scan` op in the
+recorded Program (compiler-friendly: XLA unrolls/pipelines the scan body
+instead of interpreting per-step sub-blocks the way while_op does).
+DynamicRNN takes this framework's native sequence form — padded
+[B, T, ...] plus a lengths vector (the LoD-offsets facade in core/lod.py
+converts) — and masks carry/output updates past each row's length, which
+is arithmetically the reference's LoD-bucketed execution. py_reader is a
+bounded host queue drained by the Executor when no feed dict is given
+(the C++ BufferedReader's role), raising EOFError at generator
+exhaustion like the reference's EOFException contract.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .program import (OpDesc, Program, Variable, default_main_program,
+                      program_guard)
+
+__all__ = ["StaticRNN", "DynamicRNN", "py_reader", "read_file"]
+
+
+class _RNNBase:
+    """Shared capture machinery: a sub-program recorded inside step()/
+    block(), lowered to lax.scan on completion."""
+
+    def __init__(self):
+        self._sub: Optional[Program] = None
+        self._guard = None
+        self._seq_inputs: List[tuple] = []   # (outer_name, inner_var)
+        self._static_inputs: List[tuple] = []
+        self._memories: List[dict] = []      # {inner, init_name, update}
+        self._outputs: List[Variable] = []
+        self._built = False
+        self._out_vars = None
+
+    # ---------------------------------------------------------- authoring
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            rnn = self.rnn
+            rnn._sub = Program()
+            rnn._guard = program_guard(rnn._sub)
+            rnn._guard.__enter__()
+            return rnn
+
+        def __exit__(self, *exc):
+            self.rnn._guard.__exit__(*exc)
+            self.rnn._guard = None
+            return False
+
+    def step(self):
+        """with rnn.step(): ... (reference StaticRNN.step)."""
+        return self._StepGuard(self)
+
+    block = step  # DynamicRNN spells it block()
+
+    def _inner(self, name, shape, dtype):
+        return self._sub.global_block.create_var(
+            name=self._sub.unique_name(name), shape=shape, dtype=dtype)
+
+    def static_input(self, x: Variable) -> Variable:
+        """A loop-invariant input visible at every step."""
+        iv = self._inner("rnn.static", x.shape, x._value.dtype)
+        self._static_inputs.append((x.name, iv))
+        return iv
+
+    def update_memory(self, mem: Variable, new: Variable):
+        for m in self._memories:
+            if m["inner"] is mem:
+                m["update"] = new
+                return
+        raise ValueError("update_memory: not a memory var of this RNN")
+
+    # ------------------------------------------------------------ lowering
+    def _scan_op(self, blk, prog, seq_axis_len_of, mask_names=()):
+        from .executor import _interpret
+        sub = self._sub
+        ops = list(sub.global_block.ops)
+        consts = dict(sub._consts)
+        seq_names = [outer for outer, _ in self._seq_inputs]
+        in_names = [iv.name for _, iv in self._seq_inputs]
+        stat_names = [outer for outer, _ in self._static_inputs]
+        stat_inner = [iv.name for _, iv in self._static_inputs]
+        mem_inner = [m["inner"].name for m in self._memories]
+        upd_names = [m["update"].name for m in self._memories]
+        init_names = [m["init_name"] for m in self._memories]
+        out_names = [v.name for v in self._outputs]
+        if any(u is None for u in upd_names):
+            raise ValueError("every memory needs an update_memory() call")
+
+        produced = set(consts) | set(in_names) | set(stat_inner) \
+            | set(mem_inner)
+        free = []
+        for od in ops:
+            for n in od.input_names:
+                if n not in produced and n not in free:
+                    free.append(n)
+            produced.update(od.output_names)
+
+        n_seq, n_init, n_stat = len(seq_names), len(init_names), \
+            len(stat_names)
+        n_mask = len(mask_names)
+
+        def scan_fn(*args):
+            seqs = args[:n_seq]
+            masks = args[n_seq:n_seq + n_mask]
+            inits = args[n_seq + n_mask:n_seq + n_mask + n_init]
+            stats = args[n_seq + n_mask + n_init:
+                         n_seq + n_mask + n_init + n_stat]
+            frees = args[n_seq + n_mask + n_init + n_stat:]
+
+            def body(carry, xs):
+                step_xs = xs[:n_seq]
+                step_mask = xs[n_seq] if n_mask else None
+                env = dict(consts)
+                env.update(zip(free, frees))
+                env.update(zip(stat_inner, stats))
+                env.update(zip(mem_inner, carry))
+                env.update(zip(in_names, step_xs))
+                _interpret(ops, env, dict(env))
+                new_carry = tuple(env[u] for u in upd_names)
+                if step_mask is not None:
+                    # past a row's length: hold the carry (the reference's
+                    # LoD bucketing simply stops stepping those rows)
+                    def hold(new, old):
+                        m = step_mask.reshape(
+                            (-1,) + (1,) * (new.ndim - 1)).astype(new.dtype)
+                        return new * m + old * (1 - m)
+                    new_carry = tuple(hold(n, o)
+                                      for n, o in zip(new_carry, carry))
+                ys = tuple(env[o] for o in out_names)
+                if step_mask is not None:
+                    ys = tuple(y * step_mask.reshape(
+                        (-1,) + (1,) * (y.ndim - 1)).astype(y.dtype)
+                        for y in ys)
+                return new_carry, ys
+
+            # scan over axis 0 of the [T, ...] sequences (+ [T, B] masks)
+            xs = tuple(seqs) + ((masks[0],) if n_mask else ())
+            _, stacked = jax.lax.scan(body, tuple(inits), xs)
+            return stacked
+
+        op_inputs = seq_names + list(mask_names) + init_names \
+            + stat_names + free
+        out_vars = []
+        for v in self._outputs:
+            T = seq_axis_len_of
+            ov = blk.create_var(name=prog.unique_name("rnn.out"),
+                                shape=(T,) + tuple(v.shape),
+                                dtype=v._value.dtype)
+            out_vars.append(ov)
+        blk.append_op(OpDesc("op", "static_rnn_scan", scan_fn, op_inputs,
+                             [v.name for v in out_vars]))
+        return out_vars
+
+
+class StaticRNN(_RNNBase):
+    """reference: fluid.layers.StaticRNN — fixed-length [T, ...] sequence,
+    block-style step authoring, lowered to one lax.scan."""
+
+    def step_input(self, x: Variable) -> Variable:
+        """x: [T, ...] time-major sequence; returns the per-step slice."""
+        iv = self._inner("rnn.in", tuple(x.shape[1:]), x._value.dtype)
+        self._seq_inputs.append((x.name, iv))
+        return iv
+
+    def memory(self, init: Variable = None, shape=None, value=0.0,
+               dtype="float32", batch_ref: Variable = None):
+        if init is not None:
+            iv = self._inner("rnn.mem", init.shape, init._value.dtype)
+            init_name = init.name
+        else:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            from .nn import persistable_buffer
+            # zero-init memory created in the outer program
+            if self._guard is not None:
+                # temporarily escape the sub-program guard
+                self._guard.__exit__(None, None, None)
+            try:
+                zed = persistable_buffer(
+                    np.full(tuple(shape), value,
+                            np.dtype(str(dtype))), prefix="rnn.mem0")
+            finally:
+                self._guard.__enter__()
+            iv = self._inner("rnn.mem", tuple(shape), np.dtype(str(dtype)))
+            init_name = zed.name
+        self._memories.append({"inner": iv, "init_name": init_name,
+                               "update": None})
+        return iv
+
+    def step_output(self, o: Variable):
+        self._outputs.append(o)
+
+    def output(self, *outs):
+        for o in outs:
+            self.step_output(o)
+
+    def __call__(self):
+        if self._built:
+            return self._out_vars
+        if not self._seq_inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        prog = default_main_program()
+        blk = prog.current_block()
+        seq_len = int(prog.global_block.vars[
+            self._seq_inputs[0][0]].shape[0])
+        self._out_vars = self._scan_op(blk, prog, seq_len)
+        self._built = True
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
+
+
+class DynamicRNN(_RNNBase):
+    """reference: fluid.layers.DynamicRNN — variable-length sequences.
+    Native sequence form here: PADDED [B, T, ...] input + lengths [B]
+    (core/lod.py converts LoD offsets); steps past a row's length hold
+    the memory and zero the output, matching the reference's LoD-bucketed
+    execution row for row."""
+
+    def __init__(self):
+        super().__init__()
+        self._lengths_name = None
+        self._maxlen = None
+
+    def step_input(self, x: Variable, lengths: Variable = None,
+                   level=0) -> Variable:
+        """x: [B, T, ...] padded batch-major sequence + lengths [B]."""
+        if lengths is not None:
+            self._lengths_name = lengths.name
+        self._maxlen = int(x.shape[1])
+        iv = self._inner("drnn.in", (x.shape[0],) + tuple(x.shape[2:]),
+                         x._value.dtype)
+        self._seq_inputs.append((x.name, iv))
+        return iv
+
+    memory = StaticRNN.memory
+    output = StaticRNN.output
+    step_output = StaticRNN.step_output
+
+    def __call__(self):
+        if self._built:
+            return self._out_vars
+        if self._lengths_name is None:
+            raise ValueError("DynamicRNN.step_input needs lengths= "
+                             "(padded [B,T,...] + lengths form)")
+        prog = default_main_program()
+        blk = prog.current_block()
+        T = self._maxlen
+        # build the [T, B] step mask + time-major sequences as plain ops
+        lens = prog.global_block.vars[self._lengths_name]
+
+        def mask_fn(length):
+            t = jnp.arange(T)[:, None]
+            return (t < length.reshape(1, -1)).astype(jnp.float32)
+
+        mask_v = blk.create_var(name=prog.unique_name("drnn.mask"),
+                                shape=(T, int(lens.shape[0])),
+                                dtype=np.float32)
+        blk.append_op(OpDesc("op", "drnn_mask", mask_fn,
+                             [self._lengths_name], [mask_v.name]))
+        # transpose each padded input to time-major for the scan
+        tm_names = []
+        new_seq = []
+        for outer, iv in self._seq_inputs:
+            ov = prog.global_block.vars[outer]
+            ndim = len(ov.shape)
+            perm = (1, 0) + tuple(range(2, ndim))
+            tv = blk.create_var(
+                name=prog.unique_name("drnn.tm"),
+                shape=tuple(np.asarray(ov.shape)[list(perm)]),
+                dtype=ov._value.dtype)
+            blk.append_op(OpDesc("op", "drnn_time_major",
+                                 lambda a, p=perm: jnp.transpose(a, p),
+                                 [outer], [tv.name]))
+            tm_names.append(tv.name)
+            new_seq.append((tv.name, iv))
+        self._seq_inputs = new_seq
+        outs = self._scan_op(blk, prog, T, mask_names=[mask_v.name])
+        # back to batch-major [B, T, ...]
+        final = []
+        for ov in outs:
+            ndim = len(ov.shape)
+            perm = (1, 0) + tuple(range(2, ndim))
+            bv = blk.create_var(
+                name=prog.unique_name("drnn.out"),
+                shape=tuple(np.asarray(ov.shape)[list(perm)]),
+                dtype=ov._value.dtype)
+            blk.append_op(OpDesc("op", "drnn_batch_major",
+                                 lambda a, p=perm: jnp.transpose(a, p),
+                                 [ov.name], [bv.name]))
+            final.append(bv)
+        self._out_vars = final
+        self._built = True
+        return final[0] if len(final) == 1 else final
+
+
+# --------------------------------------------------------------- py_reader
+class _PyReader:
+    """Bounded async feed queue (reference: create_py_reader +
+    BufferedReader). decorate_batch_generator supplies a callable
+    returning an iterable of feed tuples; start() launches the producer
+    thread; the Executor drains one batch per run() when no feed dict is
+    passed; exhaustion raises EOFError (the reference's EOFException)."""
+
+    def __init__(self, capacity: int, shapes, dtypes, names):
+        self.capacity = int(capacity)
+        self.names = list(names)
+        self._gen = None
+        self._q: Optional[_queue.Queue] = None
+        self._thread = None
+        self._stop = threading.Event()
+        prog = default_main_program()
+        blk = prog.current_block()
+        self.vars = []
+        for name, shape, dtype in zip(self.names, shapes, dtypes):
+            v = blk.create_var(name=name, shape=tuple(shape),
+                               dtype=np.dtype(str(dtype)))
+            v.is_data = True
+            self.vars.append(v)
+        prog._py_readers.append(self)
+
+    def decorate_batch_generator(self, gen):
+        self._gen = gen
+        return self
+
+    decorate_sample_list_generator = decorate_batch_generator
+    decorate_paddle_reader = decorate_batch_generator
+
+    def start(self):
+        if self._gen is None:
+            raise RuntimeError("py_reader: decorate_batch_generator first")
+        self._stop.clear()
+        self._q = _queue.Queue(self.capacity)
+
+        def fill():
+            try:
+                for batch in self._gen():
+                    if self._stop.is_set():
+                        return
+                    self._q.put(batch)
+            finally:
+                self._q.put(None)  # EOF sentinel
+
+        self._thread = threading.Thread(target=fill, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        if self._q is not None:
+            try:  # drain so the producer unblocks
+                while True:
+                    self._q.get_nowait()
+            except _queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._q = None
+
+    def next_feed(self) -> Dict[str, np.ndarray]:
+        if self._q is None:
+            raise RuntimeError("py_reader: start() before exe.run()")
+        item = self._q.get()
+        if item is None:
+            self._q = None
+            raise EOFError("py_reader exhausted (reference: EOFException "
+                           "— call reset()/start() for the next epoch)")
+        if isinstance(item, dict):
+            return item
+        return dict(zip(self.names, item))
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference: fluid.layers.py_reader (reader.py:149)."""
+    prog = default_main_program()
+    names = [prog.unique_name(f"{name or 'py_reader'}.v{i}")
+             for i in range(len(shapes))]
+    return _PyReader(capacity, shapes, dtypes, names)
+
+
+def read_file(reader: _PyReader):
+    """reference: fluid.layers.read_file — the reader's data vars."""
+    vs = reader.vars
+    return vs[0] if len(vs) == 1 else vs

@@ -1,0 +1,151 @@
+"""StaticRNN / DynamicRNN / py_reader static-graph shims
+(reference: fluid/layers/rnn.py StaticRNN usage, control_flow.py
+DynamicRNN, reader.py:149 create_py_reader).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    static.global_scope().drop_kids()
+    with paddle.utils.unique_name.guard():
+        paddle.enable_static()
+        yield
+        paddle.disable_static()
+
+
+def test_static_rnn_matches_simple_rnn_math():
+    """A StaticRNN computing h_t = tanh(x_t W + h_{t-1} U) must equal the
+    hand-rolled numpy recurrence (the same math nn.layer.rnn.SimpleRNN
+    runs in dygraph)."""
+    T, B, D, H = 5, 3, 4, 6
+    rs = np.random.RandomState(0)
+    xv = rs.randn(T, B, D).astype(np.float32)
+    h0v = np.zeros((B, H), np.float32)
+    Wv = rs.randn(D, H).astype(np.float32) * 0.3
+    Uv = rs.randn(H, H).astype(np.float32) * 0.3
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [T, B, D], "float32")
+        h0 = static.data("h0", [B, H], "float32")
+        W = static.data("W", [D, H], "float32")
+        U = static.data("U", [H, H], "float32")
+        rnn = static.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            h = paddle.tanh(paddle.matmul(xt, W)
+                            + paddle.matmul(prev, U))
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+
+    exe = static.Executor()
+    exe.run(startup)
+    got = exe.run(main, feed={"x": xv, "h0": h0v, "W": Wv, "U": Uv},
+                  fetch_list=[out])[0]
+
+    # numpy oracle
+    h = h0v
+    expect = []
+    for t in range(T):
+        h = np.tanh(xv[t] @ Wv + h @ Uv)
+        expect.append(h)
+    np.testing.assert_allclose(got, np.stack(expect), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_static_rnn_zero_init_memory():
+    """memory(shape=..., value=...) without init: zero-filled carry
+    created in the startup program."""
+    T, B, D = 4, 2, 3
+    rs = np.random.RandomState(1)
+    xv = rs.randn(T, B, D).astype(np.float32)
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [T, B, D], "float32")
+        rnn = static.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            acc = rnn.memory(shape=[B, D], value=0.0)
+            s = acc + xt
+            rnn.update_memory(acc, s)
+            rnn.step_output(s)
+        out = rnn()
+    exe = static.Executor()
+    exe.run(startup)
+    got = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, np.cumsum(xv, axis=0), rtol=1e-6)
+
+
+def test_dynamic_rnn_respects_lengths():
+    """DynamicRNN over padded [B, T, D] + lengths: rows stop at their
+    length (memory held, outputs zeroed past the end) — the reference's
+    LoD-bucketed execution row for row."""
+    B, T, D = 3, 5, 2
+    rs = np.random.RandomState(2)
+    xv = rs.randn(B, T, D).astype(np.float32)
+    lens = np.array([5, 3, 1], np.int64)
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [B, T, D], "float32")
+        lv = static.data("lens", [B], "int64")
+        drnn = static.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, lengths=lv)
+            acc = drnn.memory(shape=[B, D], value=0.0)
+            s = acc + xt
+            drnn.update_memory(acc, s)
+            drnn.output(s)
+        out = drnn()
+    exe = static.Executor()
+    exe.run(startup)
+    got = exe.run(main, feed={"x": xv, "lens": lens},
+                  fetch_list=[out])[0]  # [B, T, D]
+
+    for b in range(B):
+        run = np.cumsum(xv[b, :lens[b]], axis=0)
+        np.testing.assert_allclose(got[b, :lens[b]], run, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(got[b, lens[b]:], 0.0)
+
+
+def test_py_reader_feeds_executor_and_signals_eof():
+    """py_reader: exe.run() without a feed dict drains the async queue;
+    exhaustion raises EOFError (reference EOFException contract); reset +
+    start replays the next epoch."""
+    main = static.Program()
+    startup = static.Program()
+    rs = np.random.RandomState(3)
+    batches = [(rs.randn(4, 3).astype(np.float32),) for _ in range(5)]
+    with static.program_guard(main, startup):
+        reader = static.py_reader(capacity=4, shapes=[[4, 3]],
+                                  dtypes=["float32"])
+        x = static.read_file(reader)
+        out = (x * 2.0).sum()
+    reader.decorate_batch_generator(lambda: iter(batches))
+
+    exe = static.Executor()
+    exe.run(startup)
+    for epoch in range(2):
+        reader.start()
+        got = []
+        while True:
+            try:
+                got.append(float(exe.run(main, fetch_list=[out])[0]))
+            except EOFError:
+                break
+        assert len(got) == 5
+        expect = [float(b[0].sum() * 2.0) for b in batches]
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+        reader.reset()
