@@ -1,0 +1,3 @@
+module paddle_tpu/go
+
+go 1.20

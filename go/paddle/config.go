@@ -1,0 +1,25 @@
+// Package paddle: Go binding for the paddle_tpu C inference API
+// (native/src/pd_capi.cc). Counterpart of the reference Go wrapper
+// (go/paddle/config.go) re-authored for this framework's PD_* surface.
+package paddle
+
+// Config selects the model artifact a Predictor serves.
+// The model prefix addresses <prefix>.pdmodel (StableHLO program) +
+// <prefix>.pdiparams, the pair save_inference_model writes.
+type Config struct {
+	modelPrefix string
+	// Path to the _pd_capi.so runtime library. Empty = $PD_CAPI_LIB.
+	LibPath string
+}
+
+// NewConfig returns a config for the given model prefix.
+func NewConfig(modelPrefix string) *Config {
+	return &Config{modelPrefix: modelPrefix}
+}
+
+// SetModel points the config at a (possibly different) model prefix.
+// Mirrors the reference AnalysisConfig.SetModel ergonomics.
+func (c *Config) SetModel(modelPrefix string) { c.modelPrefix = modelPrefix }
+
+// ModelPrefix reports the configured model prefix.
+func (c *Config) ModelPrefix() string { return c.modelPrefix }

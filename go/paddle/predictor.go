@@ -1,0 +1,210 @@
+package paddle
+
+/*
+#cgo LDFLAGS: -ldl
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdlib.h>
+
+// Runtime binding against _pd_capi.so (built lazily by paddle_tpu.native,
+// so the path is only known at run time — dlopen, not link-time deps).
+typedef const char* (*pd_last_error_t)(void);
+typedef void* (*pd_new_predictor_t)(const char*);
+typedef void (*pd_delete_predictor_t)(void*);
+typedef int (*pd_get_num_t)(void*);
+typedef const char* (*pd_get_name_t)(void*, int);
+typedef int (*pd_run_t)(void*, const void**, const char**, const int64_t*,
+                        const int*, int);
+typedef int (*pd_output_meta_t)(void*, int, char*, int, int64_t*, int,
+                                int64_t*);
+typedef int64_t (*pd_get_output_t)(void*, int, void*, int64_t);
+
+static void* pd_handle = NULL;
+static pd_last_error_t pd_last_error;
+static pd_new_predictor_t pd_new_predictor;
+static pd_delete_predictor_t pd_delete_predictor;
+static pd_get_num_t pd_get_input_num, pd_get_output_num;
+static pd_get_name_t pd_get_input_name, pd_get_output_name;
+static pd_run_t pd_run;
+static pd_output_meta_t pd_output_meta;
+static pd_get_output_t pd_get_output;
+
+static const char* pd_bind(const char* libpath) {
+    pd_handle = dlopen(libpath, RTLD_NOW | RTLD_GLOBAL);
+    if (!pd_handle) return dlerror();
+    pd_last_error = (pd_last_error_t)dlsym(pd_handle, "PD_LastError");
+    pd_new_predictor = (pd_new_predictor_t)dlsym(pd_handle, "PD_NewPredictor");
+    pd_delete_predictor =
+        (pd_delete_predictor_t)dlsym(pd_handle, "PD_DeletePredictor");
+    pd_get_input_num = (pd_get_num_t)dlsym(pd_handle, "PD_GetInputNum");
+    pd_get_output_num = (pd_get_num_t)dlsym(pd_handle, "PD_GetOutputNum");
+    pd_get_input_name = (pd_get_name_t)dlsym(pd_handle, "PD_GetInputName");
+    pd_get_output_name = (pd_get_name_t)dlsym(pd_handle, "PD_GetOutputName");
+    pd_run = (pd_run_t)dlsym(pd_handle, "PD_PredictorRun");
+    pd_output_meta = (pd_output_meta_t)dlsym(pd_handle, "PD_GetOutputMeta");
+    pd_get_output = (pd_get_output_t)dlsym(pd_handle, "PD_GetOutput");
+    if (!pd_last_error || !pd_new_predictor || !pd_delete_predictor ||
+        !pd_run || !pd_output_meta || !pd_get_output)
+        return "missing PD_* symbols in capi library";
+    return NULL;
+}
+
+static const char* pd_err(void) { return pd_last_error(); }
+static void* pd_new(const char* prefix) { return pd_new_predictor(prefix); }
+static void pd_del(void* h) { pd_delete_predictor(h); }
+static int pd_in_num(void* h) { return pd_get_input_num(h); }
+static int pd_out_num(void* h) { return pd_get_output_num(h); }
+static const char* pd_in_name(void* h, int i) { return pd_get_input_name(h, i); }
+static const char* pd_out_name(void* h, int i) { return pd_get_output_name(h, i); }
+static int pd_run_c(void* h, const void** bufs, const char** dts,
+                    const int64_t* shapes, const int* ndims, int n) {
+    return pd_run(h, bufs, dts, shapes, ndims, n);
+}
+static int pd_meta(void* h, int i, char* dt, int dtcap, int64_t* shape,
+                   int shapecap, int64_t* nbytes) {
+    return pd_output_meta(h, i, dt, dtcap, shape, shapecap, nbytes);
+}
+static int64_t pd_out(void* h, int i, void* buf, int64_t cap) {
+    return pd_get_output(h, i, buf, cap);
+}
+*/
+import "C"
+
+import (
+	"fmt"
+	"math"
+	"os"
+	"unsafe"
+)
+
+func float32Bits(f float32) uint32     { return math.Float32bits(f) }
+func float32FromBits(b uint32) float32 { return math.Float32frombits(b) }
+
+// Predictor serves one loaded inference model (reference:
+// go/paddle/predictor.go ergonomics over this framework's C API).
+type Predictor struct {
+	h unsafe.Pointer
+}
+
+var bound bool
+
+func bindLib(cfg *Config) error {
+	if bound {
+		return nil
+	}
+	path := cfg.LibPath
+	if path == "" {
+		path = os.Getenv("PD_CAPI_LIB")
+	}
+	if path == "" {
+		return fmt.Errorf("paddle: set Config.LibPath or $PD_CAPI_LIB to " +
+			"the _pd_capi.so path (python -c \"from paddle_tpu.native " +
+			"import capi_so_path; print(capi_so_path())\")")
+	}
+	cpath := C.CString(path)
+	defer C.free(unsafe.Pointer(cpath))
+	if msg := C.pd_bind(cpath); msg != nil {
+		return fmt.Errorf("paddle: dlopen %s: %s", path, C.GoString(msg))
+	}
+	bound = true
+	return nil
+}
+
+// NewPredictor loads the model named by the config.
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	if err := bindLib(cfg); err != nil {
+		return nil, err
+	}
+	cprefix := C.CString(cfg.ModelPrefix())
+	defer C.free(unsafe.Pointer(cprefix))
+	h := C.pd_new(cprefix)
+	if h == nil {
+		return nil, fmt.Errorf("paddle: NewPredictor: %s",
+			C.GoString(C.pd_err()))
+	}
+	return &Predictor{h: h}, nil
+}
+
+// Delete releases the predictor.
+func (p *Predictor) Delete() {
+	if p.h != nil {
+		C.pd_del(p.h)
+		p.h = nil
+	}
+}
+
+// InputNames lists the model's feed names in order.
+func (p *Predictor) InputNames() []string {
+	n := int(C.pd_in_num(p.h))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.pd_in_name(p.h, C.int(i)))
+	}
+	return out
+}
+
+// OutputNames lists the model's fetch names in order.
+func (p *Predictor) OutputNames() []string {
+	n := int(C.pd_out_num(p.h))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.pd_out_name(p.h, C.int(i)))
+	}
+	return out
+}
+
+// Run executes the model on the input tensors (feed order).
+func (p *Predictor) Run(inputs []*Tensor) error {
+	n := len(inputs)
+	bufs := make([]unsafe.Pointer, n)
+	dts := make([]*C.char, n)
+	var shapes []C.int64_t
+	ndims := make([]C.int, n)
+	pinned := make([][]byte, n) // keep Go buffers alive across the call
+	for i, t := range inputs {
+		pinned[i] = t.Data
+		bufs[i] = unsafe.Pointer(&pinned[i][0])
+		dts[i] = C.CString(t.Dtype)
+		defer C.free(unsafe.Pointer(dts[i]))
+		for _, d := range t.Shape {
+			shapes = append(shapes, C.int64_t(d))
+		}
+		ndims[i] = C.int(len(t.Shape))
+	}
+	rc := C.pd_run_c(p.h,
+		(**C.void)(unsafe.Pointer(&bufs[0])),
+		(**C.char)(unsafe.Pointer(&dts[0])),
+		(*C.int64_t)(unsafe.Pointer(&shapes[0])),
+		(*C.int)(unsafe.Pointer(&ndims[0])), C.int(n))
+	if rc < 0 {
+		return fmt.Errorf("paddle: Run: %s", C.GoString(C.pd_err()))
+	}
+	return nil
+}
+
+// Output copies fetch index i into a fresh Tensor.
+func (p *Predictor) Output(i int) (*Tensor, error) {
+	var dt [32]C.char
+	var shape [16]C.int64_t
+	var nbytes C.int64_t
+	nd := C.pd_meta(p.h, C.int(i), &dt[0], 32, &shape[0], 16, &nbytes)
+	if nd < 0 {
+		return nil, fmt.Errorf("paddle: OutputMeta: %s",
+			C.GoString(C.pd_err()))
+	}
+	t := &Tensor{Dtype: C.GoString(&dt[0])}
+	for d := 0; d < int(nd); d++ {
+		t.Shape = append(t.Shape, int64(shape[d]))
+	}
+	t.Data = make([]byte, int64(nbytes))
+	var buf unsafe.Pointer
+	if len(t.Data) > 0 {
+		buf = unsafe.Pointer(&t.Data[0])
+	}
+	if got := C.pd_out(p.h, C.int(i), buf, nbytes); got != nbytes {
+		return nil, fmt.Errorf("paddle: Output copy: %s",
+			C.GoString(C.pd_err()))
+	}
+	return t, nil
+}

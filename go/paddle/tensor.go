@@ -1,0 +1,59 @@
+package paddle
+
+import "fmt"
+
+// Tensor is a dense host buffer handed to / received from a Predictor.
+// Data is raw little-endian bytes of Dtype elements in row-major order
+// (the same zero-copy contract PD_PredictorRun consumes).
+type Tensor struct {
+	Dtype string  // "float32" | "int64" | "int32"
+	Shape []int64 // row-major dims
+	Data  []byte  // len == NumElements * DtypeSize
+}
+
+// DtypeSize reports the element width in bytes for a supported dtype.
+func DtypeSize(dtype string) (int, error) {
+	switch dtype {
+	case "float32", "int32":
+		return 4, nil
+	case "int64", "float64":
+		return 8, nil
+	}
+	return 0, fmt.Errorf("paddle: unsupported dtype %q", dtype)
+}
+
+// NumElements multiplies out the shape.
+func (t *Tensor) NumElements() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}
+
+// Float32s views float32 Data as a []float32 copy.
+func (t *Tensor) Float32s() ([]float32, error) {
+	if t.Dtype != "float32" {
+		return nil, fmt.Errorf("paddle: tensor dtype is %s", t.Dtype)
+	}
+	out := make([]float32, t.NumElements())
+	for i := range out {
+		bits := uint32(t.Data[4*i]) | uint32(t.Data[4*i+1])<<8 |
+			uint32(t.Data[4*i+2])<<16 | uint32(t.Data[4*i+3])<<24
+		out[i] = float32FromBits(bits)
+	}
+	return out, nil
+}
+
+// NewFloat32Tensor packs values into a float32 tensor of the shape.
+func NewFloat32Tensor(shape []int64, values []float32) *Tensor {
+	data := make([]byte, 4*len(values))
+	for i, v := range values {
+		bits := float32Bits(v)
+		data[4*i] = byte(bits)
+		data[4*i+1] = byte(bits >> 8)
+		data[4*i+2] = byte(bits >> 16)
+		data[4*i+3] = byte(bits >> 24)
+	}
+	return &Tensor{Dtype: "float32", Shape: shape, Data: data}
+}

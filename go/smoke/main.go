@@ -1,0 +1,69 @@
+// Smoke test for the Go inference binding: loads the model prefix given
+// as argv[1], feeds zeros of the shape in argv[2] (comma separated), and
+// prints the first output's meta + leading values.
+//
+// Build/run (needs go + cgo + a saved inference model):
+//
+//	export PD_CAPI_LIB=$(python -c "from paddle_tpu.native import \
+//	    capi_so_path; print(capi_so_path())")
+//	go run ./go/smoke <model_prefix> 1,4
+package main
+
+import (
+	"fmt"
+	"os"
+	"strconv"
+	"strings"
+
+	"paddle_tpu/go/paddle"
+)
+
+func main() {
+	if len(os.Args) < 3 {
+		fmt.Fprintln(os.Stderr, "usage: smoke <model_prefix> <dims>")
+		os.Exit(2)
+	}
+	var shape []int64
+	n := int64(1)
+	for _, s := range strings.Split(os.Args[2], ",") {
+		d, err := strconv.ParseInt(s, 10, 64)
+		if err != nil {
+			panic(err)
+		}
+		shape = append(shape, d)
+		n *= d
+	}
+
+	cfg := paddle.NewConfig(os.Args[1])
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		panic(err)
+	}
+	defer pred.Delete()
+
+	fmt.Println("inputs:", pred.InputNames())
+	fmt.Println("outputs:", pred.OutputNames())
+
+	vals := make([]float32, n)
+	for i := range vals {
+		vals[i] = float32(i) * 0.1
+	}
+	in := paddle.NewFloat32Tensor(shape, vals)
+	if err := pred.Run([]*paddle.Tensor{in}); err != nil {
+		panic(err)
+	}
+	out, err := pred.Output(0)
+	if err != nil {
+		panic(err)
+	}
+	fmt.Printf("out dtype=%s shape=%v bytes=%d\n", out.Dtype, out.Shape,
+		len(out.Data))
+	if f, err := out.Float32s(); err == nil && len(f) > 0 {
+		k := len(f)
+		if k > 4 {
+			k = 4
+		}
+		fmt.Println("head:", f[:k])
+	}
+	fmt.Println("GO_SMOKE_OK")
+}
