@@ -99,29 +99,53 @@ class AutoCheckpointManager:
         done = sorted(self._saved_epochs())
         for e in done[:-self.max_keep]:
             shutil.rmtree(self._epoch_dir(e), ignore_errors=True)
+        # stale temp dirs from crashed saves (the writer died before its
+        # rename): harmless to restores (no meta outside a renamed dir)
+        # but they accumulate on slow/remote filesystems — sweep them
+        for name in os.listdir(self.save_dir):
+            if name.startswith(".tmp_"):
+                shutil.rmtree(os.path.join(self.save_dir, name),
+                              ignore_errors=True)
 
     def _saved_epochs(self) -> List[int]:
         out = []
         if not os.path.isdir(self.save_dir):
             return out
         for name in os.listdir(self.save_dir):
-            if name.startswith("epoch_"):
+            if name.startswith("epoch_") and name[6:].isdigit():
+                # (quarantined epoch_N.corrupt dirs don't count)
                 meta = os.path.join(self.save_dir, name, "meta.json")
                 if os.path.exists(meta):
-                    out.append(int(name.split("_")[1]))
+                    out.append(int(name[6:]))
         return out
 
     def restore_latest(self) -> Optional[int]:
-        """Load the newest complete snapshot; returns its epoch or None."""
+        """Load the newest complete snapshot; returns its epoch or None.
+        A snapshot that fails to parse (disk-level truncation/corruption
+        AFTER the atomic rename — the failure mode remote filesystems add
+        beyond the tmp+mv contract) is quarantined with a warning and the
+        next-newest snapshot is tried, so one bad file never bricks the
+        resume path."""
         from .. import framework_io
-        done = sorted(self._saved_epochs())
-        if not done:
-            return None
-        epoch = done[-1]
-        state = framework_io.load(
-            os.path.join(self._epoch_dir(epoch), "state.pdparams"))
-        self._restore(state)
-        return epoch
+        for epoch in sorted(self._saved_epochs(), reverse=True):
+            path = os.path.join(self._epoch_dir(epoch), "state.pdparams")
+            try:
+                state = framework_io.load(path)
+            except Exception as e:
+                import warnings
+                bad = self._epoch_dir(epoch)
+                warnings.warn(
+                    f"auto-checkpoint: snapshot epoch_{epoch} is corrupt "
+                    f"({e!r}); quarantining {bad} and falling back",
+                    RuntimeWarning)
+                try:
+                    os.rename(bad, bad + ".corrupt")
+                except OSError:
+                    shutil.rmtree(bad, ignore_errors=True)
+                continue
+            self._restore(state)
+            return epoch
+        return None
 
     # ---------------------------------------------------------------- range
     def train_epoch_range(self, max_epoch_num: int) -> Iterator[int]:
