@@ -43,6 +43,10 @@ class GPTConfig:
     dropout: float = 0.0
     use_recompute: bool = False
     sequence_parallel: bool = False
+    # context parallelism: attention itself runs ring-sharded over the
+    # 'sp' mesh axis (parallel/ring_attention.py) — the long-context path
+    # where even one layer's [T, T] scores don't fit a chip
+    context_parallel: bool = False
 
     # presets (reference marketing targets: BASELINE.json configs)
     @staticmethod
@@ -78,11 +82,42 @@ class GPTAttention(nn.Layer):
         qkv = M.reshape(qkv, [B, T, 3, self.num_heads, self.head_dim])
         qkv = M.transpose(qkv, [2, 0, 3, 1, 4])
         q, k, v = M.unstack(qkv, axis=0)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.cfg.dropout,
-            training=self.training, _heads_major=True)  # [B, H, T, D]
+        use_ring = False
+        if self.cfg.context_parallel:
+            from ..parallel.mesh import ensure_global_mesh
+            use_ring = ensure_global_mesh().shape.get("sp", 1) > 1
+        if use_ring:
+            out = self._ring_attention(q, k, v)  # [B, H, T, D]
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.cfg.dropout,
+                training=self.training, _heads_major=True)  # [B, H, T, D]
         out = M.reshape(M.transpose(out, [0, 2, 1, 3]), [B, T, -1])
         return self.out(out)
+
+    def _ring_attention(self, q, k, v):
+        """Attention sequence-sharded over the 'sp' mesh axis: Q resident,
+        K/V rotating over ICI (parallel/ring_attention.py). Manual over
+        'sp' only — dp/tp/sharding stay in GSPMD auto mode so context
+        parallelism composes with the other degrees."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from ..core.dispatch import dispatch
+        from ..parallel.mesh import ensure_global_mesh
+        from ..parallel.ring_attention import ring_attention
+        if self.cfg.dropout > 0.0 and self.training:
+            raise NotImplementedError(
+                "attention dropout under context_parallel is not "
+                "implemented (per-chunk RNG across the rotating ring); "
+                "set dropout=0.0 or context_parallel=False")
+        mesh = ensure_global_mesh()
+        spec = P(None, None, "sp", None)
+        fn = shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, "sp",
+                                              causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={"sp"}, check_vma=False)
+        return dispatch("ring_attention", fn, (q, k, v), {}, True)
 
 
 class GPTMLP(nn.Layer):

@@ -10,3 +10,4 @@ from .api import (  # noqa: F401
     mark_sharding,
     param_spec,
 )
+from .ring_attention import ring_attention  # noqa: F401
