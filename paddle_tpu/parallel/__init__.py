@@ -10,4 +10,4 @@ from .api import (  # noqa: F401
     mark_sharding,
     param_spec,
 )
-from .ring_attention import ring_attention  # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401

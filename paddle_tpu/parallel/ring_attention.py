@@ -27,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ring_attention"]
+__all__ = ["ring_attention", "ulysses_attention"]
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,
@@ -81,3 +81,44 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     # chunk, but guard the division anyway)
     safe_l = jnp.maximum(l, 1e-30)
     return (acc / safe_l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): the
+    OTHER long-context form SURVEY.md §2.3 names. Instead of rotating
+    K/V, one all_to_all re-shards [B, H, T_local, D] → [B, H/n, T, D]
+    (heads scatter, sequence gathers), each device runs FULL attention
+    over its head subset, and a second all_to_all restores the sequence
+    sharding. Two collectives total per call vs the ring's n hops —
+    cheaper when H >= ring size and the full [T, T] score block fits;
+    the ring wins when T is too long for any single chip.
+
+    Use inside shard_map over `axis_name`; requires H % ring_size == 0.
+    """
+    n = jax.lax.psum(1, axis_name)
+    if q.shape[1] % n:
+        raise ValueError(
+            f"ulysses_attention: heads {q.shape[1]} must divide by the "
+            f"'{axis_name}' axis size {n} (use ring_attention otherwise)")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+
+    def a2a_in(x):   # [B, H, Tl, D] -> [B, H/n, T, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def a2a_out(x):  # [B, H/n, T, D] -> [B, H, Tl, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qg, kg, vg = a2a_in(q), a2a_in(k), a2a_in(v)
+    s = jnp.einsum("bhtd,bhsd->bhts", qg.astype(jnp.float32),
+                   kg.astype(jnp.float32)) * scale
+    if causal:
+        T = s.shape[-1]
+        allow = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(allow[None, None], s, jnp.asarray(-1e30, jnp.float32))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhts,bhsd->bhtd", p, vg.astype(jnp.float32))
+    return a2a_out(out.astype(q.dtype))
