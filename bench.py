@@ -163,6 +163,56 @@ def bench_lenet():
     return n * 64 / (time.perf_counter() - t0)
 
 
+def bench_bert(on_tpu: bool):
+    """BASELINE.md config 3: BERT-base MLM+NSP pretraining samples/sec
+    (batch 64, seq 128 — the standard phase-1 geometry) + MFU."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        bert_pretrain_loss_fn)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = BertConfig()  # bert-base: 30522 vocab, 768h, 12L
+        bs, seq, iters = 64, 128, 30
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=4, max_position=64)
+        bs, seq, iters = 2, 32, 2
+    model = BertForPretraining(cfg)
+    optim = opt.AdamW(1e-4, parameters=model.parameters())
+    if on_tpu:
+        model, optim = paddle.amp.decorate(model, optim, level="O2",
+                                           dtype="bfloat16")
+    step = paddle.jit.TrainStep(model, bert_pretrain_loss_fn, optim)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (bs, seq),
+                                     dtype=np.int32))
+    tt = paddle.to_tensor(rng.randint(0, 2, (bs, seq), dtype=np.int32))
+    mlm = np.full((bs, seq), -100, np.int64)
+    mask = rng.rand(bs, seq) < 0.15
+    mlm[mask] = rng.randint(0, cfg.vocab_size, mask.sum())
+    mlm_t = paddle.to_tensor(mlm)
+    nsp = paddle.to_tensor(rng.randint(0, 2, (bs,)).astype(np.int64))
+    step(x, tt, mlm_t, nsp)
+    step(x, tt, mlm_t, nsp)
+    _drain(model)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step(x, tt, mlm_t, nsp)
+    _drain(model)
+    sps = iters * bs / (time.perf_counter() - t0)
+    mfu = None
+    if on_tpu:
+        h, L, V, T = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                      seq)
+        per_layer = 4 * h * h + 2 * cfg.ffn_mult * h * h
+        n_matmul = L * per_layer + V * h  # MLM unembed (tied weights)
+        flops_per_tok = 6 * n_matmul + 12 * L * h * T
+        mfu = sps * seq * flops_per_tok / _peak_flops(jax.devices()[0])
+    return sps, mfu
+
+
 def bench_resnet(on_tpu: bool):
     """BASELINE.md config 2: ResNet-50-class conv workload imgs/sec
     (synthetic ImageNet batch, train step). Returns (imgs/sec, mfu)."""
@@ -244,6 +294,11 @@ def main():
             line["gpt_12head_tokens_per_sec"] = round(tps12, 1)
             line["mfu_12head"] = round(mfu12, 4)
         line["lenet_imgs_per_sec"] = round(bench_lenet(), 1)
+        bt, bt_mfu = bench_bert(on_tpu)
+        line["bert_base_samples_per_sec" + ("" if on_tpu else "_cpu")] = \
+            round(bt, 1)
+        if bt_mfu is not None:
+            line["mfu_bert"] = round(bt_mfu, 4)
         rn, rn_mfu = bench_resnet(on_tpu)
         line["resnet50_imgs_per_sec"] = round(rn, 1)
         if rn_mfu is not None:

@@ -1,1 +1,6 @@
-
+"""Flagship model families (reference marketing targets, BASELINE.md):
+GPT (decoder, config 5), BERT/ERNIE (encoders, configs 3-4). Vision
+CNNs live in paddle_tpu.vision.models."""
+from .gpt import GPT, GPTConfig, gpt_loss_fn  # noqa: F401
+from .bert import (Bert, BertConfig, BertForPretraining,  # noqa: F401
+                   bert_base, bert_pretrain_loss_fn, ernie_large)

@@ -167,7 +167,9 @@ class GPT(nn.Layer):
         self.blocks = nn.LayerList([GPTBlock(cfg)
                                     for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
-        # weight-tied LM head (standard GPT); column-parallel over vocab
+        # column-parallel LM head over vocab (untied: its own V x H
+        # matrix; the bench FLOPs formula counts the unembed matmul once
+        # either way)
         self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
                                             has_bias=False,
                                             gather_output=True)

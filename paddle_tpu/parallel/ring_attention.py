@@ -104,15 +104,15 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
 
-    def a2a_in(x):   # [B, H, Tl, D] -> [B, H/n, T, D]
-        return jax.lax.all_to_all(x, axis_name, split_axis=1,
-                                  concat_axis=2, tiled=True)
+    def a2a_in(x):   # [3, B, H, Tl, D] -> [3, B, H/n, T, D] (one launch)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=3, tiled=True)
 
     def a2a_out(x):  # [B, H/n, T, D] -> [B, H, Tl, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2,
                                   concat_axis=1, tiled=True)
 
-    qg, kg, vg = a2a_in(q), a2a_in(k), a2a_in(v)
+    qg, kg, vg = a2a_in(jnp.stack([q, k, v]))
     s = jnp.einsum("bhtd,bhsd->bhts", qg.astype(jnp.float32),
                    kg.astype(jnp.float32)) * scale
     if causal:
