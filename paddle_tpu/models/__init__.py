@@ -4,3 +4,4 @@ CNNs live in paddle_tpu.vision.models."""
 from .gpt import GPT, GPTConfig, gpt_loss_fn  # noqa: F401
 from .bert import (Bert, BertConfig, BertForPretraining,  # noqa: F401
                    bert_base, bert_pretrain_loss_fn, ernie_large)
+from .generation import generate  # noqa: F401

@@ -1,0 +1,174 @@
+"""Autoregressive generation with a KV cache for models.gpt.GPT.
+
+Reference-era Paddle served decoding through fluid inference programs
+(beam_search/while ops); the TPU-native design is a PURE-JAX decode pair
+— `prefill` (one full forward that also returns per-layer K/V) and
+`decode_step` (single-token forward against the cache, updated with
+`lax.dynamic_update_slice`) — scanned under jit with STATIC shapes:
+the cache is [L, 2, B, H, max_seq, D] from the start, positions past
+`cur_len` masked, so one compilation serves every prompt/output length.
+
+The decode math mirrors GPT.forward exactly (pre-LN blocks, tanh-gelu
+MLP, 1/sqrt(D) attention scale, tied layout conventions); parity with
+the Layer forward is asserted in tests/test_generation.py, so the two
+implementations cannot drift silently.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["extract_params", "prefill", "decode_step", "generate"]
+
+
+def extract_params(model) -> dict:
+    """GPT Layer → flat {name: jnp array} pytree for the decode fns."""
+    return {k: p._value for k, p in model.named_parameters()}
+
+
+def _ln(x, w, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def _gelu(x):
+    # constants pinned to x.dtype: a bare numpy float64 scalar would
+    # promote everything under this package's x64 default
+    c0 = jnp.asarray(np.sqrt(2.0 / np.pi), x.dtype)
+    c1 = jnp.asarray(0.044715, x.dtype)
+    half = jnp.asarray(0.5, x.dtype)
+    one = jnp.asarray(1.0, x.dtype)
+    return half * x * (one + jnp.tanh(c0 * (x + c1 * x ** 3)))
+
+
+def _block(p, i, x, k_cache, v_cache, pos_mask, geom):
+    """One pre-LN block over x [B, t, H*D] attending to the cache.
+    k_cache/v_cache: [B, H, S, D]; pos_mask [t, S] True=attend."""
+    _, H, D, _ = geom
+    pre = f"blocks.{i}."
+    h = _ln(x, p[pre + "ln1.weight"], p[pre + "ln1.bias"])
+    qkv = h @ p[pre + "attn.qkv.weight"] + p[pre + "attn.qkv.bias"]
+    B, t = x.shape[0], x.shape[1]
+    qkv = qkv.reshape(B, t, 3, H, D).transpose(2, 0, 3, 1, 4)
+    q, k_new, v_new = qkv[0], qkv[1], qkv[2]      # [B, H, t, D]
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, k_cache) \
+        * jnp.asarray(1.0 / np.sqrt(D), q.dtype)
+    scores = jnp.where(pos_mask[None, None], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    att = jnp.einsum("bhts,bhsd->bhtd", probs, v_cache)
+    att = att.transpose(0, 2, 1, 3).reshape(B, t, H * D)
+    x = x + att @ p[pre + "attn.out.weight"] + p[pre + "attn.out.bias"]
+    h = _ln(x, p[pre + "ln2.weight"], p[pre + "ln2.bias"])
+    h = _gelu(h @ p[pre + "mlp.up.weight"] + p[pre + "mlp.up.bias"])
+    x = x + h @ p[pre + "mlp.down.weight"] + p[pre + "mlp.down.bias"]
+    return x, k_new, v_new
+
+
+def _embed(p, ids, pos0):
+    tok = p["wte.weight"][ids]                        # [B, t, H]
+    t = ids.shape[1]
+    pos = p["wpe.weight"][pos0 + jnp.arange(t)]       # [t, H]
+    return tok + pos[None]
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def prefill(params, input_ids, geom):
+    """Full forward over the prompt; returns (last-position logits,
+    cache [L, 2, B, H, max_seq, D]). geom: hashable static geometry
+    (num_layers, num_heads, head_dim, max_seq_len)."""
+    L, H, D, S = geom
+    B, T = input_ids.shape
+    x = _embed(params, input_ids, 0)
+    causal = (jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]) & \
+        (jnp.arange(S)[None, :] < T)
+    cache = jnp.zeros((L, 2, B, H, S, D), x.dtype)
+    for i in range(L):
+        # write this layer's K/V for the prompt region, then attend
+        pre = f"blocks.{i}."
+        h = _ln(x, params[pre + "ln1.weight"], params[pre + "ln1.bias"])
+        qkv = h @ params[pre + "attn.qkv.weight"] + \
+            params[pre + "attn.qkv.bias"]
+        qkv = qkv.reshape(B, T, 3, H, D).transpose(2, 0, 3, 1, 4)
+        kc = jnp.zeros((B, H, S, D), x.dtype).at[:, :, :T].set(qkv[1])
+        vc = jnp.zeros((B, H, S, D), x.dtype).at[:, :, :T].set(qkv[2])
+        cache = cache.at[i, 0].set(kc)
+        cache = cache.at[i, 1].set(vc)
+        x, _, _ = _block(params, i, x, kc, vc, causal, geom)
+    x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
+    logits = x[:, -1] @ params["lm_head.weight"]
+    return logits, cache
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def decode_step(params, cache, token, pos, geom):
+    """One cached decode step. token [B], pos scalar (int32). Returns
+    (logits [B, V], updated cache)."""
+    L, H, D, S = geom
+    B = token.shape[0]
+    x = _embed(params, token[:, None], pos)           # [B, 1, H]
+    attend = jnp.arange(S)[None, :] <= pos            # [1, S]
+    for i in range(L):
+        pre = f"blocks.{i}."
+        h = _ln(x, params[pre + "ln1.weight"], params[pre + "ln1.bias"])
+        qkv = h @ params[pre + "attn.qkv.weight"] + \
+            params[pre + "attn.qkv.bias"]
+        qkv = qkv.reshape(B, 1, 3, H, D).transpose(2, 0, 3, 1, 4)
+        z = jnp.asarray(0, pos.dtype)
+        kc = jax.lax.dynamic_update_slice(
+            cache[i, 0], qkv[1], (z, z, pos, z))
+        vc = jax.lax.dynamic_update_slice(
+            cache[i, 1], qkv[2], (z, z, pos, z))
+        cache = cache.at[i, 0].set(kc)
+        cache = cache.at[i, 1].set(vc)
+        x, _, _ = _block(params, i, x, kc, vc, attend, geom)
+    x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
+    return x[:, 0] @ params["lm_head.weight"], cache
+
+
+def generate(model, input_ids, max_new_tokens: int,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             seed: int = 0):
+    """Autoregressive sampling: greedy at temperature 0, else
+    temperature(+top-k) sampling. input_ids: [B, T] array-like; returns
+    np.ndarray [B, T + max_new_tokens]."""
+    from ..core.tensor import Tensor
+    cfg = model.cfg
+    geom = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
+    params = extract_params(model)
+    ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                     else input_ids)
+    B, T = ids.shape
+    if T + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {T} + new {max_new_tokens} exceeds max_seq_len "
+            f"{cfg.max_seq_len}")
+    logits, cache = prefill(params, jnp.asarray(ids, jnp.int32), geom)
+    key = jax.random.PRNGKey(seed)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits.astype(jnp.float32) / temperature
+        if top_k:
+            kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+            lg = jnp.where(lg < kth, -1e30, lg)
+        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+    def body(carry, _):
+        logits, cache, pos, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        logits, cache = decode_step(params, cache, tok, pos, geom)
+        return (logits, cache, pos + 1, key), tok
+
+    (_, _, _, _), toks = jax.lax.scan(
+        body, (logits, cache, jnp.asarray(T, jnp.int32), key), None,
+        length=max_new_tokens)
+    return np.concatenate([ids, np.asarray(toks).T], axis=1)
