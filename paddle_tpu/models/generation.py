@@ -22,7 +22,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["extract_params", "prefill", "decode_step", "generate"]
+__all__ = ["extract_params", "prefill", "decode_step", "generate",
+           "beam_search_generate"]
 
 
 def extract_params(model) -> dict:
@@ -172,3 +173,84 @@ def generate(model, input_ids, max_new_tokens: int,
         body, (logits, cache, jnp.asarray(T, jnp.int32), key), None,
         length=max_new_tokens)
     return np.concatenate([ids, np.asarray(toks).T], axis=1)
+
+
+def beam_search_generate(model, input_ids, beam_size: int,
+                         max_new_tokens: int, length_penalty: float = 0.0,
+                         eos_token_id: Optional[int] = None):
+    """Beam search over the KV cache (reference: beam_search_op.cc +
+    beam_search_decode_op.cc — the fluid decoding workhorse; here the
+    beams live as an expanded batch dim, the cache is re-gathered to the
+    surviving parents each step, and the token history is backtracked
+    through the recorded (parent, token) lattice like the reference's
+    sentence-ids/sentence-scores reconstruction).
+
+    Returns (sequences [B, T + max_new_tokens], scores [B]) for the best
+    beam per batch row; finished beams (eos emitted) freeze their score.
+    """
+    from ..core.tensor import Tensor
+    cfg = model.cfg
+    geom = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
+    params = extract_params(model)
+    ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                     else input_ids)
+    B, T = ids.shape
+    K, V = int(beam_size), cfg.vocab_size
+    if T + max_new_tokens > cfg.max_seq_len:
+        raise ValueError("beam search exceeds max_seq_len")
+
+    expanded = np.repeat(ids, K, axis=0)              # [B*K, T]
+    logits, cache = prefill(params, jnp.asarray(expanded, jnp.int32),
+                            geom)
+    # only beam 0 is live at step 0 (all beams hold the same prompt)
+    scores0 = jnp.tile(jnp.asarray([0.0] + [-1e30] * (K - 1),
+                                   jnp.float32)[None], (B, 1))
+    neg = jnp.asarray(-1e30, jnp.float32)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    def body(carry, _):
+        logits, cache, scores, finished, pos = carry
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, K, V)
+        if eos >= 0:
+            # finished beams may only emit eos, at zero marginal cost
+            only_eos = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+            logp = jnp.where(finished[..., None], only_eos[None, None],
+                             logp)
+        total = scores[..., None] + logp              # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
+        parent = top_idx // V
+        token = (top_idx % V).astype(jnp.int32)
+        new_finished = finished[jnp.arange(B)[:, None], parent]
+        if eos >= 0:
+            new_finished = new_finished | (token == eos)
+        # re-gather beams: cache batch dim is B*K, parents are per-batch
+        gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        cache = cache[:, :, gidx]
+        logits, cache = decode_step(params, cache, token.reshape(-1),
+                                    pos, geom)
+        return ((logits, cache, top_scores, new_finished, pos + 1),
+                (parent, token))
+
+    finished0 = jnp.zeros((B, K), bool)
+    carry0 = (logits, cache, scores0, finished0,
+              jnp.asarray(T, jnp.int32))
+    (_, _, scores, _, _), (parents, tokens) = jax.lax.scan(
+        body, carry0, None, length=max_new_tokens)
+    parents = np.asarray(parents)                     # [steps, B, K]
+    tokens = np.asarray(tokens)
+    scores = np.asarray(scores)                       # [B, K]
+
+    if length_penalty:
+        scores = scores / ((T + max_new_tokens) ** length_penalty)
+    best = scores.argmax(axis=1)                      # [B]
+    # backtrack the (parent, token) lattice from the best leaf
+    out = np.zeros((B, max_new_tokens), np.int64)
+    for b in range(B):
+        k = best[b]
+        for s in range(max_new_tokens - 1, -1, -1):
+            out[b, s] = tokens[s, b, k]
+            k = parents[s, b, k]
+    return np.concatenate([ids, out], axis=1), scores[np.arange(B), best]

@@ -79,3 +79,56 @@ def test_sampled_generate_runs_and_respects_budget():
     assert (out[:, :4] == 0).all()
     with pytest.raises(ValueError):
         generate(m, np.zeros((1, 20), np.int64), max_new_tokens=10)
+
+
+def test_beam_search_beam1_equals_greedy():
+    m, geom = _model()
+    rng = np.random.RandomState(4)
+    ids = rng.randint(0, 97, (2, 5))
+    greedy = generate(m, ids, max_new_tokens=6)
+    from paddle_tpu.models.generation import beam_search_generate
+    beam, scores = beam_search_generate(m, ids, beam_size=1,
+                                        max_new_tokens=6)
+    np.testing.assert_array_equal(beam, greedy)
+    assert scores.shape == (2,)
+
+
+def test_beam_search_finds_higher_likelihood_than_greedy():
+    """The point of beam search: sum-logprob of the beam-4 output must be
+    >= the greedy rollout's (checked under the true model logprobs)."""
+    m, geom = _model()
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 97, (1, 4))
+    steps = 8
+    from paddle_tpu.models.generation import beam_search_generate
+    beam, beam_score = beam_search_generate(m, ids, beam_size=4,
+                                            max_new_tokens=steps)
+    greedy = generate(m, ids, max_new_tokens=steps)
+
+    def seq_logprob(seq):
+        total = 0.0
+        for s in range(steps):
+            cur = seq[:, :ids.shape[1] + s]
+            logits = m(paddle.to_tensor(cur)).numpy()[:, -1]
+            lp = logits - np.log(np.exp(
+                logits - logits.max(-1, keepdims=True)).sum(
+                -1, keepdims=True)) - logits.max(-1, keepdims=True)
+            total += lp[0, seq[0, ids.shape[1] + s]]
+        return total
+
+    assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+    np.testing.assert_allclose(seq_logprob(beam), beam_score[0],
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_beam_search_eos_freezes_score():
+    m, geom = _model()
+    ids = np.zeros((1, 3), np.int64)
+    from paddle_tpu.models.generation import beam_search_generate
+    out, scores = beam_search_generate(m, ids, beam_size=3,
+                                       max_new_tokens=8, eos_token_id=7)
+    # once 7 appears in a row, everything after must be 7 (frozen beam)
+    row = out[0, 3:]
+    if 7 in row:
+        first = list(row).index(7)
+        assert (row[first:] == 7).all()
