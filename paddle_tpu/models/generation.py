@@ -254,3 +254,92 @@ def beam_search_generate(model, input_ids, beam_size: int,
             out[b, s] = tokens[s, b, k]
             k = parents[s, b, k]
     return np.concatenate([ids, out], axis=1), scores[np.arange(B), best]
+
+
+def export_decoder(model, path_prefix: str):
+    """Serialize the decode pair as StableHLO (jax.export) so a server
+    can run autoregressive generation WITHOUT the model class or Python
+    graph rebuild — the LLM-serving analogue of save_inference_model.
+    Writes <prefix>.prefill.pdmodel, <prefix>.decode.pdmodel and
+    <prefix>.pdmeta (geometry + param tree layout; parameters are baked
+    into the artifacts as constants)."""
+    import os
+    import pickle
+    from jax import export as jexport
+    cfg = model.cfg
+    geom = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
+    L, H, D, S = geom
+    params = extract_params(model)
+
+    def prefill_fn(ids):
+        return prefill(params, ids, geom)
+
+    def decode_fn(cache, token, pos):
+        return decode_step(params, cache, token, pos, geom)
+
+    # symbolic batch, static seq buckets: export one prompt length (S//2
+    # by convention) for prefill; decode is length-independent
+    Tp = S // 2
+    b = jexport.symbolic_shape("b")[0]
+    ids_spec = jax.ShapeDtypeStruct((b, Tp), jnp.int32)
+    ex_prefill = jexport.export(jax.jit(prefill_fn))(ids_spec)
+    cache_spec = jax.ShapeDtypeStruct((L, 2, b, H, S, D), jnp.float32)
+    tok_spec = jax.ShapeDtypeStruct((b,), jnp.int32)
+    pos_spec = jax.ShapeDtypeStruct((), jnp.int32)
+    ex_decode = jexport.export(jax.jit(decode_fn))(cache_spec, tok_spec,
+                                                   pos_spec)
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".prefill.pdmodel", "wb") as f:
+        f.write(ex_prefill.serialize())
+    with open(path_prefix + ".decode.pdmodel", "wb") as f:
+        f.write(ex_decode.serialize())
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"geom": geom, "prefill_len": Tp,
+                     "vocab_size": cfg.vocab_size}, f)
+
+
+class DecoderPredictor:
+    """Serves an export_decoder artifact: greedy/temperature generation
+    from serialized StableHLO only (no model class)."""
+
+    def __init__(self, path_prefix: str):
+        import pickle
+        from jax import export as jexport
+        with open(path_prefix + ".prefill.pdmodel", "rb") as f:
+            self._prefill = jexport.deserialize(f.read())
+        with open(path_prefix + ".decode.pdmodel", "rb") as f:
+            self._decode = jexport.deserialize(f.read())
+        with open(path_prefix + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+        self.geom = tuple(meta["geom"])
+        self.prefill_len = int(meta["prefill_len"])
+        self.vocab_size = int(meta["vocab_size"])
+
+    def generate(self, input_ids, max_new_tokens: int):
+        """Greedy decode. Prompts are left-padded/truncated to the
+        exported prefill length with token 0 (mask-free convention: pad
+        tokens participate like the reference's fixed-shape serving)."""
+        ids = np.asarray(input_ids)
+        B, T = ids.shape
+        Tp = self.prefill_len
+        if T > Tp:
+            raise ValueError(f"prompt {T} exceeds exported prefill "
+                             f"length {Tp}")
+        S = self.geom[3]
+        if Tp + max_new_tokens > S:
+            raise ValueError("generation exceeds max_seq_len")
+        padded = np.zeros((B, Tp), np.int32)
+        padded[:, Tp - T:] = ids  # right-aligned: last position is live
+        logits, cache = self._prefill.call(jnp.asarray(padded))
+        seq = ids.copy()
+        pos = Tp
+        for _ in range(max_new_tokens):
+            tok = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+            seq = np.concatenate([seq, tok[:, None]], axis=1)
+            logits, cache = self._decode.call(
+                cache, jnp.asarray(tok), jnp.asarray(pos, jnp.int32))
+            pos += 1
+        return seq

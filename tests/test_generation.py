@@ -132,3 +132,24 @@ def test_beam_search_eos_freezes_score():
     if 7 in row:
         first = list(row).index(7)
         assert (row[first:] == 7).all()
+
+
+def test_exported_decoder_serves_without_model(tmp_path):
+    """export_decoder → DecoderPredictor: greedy generation from the
+    serialized StableHLO pair matches running generate() on a prompt of
+    exactly the exported prefill length (no model class at serve time)."""
+    from paddle_tpu.models.generation import (DecoderPredictor,
+                                              export_decoder)
+    m, geom = _model()
+    export_decoder(m, str(tmp_path / "gpt"))
+    pred = DecoderPredictor(str(tmp_path / "gpt"))
+
+    rng = np.random.RandomState(6)
+    Tp = pred.prefill_len
+    ids = rng.randint(1, 97, (2, Tp))
+    served = pred.generate(ids, max_new_tokens=5)
+    direct = generate(m, ids, max_new_tokens=5)
+    np.testing.assert_array_equal(served, direct)
+
+    with pytest.raises(ValueError):
+        pred.generate(np.zeros((1, Tp + 1), np.int64), 2)
