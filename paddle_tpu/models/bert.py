@@ -66,11 +66,9 @@ class BertSelfAttention(nn.Layer):
                                      input_is_parallel=True)
 
     def forward(self, x, attn_mask=None):
+        from .gpt import sliced_qkv
         B, T = x.shape[0], x.shape[1]
-        qkv = M.reshape(self.qkv(x),
-                        [B, T, 3, self.num_heads, self.head_dim])
-        qkv = M.transpose(qkv, [2, 0, 3, 1, 4])  # [3, B, H, T, D]
-        q, k, v = M.unstack(qkv, axis=0)
+        q, k, v = sliced_qkv(x, self.qkv, self.num_heads, self.head_dim)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=False,
             dropout_p=self.cfg.dropout, training=self.training,
