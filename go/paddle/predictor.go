@@ -155,28 +155,66 @@ func (p *Predictor) OutputNames() []string {
 }
 
 // Run executes the model on the input tensors (feed order).
+//
+// cgo pointer rules: the pointer ARRAYS handed to C must live in C
+// memory (a Go slice of Go pointers would trip the cgocheck "Go pointer
+// to Go pointer" panic), and the tensor payloads themselves are copied
+// into C buffers for the duration of the call.
 func (p *Predictor) Run(inputs []*Tensor) error {
 	n := len(inputs)
-	bufs := make([]unsafe.Pointer, n)
-	dts := make([]*C.char, n)
-	var shapes []C.int64_t
-	ndims := make([]C.int, n)
-	pinned := make([][]byte, n) // keep Go buffers alive across the call
-	for i, t := range inputs {
-		pinned[i] = t.Data
-		bufs[i] = unsafe.Pointer(&pinned[i][0])
-		dts[i] = C.CString(t.Dtype)
-		defer C.free(unsafe.Pointer(dts[i]))
-		for _, d := range t.Shape {
-			shapes = append(shapes, C.int64_t(d))
+	if n == 0 {
+		return fmt.Errorf("paddle: Run needs at least one input")
+	}
+	ptrSize := C.size_t(unsafe.Sizeof(uintptr(0)))
+	bufs := C.malloc(C.size_t(n) * ptrSize)
+	dts := C.malloc(C.size_t(n) * ptrSize)
+	ndims := C.malloc(C.size_t(n) * C.size_t(unsafe.Sizeof(C.int(0))))
+	defer C.free(bufs)
+	defer C.free(dts)
+	defer C.free(ndims)
+	var toFree []unsafe.Pointer
+	defer func() {
+		for _, q := range toFree {
+			C.free(q)
 		}
-		ndims[i] = C.int(len(t.Shape))
+	}()
+
+	totalDims := 0
+	for _, t := range inputs {
+		totalDims += len(t.Shape)
+	}
+	var shapes unsafe.Pointer
+	if totalDims > 0 {
+		shapes = C.malloc(C.size_t(totalDims) *
+			C.size_t(unsafe.Sizeof(C.int64_t(0))))
+		defer C.free(shapes)
+	}
+
+	shapeOff := 0
+	for i, t := range inputs {
+		var data unsafe.Pointer
+		if len(t.Data) > 0 {
+			data = C.CBytes(t.Data) // C copy: no Go pointers cross
+			toFree = append(toFree, data)
+		}
+		*(*unsafe.Pointer)(unsafe.Add(bufs, uintptr(i)*uintptr(ptrSize))) = data
+		cs := C.CString(t.Dtype)
+		toFree = append(toFree, unsafe.Pointer(cs))
+		*(*unsafe.Pointer)(unsafe.Add(dts, uintptr(i)*uintptr(ptrSize))) =
+			unsafe.Pointer(cs)
+		*(*C.int)(unsafe.Add(ndims,
+			uintptr(i)*unsafe.Sizeof(C.int(0)))) = C.int(len(t.Shape))
+		for _, d := range t.Shape {
+			*(*C.int64_t)(unsafe.Add(shapes,
+				uintptr(shapeOff)*unsafe.Sizeof(C.int64_t(0)))) = C.int64_t(d)
+			shapeOff++
+		}
 	}
 	rc := C.pd_run_c(p.h,
-		(**C.void)(unsafe.Pointer(&bufs[0])),
-		(**C.char)(unsafe.Pointer(&dts[0])),
-		(*C.int64_t)(unsafe.Pointer(&shapes[0])),
-		(*C.int)(unsafe.Pointer(&ndims[0])), C.int(n))
+		(**C.void)(bufs),
+		(**C.char)(dts),
+		(*C.int64_t)(shapes),
+		(*C.int)(ndims), C.int(n))
 	if rc < 0 {
 		return fmt.Errorf("paddle: Run: %s", C.GoString(C.pd_err()))
 	}

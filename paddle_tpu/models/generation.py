@@ -47,16 +47,24 @@ def _gelu(x):
     return half * x * (one + jnp.tanh(c0 * (x + c1 * x ** 3)))
 
 
-def _block(p, i, x, k_cache, v_cache, pos_mask, geom):
-    """One pre-LN block over x [B, t, H*D] attending to the cache.
-    k_cache/v_cache: [B, H, S, D]; pos_mask [t, S] True=attend."""
+def _qkv_proj(p, i, x, geom):
+    """ln1 + fused qkv projection → [3, B, H, t, D] (computed ONCE per
+    layer per step; both the cache write and the attention consume it)."""
     _, H, D, _ = geom
     pre = f"blocks.{i}."
     h = _ln(x, p[pre + "ln1.weight"], p[pre + "ln1.bias"])
     qkv = h @ p[pre + "attn.qkv.weight"] + p[pre + "attn.qkv.bias"]
     B, t = x.shape[0], x.shape[1]
-    qkv = qkv.reshape(B, t, 3, H, D).transpose(2, 0, 3, 1, 4)
-    q, k_new, v_new = qkv[0], qkv[1], qkv[2]      # [B, H, t, D]
+    return qkv.reshape(B, t, 3, H, D).transpose(2, 0, 3, 1, 4)
+
+
+def _block(p, i, x, q, k_cache, v_cache, pos_mask, geom):
+    """One pre-LN block over x [B, t, H*D]: attention of the precomputed
+    q [B, H, t, D] against the cache, then the MLP.
+    k_cache/v_cache: [B, H, S, D]; pos_mask [t, S] True=attend."""
+    _, H, D, _ = geom
+    pre = f"blocks.{i}."
+    B, t = x.shape[0], x.shape[1]
     scores = jnp.einsum("bhtd,bhsd->bhts", q, k_cache) \
         * jnp.asarray(1.0 / np.sqrt(D), q.dtype)
     scores = jnp.where(pos_mask[None, None], scores,
@@ -68,7 +76,7 @@ def _block(p, i, x, k_cache, v_cache, pos_mask, geom):
     h = _ln(x, p[pre + "ln2.weight"], p[pre + "ln2.bias"])
     h = _gelu(h @ p[pre + "mlp.up.weight"] + p[pre + "mlp.up.bias"])
     x = x + h @ p[pre + "mlp.down.weight"] + p[pre + "mlp.down.bias"]
-    return x, k_new, v_new
+    return x
 
 
 def _embed(p, ids, pos0):
@@ -90,17 +98,14 @@ def prefill(params, input_ids, geom):
         (jnp.arange(S)[None, :] < T)
     cache = jnp.zeros((L, 2, B, H, S, D), x.dtype)
     for i in range(L):
-        # write this layer's K/V for the prompt region, then attend
-        pre = f"blocks.{i}."
-        h = _ln(x, params[pre + "ln1.weight"], params[pre + "ln1.bias"])
-        qkv = h @ params[pre + "attn.qkv.weight"] + \
-            params[pre + "attn.qkv.bias"]
-        qkv = qkv.reshape(B, T, 3, H, D).transpose(2, 0, 3, 1, 4)
+        # one ln1+qkv projection per layer: the cache write AND the
+        # attention both consume it
+        qkv = _qkv_proj(params, i, x, geom)
         kc = jnp.zeros((B, H, S, D), x.dtype).at[:, :, :T].set(qkv[1])
         vc = jnp.zeros((B, H, S, D), x.dtype).at[:, :, :T].set(qkv[2])
         cache = cache.at[i, 0].set(kc)
         cache = cache.at[i, 1].set(vc)
-        x, _, _ = _block(params, i, x, kc, vc, causal, geom)
+        x = _block(params, i, x, qkv[0], kc, vc, causal, geom)
     x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
     logits = x[:, -1] @ params["lm_head.weight"]
     return logits, cache
@@ -115,11 +120,7 @@ def decode_step(params, cache, token, pos, geom):
     x = _embed(params, token[:, None], pos)           # [B, 1, H]
     attend = jnp.arange(S)[None, :] <= pos            # [1, S]
     for i in range(L):
-        pre = f"blocks.{i}."
-        h = _ln(x, params[pre + "ln1.weight"], params[pre + "ln1.bias"])
-        qkv = h @ params[pre + "attn.qkv.weight"] + \
-            params[pre + "attn.qkv.bias"]
-        qkv = qkv.reshape(B, 1, 3, H, D).transpose(2, 0, 3, 1, 4)
+        qkv = _qkv_proj(params, i, x, geom)           # once per layer
         z = jnp.asarray(0, pos.dtype)
         kc = jax.lax.dynamic_update_slice(
             cache[i, 0], qkv[1], (z, z, pos, z))
@@ -127,7 +128,7 @@ def decode_step(params, cache, token, pos, geom):
             cache[i, 1], qkv[2], (z, z, pos, z))
         cache = cache.at[i, 0].set(kc)
         cache = cache.at[i, 1].set(vc)
-        x, _, _ = _block(params, i, x, kc, vc, attend, geom)
+        x = _block(params, i, x, qkv[0], kc, vc, attend, geom)
     x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
     return x[:, 0] @ params["lm_head.weight"], cache
 
@@ -210,7 +211,7 @@ def beam_search_generate(model, input_ids, beam_size: int,
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
     def body(carry, _):
-        logits, cache, scores, finished, pos = carry
+        logits, cache, scores, finished, lengths, pos = carry
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         logp = logp.reshape(B, K, V)
         if eos >= 0:
@@ -223,28 +224,36 @@ def beam_search_generate(model, input_ids, beam_size: int,
         top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
         parent = top_idx // V
         token = (top_idx % V).astype(jnp.int32)
-        new_finished = finished[jnp.arange(B)[:, None], parent]
+        brow = jnp.arange(B)[:, None]
+        was_finished = finished[brow, parent]
+        new_lengths = lengths[brow, parent] + (~was_finished).astype(
+            lengths.dtype)  # frozen beams stop accruing length
+        new_finished = was_finished
         if eos >= 0:
             new_finished = new_finished | (token == eos)
         # re-gather beams: cache batch dim is B*K, parents are per-batch
-        gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        gidx = (brow * K + parent).reshape(-1)
         cache = cache[:, :, gidx]
         logits, cache = decode_step(params, cache, token.reshape(-1),
                                     pos, geom)
-        return ((logits, cache, top_scores, new_finished, pos + 1),
-                (parent, token))
+        return ((logits, cache, top_scores, new_finished, new_lengths,
+                 pos + 1), (parent, token))
 
     finished0 = jnp.zeros((B, K), bool)
-    carry0 = (logits, cache, scores0, finished0,
+    lengths0 = jnp.full((B, K), T, jnp.float32)
+    carry0 = (logits, cache, scores0, finished0, lengths0,
               jnp.asarray(T, jnp.int32))
-    (_, _, scores, _, _), (parents, tokens) = jax.lax.scan(
+    (_, _, scores, _, lengths, _), (parents, tokens) = jax.lax.scan(
         body, carry0, None, length=max_new_tokens)
     parents = np.asarray(parents)                     # [steps, B, K]
     tokens = np.asarray(tokens)
     scores = np.asarray(scores)                       # [B, K]
+    lengths = np.asarray(lengths)                     # [B, K]
 
     if length_penalty:
-        scores = scores / ((T + max_new_tokens) ** length_penalty)
+        # per-HYPOTHESIS length normalization (reference beam_search_op):
+        # beams that emitted eos early divide by their own shorter length
+        scores = scores / (lengths ** length_penalty)
     best = scores.argmax(axis=1)                      # [B]
     # backtrack the (parent, token) lattice from the best leaf
     out = np.zeros((B, max_new_tokens), np.int64)
@@ -263,8 +272,8 @@ def export_decoder(model, path_prefix: str):
     Writes <prefix>.prefill.pdmodel, <prefix>.decode.pdmodel and
     <prefix>.pdmeta (geometry + param tree layout; parameters are baked
     into the artifacts as constants)."""
+    import json
     import os
-    import pickle
     from jax import export as jexport
     cfg = model.cfg
     geom = (cfg.num_layers, cfg.num_heads,
@@ -296,9 +305,12 @@ def export_decoder(model, path_prefix: str):
         f.write(ex_prefill.serialize())
     with open(path_prefix + ".decode.pdmodel", "wb") as f:
         f.write(ex_decode.serialize())
-    with open(path_prefix + ".pdmeta", "wb") as f:
-        pickle.dump({"geom": geom, "prefill_len": Tp,
-                     "vocab_size": cfg.vocab_size}, f)
+    with open(path_prefix + ".pdmeta", "w") as f:
+        # JSON, not pickle: serving artifacts may come from third parties
+        # and must not be able to execute code at load (same rule as the
+        # p2p raw-buffer framing)
+        json.dump({"geom": list(geom), "prefill_len": Tp,
+                   "vocab_size": cfg.vocab_size}, f)
 
 
 class DecoderPredictor:
@@ -306,34 +318,36 @@ class DecoderPredictor:
     from serialized StableHLO only (no model class)."""
 
     def __init__(self, path_prefix: str):
-        import pickle
+        import json
         from jax import export as jexport
         with open(path_prefix + ".prefill.pdmodel", "rb") as f:
             self._prefill = jexport.deserialize(f.read())
         with open(path_prefix + ".decode.pdmodel", "rb") as f:
             self._decode = jexport.deserialize(f.read())
-        with open(path_prefix + ".pdmeta", "rb") as f:
-            meta = pickle.load(f)
+        with open(path_prefix + ".pdmeta") as f:
+            meta = json.load(f)  # JSON: no code execution at load
         self.geom = tuple(meta["geom"])
         self.prefill_len = int(meta["prefill_len"])
         self.vocab_size = int(meta["vocab_size"])
 
     def generate(self, input_ids, max_new_tokens: int):
-        """Greedy decode. Prompts are left-padded/truncated to the
-        exported prefill length with token 0 (mask-free convention: pad
-        tokens participate like the reference's fixed-shape serving)."""
+        """Greedy decode. Prompts must be EXACTLY the exported prefill
+        length: the fixed-shape prefill has no pad masking, so a shorter
+        prompt would silently attend pad tokens at shifted positions and
+        diverge from generate() — a loud error beats silent divergence.
+        (Serve multiple buckets by exporting one artifact per length.)"""
         ids = np.asarray(input_ids)
         B, T = ids.shape
         Tp = self.prefill_len
-        if T > Tp:
-            raise ValueError(f"prompt {T} exceeds exported prefill "
-                             f"length {Tp}")
+        if T != Tp:
+            raise ValueError(
+                f"prompt length {T} != exported prefill length {Tp}; the "
+                "fixed-shape prefill has no pad masking — export an "
+                "artifact per prompt-length bucket")
         S = self.geom[3]
         if Tp + max_new_tokens > S:
             raise ValueError("generation exceeds max_seq_len")
-        padded = np.zeros((B, Tp), np.int32)
-        padded[:, Tp - T:] = ids  # right-aligned: last position is live
-        logits, cache = self._prefill.call(jnp.asarray(padded))
+        logits, cache = self._prefill.call(jnp.asarray(ids, jnp.int32))
         seq = ids.copy()
         pos = Tp
         for _ in range(max_new_tokens):

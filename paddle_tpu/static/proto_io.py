@@ -298,8 +298,15 @@ def _parse_tensor_desc(data: bytes) -> Tuple[str, List[int]]:
     code = f.get(1, [5])[0]
     dims = []
     for raw in f.get(2, []):
-        v = raw if isinstance(raw, int) else 0
-        dims.append(v - (1 << 64) if v >= (1 << 63) else v)
+        if isinstance(raw, int):
+            dims.append(raw - (1 << 64) if raw >= (1 << 63) else raw)
+        else:
+            # packed encoding (proto3 default / [packed=true] writers):
+            # the repeated int64s arrive as one length-delimited payload
+            # of concatenated varints
+            r = _Reader(raw)
+            while not r.eof():
+                dims.append(r.signed64())
     return _CODE_TO_DTYPE.get(code, "float32"), dims
 
 

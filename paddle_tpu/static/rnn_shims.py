@@ -192,10 +192,13 @@ class StaticRNN(_RNNBase):
             if shape is None:
                 raise ValueError("memory() needs init= or shape=")
             from .nn import persistable_buffer
-            # zero-init memory created in the outer program
-            if self._guard is not None:
-                # temporarily escape the sub-program guard
-                self._guard.__exit__(None, None, None)
+            if self._guard is None:
+                raise RuntimeError(
+                    "StaticRNN.memory() must be called inside "
+                    "`with rnn.step():` (reference StaticRNN contract)")
+            # zero-init memory created in the OUTER program: temporarily
+            # escape the sub-program guard
+            self._guard.__exit__(None, None, None)
             try:
                 zed = persistable_buffer(
                     np.full(tuple(shape), value,
@@ -351,16 +354,20 @@ class _PyReader:
         if self._gen is None:
             raise RuntimeError("py_reader: decorate_batch_generator first")
         self._stop.clear()
-        self._q = _queue.Queue(self.capacity)
+        q = _queue.Queue(self.capacity)
+        self._q = q
 
-        def fill():
+        def fill(q=q):
+            # bind the queue locally: reset() nulls self._q, and the
+            # producer must not race that rebind (its sentinel goes to
+            # the queue it was started with)
             try:
                 for batch in self._gen():
                     if self._stop.is_set():
                         return
-                    self._q.put(batch)
+                    q.put(batch)
             finally:
-                self._q.put(None)  # EOF sentinel
+                q.put(None)  # EOF sentinel
 
         self._thread = threading.Thread(target=fill, daemon=True)
         self._thread.start()

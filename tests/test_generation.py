@@ -153,3 +153,31 @@ def test_exported_decoder_serves_without_model(tmp_path):
 
     with pytest.raises(ValueError):
         pred.generate(np.zeros((1, Tp + 1), np.int64), 2)
+
+
+def test_beam_length_penalty_normalizes_per_hypothesis():
+    """length_penalty divides each beam by ITS OWN hypothesis length
+    (reference beam_search_op semantics; a uniform divisor could never
+    change the argmax). Verified arithmetically: the returned score must
+    equal the winner's raw model logprob (up to and including its first
+    eos) divided by that hypothesis's length."""
+    from paddle_tpu.models.generation import beam_search_generate
+    m, geom = _model()
+    ids = np.zeros((1, 3), np.int64)
+    T, steps = 3, 8
+    out1, s1 = beam_search_generate(m, ids, beam_size=4,
+                                    max_new_tokens=steps,
+                                    eos_token_id=7, length_penalty=1.0)
+    assert np.isfinite(s1).all()
+    row = out1[0, T:]
+    n_real = (list(row).index(7) + 1) if 7 in row else steps
+
+    raw = 0.0
+    for s in range(n_real):  # logprob of tokens up to + incl. first eos
+        cur = out1[:, :T + s]
+        logits = m(paddle.to_tensor(cur)).numpy()[:, -1].astype(np.float64)
+        lp = logits - logits.max(-1, keepdims=True)
+        lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+        raw += lp[0, row[s]]
+    np.testing.assert_allclose(s1[0], raw / (T + n_real), rtol=1e-3,
+                               atol=1e-3)

@@ -177,3 +177,29 @@ def test_save_load_retrain_parity_proto_format(tmp_path):
         static.nn.fc(x, 2)
     with pytest.raises(ValueError):
         load_program(main3, str(tmp_path / "model"))
+
+
+def test_packed_repeated_dims_parse():
+    """Writers using packed encoding (proto3 default) put all dims in one
+    length-delimited payload; the parser must decode them, not coerce to
+    0."""
+    from paddle_tpu.static.proto_io import _parse_tensor_desc
+
+    def varint(n):
+        n &= (1 << 64) - 1
+        out = bytearray()
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            if n:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+
+    # TensorDesc{data_type=5, dims packed [-1, 640, 480]}
+    payload = b"".join(varint(d) for d in (-1, 640, 480))
+    msg = b"\x08\x05" + b"\x12" + varint(len(payload)) + payload
+    dtype, dims = _parse_tensor_desc(msg)
+    assert dtype == "float32"
+    assert dims == [-1, 640, 480]
