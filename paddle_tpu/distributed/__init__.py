@@ -14,6 +14,7 @@ from .tp_layers import (  # noqa: F401
     split, ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
 )
+from .moe import MoEMLP  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .launch import launch  # noqa: F401

@@ -47,6 +47,14 @@ class GPTConfig:
     # 'sp' mesh axis (parallel/ring_attention.py) — the long-context path
     # where even one layer's [T, T] scores don't fit a chip
     context_parallel: bool = False
+    # mixture-of-experts: >0 replaces the dense MLP with an
+    # expert-parallel MoEMLP (distributed/moe.py, 'ep' mesh axis) in
+    # every moe_every-th block; load-balance aux added to loss()
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     # presets (reference marketing targets: BASELINE.json configs)
     @staticmethod
@@ -163,13 +171,23 @@ class GPTMLP(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.cfg = cfg
         self.ln1 = nn.LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.hidden_size)
-        self.mlp = GPTMLP(cfg)
+        use_moe = (cfg.moe_experts > 0
+                   and layer_idx % max(cfg.moe_every, 1)
+                   == max(cfg.moe_every, 1) - 1)
+        if use_moe:
+            from ..distributed.moe import MoEMLP
+            self.mlp = MoEMLP(cfg.hidden_size, cfg.moe_experts,
+                              ffn_hidden_size=cfg.ffn_mult * cfg.hidden_size,
+                              top_k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(cfg)
 
     def _body(self, x):
         x = x + self.attn(self.ln1(x))
@@ -181,6 +199,17 @@ class GPTBlock(nn.Layer):
     def forward(self, x):
         if self.cfg.use_recompute:
             from ..distributed.fleet.utils import recompute
+            from ..distributed.moe import MoEMLP
+            if isinstance(self.mlp, MoEMLP):
+                # aux loss must ride the checkpointed return — a Tensor
+                # stashed on the layer inside jax.checkpoint would leak
+                # its tracer into the outer trace
+                def body_with_aux(x_):
+                    out = self._body(x_)
+                    return out, self.mlp.aux_loss
+                out, aux = recompute(body_with_aux, x)
+                self.mlp.aux_loss = aux
+                return out
             return recompute(self._body, x)
         return self._body(x)
 
@@ -193,8 +222,8 @@ class GPT(nn.Layer):
         self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
         self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
-        self.blocks = nn.LayerList([GPTBlock(cfg)
-                                    for _ in range(cfg.num_layers)])
+        self.blocks = nn.LayerList([GPTBlock(cfg, layer_idx=i)
+                                    for i in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
         # column-parallel LM head over vocab (untied: its own V x H
         # matrix; the bench FLOPs formula counts the unembed matmul once
@@ -218,9 +247,15 @@ class GPT(nn.Layer):
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)
-        return F.cross_entropy(
+        loss = F.cross_entropy(
             M.reshape(logits, [-1, self.cfg.vocab_size]),
             M.reshape(labels, [-1]))
+        if self.cfg.moe_experts > 0 and self.cfg.moe_aux_weight > 0:
+            from ..distributed.moe import MoEMLP
+            for blk in self.blocks:
+                if isinstance(blk.mlp, MoEMLP) and blk.mlp.aux_loss is not None:
+                    loss = loss + self.cfg.moe_aux_weight * blk.mlp.aux_loss
+        return loss
 
 
 def gpt_loss_fn(model, input_ids, labels):

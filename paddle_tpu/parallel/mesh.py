@@ -27,23 +27,24 @@ _ring_axes: Dict[int, str] = {}   # ring_id -> mesh axis (reference parity)
 
 
 def build_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
-               sharding: int = 1, devices=None) -> Mesh:
+               sharding: int = 1, ep: int = 1, devices=None) -> Mesh:
     """Build a named mesh over the device grid.
 
     Axis order chosen for ICI locality (scaling-book recipe): tp innermost
-    (highest-bandwidth neighbours), then sharding/sp, then pp, dp outermost
-    (can ride DCN). Degrees must multiply to the device count; any degree
-    left at 1 is still a named axis so strategies can be toggled without
-    re-annotating the model.
+    (highest-bandwidth neighbours), then ep (all-to-all heavy), then
+    sharding/sp, then pp, dp outermost (can ride DCN). Degrees must
+    multiply to the device count; any degree left at 1 is still a named
+    axis so strategies can be toggled without re-annotating the model.
     """
     devices = list(devices if devices is not None else jax.devices())
-    want = dp * pp * tp * sp * sharding
+    want = dp * pp * tp * sp * sharding * ep
     if want != len(devices):
         raise TopologyError(
             f"mesh degrees dp={dp}×pp={pp}×tp={tp}×sp={sp}×"
-            f"sharding={sharding} = {want} != {len(devices)} devices")
-    arr = np.asarray(devices).reshape(dp, pp, sharding, sp, tp)
-    return Mesh(arr, ("dp", "pp", "sharding", "sp", "tp"))
+            f"sharding={sharding}×ep={ep} = {want} != "
+            f"{len(devices)} devices")
+    arr = np.asarray(devices).reshape(dp, pp, sharding, sp, ep, tp)
+    return Mesh(arr, ("dp", "pp", "sharding", "sp", "ep", "tp"))
 
 
 def set_global_mesh(mesh: Mesh):

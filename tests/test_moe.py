@@ -1,0 +1,177 @@
+"""Mixture-of-Experts + expert parallelism ('ep' mesh axis).
+
+Reference context: the 2.0/2.1-dev snapshot scales sparse capacity via the
+PS distributed lookup table (distribute_transpiler.py:393); MoE landed in
+later paddle (incubate.distributed.models.moe) on the same
+dispatch/combine design. These tests validate the TPU-native
+expert-parallel layer (distributed/moe.py): routing math against a dense
+oracle, capacity-overflow semantics, load-balance aux, and n-device loss
+parity in the TestDistBase style (test_dist_base.py:660 — same model,
+same data, sharded run must match the 1-device run).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.moe import MoEMLP, moe_dispatch_combine
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+from paddle_tpu.parallel import build_mesh, set_global_mesh, ShardedTrainStep
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    set_global_mesh(None)
+    yield
+    set_global_mesh(None)
+
+
+def _gelu(h):
+    return 0.5 * h * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                  * (h + 0.044715 * h ** 3)))
+
+
+def _force_router(m, expert):
+    """Router logits that send every token to `expert` with gate ~1."""
+    r = np.full((m.router.shape[0], m.num_experts), -20.0, np.float32)
+    r[:, expert] = 20.0
+    # constant over the feature dim: logits = sum(x) * row — instead make
+    # the router ignore x by zeroing weight and using the softmax of a
+    # fixed bias folded into one input row; simpler: set every row equal
+    # so logits = (sum_h x_h) * bias_pattern. Sign of sum(x) could flip
+    # the argmax, so route through a weight that yields the pattern for
+    # any x: not expressible with a linear router alone. Use x >= 0 data
+    # in the callers instead.
+    m.router._value = jnp.asarray(r / m.router.shape[0])
+
+
+def test_moe_forced_routing_matches_dense_expert():
+    paddle.seed(0)
+    m = MoEMLP(16, num_experts=4, ffn_hidden_size=32, top_k=1,
+               capacity_factor=8.0)
+    _force_router(m, 1)
+    x = np.abs(np.random.RandomState(0).randn(1, 6, 16)).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy().reshape(6, 16)
+    h = x.reshape(6, 16) @ m.w_up.numpy()[1] + m.b_up.numpy()[1]
+    dense = _gelu(h) @ m.w_down.numpy()[1] + m.b_down.numpy()[1]
+    # gate = softmax gap of 40 logits ≈ 1.0
+    np.testing.assert_allclose(out, dense, rtol=1e-4, atol=1e-4)
+    assert float(m.aux_loss.numpy()) > 1.5  # maximally unbalanced > 1
+
+
+def test_moe_top2_renormalised_combine():
+    # uniform router -> every token takes two experts at gate 0.5 each;
+    # output must be the MEAN of the two dense expert FFNs (GShard top-2
+    # normalisation), not the raw 0.25+0.25 softmax mass.
+    paddle.seed(1)
+    m = MoEMLP(8, num_experts=2, ffn_hidden_size=16, top_k=2,
+               capacity_factor=8.0)
+    m.router._value = jnp.zeros((8, 2), jnp.float32)
+    x = np.random.RandomState(1).randn(1, 5, 8).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy().reshape(5, 8)
+    xs = x.reshape(5, 8)
+    dense = []
+    for e in (0, 1):
+        h = xs @ m.w_up.numpy()[e] + m.b_up.numpy()[e]
+        dense.append(_gelu(h) @ m.w_down.numpy()[e] + m.b_down.numpy()[e])
+    np.testing.assert_allclose(out, 0.5 * (dense[0] + dense[1]),
+                               rtol=1e-4, atol=1e-4)
+    # perfectly balanced -> aux == E * sum(1/E * 1/E * E) == 1
+    np.testing.assert_allclose(float(m.aux_loss.numpy()), 1.0, atol=1e-4)
+
+
+def test_moe_capacity_overflow_drops_to_zero():
+    # all 8 tokens routed to expert 0 with capacity 1: token 0 is served,
+    # tokens 1..7 dropped -> expert-path output exactly 0 (the residual
+    # carries them in a transformer block; Switch semantics)
+    paddle.seed(2)
+    m = MoEMLP(8, num_experts=4, ffn_hidden_size=16, top_k=1,
+               capacity_factor=0.25, min_capacity=1)
+    _force_router(m, 0)
+    x = np.abs(np.random.RandomState(2).randn(1, 8, 8)).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy().reshape(8, 8)
+    assert np.abs(out[0]).sum() > 0
+    np.testing.assert_array_equal(out[1:], np.zeros_like(out[1:]))
+
+
+def test_moe_dispatch_combine_positions():
+    # 4 tokens, 2 experts, alternating routing: per-expert queue positions
+    # must be 0,1 (not global token index)
+    gates = jnp.asarray([[0.9, 0.1], [0.1, 0.9], [0.9, 0.1], [0.1, 0.9]],
+                        jnp.float32)
+    disp, comb, aux = moe_dispatch_combine(gates, top_k=1, capacity=2)
+    d = np.asarray(disp)
+    assert d[0, 0, 0] == 1 and d[2, 0, 1] == 1    # expert 0 queue
+    assert d[1, 1, 0] == 1 and d[3, 1, 1] == 1    # expert 1 queue
+    assert d.sum() == 4
+    np.testing.assert_allclose(np.asarray(comb).sum(axis=(1, 2)),
+                               [0.9, 0.9, 0.9, 0.9], rtol=1e-6)
+
+
+def test_moe_grads_flow_to_all_experts():
+    paddle.seed(3)
+    m = MoEMLP(8, num_experts=2, ffn_hidden_size=16, top_k=2,
+               capacity_factor=4.0)
+    m.router._value = jnp.zeros((8, 2), jnp.float32)
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 4, 8).astype(np.float32))
+    (m(x).sum() + m.aux_loss).backward()
+    for p in (m.router, m.w_up, m.b_up, m.w_down, m.b_down):
+        assert p.grad is not None
+        assert np.abs(p.grad.numpy()).sum() > 0
+    g = m.w_up.grad.numpy()
+    assert np.abs(g[0]).sum() > 0 and np.abs(g[1]).sum() > 0
+
+
+def _run_moe_gpt(mesh_kw, steps=5, **cfg_kw):
+    paddle.seed(0)
+    mesh = build_mesh(**mesh_kw)
+    set_global_mesh(mesh)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=16, moe_experts=4,
+                    moe_top_k=2, moe_every=1, moe_capacity_factor=2.0,
+                    **cfg_kw)
+    model = GPT(cfg)
+    optim = opt.Adam(1e-3, parameters=model.parameters())
+    step = ShardedTrainStep(model, gpt_loss_fn, optim, mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
+    y = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
+    return [float(step(x, y).numpy()) for _ in range(steps)], step
+
+
+def test_moe_ep_parity_vs_single_device():
+    base, _ = _run_moe_gpt(dict(dp=1, devices=jax.devices()[:1]))
+    ep, _ = _run_moe_gpt(dict(dp=2, ep=4))
+    np.testing.assert_allclose(base, ep, rtol=2e-3, atol=2e-3)
+    assert base[-1] < base[0]  # it actually trains
+
+
+def test_moe_ep_recompute_parity():
+    # aux loss must survive the checkpointed block (rides the recompute
+    # return, models/gpt.py GPTBlock.forward)
+    base, _ = _run_moe_gpt(dict(dp=1, devices=jax.devices()[:1]),
+                           use_recompute=True)
+    dense_base, _ = _run_moe_gpt(dict(dp=1, devices=jax.devices()[:1]))
+    # recompute changes no math
+    np.testing.assert_allclose(base, dense_base, rtol=2e-3, atol=2e-3)
+    ep, _ = _run_moe_gpt(dict(dp=2, ep=4), use_recompute=True)
+    np.testing.assert_allclose(base, ep, rtol=2e-3, atol=2e-3)
+
+
+def test_moe_ep_hlo_has_all_to_all():
+    # the compile-time strategy assertion (analogue of the reference's
+    # meta-optimizer ProgramDesc greps, test_fleet_sharding_meta_optimizer):
+    # dp-sharded tokens x ep-sharded experts must move via collectives on
+    # the ep axis — GSPMD emits all-to-all (or all-gather+dyn-slice on
+    # some geometries); assert the expert boundary produced SOME ep
+    # collective beyond plain dp all-reduce
+    _, step = _run_moe_gpt(dict(dp=2, ep=4), steps=1)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
+    y = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
+    hlo = step.compiled_text(x, y)
+    assert ("all-to-all" in hlo or "all-gather" in hlo
+            or "collective-permute" in hlo), "no ep collective in HLO"
