@@ -75,7 +75,8 @@ def test_train_step_no_f64_in_module():
     import jax.numpy as jnp
     lowered = step._step.lower(
         params, frozen, buffers, opt_state, jnp.asarray(1e-3, jnp.float32),
-        jax.random.PRNGKey(0), x._value, y._value)
+        jax.random.PRNGKey(0), jnp.asarray(1, jnp.uint32),
+        x._value, y._value)
     txt = lowered.as_text()
     # scalar f64 CONSTANTS (weak-typed python literals immediately
     # converted) are harmless; f64 ARRAYS mean a real promotion leak
