@@ -149,19 +149,33 @@ class BertForPretraining(nn.Layer):
             [cfg.vocab_size], is_bias=True)
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None,
+                masked_positions=None):
+        """masked_positions: optional [B, P] int positions of the masked
+        tokens. When given, the MLM transform + tied unembed run only on
+        those P rows ([B, P, V] logits instead of [B, T, V]) — the
+        reference design (bert_dygraph_model.py:335 gathers mask_pos
+        before PretrainingHeads; ernie/static BERT do the same). At the
+        standard 15% masking this cuts the dominant V x H matmul and its
+        logits traffic ~6x. Omit it for dense whole-sequence logits."""
         seq, pooled = self.bert(input_ids, token_type_ids, attn_mask)
+        if masked_positions is not None:
+            idx = M.unsqueeze(masked_positions, -1)
+            seq = M.take_along_axis(seq, idx, axis=1)  # [B, P, H]
         h = self.mlm_ln(F.gelu(self.mlm_transform(seq), approximate=True))
         logits = matmul(h, self.bert.word_emb.weight,
                         transpose_y=True) + self.mlm_bias
         return logits, self.nsp(pooled)
 
     def loss(self, input_ids, token_type_ids, mlm_labels,
-             nsp_labels=None):
+             nsp_labels=None, masked_positions=None):
         """mlm_labels: [B, T] with -100 at unmasked positions (the
-        standard ignore_index contract the fused CE honours);
+        standard ignore_index contract the fused CE honours) — or [B, P]
+        labels aligned with masked_positions when those are passed
+        (ragged batches pad with -100);
         nsp_labels: [B] int64 or None."""
-        logits, nsp_logits = self(input_ids, token_type_ids)
+        logits, nsp_logits = self(input_ids, token_type_ids,
+                                  masked_positions=masked_positions)
         mlm = F.cross_entropy(
             M.reshape(logits, [-1, self.cfg.vocab_size]),
             M.reshape(mlm_labels, [-1]), ignore_index=-100)
@@ -171,6 +185,7 @@ class BertForPretraining(nn.Layer):
 
 
 def bert_pretrain_loss_fn(model, input_ids, token_type_ids, mlm_labels,
-                          nsp_labels):
+                          nsp_labels, masked_positions=None):
     """loss_fn signature for jit.TrainStep / parallel.ShardedTrainStep."""
-    return model.loss(input_ids, token_type_ids, mlm_labels, nsp_labels)
+    return model.loss(input_ids, token_type_ids, mlm_labels, nsp_labels,
+                      masked_positions=masked_positions)
