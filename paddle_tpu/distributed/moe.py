@@ -59,8 +59,24 @@ def _ep_constraint(x):
         spec = ("ep",) + (None,) * (x.ndim - 1)
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(*spec)))
-    except (ValueError, RuntimeError):
+    except (ValueError, RuntimeError) as e:
+        # e.g. inside a manual shard_map region where the mesh axis is
+        # already bound. Dropping the constraint is functionally correct
+        # but silently loses expert parallelism (no dp<->ep all-to-all,
+        # replicated expert tensors) — say so once, loudly.
+        global _WARNED_EP
+        if not _WARNED_EP:
+            _WARNED_EP = True
+            import warnings
+            warnings.warn(
+                "MoE expert-sharding constraint could not be applied "
+                f"({e!r}); continuing WITHOUT expert parallelism — the "
+                "expert tensors stay replicated and no ep all-to-all is "
+                "emitted", RuntimeWarning, stacklevel=3)
         return x
+
+
+_WARNED_EP = False
 
 
 def moe_dispatch_combine(gates, top_k: int, capacity: int):
@@ -137,7 +153,11 @@ class MoEMLP(Layer):
     mesh) the same einsums run locally, so the layer is debuggable on one
     chip. After forward, ``self.aux_loss`` holds the load-balance loss for
     the caller's objective (weight it, e.g. 0.01, and add to the task
-    loss).
+    loss) — consume it in the SAME forward/loss computation (as
+    models/gpt.py GPT.loss does). Under a jitted step the stored value is
+    a tracer: to log it per step, return it from your loss_fn (e.g.
+    ``TrainStep(..., return_outputs=True)``) rather than reading the
+    attribute after the step, which raises TracerArrayConversionError.
     """
 
     def __init__(self, hidden_size: int, num_experts: int,

@@ -173,5 +173,21 @@ def test_moe_ep_hlo_has_all_to_all():
     x = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
     y = paddle.to_tensor(rng.randint(0, 64, (8, 16)))
     hlo = step.compiled_text(x, y)
-    assert ("all-to-all" in hlo or "all-gather" in hlo
-            or "collective-permute" in hlo), "no ep collective in HLO"
+    # the assertion must not be satisfiable by dp-only collectives (size-2
+    # groups for grad allreduce): require a boundary collective whose
+    # replica groups span >= the ep degree (4), i.e. devices that differ
+    # along the ep axis actually exchange data
+    import re
+    sizes = set()
+    for line in hlo.splitlines():
+        if not re.search(r"all-to-all|all-gather|collective-permute", line):
+            continue
+        m = re.search(r"replica_groups=\{(.*?)\}\}", line)
+        if m:
+            sizes |= {len(g.split(","))
+                      for g in re.findall(r"\{([0-9,]+)\}", m.group(1) + "}")}
+        m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=", line)
+        if m:  # iota form [G,S]<=[N]: G groups of S
+            sizes.add(int(m.group(2)))
+    assert any(s >= 4 for s in sizes), \
+        f"no collective spanning the ep axis (group sizes seen: {sizes})"
