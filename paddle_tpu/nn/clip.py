@@ -81,13 +81,20 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def clip_arrays(self, grads, need_clip=None):
         if need_clip is None:
             need_clip = [True] * len(grads)
-        sq = [jnp.sum(jnp.square(g)) for g, nc in zip(grads, need_clip)
-              if g is not None and nc]
+        # per-tensor partial reductions + scalar sum: under GSPMD-sharded
+        # grads each partial reduces locally and only the scalar crosses
+        # the mesh (a concat-then-reduce variant measured no faster on the
+        # flagship GPT and would force per-step all-gathers of sharded
+        # grad buffers). The upcast matters: bf16 grads must NOT
+        # accumulate their squares in bf16 (8 mantissa bits over 1e8
+        # elements); astype(f32) fuses into the reduce read under jit.
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g, nc in zip(grads, need_clip) if g is not None and nc]
         if not sq:
             return grads
         global_norm = jnp.sqrt(sum(sq))
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        return [g if (g is None or not nc) else g * scale
+        return [g if (g is None or not nc) else g * scale.astype(g.dtype)
                 for g, nc in zip(grads, need_clip)]
 
     def __call__(self, params_grads):

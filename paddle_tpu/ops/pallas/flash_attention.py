@@ -26,8 +26,68 @@ from ...core.tensor import Tensor
 def _kernel():
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         flash_attention as fa, BlockSizes)
-    _patch_dq_di_broadcast()
+    if not _patch_lmdi_width1():
+        _patch_dq_di_broadcast()
     return fa, BlockSizes
+
+
+@functools.lru_cache(maxsize=1)
+def _patch_lmdi_width1():
+    """Stop materialising the softmax residuals broadcast: upstream's bwd
+    wrappers expand l, m and di ([B, H, T] f32) to [B, H, T, 128] before
+    pallas_call — 3 × 100 MB HBM round-trips per layer at the flagship
+    geometry, profiled at 7.6 ms/step (3.7% of the step) with the tensors
+    CSE-shared between the dq and dkv passes. The kernel bodies only ever
+    use the values replicated across lanes (`jnp.tile(x, (1, block_k //
+    MIN_BLOCK_SIZE))` right before use), so pass them as width-1 blocks
+    ([..., 1] is a reshape, not a copy) and lane-splat in VMEM instead
+    (`jnp.broadcast_to(x, capped_logits.shape)` — a register splat, no
+    HBM traffic). Result-identical; verified against composed attention
+    on TPU. Applied by guarded source rewrite; any drift in the upstream
+    text → return False and fall back to the narrower dq-di patch."""
+    import inspect
+    import re
+    import jax.experimental.pallas.ops.tpu.flash_attention as m
+
+    fns = ["_flash_attention_bwd_dkv", "_flash_attention_bwd_dq",
+           "_flash_attention_dkv_kernel", "_flash_attention_dq_kernel"]
+    srcs = {}
+    try:
+        for fn in fns:
+            srcs[fn] = inspect.getsource(getattr(m, fn))
+    except (OSError, AttributeError):
+        return False
+
+    bcast = re.compile(
+        r"jnp\.broadcast_to\((l|m|di)\[\.\.\., None\], "
+        r"\(\*\1\.shape, (?:MIN_BLOCK_SIZE|block_k_major)\)\)")
+    spec = re.compile(r"pl\.BlockSpec\(\n?\s*\(1, 1, block_q_major, "
+                      r"MIN_BLOCK_SIZE\),")
+    tile = re.compile(r"jnp\.tile\(\n?\s*(m|1 / l|di),"
+                      r" \(1, block_k // MIN_BLOCK_SIZE\)\n?\s*\)")
+    patched = {}
+    for fn in fns[:2]:   # wrappers
+        src, n1 = bcast.subn(
+            lambda g: f"jnp.broadcast_to({g.group(1)}[..., None], "
+                      f"(*{g.group(1)}.shape, 1))", srcs[fn])
+        src, n2 = spec.subn("pl.BlockSpec((1, 1, block_q_major, 1),", src)
+        if n1 != 3 or n2 != 2:   # l/m/di bcasts; lm_spec + di_spec
+            return False
+        patched[fn] = src
+    for fn in fns[2:]:   # kernel bodies
+        src, n = tile.subn(
+            lambda g: f"jnp.broadcast_to({g.group(1)}, "
+                      "capped_logits.shape)", srcs[fn])
+        if n != 3:       # m, 1/l, di
+            return False
+        # the q_segment_ids jnp.tile(..., (1, repeats)) uses a different
+        # pattern and must remain untouched
+        if "jnp.tile(m," in src or "jnp.tile(di," in src:
+            return False
+        patched[fn] = src
+    for fn, src in patched.items():
+        exec(src, m.__dict__)  # noqa: S102 - vendored jax fix
+    return True
 
 
 @functools.lru_cache(maxsize=1)

@@ -303,3 +303,13 @@ def test_weight_norm():
     remove_weight_norm(m)
     y2 = m(x).numpy()
     np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_lmdi_width1_patch_applies():
+    """The vendored width-1 l/m/di rewrite must keep matching the upstream
+    pallas flash kernel source (all guards hit); a False here means jax
+    drifted and the bwd pass silently reverted to materialising 3x100MB
+    broadcast copies per layer (or, worse, the fallback dq-di patch also
+    stopped matching)."""
+    from paddle_tpu.ops.pallas.flash_attention import _patch_lmdi_width1
+    assert _patch_lmdi_width1() is True
