@@ -102,5 +102,44 @@ class DataParallel:  # real impl re-exported below once distributed loads
 
 from .distributed.parallel import DataParallel  # noqa: F401,E402,F811
 
+# ---------------------------------------------------------------------------
+# top-level namespace parity with the reference python/paddle/__init__.py
+# (audited mechanically by tests/test_api_parity.py)
+
+from . import distribution  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import compat  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402
+from .legacy_api import *  # noqa: F401,F403,E402
+from .core.place import XPUPlace  # noqa: F401,E402
+from .core.selected_rows import get_tensor_from_selected_rows  # noqa: F401,E402
+from .ops.extra_ops import multiplex  # noqa: F401,E402
+from .ops.array_ops import TensorArray as LoDTensorArray  # noqa: E402
+from .static.program import data  # noqa: F401,E402
+from .static.nn import create_global_var  # noqa: F401,E402
+from .static.program import create_parameter  # noqa: F401,E402
+from . import ops as tensor  # noqa: F401,E402  (paddle.tensor module alias)
+
+# pybind-era aliases: the eager tensor IS VarBase/LoDTensor here
+VarBase = Tensor
+LoDTensor = Tensor
+
+
+def enable_dygraph(place=None):
+    """reference fluid/dygraph/base.py enable_dygraph — dygraph is the
+    default mode; this leaves static mode if it was entered."""
+    disable_static()
+
+
+def disable_dygraph():
+    enable_static()
+
+
+from .device import get_cudnn_version, is_compiled_with_xpu  # noqa: F401,E402
+
 __version__ = "0.1.0"
 version = __version__

@@ -1,0 +1,70 @@
+"""paddle.compat — python 2/3 string-compat helpers kept for API parity.
+
+Reference: /root/reference/python/paddle/compat.py (to_text:36,
+to_bytes:132, round:217, floor_division:243, get_exception_message:260).
+On python-3-only this collapses to thin conversions with the same
+recursive list/set/dict semantics (inplace honoured for containers).
+"""
+from __future__ import annotations
+
+import math
+
+
+def _convert(obj, encoding, inplace, conv):
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_convert(i, encoding, inplace, conv) for i in obj]
+            return obj
+        return [_convert(i, encoding, inplace, conv) for i in obj]
+    if isinstance(obj, set):
+        out = {_convert(i, encoding, False, conv) for i in obj}
+        if inplace:
+            obj.clear()
+            obj.update(out)
+            return obj
+        return out
+    if isinstance(obj, dict):
+        out = {_convert(k, encoding, False, conv):
+               _convert(v, encoding, False, conv) for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(out)
+            return obj
+        return out
+    return conv(obj, encoding)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes → str (recursively through list/set/dict)."""
+    def conv(o, enc):
+        return o.decode(enc) if isinstance(o, bytes) else o
+    return _convert(obj, encoding, inplace, conv)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str → bytes (recursively through list/set/dict)."""
+    def conv(o, enc):
+        return o.encode(enc) if isinstance(o, str) else o
+    return _convert(obj, encoding, inplace, conv)
+
+
+def round(x, d=0):  # noqa: A001 - reference name
+    """Half-away-from-zero rounding (python2 semantics the reference
+    preserves; python3 builtin round is banker's)."""
+    if x in (float("inf"), float("-inf")) or x != x:  # inf / nan
+        return x
+    p = 10 ** d
+    if x >= 0.0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    assert exc is not None
+    return str(exc)

@@ -63,6 +63,10 @@ class XLAPlace(TPUPlace):
 
 CUDAPlace = XLAPlace
 
+# reference platform/place.h:62 XPUPlace (Kunlun accelerator): map onto
+# THE accelerator backend here too — on this stack that is the TPU chip
+XPUPlace = XLAPlace
+
 
 class CUDAPinnedPlace(CPUPlace):
     """Pinned host memory is a PJRT implementation detail; alias of CPU."""

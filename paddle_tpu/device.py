@@ -1,0 +1,30 @@
+"""paddle.device — device query/selection module.
+
+Reference: /root/reference/python/paddle/device.py (set_device:104,
+get_device:170, is_compiled_with_xpu:41, XPUPlace:56,
+get_cudnn_version:72). Re-exports this framework's place/device API
+under the reference's module path; the accelerator here is the TPU/XLA
+backend, so `gpu`-flavoured queries answer for the accelerator the same
+way the reference's XPU build answers for Kunlun.
+"""
+from __future__ import annotations
+
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XLAPlace, XPUPlace,
+    set_device, get_device, is_compiled_with_cuda,
+)
+
+__all__ = ["get_cudnn_version", "set_device", "get_device",
+           "XPUPlace", "is_compiled_with_xpu"]
+
+
+def is_compiled_with_xpu():
+    """False: the accelerator backend is TPU via PJRT, not Kunlun XPU
+    (reference device.py:41)."""
+    return False
+
+
+def get_cudnn_version():
+    """None — no cuDNN in the XLA:TPU stack (the reference returns None
+    when not compiled with CUDA, device.py:72)."""
+    return None

@@ -1,0 +1,237 @@
+"""paddle.distribution — probability distributions.
+
+TPU-native analogue of /root/reference/python/paddle/distribution.py
+(Distribution:41, Uniform:168, Normal:390, Categorical:640). Same class
+surface and math; sampling rides the framework's counter-based PRNG (the
+reference's per-call ``seed`` argument is honoured the same way its ops
+honour it: seed==0 means "draw from the global generator", a non-zero
+seed gives a deterministic stream for that call), so samples are
+reproducible under ``paddle.seed`` and trace-safe inside jitted steps.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .core.tensor import Tensor, to_tensor
+from .ops import creation as C
+from .ops import math as M
+from .ops import manipulation as MP
+from .ops import random_ops as R
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+class Distribution:
+    """Abstract base (reference distribution.py:41). Subclasses implement
+    sample/entropy/log_prob/probs and, where defined, kl_divergence."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    # -- helpers mirroring the reference's arg handling ------------------
+    @staticmethod
+    def _wrap(v, dtype="float32"):
+        """floats/lists/ndarrays → Tensor (reference _to_tensor:92);
+        Tensors pass through keeping their dtype."""
+        if isinstance(v, Tensor):
+            return v, False
+        arr = np.asarray(v, dtype=np.float64)
+        return to_tensor(arr.astype(dtype)), not isinstance(
+            v, (list, tuple, np.ndarray))
+
+    @staticmethod
+    def _value_like(param, value):
+        """reference _check_values_dtype_in_probs:136 — cast value to the
+        parameter dtype when they disagree."""
+        value = value if isinstance(value, Tensor) else to_tensor(value)
+        if str(value.dtype) != str(param.dtype):
+            value = M.cast(value, param.dtype)
+        return value
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference distribution.py:168). low/high may be
+    float, list, ndarray or Tensor; float args give scalar batch shape."""
+
+    def __init__(self, low, high, name=None):
+        self.name = name or "Uniform"
+        self.low, low_f = self._wrap(low)
+        self.high, high_f = self._wrap(high)
+        self.all_arg_is_float = low_f and high_f
+        self.dtype = str(self.low.dtype)
+
+    def sample(self, shape, seed=0):
+        """uniform_random(shape+batch)*(high-low)+low (reference :269);
+        float-only args collapse the batch dims (reference :311)."""
+        batch_shape = list((self.low + self.high).shape)
+        output_shape = list(shape) + batch_shape
+        u = C.uniform(output_shape, dtype=self.dtype, min=0.0, max=1.0,
+                      seed=seed)
+        out = u * (self.high - self.low) + self.low
+        if self.all_arg_is_float:
+            return MP.reshape(out, list(shape))
+        return out
+
+    def log_prob(self, value):
+        """log(1[low<value<high]) - log(high-low) (reference :315)."""
+        value = self._value_like(self.low, value)
+        lb = M.cast(self.low < value, value.dtype)
+        ub = M.cast(value < self.high, value.dtype)
+        return M.log(lb * ub) - M.log(self.high - self.low)
+
+    def probs(self, value):
+        value = self._value_like(self.low, value)
+        lb = M.cast(self.low < value, value.dtype)
+        ub = M.cast(value < self.high, value.dtype)
+        return (lb * ub) / (self.high - self.low)
+
+    def entropy(self):
+        """log(high - low) (reference :373)."""
+        return M.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distribution.py:390)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.name = name or "Normal"
+        self.loc, loc_f = self._wrap(loc)
+        self.scale, scale_f = self._wrap(scale)
+        self.all_arg_is_float = loc_f and scale_f
+        self.dtype = str(self.loc.dtype)
+
+    def sample(self, shape, seed=0):
+        """gaussian(shape+batch)*scale + loc (reference :491)."""
+        batch_shape = list((self.loc + self.scale).shape)
+        output_shape = list(shape) + batch_shape
+        g = C.gaussian(output_shape, mean=0.0, std=1.0, dtype=self.dtype,
+                       seed=seed)
+        out = g * self.scale + self.loc
+        if self.all_arg_is_float:
+            return MP.reshape(out, list(shape))
+        return out
+
+    def entropy(self):
+        """0.5 + 0.5*log(2*pi) + log(scale) (reference :530)."""
+        zero = self.loc * 0.0 + self.scale * 0.0
+        return (0.5 + zero) + (0.5 * math.log(2.0 * math.pi)
+                               + M.log(self.scale + zero))
+
+    def log_prob(self, value):
+        """-((v-loc)^2)/(2 var) - log(scale) - log(sqrt(2 pi))
+        (reference :556)."""
+        value = self._value_like(self.loc, value)
+        var = self.scale * self.scale
+        return (-1.0 * ((value - self.loc) * (value - self.loc))
+                / (2.0 * var)) - (M.log(self.scale)
+                                  + math.log(math.sqrt(2.0 * math.pi)))
+
+    def probs(self, value):
+        value = self._value_like(self.loc, value)
+        var = self.scale * self.scale
+        return M.exp(-1.0 * ((value - self.loc) * (value - self.loc))
+                     / (2.0 * var)) / (math.sqrt(2.0 * math.pi)
+                                       * self.scale)
+
+    def kl_divergence(self, other):
+        """0.5 (ratio^2 + (diff/scale1)^2 - 1 - 2 ln ratio)
+        (reference :595)."""
+        if not isinstance(other, Normal):
+            raise TypeError("kl_divergence expects a Normal instance")
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return 0.5 * var_ratio + 0.5 * (t1 - 1.0 - M.log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalised logits; the last axis is the
+    category axis (reference distribution.py:640)."""
+
+    def __init__(self, logits, name=None):
+        self.name = name or "Categorical"
+        if isinstance(logits, Tensor):
+            self.logits = logits
+        else:
+            self.logits = to_tensor(np.asarray(logits, dtype=np.float32))
+        self.dtype = str(self.logits.dtype)
+
+    def _norm(self, logits):
+        shifted = logits - M.max(logits, axis=-1, keepdim=True)
+        e = M.exp(shifted)
+        z = M.sum(e, axis=-1, keepdim=True)
+        return shifted, e, z
+
+    def sample(self, shape):
+        """multinomial with replacement, prepended sample dims
+        (reference :726)."""
+        shape = list(shape)
+        num_samples = int(np.prod(shape)) if shape else 1
+        logits_shape = list(self.logits.shape)
+        if len(logits_shape) > 1:
+            sample_shape = shape + logits_shape[:-1]
+            flat = MP.reshape(self.logits,
+                              [int(np.prod(logits_shape[:-1])),
+                               logits_shape[-1]])
+        else:
+            sample_shape = shape
+            flat = self.logits
+        # multinomial draws category indices from softmax(logits)
+        from .nn.functional import softmax as _softmax
+        idx = R.multinomial(_softmax(flat, axis=-1), num_samples,
+                            replacement=True)
+        if len(logits_shape) > 1:
+            idx = MP.transpose(idx, [1, 0])
+        return MP.reshape(idx, sample_shape)
+
+    def entropy(self):
+        """-sum(p * normalized_logits) keepdim (reference :827)."""
+        shifted, e, z = self._norm(self.logits)
+        prob = e / z
+        neg = M.sum(prob * (shifted - M.log(z)), axis=-1, keepdim=True)
+        return -1.0 * neg
+
+    def kl_divergence(self, other):
+        """sum(p * (l0 - log z0 - l1 + log z1)) keepdim (reference
+        :773)."""
+        if not isinstance(other, Categorical):
+            raise TypeError("kl_divergence expects a Categorical instance")
+        s0, e0, z0 = self._norm(self.logits)
+        s1, e1, z1 = self._norm(other.logits)
+        prob = e0 / z0
+        return M.sum(prob * (s0 - M.log(z0) - s1 + M.log(z1)),
+                     axis=-1, keepdim=True)
+
+    def probs(self, value):
+        """Gather softmax probabilities at the selected category indices
+        (reference :862): 1-D value broadcasts across the batch of
+        distributions; otherwise value's batch dims must match."""
+        _, e, z = self._norm(self.logits)
+        prob = e / z                       # [..., K]
+        value = value if isinstance(value, Tensor) else to_tensor(value)
+        if len(prob.shape) == 1:
+            return MP.index_select(prob, M.cast(value, "int64"), axis=0)
+        if len(value.shape) == 1:
+            return MP.index_select(prob, value, axis=-1)
+        idx = MP.unsqueeze(M.cast(value, "int64"), -1)
+        out = MP.take_along_axis(prob, idx, axis=-1)
+        return MP.squeeze(out, -1)
+
+    def log_prob(self, value):
+        """log(probs(value)) (reference :935)."""
+        return M.log(self.probs(value))

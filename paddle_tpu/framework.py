@@ -1,0 +1,21 @@
+"""paddle.framework — the reference's framework re-export module
+(/root/reference/python/paddle/framework/__init__.py: random/seed,
+get/set_default_dtype, ParamAttr, places, VarBase, no_grad, grad,
+save/load, DataParallel)."""
+from __future__ import annotations
+
+from .core.random import seed  # noqa: F401
+from .core.dtypes import get_default_dtype, set_default_dtype  # noqa: F401
+from .nn.layer.base import ParamAttr  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace,
+)
+from .core.tensor import Tensor  # noqa: F401
+from .core.autograd import no_grad, grad  # noqa: F401
+from .framework_io import save, load  # noqa: F401
+
+VarBase = Tensor  # reference fluid/core VarBase == the eager tensor
+
+__all__ = ["seed", "get_default_dtype", "set_default_dtype", "ParamAttr",
+           "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "TPUPlace",
+           "XPUPlace", "VarBase", "no_grad", "grad", "save", "load"]

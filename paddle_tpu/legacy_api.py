@@ -1,0 +1,147 @@
+"""Fluid-era top-level API parity: the reference exports these from
+`paddle.*` (python/paddle/__init__.py) out of fluid modules. Thin,
+documented forms over this framework's unified ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.tensor import Tensor, to_tensor, alias_for_inplace, \
+    rebind_inplace, check_inplace_allowed
+from .ops import math as _M
+from .ops import manipulation as _MP
+
+__all__ = [
+    "elementwise_add", "elementwise_sub", "elementwise_div",
+    "elementwise_mod", "elementwise_pow", "elementwise_floordiv",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod", "has_inf", "has_nan", "tanh_", "crop_tensor",
+    "set_printoptions", "monkey_patch_math_varbase",
+    "monkey_patch_variable", "get_cuda_rng_state", "set_cuda_rng_state",
+]
+
+
+def _fluid_axis_broadcast(x, y, axis):
+    """fluid elementwise broadcast: with axis >= 0, y's dims align to
+    x's dims STARTING at `axis` (trailing dims of size 1 appended) —
+    reference operators/elementwise/elementwise_op_function.h
+    GetMidDims; axis == -1 is trailing (numpy) alignment."""
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    y = y if isinstance(y, Tensor) else to_tensor(y)
+    xd, yd = len(x.shape), len(y.shape)
+    if axis != -1 and xd > yd:
+        y = _MP.reshape(y, [1] * axis + list(y.shape)
+                        + [1] * (xd - axis - yd))
+    return x, y
+
+
+def _elementwise(name, fn):
+    def impl(x, y, axis=-1, act=None, name=None):
+        x, y = _fluid_axis_broadcast(x, y, axis)
+        out = fn(x, y)
+        if act is not None:
+            from .nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+    impl.__name__ = name
+    impl.__doc__ = (f"fluid-style {name} with axis-aligned broadcasting "
+                    "(reference python/paddle/fluid/layers/nn.py "
+                    "elementwise family).")
+    return impl
+
+
+elementwise_add = _elementwise("elementwise_add", lambda x, y: x + y)
+elementwise_sub = _elementwise("elementwise_sub", lambda x, y: x - y)
+elementwise_div = _elementwise("elementwise_div", lambda x, y: x / y)
+elementwise_mod = _elementwise("elementwise_mod", _M.mod)
+elementwise_pow = _elementwise("elementwise_pow", lambda x, y: x ** y)
+elementwise_floordiv = _elementwise("elementwise_floordiv",
+                                    _M.floor_divide)
+
+
+def _reduce(name, fn):
+    def impl(input, dim=None, keep_dim=False, name=None):
+        axis = dim
+        if isinstance(axis, (list, tuple)) and len(axis) == 0:
+            axis = None
+        return fn(input, axis=axis, keepdim=keep_dim)
+    impl.__name__ = name
+    impl.__doc__ = (f"fluid-style {name}(input, dim, keep_dim) "
+                    "(reference fluid/layers/nn.py reduce family).")
+    return impl
+
+
+reduce_sum = _reduce("reduce_sum", _M.sum)
+reduce_mean = _reduce("reduce_mean", _M.mean)
+reduce_max = _reduce("reduce_max", _M.max)
+reduce_min = _reduce("reduce_min", _M.min)
+reduce_prod = _reduce("reduce_prod", _M.prod)
+
+
+def has_inf(x, name=None):
+    """Scalar bool tensor: any +/-inf in x (reference operators/isfinite_op
+    `has_inf`/OverflowOp family)."""
+    return _M.any(_M.isinf(x))
+
+
+def has_nan(x, name=None):
+    """Scalar bool tensor: any NaN in x (reference isfinite_op has_nan)."""
+    return _M.any(_M.isnan(x))
+
+
+def tanh_(x, name=None):
+    """In-place tanh (reference inplace-abn era `tanh_`); follows the
+    framework's inplace contract (version bump + leaf checks)."""
+    check_inplace_allowed(x)
+    out = _M.tanh(alias_for_inplace(x))
+    return rebind_inplace(x, out)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Alias of the unified crop (reference fluid/layers/nn.py
+    crop_tensor == crop with tensor-valued shape support)."""
+    from .ops.array_ops import crop
+    return crop(x, shape=shape, offsets=offsets, name=name)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (reference tensor/to_string.py
+    set_printoptions). Tensor __repr__ renders via numpy, so this maps
+    onto numpy's printoptions with paddle's parameter names."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    np.set_printoptions(**kw)
+
+
+def monkey_patch_math_varbase():
+    """Parity no-op: the reference patches arithmetic dunders onto the
+    pybind VarBase at import (fluid/dygraph/math_op_patch.py); this
+    framework's Tensor defines them natively."""
+
+
+def monkey_patch_variable():
+    """Parity no-op: static Variable operator overloads are built into
+    static/program.py rather than patched in."""
+
+
+def get_cuda_rng_state():
+    """Device RNG state (reference cuda rng state surface). The TPU
+    stream is the counter-based global generator — returns the same
+    (seed, counter) snapshot as paddle.get_rng_state()."""
+    from .core import random as _random
+    return _random.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from .core import random as _random
+    _random.set_rng_state(state)

@@ -1,0 +1,11 @@
+"""paddle.onnx — ONNX export facade.
+
+Reference: /root/reference/python/paddle/onnx/export.py:21 — a thin
+delegation to the external `paddle2onnx` package. That dependency does
+not exist for this framework (and ONNX is not the TPU deployment path);
+`export` loud-fails with the supported alternative: `paddle.jit.save`
+emits a StableHLO artifact servable by `paddle_tpu.inference` (and
+portable to any StableHLO consumer), which is this framework's
+exchange format.
+"""
+from .export import export  # noqa: F401

@@ -1,0 +1,14 @@
+"""ONNX export loud-fail (reference onnx/export.py delegates to the
+external paddle2onnx package, which has no TPU-native equivalent)."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not supported: the reference implementation "
+        "delegates to the external `paddle2onnx` package "
+        "(reference onnx/export.py:21), which is not available here and "
+        "ONNX is not the TPU serving path. Use paddle.jit.save(layer, "
+        "path, input_spec) to produce a StableHLO artifact, served by "
+        "paddle_tpu.inference.create_predictor or any StableHLO-"
+        "compatible runtime.")

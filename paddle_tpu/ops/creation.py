@@ -199,9 +199,12 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
         _random.next_key(), _shape(shape or [1]), get_default_dtype()))
 
 
-def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
-    return Tensor(mean + std * jax.random.normal(_random.next_key(),
-                                                 _shape(shape), _dt(dtype)))
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, seed=0, name=None):
+    # seed==0: draw from the global generator (reference gaussian_random
+    # seed attr semantics, same contract as uniform above)
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape),
+                                                 _dt(dtype)))
 
 
 def standard_normal(shape, dtype=None, name=None):

@@ -1,0 +1,26 @@
+"""paddle.sysconfig — include/lib directory discovery.
+
+Reference: /root/reference/python/paddle/sysconfig.py (get_include:20,
+get_lib:37). This package ships its native pieces under
+``paddle_tpu/native`` (ctypes boundary, no C headers exported beyond the
+C API header), so both point there.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_include():
+    """Directory containing the framework's C headers (the C inference
+    API, reference capi analogue)."""
+    import paddle_tpu
+    return os.path.join(os.path.dirname(paddle_tpu.__file__), "native",
+                        "src")
+
+
+def get_lib():
+    """Directory containing the framework's native shared libraries."""
+    import paddle_tpu
+    # native/__init__.py builds the .so files into native/_build
+    return os.path.join(os.path.dirname(paddle_tpu.__file__), "native",
+                        "_build")
