@@ -227,7 +227,7 @@ class Categorical(Distribution):
         if len(prob.shape) == 1:
             return MP.index_select(prob, M.cast(value, "int64"), axis=0)
         if len(value.shape) == 1:
-            return MP.index_select(prob, value, axis=-1)
+            return MP.index_select(prob, M.cast(value, "int64"), axis=-1)
         idx = MP.unsqueeze(M.cast(value, "int64"), -1)
         out = MP.take_along_axis(prob, idx, axis=-1)
         return MP.squeeze(out, -1)

@@ -505,3 +505,56 @@ class TrainStep:
         if self.return_outputs:
             return Tensor(loss), jax.tree_util.tree_map(Tensor, out)
         return Tensor(loss)
+
+
+class TracedLayer:
+    """reference fluid/dygraph/jit.py:1047 TracedLayer — trace a dygraph
+    layer with example inputs into a static artifact; `trace` returns
+    (outputs, traced) and the traced object replays the captured program
+    and saves an inference model. Here the captured program is the jitted
+    StableHLO export (same substrate as jit.save)."""
+
+    def __init__(self, layer, input_spec):
+        self._layer = layer
+        self._input_spec = input_spec
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        inputs = list(inputs)
+        out = layer(*inputs)
+        spec = [InputSpec(shape=list(i.shape), dtype=str(i.dtype))
+                for i in inputs]
+        return out, cls(layer, spec)
+
+    def __call__(self, *args):
+        return self._layer(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **cfg):
+        if feed is not None or fetch is not None:
+            import warnings
+            warnings.warn(
+                "TracedLayer.save_inference_model: feed/fetch slicing of "
+                "the traced program is not supported on the StableHLO "
+                "artifact — the FULL traced signature is exported "
+                "(reference jit.py:1047 slices the ProgramDesc by these "
+                "indices). Wrap the layer to expose the wanted subset "
+                "instead.", stacklevel=2)
+        save(self._layer, path, input_spec=self._input_spec, **cfg)
+
+
+def set_code_level(level=100):
+    """reference jit/dy2static logging knob: print transformed code at/\
+    below `level`. Stored on the dy2static module for its transformer."""
+    from . import dy2static
+    dy2static.CODE_LEVEL = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference jit logging verbosity (maps onto python logging for the
+    paddle_tpu.jit logger)."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+from . import dy2static  # noqa: F401,E402

@@ -62,6 +62,10 @@ class _Undef:
 _UNDEF = _Undef()
 
 
+# paddle.jit.set_code_level stores the level here; non-None prints
+# each transformed function's source at conversion time
+CODE_LEVEL = None
+
 def _is_traced(x) -> bool:
     v = x._value if isinstance(x, Tensor) else x
     return isinstance(v, jax.core.Tracer)
@@ -332,6 +336,12 @@ def convert_to_static(fn: Callable) -> Callable:
     if tr.counter == 0:
         return fn if bound_self is None else fn.__get__(bound_self)
     ast.fix_missing_locations(new_tree)
+    if CODE_LEVEL is not None:
+        # paddle.jit.set_code_level: print the transformed source
+        # (reference dygraph_to_static logging_utils.set_code_level)
+        print(f"--- to_static transformed code for {fn.__qualname__} "
+              f"(code level {CODE_LEVEL}) ---")
+        print(ast.unparse(new_tree))
     try:
         code = compile(new_tree, f"<to_static {fn.__name__}>", "exec")
     except (SyntaxError, ValueError):

@@ -4,10 +4,7 @@ documented forms over this framework's unified ops.
 """
 from __future__ import annotations
 
-import numpy as np
-
-from .core.tensor import Tensor, to_tensor, alias_for_inplace, \
-    rebind_inplace, check_inplace_allowed
+from .core.tensor import Tensor, to_tensor
 from .ops import math as _M
 from .ops import manipulation as _MP
 
@@ -89,12 +86,7 @@ def has_nan(x, name=None):
     return _M.any(_M.isnan(x))
 
 
-def tanh_(x, name=None):
-    """In-place tanh (reference inplace-abn era `tanh_`); follows the
-    framework's inplace contract (version bump + leaf checks)."""
-    check_inplace_allowed(x)
-    out = _M.tanh(alias_for_inplace(x))
-    return rebind_inplace(x, out)
+from .ops import tanh_  # noqa: F401,E402  (single source: ops)
 
 
 def crop_tensor(x, shape=None, offsets=None, name=None):
@@ -104,23 +96,7 @@ def crop_tensor(x, shape=None, offsets=None, name=None):
     return crop(x, shape=shape, offsets=offsets, name=name)
 
 
-def set_printoptions(precision=None, threshold=None, edgeitems=None,
-                     sci_mode=None, linewidth=None):
-    """Tensor repr formatting (reference tensor/to_string.py
-    set_printoptions). Tensor __repr__ renders via numpy, so this maps
-    onto numpy's printoptions with paddle's parameter names."""
-    kw = {}
-    if precision is not None:
-        kw["precision"] = int(precision)
-    if threshold is not None:
-        kw["threshold"] = int(threshold)
-    if edgeitems is not None:
-        kw["edgeitems"] = int(edgeitems)
-    if linewidth is not None:
-        kw["linewidth"] = int(linewidth)
-    if sci_mode is not None:
-        kw["suppress"] = not bool(sci_mode)
-    np.set_printoptions(**kw)
+from .ops import set_printoptions  # noqa: F401,E402  (single source: ops)
 
 
 def monkey_patch_math_varbase():

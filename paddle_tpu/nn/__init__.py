@@ -53,3 +53,13 @@ from .layer.transformer import (  # noqa: F401
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
 from . import utils  # noqa: F401
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401,E402
+from .layer.loss import HSigmoidLoss  # noqa: F401,E402
+
+# reference nn/__init__ re-exports its layer submodules by name
+from .layer import (  # noqa: F401,E402
+    common, conv, loss, norm, rnn,
+)
+from .functional import extension  # noqa: F401,E402
+from .layer import common as vision  # noqa: F401,E402  (PixelShuffle home)
+from . import utils as weight_norm_hook  # noqa: F401,E402  (module alias: weight_norm/remove_weight_norm live in utils)

@@ -1,0 +1,194 @@
+"""Seq2seq decoding API: Decoder / BeamSearchDecoder / dynamic_decode.
+
+Reference: /root/reference/python/paddle/fluid/layers/rnn.py
+(Decoder:~Decoder class, BeamSearchDecoder:~BeamSearchDecoder,
+dynamic_decode) re-exported at paddle.nn. The decode loop here runs as a
+python step loop over framework ops (the reference's dygraph branch);
+back-tracking uses the unified gather_tree op. For batch-serving decode
+of transformer LMs the TPU-native path is models/generation.py (static
+KV cache + jitted step); this class exists for the reference's
+RNN-cell-based seq2seq surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..ops import manipulation as MP
+from ..ops import math as M
+from ..ops import logic as L
+from ..ops.search import topk as _topk
+from ..ops import creation as C
+from ..ops.extra_ops import gather_tree
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decode protocol (reference rnn.py Decoder):
+    initialize() → (initial_inputs, initial_states, initial_finished);
+    step(time, inputs, states, **kwargs) → (outputs, next_states,
+    next_inputs, finished); optional finalize()."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _map_state(tree, fn):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_map_state(t, fn) for t in tree)
+    return fn(tree)
+
+
+class BeamSearchDecoder(Decoder):
+    """reference fluid/layers/rnn.py BeamSearchDecoder: length-unnormalised
+    beam search over an RNN cell. cell(inputs, states) must return
+    (cell_out, next_states); output_fn maps cell_out to vocab logits;
+    embedding_fn maps token ids to the next step's inputs."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] → [B*beam, ...] by repeating each batch row beam_size
+        times (reference helper of the same name)."""
+        x = x if isinstance(x, Tensor) else to_tensor(x)
+        expanded = MP.unsqueeze(x, 1)
+        tiled = MP.expand(expanded, [x.shape[0], beam_size]
+                          + list(x.shape[1:]))
+        return MP.reshape(tiled, [x.shape[0] * beam_size]
+                          + list(x.shape[1:]))
+
+    def _merge(self, x):
+        # [B, beam, ...] -> [B*beam, ...]
+        return MP.reshape(x, [-1] + list(x.shape[2:]))
+
+    def _split(self, x):
+        # [B*beam, ...] -> [B, beam, ...]
+        return MP.reshape(x, [-1, self.beam_size] + list(x.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        leaf = states[0] if isinstance(states, (list, tuple)) else states
+        while isinstance(leaf, (list, tuple)):
+            leaf = leaf[0]
+        batch = leaf.shape[0]
+        self._batch = batch
+        cell_states = _map_state(
+            states, lambda s: self.tile_beam_merge_with_batch(
+                s, self.beam_size))
+        start = C.full([batch, self.beam_size], self.start_token, "int64")
+        # beam 0 live, others -inf so the first step picks beam-0 tokens
+        lp = np.full((batch, self.beam_size), -1e9, np.float32)
+        lp[:, 0] = 0.0
+        init = {
+            "cell_states": cell_states,
+            "log_probs": to_tensor(lp),
+            "finished": C.full([batch, self.beam_size], False, "bool"),
+            "lengths": C.full([batch, self.beam_size], 0, "int64"),
+        }
+        inputs = self.embedding_fn(start) if self.embedding_fn else start
+        return inputs, init, init["finished"]
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states = states["cell_states"]
+        flat_in = self._merge(inputs) if len(inputs.shape) > 2 else \
+            MP.reshape(inputs, [self._batch * self.beam_size, -1])
+        cell_out, next_cell_states = self.cell(flat_in, cell_states,
+                                               **kwargs)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        V = logits.shape[-1]
+        from ..nn.functional import log_softmax
+        step_lp = self._split(log_softmax(logits, axis=-1))  # [B,bm,V]
+        # finished beams only extend with end_token at logprob 0
+        fin = states["finished"]
+        mask = np.full((1, 1, V), 0.0, np.float32)
+        end_only = to_tensor(np.array(
+            [0.0 if i == self.end_token else -1e9 for i in range(V)],
+            np.float32)).reshape([1, 1, V])
+        step_lp = MP.where(
+            MP.unsqueeze(fin, -1), end_only + mask,
+            step_lp)
+        total = MP.unsqueeze(states["log_probs"], -1) + step_lp
+        flat = MP.reshape(total, [self._batch, self.beam_size * V])
+        top_lp, top_idx = _topk(flat, self.beam_size, axis=-1)
+        parent = M.cast(top_idx // V, "int64")        # [B, beam]
+        token = M.cast(top_idx % V, "int64")
+        # gather parent beams' states
+        offs = C.arange(0, self._batch, 1, "int64") * self.beam_size
+        flat_parent = MP.reshape(parent + MP.unsqueeze(offs, -1), [-1])
+        next_cell_states = _map_state(
+            next_cell_states,
+            lambda s: MP.index_select(s, flat_parent, axis=0))
+        prev_fin = MP.take_along_axis(fin, parent, axis=1)
+        now_fin = L.logical_or(prev_fin, token == self.end_token)
+        lengths = MP.take_along_axis(states["lengths"], parent, axis=1)
+        lengths = lengths + M.cast(L.logical_not(prev_fin), "int64")
+        next_states = {
+            "cell_states": next_cell_states,
+            "log_probs": top_lp,
+            "finished": now_fin,
+            "lengths": lengths,
+        }
+        outputs = {"scores": top_lp, "predicted_ids": token,
+                   "parent_ids": parent}
+        next_tok = token
+        next_inputs = self.embedding_fn(next_tok) if self.embedding_fn \
+            else next_tok
+        return outputs, next_states, next_inputs, now_fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        # outputs: dict of [T, B, beam] stacked step outputs; back-track
+        # the beam ancestry into full sequences (gather_tree op)
+        preds = gather_tree(outputs["predicted_ids"],
+                            outputs["parent_ids"])
+        out = dict(outputs)
+        out["predicted_ids"] = preds
+        return out, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference fluid/layers/rnn.py dynamic_decode (dygraph branch):
+    python loop over decoder.step until every sequence finishes or
+    max_step_num; stacks per-step outputs time-major, then finalize."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    time = 0
+    while True:
+        outputs, states, inputs, finished = decoder.step(
+            time, inputs, states, **kwargs)
+        step_outputs.append(outputs)
+        time += 1
+        done = bool(np.asarray(M.all(finished).numpy()))
+        if done or (max_step_num is not None and time >= max_step_num):
+            break
+    stacked = {k: MP.stack([o[k] for o in step_outputs], axis=0)
+               for k in step_outputs[0]}
+    lengths = states.get("lengths") if isinstance(states, dict) else None
+    if hasattr(decoder, "finalize"):
+        stacked, states = decoder.finalize(stacked, states, lengths)
+    if not output_time_major:
+        stacked = {k: MP.transpose(v, [1, 0] + list(
+            range(2, len(v.shape)))) for k, v in stacked.items()}
+    if return_length:
+        return stacked, states, lengths
+    return stacked, states

@@ -20,3 +20,13 @@ try:
     from .attention import scaled_dot_product_attention  # noqa: F401
 except ImportError:
     pass
+
+# vision/extension functionals unified in ops (reference keeps them under
+# nn.functional too: python/paddle/nn/functional/__init__.py)
+from ...ops.vision_ops import (  # noqa: F401,E402
+    affine_grid, grid_sample, temporal_shift,
+)
+from ...ops.creation import diag_embed  # noqa: F401,E402
+from ...ops.extra_ops import gather_tree, sigmoid_focal_loss  # noqa: F401,E402
+from ...ops.manipulation import pad  # noqa: F401,E402
+from . import extension  # noqa: F401,E402

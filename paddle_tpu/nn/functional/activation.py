@@ -243,3 +243,34 @@ def _glu(x, axis):
 
 def glu(x, axis=-1, name=None):
     return _glu(_wrap(x), axis)
+
+
+def _inplace(x, out):
+    from ...core.tensor import rebind_inplace
+    return rebind_inplace(x, out)
+
+
+def relu_(x, name=None):
+    """In-place relu (reference nn/functional relu_ inplace variant;
+    follows the framework inplace contract: version bump + leaf check)."""
+    from ...core.tensor import check_inplace_allowed, alias_for_inplace
+    check_inplace_allowed(x)
+    return _inplace(x, relu(alias_for_inplace(x)))
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...core.tensor import check_inplace_allowed, alias_for_inplace
+    check_inplace_allowed(x)
+    return _inplace(x, elu(alias_for_inplace(x), alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...core.tensor import check_inplace_allowed, alias_for_inplace
+    check_inplace_allowed(x)
+    return _inplace(x, softmax(alias_for_inplace(x), axis=axis,
+                               dtype=dtype))
+
+
+def tanh_(x, name=None):
+    from ...ops import tanh_ as _t
+    return _t(x, name)

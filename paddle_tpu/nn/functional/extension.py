@@ -1,0 +1,8 @@
+"""paddle.nn.functional.extension (reference:
+python/paddle/nn/functional/extension.py — diag_embed, gather_tree,
+temporal_shift re-exports over the unified ops)."""
+from ...ops.creation import diag_embed  # noqa: F401
+from ...ops.extra_ops import gather_tree  # noqa: F401
+from ...ops.vision_ops import temporal_shift  # noqa: F401
+
+__all__ = ["diag_embed", "gather_tree", "temporal_shift"]

@@ -442,3 +442,51 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         from ...ops import math as m
         return m.sum(out)
     return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: fluid/layers/nn.py:7051 — 1 - 2*intersection/total over
+    all non-batch dims, one-hot label on the trailing class dim, meaned
+    over the batch."""
+    from ...ops import math as m
+    from .common import one_hot
+    x = _wrap(input)
+    lab = one_hot(_wrap(label).squeeze(-1) if label.shape[-1] == 1
+                  else _wrap(label), x.shape[-1]).astype(x.dtype)
+    axes = list(range(1, len(x.shape)))
+    inse = m.sum(x * lab, axis=axes)
+    denom = m.sum(x, axis=axes) + m.sum(lab, axis=axes)
+    return m.mean(1.0 - 2.0 * inse / (denom + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: fluid/layers/loss.py:1653 — 0.25*l2_reg L2 term on both
+    embeddings + soft-label CE over the anchor@positive^T similarity
+    matrix with row-normalised label-equality targets."""
+    from ...ops import math as m
+    from ...ops import manipulation as mp
+    from ...ops.linalg import matmul
+    a, p = _wrap(anchor), _wrap(positive)
+    lab = _wrap(labels)
+    bs = lab.shape[0]
+    lab2 = mp.reshape(lab, [bs, 1]).astype("float32")
+    eq = (lab2 == mp.transpose(lab2, [1, 0])).astype("float32")
+    targets = eq / m.sum(eq, axis=1, keepdim=True)
+    l2 = (m.mean(m.sum(a * a, axis=1)) + m.mean(m.sum(p * p, axis=1))) \
+        * 0.25 * l2_reg
+    sim = matmul(a, p, transpose_y=True)
+    ce = softmax_with_cross_entropy(sim, targets, soft_label=True)
+    return l2 + m.mean(m.sum(targets * ce, axis=0))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: nn/functional/loss.py:329 → hierarchical_sigmoid_op;
+    the 2.0 argument order over the unified op (is_sparse is a gradient
+    storage hint the dense TPU path doesn't need)."""
+    from ...ops.extra_ops import hierarchical_sigmoid
+    return hierarchical_sigmoid(input, weight, label,
+                                path_table=path_table,
+                                path_code=path_code, bias=bias,
+                                num_classes=num_classes)

@@ -153,3 +153,33 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn/layer/loss.py
+    HSigmoidLoss over hierarchical_sigmoid_op): holds the [num_classes-1,
+    feature_size] inner-node weight (+bias) and delegates to
+    functional.hsigmoid_loss; custom trees via (path_table, path_code)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        rows = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter([rows, feature_size],
+                                            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [rows], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ..functional import hsigmoid_loss
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError(
+                "is_custom=True requires path_table and path_code")
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             bias=self.bias, path_table=path_table,
+                             path_code=path_code)

@@ -313,3 +313,34 @@ def _attach_tensor_methods():
 
 
 _attach_tensor_methods()
+
+# reference paddle.tensor re-exports these at module level
+from .extra_ops import multiplex  # noqa: F401,E402
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference tensor/to_string.py set_printoptions (same impl as the
+    top-level alias; defined here because paddle.tensor re-exports it)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)
+
+
+def tanh_(x, name=None):
+    """In-place tanh (paddle.tensor.tanh_)."""
+    from ..core.tensor import (check_inplace_allowed, alias_for_inplace,
+                               rebind_inplace)
+    from . import math as _m
+    check_inplace_allowed(x)
+    return rebind_inplace(x, _m.tanh(alias_for_inplace(x)))

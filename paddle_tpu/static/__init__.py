@@ -60,3 +60,11 @@ from .debug_ops import Print, Assert  # noqa: F401
 from .rnn_shims import (StaticRNN, DynamicRNN, py_reader,  # noqa: F401
                         read_file)
 from . import amp  # noqa: F401
+from .compat import (  # noqa: F401,E402
+    name_scope, scope_guard, device_guard, cpu_places, cuda_places,
+    xpu_places, save_vars, load_vars, save_to_file, load_from_file,
+    serialize_persistables, deserialize_persistables, load_program_state,
+    set_program_state, ParallelExecutor, WeightNormParamAttr,
+    accuracy, auc, py_func,
+)
+from .io import serialize_program, deserialize_program  # noqa: F401,E402

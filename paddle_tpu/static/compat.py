@@ -1,0 +1,265 @@
+"""paddle.static long-tail surface: scope/name/device guards, places,
+program-state and persistables serialization, var-level save/load, the
+ParallelExecutor/WeightNormParamAttr shims, and metric-op re-exports.
+
+Reference: /root/reference/python/paddle/static/__init__.py exports
+(name_scope from fluid/framework.py:576, scope_guard from
+fluid/executor.py, device_guard from fluid/framework.py,
+cpu_places/cuda_places/xpu_places from fluid/framework.py,
+save_vars/load_vars + save_to_file/load_from_file +
+serialize_program/serialize_persistables + load/set_program_state from
+fluid/io.py, ParallelExecutor from fluid/parallel_executor.py,
+WeightNormParamAttr from fluid/param_attr.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+
+import numpy as np
+
+from ..nn.layer.base import ParamAttr
+from .executor import Scope, global_scope, _swap_global_scope
+from .program import default_main_program
+
+__all__ = [
+    "name_scope", "scope_guard", "device_guard", "cpu_places",
+    "cuda_places", "xpu_places", "save_vars", "load_vars",
+    "save_to_file", "load_from_file", "serialize_persistables",
+    "deserialize_persistables", "load_program_state",
+    "set_program_state", "ParallelExecutor", "WeightNormParamAttr",
+]
+
+_NAME_SCOPE: list[str] = []
+_DEVICE_SCOPE: list[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix="my_scope"):
+    """reference fluid/framework.py:576 — hierarchical debug-name prefix
+    for ops/vars created inside the scope (purely cosmetic there too:
+    used by graph visualisation, not execution)."""
+    _NAME_SCOPE.append(str(prefix))
+    try:
+        yield "/".join(_NAME_SCOPE)
+    finally:
+        _NAME_SCOPE.pop()
+
+
+def current_name_scope() -> str:
+    return "/".join(_NAME_SCOPE)
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """reference fluid/executor.py scope_guard — swap the global Scope
+    that Executor.run reads/writes persistables through."""
+    old = _swap_global_scope(scope)
+    try:
+        yield
+    finally:
+        _swap_global_scope(old)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference fluid/framework.py device_guard — marks ops for a device
+    ('cpu'/'gpu'/'gpu:0'). The pipeline planner reads these marks to
+    assign stages (reference PipelineOptimizer's device_guard sections);
+    single-device XLA programs ignore them."""
+    _DEVICE_SCOPE.append(device)
+    try:
+        yield
+    finally:
+        _DEVICE_SCOPE.pop()
+
+
+def current_device_scope():
+    return _DEVICE_SCOPE[-1] if _DEVICE_SCOPE else None
+
+
+def cpu_places(device_count=None):
+    """reference framework.py cpu_places: CPU_NUM env (default 1)."""
+    from ..core.place import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (reference cuda_places; the accelerator here is
+    the TPU backend)."""
+    from ..core.place import CUDAPlace
+    import jax
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [CUDAPlace(i) for i in device_ids]
+
+
+xpu_places = cuda_places
+
+
+# -- program state / persistables -------------------------------------------
+
+def _persistable_names(program):
+    return [v.name for v in program.list_vars()
+            if getattr(v, "persistable", False)]
+
+
+def load_program_state(model_path, var_list=None):
+    """reference fluid/io.py load_program_state — read a saved params
+    file into a {name: ndarray} dict without touching any program."""
+    path = model_path if os.path.exists(model_path) \
+        else model_path + ".pdparams"
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if var_list is not None:
+        names = {v if isinstance(v, str) else v.name for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return {k: np.asarray(v) for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    """reference fluid/io.py set_program_state — write ndarrays into the
+    scope slots of the program's persistables (shape-checked)."""
+    import jax.numpy as jnp
+    scope = global_scope()
+    for name in _persistable_names(program):
+        if name not in state_dict:
+            continue
+        arr = np.asarray(state_dict[name])
+        cur = scope.find_var(name)
+        if cur is not None and tuple(cur.shape) != arr.shape:
+            raise ValueError(
+                f"shape mismatch for {name}: program has "
+                f"{tuple(cur.shape)}, state has {arr.shape}")
+        scope.set(name, jnp.asarray(arr))
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    """reference static/io.py serialize_persistables — persistable
+    values of the (default) main program as bytes."""
+    program = program or default_main_program()
+    scope = global_scope()
+    state = {}
+    for name in _persistable_names(program):
+        v = scope.find_var(name)
+        if v is not None:
+            state[name] = np.asarray(v)
+    return pickle.dumps(state, protocol=2)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """Inverse of serialize_persistables into the global scope."""
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path, content):
+    """reference static/io.py save_to_file (bytes → file)."""
+    if not isinstance(content, bytes):
+        raise TypeError("save_to_file expects bytes content")
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference fluid/io.py save_vars — save selected persistables (by
+    list or predicate) under dirname, one file per var, or a single
+    `filename` blob."""
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if getattr(v, "persistable", False)
+                and (predicate is None or predicate(v))]
+    scope = global_scope()
+    state = {}
+    for v in vars:
+        name = v if isinstance(v, str) else v.name
+        val = scope.find_var(name)
+        if val is None:
+            raise ValueError(f"save_vars: {name} has no value in scope")
+        state[name] = np.asarray(val)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(state, f, protocol=2)
+    else:
+        for name, arr in state.items():
+            with open(os.path.join(dirname, name), "wb") as f:
+                pickle.dump({name: arr}, f, protocol=2)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference fluid/io.py load_vars — inverse of save_vars."""
+    import jax.numpy as jnp
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if getattr(v, "persistable", False)
+                and (predicate is None or predicate(v))]
+    scope = global_scope()
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            state = pickle.load(f)
+    else:
+        state = {}
+        for v in vars:
+            name = v if isinstance(v, str) else v.name
+            with open(os.path.join(dirname, name), "rb") as f:
+                state.update(pickle.load(f))
+    for v in vars:
+        name = v if isinstance(v, str) else v.name
+        if name not in state:
+            raise ValueError(f"load_vars: {name} not found in {dirname}")
+        scope.set(name, jnp.asarray(state[name]))
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference fluid/param_attr.py WeightNormParamAttr — ParamAttr that
+    requests weight normalisation along `dim`; layers apply it via
+    nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.dim = dim
+
+
+class ParallelExecutor:
+    """reference fluid/parallel_executor.py — the multi-device SSA-graph
+    engine. Its capability (clone per device + allreduce insertion) is
+    GSPMD's job here (parallel/api.py); this shim keeps the construction
+    API and runs through the ordinary Executor (same single-program
+    semantics as CompiledProgram)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .executor import Executor
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+# metric ops the reference exports at paddle.static
+from ..ops.metrics_ops import accuracy, auc  # noqa: F401,E402
+from ..ops.extra_ops import py_func  # noqa: F401,E402

@@ -55,6 +55,16 @@ def global_scope() -> Scope:
     return _global_scope
 
 
+def _swap_global_scope(scope: Scope) -> Scope:
+    """Install `scope` as the global scope, returning the previous one
+    (static.scope_guard's mechanism — reference executor.py
+    scope_guard/_switch_scope)."""
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    return old
+
+
 def _interpret(ops: List[OpDesc], env: Dict[str, jax.Array],
                init_env: Dict[str, jax.Array]):
     """Run the op list over the environment (inside a jax trace)."""

@@ -2,12 +2,7 @@
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+from . import image  # noqa: F401
+from . import ops  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50, MobileNetV1, MobileNetV2  # noqa: F401
-
-
-def set_image_backend(backend):
-    pass
-
-
-def get_image_backend():
-    return "numpy"
+from .image import set_image_backend, get_image_backend, image_load  # noqa: F401

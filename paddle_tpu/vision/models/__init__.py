@@ -8,3 +8,9 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
 )
+
+# reference exposes the model-definition modules by file name too
+# (vision/models/__init__.py imports mobilenetv1/mobilenetv2 modules);
+# both live in one file here
+from . import mobilenet as mobilenetv1  # noqa: F401
+from . import mobilenet as mobilenetv2  # noqa: F401

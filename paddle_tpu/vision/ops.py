@@ -1,0 +1,50 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py — vision
+operators re-exported from the unified op corpus; yolo_loss is the 2.0
+name of yolov3_loss, deform_conv2d the 2.0 name of deformable_conv, and
+DeformConv2D its layer wrapper)."""
+from ..ops.vision_ops import (  # noqa: F401
+    roi_align, roi_pool, yolo_box, nms, prior_box, box_coder,
+    deformable_conv,
+)
+from ..ops.detection_ops import yolov3_loss as yolo_loss  # noqa: F401
+from ..nn.layer.layers import Layer as _Layer
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """2.0-name wrapper over the unified deformable_conv op (reference
+    vision/ops.py deform_conv2d → deformable_conv v1/v2 kernels)."""
+    return deformable_conv(x, offset, weight, mask=mask, bias=bias,
+                           stride=stride, padding=padding,
+                           dilation=dilation, groups=groups,
+                           deformable_groups=deformable_groups)
+
+
+class DeformConv2D(_Layer):
+    """reference vision/ops.py DeformConv2D — layer wrapper over
+    deform_conv2d (offset/mask supplied per call)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self.stride,
+            padding=self.padding, dilation=self.dilation,
+            deformable_groups=self.deformable_groups, groups=self.groups,
+            mask=mask)
