@@ -1,0 +1,72 @@
+"""paddle.fluid — the legacy namespace reference-era user code imports
+(`import paddle.fluid as fluid`). Reference: python/paddle/fluid/
+__init__.py. Pure delegation: every attribute maps onto this framework's
+modern module that carries the capability; nothing is implemented here.
+The hot sub-namespaces (`fluid.layers`, `fluid.dygraph`, `fluid.io`,
+`fluid.core`) are PEP-562 delegator modules so the very wide fluid
+surface resolves against the unified op/layer corpus instead of being
+hand-listed.
+"""
+from __future__ import annotations
+
+# framework / program / executor surface
+from ..static.program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    Variable, data,
+)
+from ..static.executor import Executor, Scope, global_scope  # noqa: F401
+from ..static import (  # noqa: F401
+    CompiledProgram, ExecutionStrategy, BuildStrategy, ParallelExecutor,
+    scope_guard, name_scope, device_guard, cpu_places, cuda_places,
+    WeightNormParamAttr,
+)
+from ..static.mode import in_dynamic_mode as in_dygraph_mode  # noqa: F401
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+)
+from ..core.flags import set_flags, get_flags  # noqa: F401
+from ..core.tensor import Tensor as LoDTensor  # noqa: F401
+from ..ops.array_ops import TensorArray as LoDTensorArray  # noqa: F401
+from ..nn.layer.base import ParamAttr  # noqa: F401
+from ..static.backward import append_backward, gradients  # noqa: F401
+from ..distributed.transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig,
+)
+
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import io  # noqa: F401
+from . import core  # noqa: F401
+from .. import optimizer  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from .. import regularizer  # noqa: F401
+from .. import metric as metrics  # noqa: F401
+from ..nn import clip  # noqa: F401
+from ..static import nn as nets  # noqa: F401
+from .. import compat  # noqa: F401
+from ..static import backward  # noqa: F401
+from .. import framework  # noqa: F401
+from ..static import executor  # noqa: F401
+
+
+def require_version(min_version, max_version=None):
+    """reference fluid/framework.py require_version — this framework
+    reports its own version; the check passes for any requested paddle
+    version since the surface is the parity target, not the codebase."""
+    return None
+
+
+class DataFeeder:
+    """reference fluid/data_feeder.py DataFeeder — converts python data
+    into the feed dict the Executor consumes. With the XLA executor any
+    array-like feeds directly, so feed() is a zip into a dict."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_names = [v if isinstance(v, str) else v.name
+                           for v in feed_list]
+
+    def feed(self, iterable):
+        import numpy as np
+        batch = list(iterable)
+        cols = list(zip(*batch))
+        return {n: np.asarray(c) for n, c in zip(self.feed_names, cols)}

@@ -1,0 +1,47 @@
+"""fluid.core — the pybind module surface (reference:
+paddle/fluid/pybind/pybind.cc builds core_avx/core_noavx). The
+capability here is the framework itself; this module maps the
+most-touched pybind names onto it, and `core.ops` exposes the
+registered-op corpus the way op_function_generator's generated module
+did (core.ops.<op_name>(...) fast-path callables).
+"""
+from __future__ import annotations
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+)
+from ..core.tensor import Tensor as VarBase  # noqa: F401
+from ..core.tensor import Tensor as LoDTensor  # noqa: F401
+from ..ops.array_ops import TensorArray as LoDTensorArray  # noqa: F401
+from ..static.executor import Scope  # noqa: F401
+from ..core.flags import set_flags, get_flags  # noqa: F401
+from ..core.selected_rows import SelectedRows  # noqa: F401
+
+
+def is_compiled_with_cuda():
+    from ..core.place import is_compiled_with_cuda as f
+    return f()
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+class _OpsModule:
+    """core.ops.<name> — the reference's generated per-op fast-path
+    functions (pybind/op_function_generator.cc). Resolves against the
+    @op registry (the same kernels every API routes through)."""
+
+    def __getattr__(self, name):
+        from ..core.dispatch import get_op
+        fn = get_op(name)
+        if fn is None:
+            raise AttributeError(f"core.ops has no registered op {name!r}")
+        return fn
+
+    def __dir__(self):
+        from ..core.dispatch import registered_ops
+        return registered_ops()
+
+
+ops = _OpsModule()

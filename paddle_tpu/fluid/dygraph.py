@@ -1,0 +1,110 @@
+"""fluid.dygraph (reference: python/paddle/fluid/dygraph/ — Layer,
+to_variable, guard, the fluid-signature layer set with `act` fusion,
+jit entry points)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.layers import Layer  # noqa: F401
+from ..nn.layer.container import Sequential, LayerList, ParameterList  # noqa: F401
+from ..core.autograd import no_grad, grad  # noqa: F401
+from ..core.tensor import to_tensor
+from ..distributed.parallel import DataParallel  # noqa: F401
+from ..jit import (  # noqa: F401
+    to_static as declarative, ProgramTranslator, TracedLayer,
+)
+from .. import nn as _nn
+from ..nn import functional as _F
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """reference fluid/dygraph/base.py to_variable — ndarray → VarBase."""
+    t = to_tensor(value)
+    return t.astype(dtype) if dtype else t
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """reference fluid/dygraph/base.py guard — enters dygraph mode; this
+    framework is dygraph-by-default, so it (re)asserts dynamic mode."""
+    from ..static.mode import in_dynamic_mode, disable_static
+    was_static = not in_dynamic_mode()
+    if was_static:
+        disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            from ..static.mode import enable_static
+            enable_static()
+
+
+def _actify(out, act):
+    return getattr(_F, act)(out) if act else out
+
+
+class Linear(Layer):
+    """fluid.dygraph.Linear(input_dim, output_dim, act=None) — the
+    fluid-era signature with fused activation (reference
+    fluid/dygraph/nn.py Linear), over the 2.0 Linear."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._linear = _nn.Linear(input_dim, output_dim,
+                                  weight_attr=param_attr,
+                                  bias_attr=bias_attr)
+        self._act = act
+
+    @property
+    def weight(self):
+        return self._linear.weight
+
+    @property
+    def bias(self):
+        return self._linear.bias
+
+    def forward(self, input):
+        return _actify(self._linear(input), self._act)
+
+
+class Embedding(Layer):
+    """fluid.dygraph.Embedding(size=[V, H]) (reference fluid/dygraph/
+    nn.py Embedding: size list, is_sparse/padding_idx knobs)."""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        self._emb = _nn.Embedding(size[0], size[1],
+                                  padding_idx=padding_idx,
+                                  weight_attr=param_attr)
+
+    @property
+    def weight(self):
+        return self._emb.weight
+
+    def forward(self, input):
+        return self._emb(input)
+
+
+def save_dygraph(state_dict, model_path):
+    """reference fluid/dygraph/checkpoint.py save_dygraph: .pdparams for
+    layer state, .pdopt for optimizer state. Every optimizer state_dict
+    here carries a top-level "global_step" entry
+    (optimizer/optimizer.py state_dict), which layer state dicts never
+    produce — that is the discriminator."""
+    from ..framework_io import save
+    suffix = ".pdopt" if "global_step" in state_dict else ".pdparams"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path):
+    """reference fluid/dygraph/checkpoint.py load_dygraph → (param_dict,
+    opt_dict)."""
+    import os
+    from ..framework_io import load
+    params = load(model_path + ".pdparams") \
+        if os.path.exists(model_path + ".pdparams") else None
+    opt = load(model_path + ".pdopt") \
+        if os.path.exists(model_path + ".pdopt") else None
+    return params, opt

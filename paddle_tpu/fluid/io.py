@@ -1,0 +1,75 @@
+"""fluid.io (reference: python/paddle/fluid/io.py — readers + save/load)."""
+from ..io import DataLoader  # noqa: F401
+from ..batch import batch  # noqa: F401
+from ..static.io import (
+    save_inference_model as _save_inference_model_v2,
+    load_inference_model as _load_inference_model_v2,
+)
+from ..static.compat import (  # noqa: F401
+    save_vars, load_vars, load_program_state, set_program_state,
+)
+from ..framework_io import save, load  # noqa: F401
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference fluid/io.py:621 — persistables of the (default) main
+    program to dirname."""
+    return save_vars(executor, dirname, main_program=main_program,
+                     filename=filename or "__persistables__")
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program=main_program,
+                     filename=filename or "__persistables__")
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    from ..static.program import default_main_program
+    program = main_program or default_main_program()
+    params = [v.name for v in program.all_parameters()]
+    return save_vars(executor, dirname, main_program=program, vars=params,
+                     filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    from ..static.program import default_main_program
+    program = main_program or default_main_program()
+    params = [v.name for v in program.all_parameters()]
+    return load_vars(executor, dirname, main_program=program, vars=params,
+                     filename=filename)
+
+
+def _resolve_vars(program, names_or_vars):
+    from ..static.program import default_main_program
+    program = program or default_main_program()
+    out = []
+    for v in names_or_vars:
+        if isinstance(v, str):
+            out.append(program.global_block.vars[v])
+        else:
+            out.append(v)
+    return program, out
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, **kw):
+    """fluid signature (reference fluid/io.py:1199): feed vars by NAME,
+    artifact under dirname. Delegates to the 2.0 static saver (StableHLO
+    artifact at dirname/__model__*)."""
+    import os
+    program, feeds = _resolve_vars(main_program, feeded_var_names)
+    _, fetches = _resolve_vars(program, target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    return _save_inference_model_v2(os.path.join(dirname, "__model__"),
+                                    feeds, fetches, executor,
+                                    program=program)
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """fluid signature (reference fluid/io.py load_inference_model) —
+    returns (program, feed_names, fetch_targets) like the reference."""
+    import os
+    return _load_inference_model_v2(os.path.join(dirname, "__model__"),
+                                    executor)

@@ -1,0 +1,60 @@
+"""fluid.layers — the static layer library namespace (reference:
+python/paddle/fluid/layers/, 36k LoC across nn.py/tensor.py/
+control_flow.py/loss.py/detection.py/sequence_lod.py).
+
+Delegation order (PEP-562 __getattr__): static.nn authoring layers →
+fluid-signature aliases (legacy_api) → the unified op corpus (ops.*,
+which carries the tensor/detection/sequence surface under the
+reference's op names) → nn.functional. This is exactly how the
+reference resolves too — fluid.layers re-exported the op library.
+"""
+from __future__ import annotations
+
+from ..static import nn as _static_nn
+from .. import legacy_api as _legacy
+from .. import ops as _ops
+from ..nn import functional as _F
+from ..ops import control_flow as _cf
+from ..static.rnn_shims import StaticRNN, DynamicRNN, py_reader  # noqa: F401
+from ..static.nn import create_global_var  # noqa: F401
+
+
+_SOURCES = (_static_nn, _legacy, _ops, _F, _cf)
+
+
+def __getattr__(name):
+    for mod in _SOURCES:
+        if hasattr(mod, name):
+            return getattr(mod, name)
+    raise AttributeError(
+        f"fluid.layers has no attribute {name!r} (searched static.nn, "
+        "legacy aliases, the unified op corpus, nn.functional, "
+        "control_flow)")
+
+
+def __dir__():
+    names = set()
+    for mod in _SOURCES:
+        names.update(n for n in dir(mod) if not n.startswith("_"))
+    return sorted(names)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid kw names (input/param_attr/act) over static.nn.fc
+    (reference fluid/layers/nn.py fc vs static/nn/common.py fc)."""
+    return _static_nn.fc(input, size, num_flatten_dims=num_flatten_dims,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         activation=act, name=name)
+
+
+def data(name, shape, append_batch_size=True, dtype="float32",
+         lod_level=0, type=None, stop_gradient=True):
+    """fluid.layers.data (reference fluid/layers/io.py data): unlike 2.0
+    static.data, the batch dim is PREPENDED unless the caller already
+    made it variadic (append_batch_size semantics)."""
+    from ..static.program import data as _data
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    return _data(name, shape, dtype, lod_level)

@@ -50,6 +50,12 @@ def _map_state(tree, fn):
     return fn(tree)
 
 
+def _map_state2(a, b, fn):
+    if isinstance(a, (list, tuple)):
+        return type(a)(_map_state2(x, y, fn) for x, y in zip(a, b))
+    return fn(a, b)
+
+
 class BeamSearchDecoder(Decoder):
     """reference fluid/layers/rnn.py BeamSearchDecoder: length-unnormalised
     beam search over an RNN cell. cell(inputs, states) must return
@@ -64,6 +70,7 @@ class BeamSearchDecoder(Decoder):
         self.beam_size = int(beam_size)
         self.embedding_fn = embedding_fn
         self.output_fn = output_fn
+        self._impute_finished = False
 
     @staticmethod
     def tile_beam_merge_with_batch(x, beam_size):
@@ -119,13 +126,12 @@ class BeamSearchDecoder(Decoder):
         step_lp = self._split(log_softmax(logits, axis=-1))  # [B,bm,V]
         # finished beams only extend with end_token at logprob 0
         fin = states["finished"]
-        mask = np.full((1, 1, V), 0.0, np.float32)
-        end_only = to_tensor(np.array(
-            [0.0 if i == self.end_token else -1e9 for i in range(V)],
-            np.float32)).reshape([1, 1, V])
-        step_lp = MP.where(
-            MP.unsqueeze(fin, -1), end_only + mask,
-            step_lp)
+        if getattr(self, "_end_only_v", None) != V:
+            arr = np.full((1, 1, V), -1e9, np.float32)
+            arr[0, 0, self.end_token] = 0.0
+            self._end_only = to_tensor(arr)
+            self._end_only_v = V
+        step_lp = MP.where(MP.unsqueeze(fin, -1), self._end_only, step_lp)
         total = MP.unsqueeze(states["log_probs"], -1) + step_lp
         flat = MP.reshape(total, [self._batch, self.beam_size * V])
         top_lp, top_idx = _topk(flat, self.beam_size, axis=-1)
@@ -139,6 +145,21 @@ class BeamSearchDecoder(Decoder):
             lambda s: MP.index_select(s, flat_parent, axis=0))
         prev_fin = MP.take_along_axis(fin, parent, axis=1)
         now_fin = L.logical_or(prev_fin, token == self.end_token)
+        if self._impute_finished:
+            # reference dynamic_decode impute_finished/_maybe_copy: the
+            # states of already-finished beams pass through unchanged
+            # instead of taking the cell's update
+            old_gathered = _map_state(
+                cell_states,
+                lambda s: MP.index_select(s, flat_parent, axis=0))
+            flat_fin = MP.reshape(prev_fin, [-1])
+
+            def _impute(new_s, old_s):
+                m = MP.reshape(flat_fin, [-1] + [1] * (len(new_s.shape)
+                                                       - 1))
+                return MP.where(m, old_s, new_s)
+            next_cell_states = _map_state2(next_cell_states,
+                                           old_gathered, _impute)
         lengths = MP.take_along_axis(states["lengths"], parent, axis=1)
         lengths = lengths + M.cast(L.logical_not(prev_fin), "int64")
         next_states = {
@@ -170,6 +191,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     """reference fluid/layers/rnn.py dynamic_decode (dygraph branch):
     python loop over decoder.step until every sequence finishes or
     max_step_num; stacks per-step outputs time-major, then finalize."""
+    decoder._impute_finished = bool(impute_finished)
     inputs, states, finished = decoder.initialize(inits)
     step_outputs = []
     time = 0
@@ -184,8 +206,10 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     stacked = {k: MP.stack([o[k] for o in step_outputs], axis=0)
                for k in step_outputs[0]}
     lengths = states.get("lengths") if isinstance(states, dict) else None
-    if hasattr(decoder, "finalize"):
+    try:
         stacked, states = decoder.finalize(stacked, states, lengths)
+    except NotImplementedError:
+        pass  # finalize optional (reference rnn.py wraps it the same way)
     if not output_time_major:
         stacked = {k: MP.transpose(v, [1, 0] + list(
             range(2, len(v.shape)))) for k, v in stacked.items()}

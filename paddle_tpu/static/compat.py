@@ -111,8 +111,19 @@ def load_program_state(model_path, var_list=None):
     file into a {name: ndarray} dict without touching any program."""
     path = model_path if os.path.exists(model_path) \
         else model_path + ".pdparams"
-    with open(path, "rb") as f:
-        state = pickle.load(f)
+    if os.path.isdir(path):
+        # the per-variable layout save_vars(filename=None) writes:
+        # one pickle per var under the directory (reference
+        # load_program_state handles the same split layout)
+        state = {}
+        for fn in sorted(os.listdir(path)):
+            fp = os.path.join(path, fn)
+            if os.path.isfile(fp):
+                with open(fp, "rb") as f:
+                    state.update(pickle.load(f))
+    else:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
     if var_list is not None:
         names = {v if isinstance(v, str) else v.name for v in var_list}
         state = {k: v for k, v in state.items() if k in names}

@@ -13,21 +13,40 @@ import paddle_tpu as paddle
 REF_INIT = "/root/reference/python/paddle/__init__.py"
 
 
-def _reference_top_level_names():
-    """Exported top-level names: for `from X import a as b` the exported
-    name is the ALIAS b; commented-out imports don't count."""
+def _names_from_source(path, use_all=False):
+    """AST-walk a reference module: every `from X import a as b` exports
+    b (the __init__ convention), plus `import paddle.x` submodules; for
+    plain module files an explicit __all__ wins when use_all."""
+    import ast as _ast
+    tree = _ast.parse(open(path).read())
+    if use_all:
+        for node in tree.body:
+            if isinstance(node, _ast.Assign) and any(
+                    isinstance(t, _ast.Name) and t.id == "__all__"
+                    for t in node.targets):
+                try:
+                    vals = _ast.literal_eval(node.value)
+                    return {n for n in vals if not n.startswith("_")}
+                except ValueError:
+                    break
     names = set()
-    for line in open(REF_INIT):
-        line = line.split("#", 1)[0]
-        m = re.match(r"\s*from\s+\.[\w.]*\s+import\s+(\w+)"
-                     r"(?:\s+as\s+(\w+))?", line)
-        if m:
-            names.add(m.group(2) or m.group(1))
-            continue
-        m = re.match(r"\s*import\s+paddle\.(\w+)", line)
-        if m:
-            names.add(m.group(1))
+    for node in _ast.walk(tree):
+        if isinstance(node, _ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                n = a.asname or a.name
+                if n != "*" and not n.startswith("_"):
+                    names.add(n)
+        elif isinstance(node, _ast.Import):
+            for a in node.names:
+                if a.name.startswith("paddle."):
+                    names.add(a.name.split(".")[1])
     return names
+
+
+def _reference_top_level_names():
+    return _names_from_source(REF_INIT)
 
 
 def test_top_level_namespace_parity():
@@ -171,30 +190,13 @@ def test_compat_and_misc():
 
 def _reference_module_names(relpath):
     """Exported names of a reference submodule: its __all__ when declared
-    (plain module files), else its import lines (__init__.py convention:
-    imports ARE the exports). __future__ and private names excluded."""
+    (plain module files), else its imports (the __init__ convention)."""
     import os
     base = "/root/reference/python/paddle"
     p = os.path.join(base, *relpath.split("."))
-    p = p + ".py" if os.path.isfile(p + ".py") else \
-        os.path.join(p, "__init__.py")
-    src = open(p).read()
-    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
-    if m and not p.endswith("__init__.py"):
-        return {n for n in re.findall(r"['\"](\w+)['\"]", m.group(1))
-                if not n.startswith("_")}
-    names = set()
-    for line in src.splitlines():
-        line = line.split("#", 1)[0]
-        if "__future__" in line:
-            continue
-        mm = re.match(r"\s*from\s+[\w.]+\s+import\s+(\w+)"
-                      r"(?:\s+as\s+(\w+))?", line)
-        if mm:
-            n = mm.group(2) or mm.group(1)
-            if not n.startswith("_"):
-                names.add(n)
-    return names
+    plain = os.path.isfile(p + ".py")
+    p = p + ".py" if plain else os.path.join(p, "__init__.py")
+    return _names_from_source(p, use_all=plain)
 
 
 def test_submodule_namespace_parity():

@@ -1,0 +1,130 @@
+"""The legacy fluid namespace: reference-era user code must run as-is
+(`import paddle.fluid as fluid` style, reference python/paddle/fluid/).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_static_book_flow(tmp_path):
+    """The reference book-test shape (test_recognize_digits style):
+    build a program with fluid.layers, train with fluid.Executor,
+    save/load persistables through fluid.io."""
+    paddle.enable_static()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, size=8, act="relu")
+            logits = fluid.layers.fc(h, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            import paddle_tpu.optimizer as opt
+            opt.SGD(0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(32, 4).astype(np.float32)
+        ys = (xs.sum(1, keepdims=True) > 0).astype(np.int64) * 2
+        losses = []
+        for _ in range(20):
+            (lv,) = exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        assert losses[-1] < losses[0] * 0.8, losses
+        fluid.io.save_persistables(exe, str(tmp_path))
+        fluid.io.load_persistables(exe, str(tmp_path))
+        (lv2,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv2)))
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_dygraph_flow():
+    paddle.seed(0)
+    with fluid.dygraph.guard():
+        lin = fluid.dygraph.Linear(4, 2, act="relu")
+        emb = fluid.dygraph.Embedding(size=[10, 4])
+        ids = fluid.dygraph.to_variable(
+            np.array([[1, 2], [3, 4]], np.int64))
+        out = lin(emb(ids))
+        assert list(out.shape) == [2, 2, 2]
+        assert (out.numpy() >= 0).all()  # relu fused
+        out.backward()
+        assert emb.weight.grad is not None
+
+
+def test_fluid_core_ops_and_misc():
+    # core.ops.<op> fast-path callables (op_function_generator analogue)
+    import jax.numpy as jnp
+    r = fluid.core.ops.relu(jnp.asarray(np.array([-1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(r), [0.0, 2.0])
+    assert "relu" in dir(fluid.core.ops)
+    assert fluid.core.is_compiled_with_xpu() is False
+    assert isinstance(fluid.core.Scope(), fluid.Scope)
+    # layers delegation breadth: tensor/math/control-flow names resolve
+    for name in ("concat", "reshape", "reduce_sum", "elementwise_add",
+                 "fill_constant", "cast", "while_loop", "cond", "topk",
+                 "softmax", "relu", "cross_entropy", "fc", "StaticRNN"):
+        assert callable(getattr(fluid.layers, name)), name
+    fluid.require_version("1.8.0")
+    # save/load_dygraph round trip
+    lin = fluid.dygraph.Linear(3, 2)
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    fluid.dygraph.save_dygraph(lin.state_dict(), os.path.join(d, "m"))
+    params, opt = fluid.dygraph.load_dygraph(os.path.join(d, "m"))
+    assert params is not None and "_linear.weight" in params
+
+
+def test_fluid_save_load_inference_model(tmp_path):
+    """fluid-era signature: feed by NAME, artifact under dirname."""
+    paddle.enable_static()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / "inf")
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+        res = fluid.io.load_inference_model(d, exe)
+        assert res is not None
+    finally:
+        paddle.disable_static()
+
+
+def test_dynamic_decode_minimal_decoder_and_impute():
+    """A Decoder subclass without finalize must work (reference wraps
+    finalize in try/except NotImplementedError); impute_finished freezes
+    finished beams' states."""
+    import paddle_tpu.nn as nn
+
+    class CountDecoder(nn.Decoder):
+        def initialize(self, inits):
+            z = paddle.to_tensor(np.zeros((2,), np.float32))
+            return z, z, paddle.to_tensor(np.array([False, False]))
+
+        def step(self, time, inputs, states, **kwargs):
+            nxt = states + 1.0
+            fin = paddle.to_tensor(np.array([time >= 1, time >= 2]))
+            return {"out": nxt}, nxt, nxt, fin
+
+    outs, states = nn.dynamic_decode(CountDecoder(), max_step_num=4)
+    assert outs["out"].shape[1] == 3  # stopped when all finished (t=2)
+
+    paddle.seed(0)
+    cell = paddle.nn.GRUCell(4, 8)
+    emb = paddle.nn.Embedding(6, 4)
+    proj = paddle.nn.Linear(8, 6)
+    dec = nn.BeamSearchDecoder(cell, 0, 1, 2, embedding_fn=emb,
+                               output_fn=proj)
+    h0 = paddle.to_tensor(np.random.RandomState(0).randn(2, 8)
+                          .astype(np.float32))
+    o1, s1 = nn.dynamic_decode(dec, inits=h0, max_step_num=6,
+                               impute_finished=True)
+    assert o1["predicted_ids"].numpy().shape[0] == 2
