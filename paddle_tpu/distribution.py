@@ -20,7 +20,8 @@ from .ops import math as M
 from .ops import manipulation as MP
 from .ops import random_ops as R
 
-__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
 
 
 class Distribution:
@@ -235,3 +236,43 @@ class Categorical(Distribution):
     def log_prob(self, value):
         """log(probs(value)) (reference :935)."""
         return M.log(self.probs(value))
+
+
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal with diagonal covariance (reference
+    fluid/layers/distributions.py:531 — loc [k], scale [k, k] diagonal
+    matrix; entropy/kl_divergence only, like the reference)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.name = name or "MultivariateNormalDiag"
+        self.loc, _ = self._wrap(loc)
+        self.scale, _ = self._wrap(scale)
+
+    def _diag(self):
+        return self._diag_of(self.scale)
+
+    @staticmethod
+    def _diag_of(scale):
+        import jax.numpy as jnp
+        return Tensor(jnp.diagonal(scale._value))
+
+    def entropy(self):
+        """0.5 (k (1 + log 2π) + log det Σ) (reference :633)."""
+        k = self.scale.shape[0]
+        logdet = M.sum(M.log(self._diag()))
+        return 0.5 * (k * (1.0 + math.log(2.0 * math.pi))) + 0.5 * logdet
+
+    def kl_divergence(self, other):
+        """0.5 (tr(Σ1⁻¹Σ0) + (μ1-μ0)ᵀΣ1⁻¹(μ1-μ0) - k + ln detΣ1/detΣ0)
+        (reference :646)."""
+        if not isinstance(other, MultivariateNormalDiag):
+            raise TypeError(
+                "kl_divergence expects a MultivariateNormalDiag")
+        d0 = self._diag()
+        d1 = self._diag_of(other.scale)
+        k = self.scale.shape[0]
+        tr = M.sum(d0 / d1)
+        diff = other.loc - self.loc
+        quad = M.sum(diff * diff / d1)
+        ln_cov = M.sum(M.log(d1)) - M.sum(M.log(d0))
+        return 0.5 * (tr + quad - k + ln_cov)

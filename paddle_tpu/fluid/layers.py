@@ -2,13 +2,20 @@
 python/paddle/fluid/layers/, 36k LoC across nn.py/tensor.py/
 control_flow.py/loss.py/detection.py/sequence_lod.py).
 
-Delegation order (PEP-562 __getattr__): static.nn authoring layers →
-fluid-signature aliases (legacy_api) → the unified op corpus (ops.*,
-which carries the tensor/detection/sequence surface under the
-reference's op names) → nn.functional. This is exactly how the
-reference resolves too — fluid.layers re-exported the op library.
+Delegation (PEP-562 __getattr__), in order:
+1. static.nn authoring layers, fluid-signature adapters defined below;
+2. the fluid alias set (legacy_api) and unified op corpus — every ops/
+   submodule, nn + nn.functional, decode, distribution, debug/rnn shims;
+3. the documented reference-name RENAMES map (ops/op_renames.py — the
+   same accounting the op coverage gate enforces), so fluid-era names
+   like `warpctc`, `lrn` or `pool2d` resolve to their 2.0 forms. A
+   renamed target keeps ITS OWN (2.0) signature — capability parity,
+   with loud TypeErrors rather than silent kwarg reinterpretation.
 """
 from __future__ import annotations
+
+import importlib
+import pkgutil
 
 from ..static import nn as _static_nn
 from .. import legacy_api as _legacy
@@ -19,23 +26,64 @@ from ..static.rnn_shims import StaticRNN, DynamicRNN, py_reader  # noqa: F401
 from ..static.nn import create_global_var  # noqa: F401
 
 
-_SOURCES = (_static_nn, _legacy, _ops, _F, _cf)
+def _sources():
+    from . import layers_adapters as _adapt
+    mods = [_adapt, _static_nn, _legacy, _ops, _F, _cf]
+    import paddle_tpu.ops as _o
+    for mi in pkgutil.iter_modules(_o.__path__):
+        try:
+            mods.append(importlib.import_module("paddle_tpu.ops."
+                                                + mi.name))
+        except ImportError:
+            pass
+    from .. import nn as _nn
+    from .. import distribution as _dist
+    from ..nn import decode as _decode
+    from ..static import debug_ops as _dbg
+    from ..static import rnn_shims as _shims
+    from ..core import selected_rows as _sr
+    from .. import optimizer as _opt
+    mods += [_nn, _decode, _dist, _dbg, _shims, _sr, _opt.lr]
+    return mods
+
+
+_SOURCE_CACHE = None
 
 
 def __getattr__(name):
-    for mod in _SOURCES:
+    global _SOURCE_CACHE
+    if _SOURCE_CACHE is None:
+        _SOURCE_CACHE = _sources()
+    for mod in _SOURCE_CACHE:
         if hasattr(mod, name):
             return getattr(mod, name)
+    from ..ops.op_renames import RENAMES, resolve_api
+    if name in RENAMES:
+        target = RENAMES[name]
+        if target.startswith("api:"):
+            obj = resolve_api(target[4:])
+            if obj is not None:
+                return obj
+        else:
+            from ..core.dispatch import get_op
+            fn = get_op(target)
+            if fn is not None:
+                return fn
     raise AttributeError(
         f"fluid.layers has no attribute {name!r} (searched static.nn, "
-        "legacy aliases, the unified op corpus, nn.functional, "
-        "control_flow)")
+        "legacy aliases, the unified op corpus, nn/functional/decode/"
+        "distribution, and the documented reference-name rename map)")
 
 
 def __dir__():
+    global _SOURCE_CACHE
+    if _SOURCE_CACHE is None:
+        _SOURCE_CACHE = _sources()
     names = set()
-    for mod in _SOURCES:
+    for mod in _SOURCE_CACHE:
         names.update(n for n in dir(mod) if not n.startswith("_"))
+    from ..ops.op_renames import RENAMES
+    names.update(RENAMES)
     return sorted(names)
 
 
@@ -55,6 +103,8 @@ def data(name, shape, append_batch_size=True, dtype="float32",
     made it variadic (append_batch_size semantics)."""
     from ..static.program import data as _data
     shape = list(shape)
-    if append_batch_size and (not shape or shape[0] != -1):
+    # the reference forces append_batch_size=False when ANY dim is
+    # negative (fluid/layers/io.py data)
+    if append_batch_size and all(int(d) >= 0 for d in shape):
         shape = [-1] + shape
     return _data(name, shape, dtype, lod_level)

@@ -53,7 +53,11 @@ from .layer.transformer import (  # noqa: F401
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
 from . import utils  # noqa: F401
-from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401,E402
+from .decode import (  # noqa: F401,E402
+    Decoder, BeamSearchDecoder, dynamic_decode, BasicDecoder,
+    DecodeHelper, TrainingHelper, GreedyEmbeddingHelper,
+    SampleEmbeddingHelper,
+)
 from .layer.loss import HSigmoidLoss  # noqa: F401,E402
 
 # reference nn/__init__ re-exports its layer submodules by name

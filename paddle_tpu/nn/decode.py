@@ -21,7 +21,9 @@ from ..ops.search import topk as _topk
 from ..ops import creation as C
 from ..ops.extra_ops import gather_tree
 
-__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode",
+           "BasicDecoder", "DecodeHelper", "TrainingHelper",
+           "GreedyEmbeddingHelper", "SampleEmbeddingHelper"]
 
 
 class Decoder:
@@ -196,8 +198,15 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     step_outputs = []
     time = 0
     while True:
-        outputs, states, inputs, finished = decoder.step(
+        outputs, states, inputs, step_finished = decoder.step(
             time, inputs, states, **kwargs)
+        # a sequence must never un-finish: OR with the accumulated flags
+        # unless the decoder tracks its own (reference rnn.py
+        # dynamic_decode's next_finished = logical_or(...) branch)
+        if getattr(decoder, "tracks_own_finished", False):
+            finished = step_finished
+        else:
+            finished = L.logical_or(finished, step_finished)
         step_outputs.append(outputs)
         time += 1
         done = bool(np.asarray(M.all(finished).numpy()))
@@ -216,3 +225,126 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     if return_length:
         return stacked, states, lengths
     return stacked, states
+
+
+class DecodeHelper:
+    """Sampling-strategy protocol for BasicDecoder (reference
+    fluid/layers/rnn.py DecodeHelper): initialize() → (inputs, finished);
+    sample(time, outputs, states) → sample_ids; next_inputs(...) →
+    (finished, next_inputs, next_states)."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: read the next step's inputs from the provided
+    ground-truth sequence (reference rnn.py TrainingHelper)."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        self.inputs = inputs if isinstance(inputs, Tensor) \
+            else to_tensor(inputs)
+        if not time_major:
+            self.inputs = MP.transpose(
+                self.inputs,
+                [1, 0] + list(range(2, len(self.inputs.shape))))
+        self.sequence_length = sequence_length
+        self._T = self.inputs.shape[0]
+        self._B = self.inputs.shape[1]
+
+    def initialize(self):
+        finished = C.full([self._B], False, "bool")
+        return self.inputs[0], finished
+
+    def sample(self, time, outputs, states):
+        from ..ops.search import argmax
+        return argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        next_time = time + 1
+        finished = C.full([self._B], next_time >= self._T, "bool")
+        if self.sequence_length is not None:
+            seq = self.sequence_length \
+                if isinstance(self.sequence_length, Tensor) \
+                else to_tensor(self.sequence_length)
+            finished = L.logical_or(
+                finished, to_tensor(np.full(self._B, next_time,
+                                            np.int64)) >= seq)
+        idx = min(next_time, self._T - 1)
+        return finished, self.inputs[idx], states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Inference-time argmax feeding (reference rnn.py
+    GreedyEmbeddingHelper): embed the previous argmax as the next
+    input."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens if isinstance(start_tokens,
+                                                      Tensor) \
+            else to_tensor(np.asarray(start_tokens, np.int64))
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        finished = C.full([self.start_tokens.shape[0]], False, "bool")
+        return self.embedding_fn(self.start_tokens), finished
+
+    def sample(self, time, outputs, states):
+        from ..ops.search import argmax
+        return argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        finished = sample_ids == self.end_token
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Categorical sampling instead of argmax (reference rnn.py
+    SampleEmbeddingHelper; softmax_temperature scales the logits)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.seed = seed
+
+    def sample(self, time, outputs, states):
+        from ..distribution import Categorical
+        logits = outputs if self.temperature is None \
+            else outputs / self.temperature
+        flat = Categorical(logits)
+        s = flat.sample([1])
+        return MP.reshape(MP.transpose(s, [1, 0])
+                          if len(s.shape) > 1 else s, [-1])
+
+
+class BasicDecoder(Decoder):
+    """Cell + helper single-beam decoder (reference rnn.py BasicDecoder):
+    step = cell forward, optional output layer, helper.sample +
+    helper.next_inputs."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        inputs, finished = self.helper.initialize()
+        return inputs, initial_cell_states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        sample_ids = self.helper.sample(time, cell_out, next_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_out, next_states, sample_ids)
+        outputs = {"cell_outputs": cell_out, "sample_ids": sample_ids}
+        return outputs, next_states, next_inputs, finished

@@ -47,275 +47,12 @@ def extract_reference_ops():
     return sorted(names)
 
 
-# ---------------------------------------------------------------------------
-# Reference name → our name. Plain string = op-registry name;
-# "api:<dotted.path>" = public callable (resolved by import below).
-RENAMES = {
-    "batch_norm": "batch_norm_train",
-    "inplace_abn": "batch_norm_train",       # in-place variant: XLA donation
-    "pool2d": "pool_max",
-    "pool3d": "pool_max",
-    "fill_constant": "api:paddle_tpu.full",
-    "fill": "assign_value",
-    "fill_zeros_like": "zeros_like",
-    "fill_zeros_like2": "zeros_like",
-    "fill_constant_batch_size_like": "api:paddle_tpu.ops.creation.fill_constant_batch_size_like",
-    "gaussian_random": "api:paddle_tpu.normal",
-    "gaussian_random_batch_size_like": "api:paddle_tpu.ops.creation.gaussian_random_batch_size_like",
-    "uniform_random": "api:paddle_tpu.uniform",
-    "uniform_random_batch_size_like": "api:paddle_tpu.ops.creation.uniform_random_batch_size_like",
-    "range": "api:paddle_tpu.arange",
-    "linspace": "api:paddle_tpu.linspace",
-    "eye": "api:paddle_tpu.eye",
-    "empty": "api:paddle_tpu.empty",
-    "randint": "api:paddle_tpu.randint",
-    "randperm": "api:paddle_tpu.randperm",
-    "seed": "api:paddle_tpu.seed",
-    "pow": "api:paddle_tpu.pow",
-    "crop": "api:paddle_tpu.crop",
-    "allclose": "api:paddle_tpu.allclose",
-    "is_empty": "api:paddle_tpu.is_empty",
-    "where_index": "api:paddle_tpu.nonzero",
-    "diag_v2": "diag",
-    "diag_embed": "api:paddle_tpu.ops.creation.diag_embed",
-    "expand_as": "expand_as_v2",
-    "grad_add": "elementwise_add",
-    "dist": "api:paddle_tpu.dist",
-    "shard_index": "api:paddle_tpu.shard_index",
-    "clip_by_norm": "api:paddle_tpu.nn.ClipGradByNorm",
-    "segment_pool": "segment_pool_sum",
-    "edit_distance": "api:paddle_tpu.ops.sequence_ops.edit_distance",
-    "sequence_expand": "api:paddle_tpu.ops.sequence_ops.sequence_expand",
-    "sequence_unpad": "api:paddle_tpu.ops.sequence_ops.sequence_unpad",
-    "sequence_slice": "api:paddle_tpu.ops.sequence_ops.sequence_slice",
-    "sequence_concat": "api:paddle_tpu.ops.sequence_ops.sequence_concat",
-    "sequence_conv": "api:paddle_tpu.ops.sequence_ops.sequence_conv",
-    "sequence_enumerate": "api:paddle_tpu.ops.sequence_ops.sequence_enumerate",
-    "sequence_erase": "api:paddle_tpu.ops.sequence_ops.sequence_erase",
-    "sequence_reshape": "api:paddle_tpu.ops.sequence_ops.sequence_reshape",
-    "sequence_scatter": "api:paddle_tpu.ops.sequence_ops.sequence_scatter",
-    "sequence_expand_as": "api:paddle_tpu.ops.sequence_ops.sequence_expand_as",
-    "sequence_topk_avg_pooling": "api:paddle_tpu.ops.sequence_ops.sequence_topk_avg_pooling",
-    "im2sequence": "api:paddle_tpu.ops.sequence_ops.im2sequence",
-    "ctc_align": "api:paddle_tpu.ops.sequence_ops.ctc_align",
-    "lod_reset": "api:paddle_tpu.ops.sequence_ops.lod_reset",
-    "var_conv_2d": "api:paddle_tpu.ops.sequence_ops.var_conv_2d",
-    "match_matrix_tensor": "api:paddle_tpu.ops.sequence_ops.match_matrix_tensor",
-    "array_to_lod_tensor": "api:paddle_tpu.ops.array_ops.array_to_lod_tensor",
-    "lod_tensor_to_array": "api:paddle_tpu.ops.array_ops.lod_tensor_to_array",
-    "write_to_array": "api:paddle_tpu.ops.array_ops.array_write",
-    "read_from_array": "api:paddle_tpu.ops.array_ops.array_read",
-    "lod_array_length": "api:paddle_tpu.ops.array_ops.array_length",
-    "tensor_array_to_tensor": "api:paddle_tpu.ops.array_ops.tensor_array_to_tensor",
-    "beam_search_decode": "api:paddle_tpu.ops.extra_ops.beam_search_decode",
-    "gru_unit": "api:paddle_tpu.ops.rnn_unit_ops.gru_unit",
-    "lstm_unit": "api:paddle_tpu.ops.rnn_unit_ops.lstm_unit",
-    "lstmp": "api:paddle_tpu.ops.rnn_unit_ops.lstmp",
-    "multi_gru": "api:paddle_tpu.ops.rnn_unit_ops.multi_gru",
-    "attention_lstm": "api:paddle_tpu.ops.rnn_unit_ops.attention_lstm",
-    "fused_embedding_fc_lstm": "api:paddle_tpu.ops.rnn_unit_ops.fused_embedding_fc_lstm",
-    "proximal_adagrad": "api:paddle_tpu.ops.optimizer_ops.proximal_adagrad_step",
-    "proximal_gd": "api:paddle_tpu.ops.optimizer_ops.proximal_gd_step",
-    "dpsgd": "api:paddle_tpu.ops.optimizer_ops.dpsgd_step",
-    "average_accumulates": "api:paddle_tpu.ops.optimizer_ops.average_accumulates",
-    "chunk_eval": "api:paddle_tpu.ops.metrics_ops.chunk_eval",
-    "precision_recall": "api:paddle_tpu.ops.metrics_ops.precision_recall",
-    "positive_negative_pair": "api:paddle_tpu.ops.metrics_ops.positive_negative_pair",
-    "mean_iou": "api:paddle_tpu.ops.metrics_ops.mean_iou",
-    "detection_map": "api:paddle_tpu.ops.metrics_ops.detection_map",
-    "nce": "api:paddle_tpu.ops.extra_ops.nce",
-    "hierarchical_sigmoid": "api:paddle_tpu.ops.extra_ops.hierarchical_sigmoid",
-    "modified_huber_loss": "api:paddle_tpu.ops.extra_ops.modified_huber_loss",
-    "teacher_student_sigmoid_loss": "api:paddle_tpu.ops.extra_ops.teacher_student_sigmoid_loss",
-    "squared_l2_distance": "api:paddle_tpu.ops.extra_ops.squared_l2_distance",
-    "similarity_focus": "api:paddle_tpu.ops.extra_ops.similarity_focus",
-    "add_position_encoding": "api:paddle_tpu.ops.extra_ops.add_position_encoding",
-    "affine_channel": "api:paddle_tpu.ops.extra_ops.affine_channel",
-    "rank_attention": "api:paddle_tpu.ops.extra_ops.rank_attention",
-    "batch_fc": "api:paddle_tpu.ops.extra_ops.batch_fc",
-    "filter_by_instag": "api:paddle_tpu.ops.extra_ops.filter_by_instag",
-    "hash": "api:paddle_tpu.ops.extra_ops.hash_op",
-    "pyramid_hash": "api:paddle_tpu.ops.extra_ops.pyramid_hash",
-    "unique_with_counts": "api:paddle_tpu.ops.extra_ops.unique_with_counts",
-    "py_func": "api:paddle_tpu.ops.extra_ops.py_func",
-    "tree_conv": "api:paddle_tpu.ops.extra_ops.tree_conv",
-    "bilateral_slice": "api:paddle_tpu.ops.extra_ops.bilateral_slice",
-    "correlation": "api:paddle_tpu.ops.extra_ops.correlation",
-    "tdm_child": "api:paddle_tpu.ops.extra_ops.tdm_child",
-    "tdm_sampler": "api:paddle_tpu.ops.extra_ops.tdm_sampler",
-    "bilinear_tensor_product": "api:paddle_tpu.ops.extra_ops.bilinear_tensor_product",
-    "deformable_conv": "api:paddle_tpu.ops.vision_ops.deformable_conv",
-    "deformable_conv_v1": "api:paddle_tpu.ops.vision_ops.deformable_conv",
-    "deformable_psroi_pooling": "api:paddle_tpu.ops.vision_ops.deformable_psroi_pooling",
-    "psroi_pool": "api:paddle_tpu.ops.vision_ops.psroi_pool",
-    "prroi_pool": "api:paddle_tpu.ops.vision_ops.prroi_pool",
-    "random_crop": "api:paddle_tpu.ops.vision_ops.random_crop",
-    "spp": "api:paddle_tpu.ops.vision_ops.spp",
-    "anchor_generator": "api:paddle_tpu.ops.detection_ops.anchor_generator",
-    "bipartite_match": "api:paddle_tpu.ops.detection_ops.bipartite_match",
-    "box_clip": "api:paddle_tpu.ops.detection_ops.box_clip",
-    "box_decoder_and_assign": "api:paddle_tpu.ops.detection_ops.box_decoder_and_assign",
-    "collect_fpn_proposals": "api:paddle_tpu.ops.detection_ops.collect_fpn_proposals",
-    "density_prior_box": "api:paddle_tpu.ops.detection_ops.density_prior_box",
-    "distribute_fpn_proposals": "api:paddle_tpu.ops.detection_ops.distribute_fpn_proposals",
-    "generate_proposals": "api:paddle_tpu.ops.detection_ops.generate_proposals",
-    "generate_proposals_v2": "api:paddle_tpu.ops.detection_ops.generate_proposals",
-    "generate_proposal_labels": "api:paddle_tpu.ops.detection_ops.generate_proposal_labels",
-    "generate_mask_labels": "api:paddle_tpu.ops.detection_ops.generate_mask_labels",
-    "locality_aware_nms": "api:paddle_tpu.ops.detection_ops.locality_aware_nms",
-    "matrix_nms": "api:paddle_tpu.ops.vision_ops.matrix_nms",
-    "multiclass_nms": "api:paddle_tpu.ops.vision_ops.multiclass_nms",
-    "multiclass_nms2": "api:paddle_tpu.ops.vision_ops.multiclass_nms",
-    "multiclass_nms3": "api:paddle_tpu.ops.vision_ops.multiclass_nms",
-    "mine_hard_examples": "api:paddle_tpu.ops.detection_ops.mine_hard_examples",
-    "polygon_box_transform": "api:paddle_tpu.ops.detection_ops.polygon_box_transform",
-    "retinanet_detection_output": "api:paddle_tpu.ops.detection_ops.retinanet_detection_output",
-    "retinanet_target_assign": "api:paddle_tpu.ops.detection_ops.retinanet_target_assign",
-    "roi_perspective_transform": "api:paddle_tpu.ops.detection_ops.roi_perspective_transform",
-    "rpn_target_assign": "api:paddle_tpu.ops.detection_ops.rpn_target_assign",
-    "target_assign": "api:paddle_tpu.ops.detection_ops.target_assign",
-    "yolov3_loss": "api:paddle_tpu.ops.detection_ops.yolov3_loss",
-    "fc": "api:paddle_tpu.ops.fused_ops.fc",
-    "conv2d_fusion": "api:paddle_tpu.ops.fused_ops.conv2d_fusion",
-    "conv2d_inception_fusion": "api:paddle_tpu.ops.fused_ops.conv2d_inception_fusion",
-    "fused_batch_norm_act": "fused_bn_act",
-    "fused_bn_add_activation": "api:paddle_tpu.ops.fused_ops.fused_bn_add_activation",
-    "fused_elemwise_add_activation": "fused_elemwise_activation",
-    "fused_embedding_eltwise_layernorm": "api:paddle_tpu.ops.fused_ops.fused_embedding_eltwise_layernorm",
-    "fused_fc_elementwise_layernorm": "api:paddle_tpu.ops.fused_ops.fused_fc_elementwise_layernorm",
-    "fusion_seqconv_eltadd_relu": "api:paddle_tpu.ops.fused_ops.fusion_seqconv_eltadd_relu",
-    "fusion_seqexpand_concat_fc": "api:paddle_tpu.ops.fused_ops.fusion_seqexpand_concat_fc",
-    "fusion_seqpool_cvm_concat": "api:paddle_tpu.ops.fused_ops.fusion_seqpool_cvm_concat",
-    "fusion_squared_mat_sub": "api:paddle_tpu.ops.fused_ops.fusion_squared_mat_sub",
-    "fusion_transpose_flatten_concat": "api:paddle_tpu.ops.fused_ops.fusion_transpose_flatten_concat",
-    "multihead_matmul": "api:paddle_tpu.ops.fused_ops.multihead_matmul",
-    "skip_layernorm": "api:paddle_tpu.ops.fused_ops.skip_layernorm",
-    "quantize": "api:paddle_tpu.ops.quant_ops.quantize",
-    "dequantize": "api:paddle_tpu.ops.quant_ops.dequantize",
-    "requantize": "api:paddle_tpu.ops.quant_ops.requantize",
-    "dequantize_abs_max": "api:paddle_tpu.ops.quant_ops.dequantize_abs_max",
-    "dequantize_log": "api:paddle_tpu.ops.quant_ops.dequantize_log",
-    "fake_dequantize_max_abs": "api:paddle_tpu.ops.quant_ops.fake_dequantize_max_abs",
-    "fake_channel_wise_dequantize_max_abs": "api:paddle_tpu.ops.quant_ops.fake_channel_wise_dequantize_max_abs",
-    "fake_quantize_range_abs_max": "api:paddle_tpu.ops.quant_ops.fake_quantize_range_abs_max",
-    "fake_init": "api:paddle_tpu.ops.quant_ops.fake_init",
-    "merge_selected_rows": "api:paddle_tpu.core.selected_rows.merge_selected_rows",
-    "get_tensor_from_selected_rows": "api:paddle_tpu.core.selected_rows.get_tensor_from_selected_rows",
-    "split_selected_rows": "api:paddle_tpu.core.selected_rows.split_selected_rows",
-    "print": "api:paddle_tpu.static.Print",
-    "assert": "api:paddle_tpu.static.Assert",
-    # collectives: the c_* generic forms carry reduce-type as an argument
-    "allreduce": "api:paddle_tpu.distributed.all_reduce",
-    "broadcast": "api:paddle_tpu.distributed.broadcast",
-    "barrier": "api:paddle_tpu.distributed.barrier",
-    "c_allreduce_sum": "c_allreduce",
-    "c_allreduce_max": "c_allreduce",
-    "c_allreduce_min": "c_allreduce",
-    "c_allreduce_prod": "c_allreduce",
-    "c_reduce_sum": "api:paddle_tpu.distributed.reduce",
-    "c_reduce_max": "api:paddle_tpu.distributed.reduce",
-    "c_reduce_min": "api:paddle_tpu.distributed.reduce",
-    "c_reduce_prod": "api:paddle_tpu.distributed.reduce",
-    "c_scatter": "api:paddle_tpu.distributed.scatter",
-    "send_v2": "api:paddle_tpu.distributed.send",
-    "recv_v2": "api:paddle_tpu.distributed.recv",
-    "c_comm_init": "api:paddle_tpu.distributed.collective.c_comm_init",
-    "c_comm_init_all": "api:paddle_tpu.distributed.collective.c_comm_init",
-}
-
-# ---------------------------------------------------------------------------
-# Capability exists as a redesigned subsystem; evidence file must exist.
-SUBSUMED = {
-    "feed": "paddle_tpu/static/executor.py",        # executor feed/fetch
-    "fetch": "paddle_tpu/static/executor.py",
-    "save": "paddle_tpu/framework_io.py",           # paddle.save/load
-    "load": "paddle_tpu/framework_io.py",
-    "save_combine": "paddle_tpu/static/io.py",
-    "load_combine": "paddle_tpu/static/io.py",
-    "memcpy": "paddle_tpu/core/place.py",           # device_put/place model
-    "get_places": "paddle_tpu/core/place.py",
-    "delete_var": "paddle_tpu/jit/__init__.py",     # GC → XLA liveness+donation
-    "read": "paddle_tpu/io/__init__.py",            # DataLoader pipeline
-    "create_custom_reader": "paddle_tpu/io/__init__.py",
-    "enqueue": "paddle_tpu/io/dataset_native.py",   # native feed queues
-    "dequeue": "paddle_tpu/io/dataset_native.py",
-    "queue_generator": "paddle_tpu/io/dataset_native.py",
-    "recurrent": "paddle_tpu/nn/layer/rnn.py",      # lax.scan RNN engine
-    "rnn_memory_helper": "paddle_tpu/nn/layer/rnn.py",
-    "shrink_rnn_memory": "paddle_tpu/nn/layer/rnn.py",
-    "max_sequence_len": "paddle_tpu/nn/layer/rnn.py",
-    "lod_rank_table": "paddle_tpu/nn/layer/rnn.py",  # DynamicRNN machinery
-    "reorder_lod_tensor_by_rank": "paddle_tpu/nn/layer/rnn.py",
-    "split_lod_tensor": "paddle_tpu/ops/control_flow.py",  # IfElse machinery
-    "merge_lod_tensor": "paddle_tpu/ops/control_flow.py",
-    "merge_lod_tensor_infer": "paddle_tpu/ops/control_flow.py",
-    "select_input": "paddle_tpu/ops/control_flow.py",      # lax.cond routing
-    "select_output": "paddle_tpu/ops/control_flow.py",
-    "conditional_block_infer": "paddle_tpu/ops/control_flow.py",
-    "run_program": "paddle_tpu/jit/dy2static.py",   # to_static subsumes
-    "c_sync_calc_stream": "paddle_tpu/parallel/api.py",  # XLA stream order
-    "c_sync_comm_stream": "paddle_tpu/parallel/api.py",
-    # legacy gRPC parameter-server runtime: capability redesigned as the
-    # threaded-TCP PS in distributed/ps (sync/async/geo, dense+sparse)
-    "listen_and_serv": "paddle_tpu/distributed/ps/__init__.py",
-    "fl_listen_and_serv": "paddle_tpu/distributed/ps/__init__.py",
-    "heter_listen_and_serv": "paddle_tpu/distributed/ps/__init__.py",
-    "send": "paddle_tpu/distributed/ps/__init__.py",
-    "recv": "paddle_tpu/distributed/ps/__init__.py",
-    "send_and_recv": "paddle_tpu/distributed/ps/__init__.py",
-    "send_barrier": "paddle_tpu/distributed/ps/__init__.py",
-    "fetch_barrier": "paddle_tpu/distributed/ps/__init__.py",
-    "prefetch": "paddle_tpu/distributed/ps/__init__.py",
-    "recv_save": "paddle_tpu/distributed/ps/__init__.py",
-    "checkpoint_notify": "paddle_tpu/distributed/ps/__init__.py",
-    "split_byref": "paddle_tpu/distributed/ps/__init__.py",
-    "split_ids": "paddle_tpu/distributed/ps/__init__.py",
-    "merge_ids": "paddle_tpu/distributed/ps/__init__.py",
-    "ref_by_trainer_id": "paddle_tpu/distributed/ps/__init__.py",
-    "distributed_lookup_table": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_sparse_table_init": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_sparse_table_read": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_sparse_table_write": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_sparse_table_merge": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_sparse_table_grad_split": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_sparse_table_fuse_adam": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_sparse_table_fuse_sgd": "paddle_tpu/distributed/ps/__init__.py",
-    "lookup_table_dequant": "paddle_tpu/distributed/ps/__init__.py",
-    "sparse_tensor_load": "paddle_tpu/distributed/ps/__init__.py",
-    "push_dense": "paddle_tpu/distributed/ps/__init__.py",
-    "push_sparse": "paddle_tpu/distributed/ps/__init__.py",
-    "push_sparse_v2": "paddle_tpu/distributed/ps/__init__.py",
-    "pull_sparse": "paddle_tpu/distributed/ps/__init__.py",
-    "pull_sparse_v2": "paddle_tpu/distributed/ps/__init__.py",
-}
-
-# ---------------------------------------------------------------------------
-# Not applicable on this stack; one-line reason each.
-NA = {
-    "c_gen_nccl_id": "NCCL bootstrap; XLA collectives need no comm-id",
-    "gen_nccl_id": "NCCL bootstrap; XLA collectives need no comm-id",
-    "tensorrt_engine": "TensorRT subgraph engine; GPU-vendor runtime",
-    "lite_engine": "Paddle-Lite mobile engine; not a TPU target",
-    "fusion_group": "CUDA codegen fusion; XLA fuses natively",
-    "dgc": "deep-gradient-compression: loud-fail by design (fleet/comm_opt.py rationale: ICI bandwidth makes sparsified allreduce a pessimization)",
-    "dgc_momentum": "see dgc",
-    "dgc_clip_by_norm": "see dgc",
-    "pull_box_sparse": "BoxPS (Baidu ads GPU-PS hardware) integration",
-    "pull_box_extended_sparse": "BoxPS integration",
-    "push_box_sparse": "BoxPS integration",
-    "push_box_extended_sparse": "BoxPS integration",
-    "ascend_trigger": "Huawei Ascend NPU scheduling hook",
-}
+from paddle_tpu.ops.op_renames import (  # noqa: E402
+    RENAMES, SUBSUMED, NA, resolve_api,
+)
 
 
-def _resolve_api(path):
-    mod_path, attr = path.rsplit(".", 1)
-    import importlib
-    try:
-        mod = importlib.import_module(mod_path)
-    except ImportError:
-        return None
-    return getattr(mod, attr, None)
+_resolve_api = resolve_api
 
 
 def test_snapshot_is_current():
