@@ -144,6 +144,8 @@ from .device import get_cudnn_version, is_compiled_with_xpu  # noqa: F401,E402
 # the legacy namespace reference-era code imports (paddle.fluid.*);
 # pure delegation onto the modules above
 from . import fluid  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
 
 __version__ = "0.1.0"
 version = __version__

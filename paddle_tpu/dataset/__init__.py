@@ -1,0 +1,28 @@
+"""paddle.dataset — the fluid-era reader-style dataset zoo.
+
+Reference: /root/reference/python/paddle/dataset/ (mnist.py, cifar.py,
+imdb.py, imikolov.py, uci_housing.py, movielens.py, conll05.py,
+flowers.py, voc2012.py, wmt14.py, wmt16.py, common.py, image.py) — each
+module exposes `train()`/`test()` sample GENERATORS. Here every module
+adapts the 2.0 Dataset classes (paddle.vision.datasets /
+paddle.text.datasets) back into that generator protocol, so fluid-era
+`paddle.batch(paddle.dataset.mnist.train(), 32)` pipelines run
+unchanged.
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import image  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "imdb", "imikolov",
+           "uci_housing", "movielens", "conll05", "flowers", "voc2012",
+           "wmt14", "wmt16", "image"]

@@ -1,0 +1,20 @@
+"""paddle.dataset.conll05 (reference dataset/conll05.py) over
+paddle.text.datasets.Conll05st."""
+from __future__ import annotations
+
+__all__ = ["test", "get_dict"]
+
+
+def get_dict():
+    from ..text.datasets import Conll05st
+    ds = Conll05st()
+    return ds.word_dict, ds.predicate_dict, ds.label_dict
+
+
+def test():
+    def rd():
+        from ..text.datasets import Conll05st
+        ds = Conll05st()
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+    return rd

@@ -21,24 +21,14 @@ def _wrap(x):
 
 
 # ---------------------------------------------------------------- arithmetic
-def _fluid_elementwise(jfn):
-    from ..legacy_api import _fluid_axis_broadcast
+from ..legacy_api import _elementwise as _fluid_elementwise
 
-    def impl(x, y, axis=-1, act=None, name=None):
-        x, y = _fluid_axis_broadcast(x, y, axis)
-        out = jfn(x, y)
-        if act is not None:
-            from ..nn import functional as F
-            out = getattr(F, act)(out)
-        return out
-    return impl
-
-
-elementwise_mul = _fluid_elementwise(lambda x, y: x * y)
+elementwise_mul = _fluid_elementwise("elementwise_mul",
+                                     lambda x, y: x * y)
 elementwise_max = _fluid_elementwise(
-    lambda x, y: __import__("paddle_tpu").maximum(x, y))
+    "elementwise_max", lambda x, y: __import__("paddle_tpu").maximum(x, y))
 elementwise_min = _fluid_elementwise(
-    lambda x, y: __import__("paddle_tpu").minimum(x, y))
+    "elementwise_min", lambda x, y: __import__("paddle_tpu").minimum(x, y))
 
 
 def reduce_all(input, dim=None, keep_dim=False, name=None):
@@ -163,6 +153,11 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
     from ..ops.manipulation import take_along_axis, concat
     from ..ops import creation as C
     from ..nn import functional as F
+    if num_true != 1:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: only num_true == 1 is "
+            "implemented (the common case); multi-true labels need "
+            "per-true sampling the reference op does in C++")
     logits, label = _wrap(logits), _wrap(label)
     V = logits.shape[-1]
     n = min(int(num_samples), V)
@@ -353,7 +348,11 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     convention, accepted both ways."""
     from .. import nn
     from ..ops.manipulation import stack
-    hidden = size // 4 if size % 4 == 0 and size >= 4 else size
+    if size % 4 != 0:
+        raise ValueError(
+            f"dynamic_lstm: size must be 4 * hidden_size (the reference "
+            f"dynamic_lstm contract), got {size}")
+    hidden = size // 4
     cell = nn.LSTMCell(input.shape[-1], hidden)
     T = input.shape[1]
     order = range(T - 1, -1, -1) if is_reverse else range(T)
@@ -411,20 +410,35 @@ def noam_decay(d_model, warmup_steps, learning_rate=1.0):
 
 def exponential_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
-    g = decay_rate ** (1.0 / decay_steps)
-    return _decay("ExponentialDecay", learning_rate, g)
+    """lr * decay_rate^(step/decay_steps), floored per window when
+    staircase (reference learning_rate_scheduler.py exponential_decay)."""
+    import math as _m
+
+    def lam(step):
+        p = step / decay_steps
+        return decay_rate ** (_m.floor(p) if staircase else p)
+    return _decay("LambdaDecay", learning_rate, lam)
 
 
 def natural_exp_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
-    return _decay("NaturalExpDecay", learning_rate,
-                  decay_rate / decay_steps)
+    import math as _m
+
+    def lam(step):
+        p = step / decay_steps
+        return _m.exp(-decay_rate * (_m.floor(p) if staircase else p))
+    return _decay("LambdaDecay", learning_rate, lam)
 
 
 def inverse_time_decay(learning_rate, decay_steps, decay_rate,
                        staircase=False):
-    return _decay("InverseTimeDecay", learning_rate,
-                  decay_rate / decay_steps)
+    import math as _m
+
+    def lam(step):
+        p = step / decay_steps
+        return 1.0 / (1.0 + decay_rate * (_m.floor(p) if staircase
+                                          else p))
+    return _decay("LambdaDecay", learning_rate, lam)
 
 
 def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
@@ -443,9 +457,8 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
 
 
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
-    base = learning_rate if not isinstance(learning_rate, (int, float)) \
-        else learning_rate
-    return _decay("LinearWarmup", base, warmup_steps, start_lr, end_lr)
+    return _decay("LinearWarmup", learning_rate, warmup_steps, start_lr,
+                  end_lr)
 
 
 # ----------------------------------------------------------------- utilities
@@ -592,9 +605,9 @@ NOT_PROVIDED = {
              "the class form in 2.0",
     "Switch": "use fluid.layers.case / switch_case (functional forms)",
     "IfElse": "use fluid.layers.cond (functional form)",
-    "reorder_lod_tensor_by_rank": "LoD-rank reordering was a CPU "
-        "DataFeed detail; the native DataFeed batcher owns ordering "
-        "here (paddle_tpu/native/src/datafeed.cc)",
+    "reorder_lod_tensor_by_rank": "capability subsumed by the dense "
+        "rnn stack + native DataFeed ordering (same accounting as "
+        "ops/op_renames.SUBSUMED['reorder_lod_tensor_by_rank'])",
     "ssd_loss": "composed SSD training loss; its ingredient ops "
         "(iou_similarity, bipartite_match, target_assign, box_coder, "
         "multiclass_nms) are all present for the composition",
@@ -603,9 +616,6 @@ NOT_PROVIDED = {
     "deformable_roi_pooling": "deform_conv2d + prroi/psroi pooling "
         "cover the deformable family; the fused deformable-roi kernel "
         "has no XLA mapping",
-    "get_tensor_from_selected_rows": "exported at paddle.* "
-        "(core/selected_rows.py) rather than under layers",
-    "merge_selected_rows": "exported at paddle.* (core/selected_rows)",
 }
 
 

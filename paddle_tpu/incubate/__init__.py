@@ -3,3 +3,65 @@ paddle.incubate 2.x): experimental features that graduated into the core
 packages here — re-exported for API parity."""
 from . import checkpoint  # noqa: F401
 from . import optimizer  # noqa: F401
+
+
+class LayerHelper:
+    """reference fluid/layer_helper.py LayerHelper — the static-graph
+    op-authoring helper (create_parameter / append_op / activation).
+    Thin form over static.program; kept for incubate parity (custom
+    layer recipes written against it)."""
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        from ..static.program import create_parameter
+        return create_parameter(shape, dtype,
+                                initializer=default_initializer)
+
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None):
+        from ..core.dispatch import get_op
+        fn = get_op(type)
+        if fn is None:
+            raise ValueError(f"LayerHelper.append_op: unknown op {type!r}")
+        ins = [v for v in (inputs or {}).values()]
+        flat = []
+        for v in ins:
+            flat.extend(v if isinstance(v, (list, tuple)) else [v])
+        return fn(*flat, **(attrs or {}))
+
+    def append_activation(self, out, act=None):
+        if act is None:
+            act = self.kwargs.get("act")
+        if act is None:
+            return out
+        from ..nn import functional as F
+        return getattr(F, act)(out)
+
+
+def load_op_library(lib_filename):
+    from ..utils import load_op_library as _l
+    return _l(lib_filename)
+
+
+from ..io import DataLoader as _DL  # noqa: E402
+
+
+class reader:  # noqa: N801 - module-style shim (reference contrib.reader)
+    """reference fluid/contrib/reader (distributed_reader decorator)."""
+
+    @staticmethod
+    def distributed_batch_reader(batch_reader):
+        """Shard a batch reader across trainers by round-robin (reference
+        contrib/reader/distributed_reader.py)."""
+        import os
+
+        def rd():
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+            for i, b in enumerate(batch_reader()):
+                if i % nranks == rank:
+                    yield b
+        return rd

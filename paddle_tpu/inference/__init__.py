@@ -279,3 +279,44 @@ def capi_so_path() -> str:
     ctypes binding pattern (Go/Rust/C bind the same symbols)."""
     from ..native import capi_so_path as _p
     return _p()
+
+
+class DataType:
+    """reference inference/api/paddle_api.h PaddleDType enum surface."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType:
+    """reference paddle_api.h PaddlePlace."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    TPU = 2  # the accelerator here
+
+
+class PrecisionType:
+    """reference paddle_analysis_config.h Precision."""
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+                DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+                DataType.BFLOAT16: 2}
+
+
+def get_num_bytes_of_data_type(dtype):
+    """reference pybind inference_api.cc get_num_bytes_of_data_type."""
+    try:
+        return _DTYPE_BYTES[dtype]
+    except KeyError:
+        raise ValueError(f"unknown inference DataType {dtype!r}")

@@ -74,6 +74,14 @@ class BeamSearchDecoder(Decoder):
         self.output_fn = output_fn
         self._impute_finished = False
 
+    @property
+    def tracks_own_finished(self):
+        """True (reference rnn.py BeamSearchDecoder:1321): beams are
+        REORDERED every step, so slot j's finished flag belongs to a
+        different hypothesis each step — dynamic_decode must take the
+        decoder's own flags instead of OR-accumulating by slot."""
+        return True
+
     @staticmethod
     def tile_beam_merge_with_batch(x, beam_size):
         """[B, ...] → [B*beam, ...] by repeating each batch row beam_size
@@ -316,13 +324,19 @@ class SampleEmbeddingHelper(GreedyEmbeddingHelper):
         self.seed = seed
 
     def sample(self, time, outputs, states):
-        from ..distribution import Categorical
+        import jax
         logits = outputs if self.temperature is None \
             else outputs / self.temperature
-        flat = Categorical(logits)
-        s = flat.sample([1])
-        return MP.reshape(MP.transpose(s, [1, 0])
-                          if len(s.shape) > 1 else s, [-1])
+        if self.seed is not None:
+            # deterministic per-(seed, step) stream — the reference's
+            # seeded sampling_id contract
+            key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                     int(time))
+        else:
+            from ..core import random as _random
+            key = _random.next_key()
+        ids = jax.random.categorical(key, logits._value.astype("float32"))
+        return Tensor(ids.astype("int64"))
 
 
 class BasicDecoder(Decoder):

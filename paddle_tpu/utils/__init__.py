@@ -121,3 +121,37 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     return _flops(net, input_size, custom_ops=custom_ops,
                   print_detail=print_detail)
 from . import crypto  # noqa: F401
+
+
+from . import profiler  # noqa: E402  (paddle.utils.profiler module)
+from .profiler import Profiler, ProfilerOptions, get_profiler  # noqa: E402
+from . import cpp_extension  # noqa: E402
+
+
+def load_op_library(lib_filename):
+    """reference fluid framework load_op_library (pybind custom-op
+    registration). Custom native code binds through ctypes here — return
+    the loaded library handle; ops register via the @op decorator from
+    python."""
+    import ctypes
+    return ctypes.CDLL(lib_filename)
+
+
+def require_version(min_version, max_version=None):
+    """reference fluid require_version — see fluid/__init__.py."""
+    from ..fluid import require_version as _rv
+    return _rv(min_version, max_version)
+
+
+class OpLastCheckpointChecker:
+    """reference utils/op_version.py-era checkpoint checker over the op
+    version registry; this framework versions ops implicitly with the
+    package (no per-op version bumps), so every query answers the
+    package version."""
+
+    def __init__(self):
+        from .. import __version__
+        self.version = __version__
+
+    def check(self, op_name, *args, **kwargs):
+        return self.version

@@ -1,0 +1,75 @@
+"""paddle.utils.cpp_extension — custom-op build helpers.
+
+Reference: utils/cpp_extension/ builds pybind11 custom C++/CUDA ops with
+setuptools. This framework's native boundary is ctypes over plain C
+symbols (no pybind11 in the image; see paddle_tpu/native/__init__.py
+for the in-tree pattern: g++ -shared + ctypes signatures). `load`
+builds a shared library the same way and hands back a ctypes.CDLL; the
+setuptools Extension wrappers delegate to the standard machinery.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+
+__all__ = ["CppExtension", "CUDAExtension", "BuildExtension", "load",
+           "setup", "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle_tpu_ext"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False):
+    """Compile C++ sources into <build_dir>/<name>.so and dlopen it via
+    ctypes (custom ops then register through the C API / ctypes, the
+    native pattern this framework uses for its own datafeed/crypto)."""
+    import ctypes
+    build_dir = build_directory or get_build_directory()
+    out = os.path.join(build_dir, f"{name}.so")
+    cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+           + (extra_cxx_cflags or [])
+           + [f"-I{p}" for p in (extra_include_paths or [])]
+           + list(sources) + ["-o", out] + (extra_ldflags or []))
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return ctypes.CDLL(out)
+
+
+class CppExtension:
+    """setuptools.Extension-style record (reference cpp_extension
+    CppExtension); consumed by `setup` below."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+        self.name = kwargs.get("name")
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension: no CUDA toolchain on the TPU stack; write the "
+        "device computation as a pallas kernel (ops/pallas/ in-tree "
+        "examples) and keep host-side helpers in a CppExtension")
+
+
+class BuildExtension:
+    @staticmethod
+    def with_options(**kwargs):
+        return BuildExtension
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Build each CppExtension in place with `load` (the no-setuptools
+    fast path; a full packaging flow can still call setuptools
+    directly)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    return [load(e.name or name or "custom_ext", e.sources)
+            for e in exts if e is not None]

@@ -1,0 +1,63 @@
+"""paddle.utils.profiler (reference utils/profiler.py: ProfilerOptions,
+Profiler context manager, get_profiler) over paddle_tpu.profiler."""
+from __future__ import annotations
+
+__all__ = ["ProfilerOptions", "Profiler", "get_profiler"]
+
+
+class ProfilerOptions:
+    """reference utils/profiler.py:26 — dict-style option bag."""
+
+    def __init__(self, options=None):
+        self.options = {
+            "state": "All", "sorted_key": "default",
+            "tracer_level": "Default", "batch_range": [0, 100],
+            "output_thread_detail": False, "profile_path": "none",
+            "timeline_path": "none", "op_summary_path": "none",
+        }
+        if options is not None:
+            self.options.update(options)
+
+    def with_state(self, state):
+        new = ProfilerOptions(dict(self.options))
+        new.options["state"] = state
+        return new
+
+    def __getitem__(self, name):
+        return self.options[name]
+
+
+class Profiler:
+    """Context manager starting/stopping the framework profiler
+    (reference utils/profiler.py:63)."""
+
+    def __init__(self, enabled=True, options=None):
+        self.enabled = enabled
+        self.profiler_options = options or ProfilerOptions()
+
+    def __enter__(self):
+        if self.enabled:
+            from ..profiler import start_profiler
+            start_profiler()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if self.enabled:
+            from ..profiler import stop_profiler
+            path = self.profiler_options["profile_path"]
+            stop_profiler(sorted_key=self.profiler_options["sorted_key"],
+                          profile_path=path)
+        return False
+
+    def reset_profile(self):
+        from ..profiler import reset_profiler
+        reset_profiler()
+
+    def record_step(self, change_profiler_status=True):
+        pass  # batch_range gating is a reference scheduling detail
+
+
+def get_profiler():
+    if not hasattr(get_profiler, "_inst"):
+        get_profiler._inst = Profiler()
+    return get_profiler._inst

@@ -283,3 +283,65 @@ def test_static_compat_helpers(tmp_path):
     p = str(tmp_path / "blob.bin")
     static.save_to_file(p, b"xyz")
     assert static.load_from_file(p) == b"xyz"
+
+
+def test_aux_namespace_parity():
+    """utils / incubate / inference / reader / dataset — the remaining
+    reference namespaces, audited the same mechanical way."""
+    import paddle_tpu as p
+    mods = {"utils": p.utils, "incubate": p.incubate,
+            "inference": p.inference, "reader": p.reader,
+            "dataset": p.dataset}
+    problems = {}
+    for name, mod in mods.items():
+        # `import paddle.reader.decorator` inside reader/__init__ makes
+        # the ast walker emit the module's own name — not an export
+        missing = sorted(n for n in _reference_module_names(name)
+                         if n != name and not hasattr(mod, n))
+        if missing:
+            problems[name] = missing
+    assert not problems, f"aux namespaces missing: {problems}"
+
+
+def test_reader_decorators():
+    import paddle_tpu as p
+    r10 = lambda: iter(range(10))
+    assert sorted(p.reader.shuffle(r10, 4)()) == list(range(10))
+    assert list(p.reader.firstn(r10, 3)()) == [0, 1, 2]
+    assert list(p.reader.chain(r10, r10)()) == list(range(10)) * 2
+    assert list(p.reader.map_readers(lambda a, b: a + b, r10, r10)()) == \
+        [2 * i for i in range(10)]
+    assert list(p.reader.compose(r10, r10)()) == \
+        [(i, i) for i in range(10)]
+    with pytest.raises(p.reader.ComposeNotAligned):
+        list(p.reader.compose(r10, lambda: iter(range(5)))())
+    assert sorted(p.reader.buffered(r10, 2)()) == list(range(10))
+    out = list(p.reader.xmap_readers(lambda x: x * 2, r10, 3, 4,
+                                     order=True)())
+    assert out == [2 * i for i in range(10)]
+    cached = p.reader.cache(r10)
+    assert list(cached()) == list(cached())
+
+
+def test_dataset_reader_adapters():
+    import paddle_tpu as p
+    img, lab = next(p.dataset.mnist.train()())
+    assert img.shape == (784,) and 0 <= lab < 10
+    x, y = next(p.dataset.uci_housing.test()())
+    assert x.shape == (13,)
+    ids, label = next(p.dataset.imdb.train(None)())
+    assert isinstance(ids, list) and label in (0, 1)
+    gram = next(p.dataset.imikolov.train(None, 5)())
+    assert len(gram) >= 2
+    # fluid-era pipeline end to end: batch over a dataset reader
+    b = p.batch(p.dataset.uci_housing.train(), 8)
+    first = next(b())
+    assert len(first) == 8
+    # image transforms
+    im = np.arange(32 * 48 * 3, dtype=np.uint8).reshape(32, 48, 3)
+    small = p.dataset.image.resize_short(im, 16)
+    assert min(small.shape[:2]) == 16
+    crop = p.dataset.image.center_crop(small, 12)
+    assert crop.shape[:2] == (12, 12)
+    chw = p.dataset.image.to_chw(crop)
+    assert chw.shape[0] == 3
