@@ -28,7 +28,8 @@ BLACK_LIST = {
     "softmax_with_cross_entropy_keepdim", "cross_entropy",
     "cross_entropy_probs", "bce_loss", "bce_with_logits",
     "sigmoid_cross_entropy_with_logits", "c_softmax_with_cross_entropy",
-    "layer_norm", "batch_norm_train", "batch_norm_infer", "p_norm",
+    "layer_norm", "batch_norm_train", "batch_norm_infer",
+    "fused_bn_add_act_train", "p_norm",
     "frobenius_norm", "softmax", "log_softmax", "logsumexp", "cumsum",
     "nll_loss", "kl_div", "mse_loss", "l1_loss",
 }

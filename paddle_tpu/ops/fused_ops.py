@@ -397,13 +397,16 @@ def fused_bn_add_activation(x, y, running_mean, running_var, weight, bias,
                             momentum=0.9, epsilon=1e-5, activation="relu",
                             name=None):
     """reference: operators/fused/fused_bn_add_activation_op.cc —
-    act(BN(x) + y)."""
-    from ..nn.functional.norm import batch_norm
+    act(BN(x) + y). relu rides the residual-light fused kernel
+    (nn/functional/norm.py batch_norm_act)."""
+    from ..nn.functional.norm import batch_norm, batch_norm_act
+    if activation == "relu":
+        return batch_norm_act(x, running_mean, running_var, weight, bias,
+                              training=True, momentum=momentum,
+                              epsilon=epsilon, add=y)
     out = batch_norm(x, running_mean, running_var, weight, bias,
                      training=True, momentum=momentum, epsilon=epsilon)
     z = _wrap(out)._value + _wrap(y)._value
-    if activation == "relu":
-        z = jax.nn.relu(z)
     return Tensor(z)
 
 

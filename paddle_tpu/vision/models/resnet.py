@@ -13,6 +13,28 @@ import functools
 from ... import nn
 
 
+def _bn_relu(bn, x, add=None):
+    """relu(bn(x) [+ add]) — fused residual-light path when training
+    (FLAGS_fuse_bn_act, default on): saves one full activation tensor per
+    BN site vs the composed ops (see nn/functional/norm.py batch_norm_act;
+    reference fuse_bn_act_pass.cc / fused_bn_add_activation_op.cc)."""
+    from ...core import flags as _flags
+    from ...nn import functional as F
+    from ...nn.layer.norm import _BatchNormBase
+    # fused path only for plain BatchNorm layers: a custom norm_layer
+    # (GroupNorm, frozen-stats BN, ...) takes its own forward()
+    if (_flags.flag("fuse_bn_act") and isinstance(bn, _BatchNormBase)
+            and not bn._use_global_stats):
+        return F.batch_norm_act(
+            x, bn._mean, bn._variance, bn.weight, bn.bias,
+            training=bn.training, momentum=bn._momentum,
+            epsilon=bn._epsilon, data_format=bn._data_format, add=add)
+    out = bn(x)
+    if add is not None:
+        out = out + add
+    return F.relu(out)
+
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
@@ -34,11 +56,11 @@ class BasicBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.bn2(self.conv2(out))
+        out = _bn_relu(self.bn1, self.conv1(x))
+        out = self.conv2(out)
         if self.downsample is not None:
             identity = self.downsample(x)
-        return self.relu(out + identity)
+        return _bn_relu(self.bn2, out, add=identity)
 
 
 class BottleneckBlock(nn.Layer):
@@ -67,12 +89,12 @@ class BottleneckBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
+        out = _bn_relu(self.bn1, self.conv1(x))
+        out = _bn_relu(self.bn2, self.conv2(out))
+        out = self.conv3(out)
         if self.downsample is not None:
             identity = self.downsample(x)
-        return self.relu(out + identity)
+        return _bn_relu(self.bn3, out, add=identity)
 
 
 class ResNet(nn.Layer):
@@ -172,7 +194,7 @@ class ResNet(nn.Layer):
             x = self._stem_s2d(x)
         else:
             x = self.conv1(x)
-        x = self.relu(self.bn1(x))
+        x = _bn_relu(self.bn1, x)
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -115,6 +115,73 @@ def test_batch_norm_train_and_eval():
     np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
 
 
+def test_fused_bn_act_matches_composed():
+    """batch_norm_act (residual-light fused bn+(add+)relu, the
+    fuse_bn_act_pass.cc / fused_bn_add_activation_op.cc analogue) must
+    match composed bn -> (+z) -> relu in outputs, grads and running
+    stats."""
+    np.random.seed(7)
+    x_np = np.random.randn(4, 6, 5, 5).astype("float32")
+    z_np = np.random.randn(4, 6, 5, 5).astype("float32")
+    w_np = (np.random.rand(6) + 0.5).astype("float32")
+    b_np = (np.random.randn(6) * 0.1).astype("float32")
+
+    for use_add in (False, True):
+        ts = []
+        for fused in (False, True):
+            x = paddle.to_tensor(x_np); x.stop_gradient = False
+            z = paddle.to_tensor(z_np); z.stop_gradient = False
+            w = paddle.to_tensor(w_np); w.stop_gradient = False
+            b = paddle.to_tensor(b_np); b.stop_gradient = False
+            rm = paddle.to_tensor(np.zeros(6, "float32"))
+            rv = paddle.to_tensor(np.ones(6, "float32"))
+            if fused:
+                out = F.batch_norm_act(x, rm, rv, w, b, training=True,
+                                       add=z if use_add else None)
+            else:
+                out = F.batch_norm(x, rm, rv, w, b, training=True)
+                if use_add:
+                    out = out + z
+                out = F.relu(out)
+            (out * out).sum().backward()
+            ts.append((out, x.grad, z.grad if use_add else None,
+                       w.grad, b.grad, rm, rv))
+        for a, bb in zip(ts[0], ts[1]):
+            if a is None:
+                assert bb is None
+                continue
+            np.testing.assert_allclose(a.numpy(), bb.numpy(),
+                                       rtol=2e-5, atol=2e-5)
+    # eval mode goes through the inference path
+    bn_args = (paddle.to_tensor(np.zeros(6, "float32")),
+               paddle.to_tensor(np.ones(6, "float32")))
+    xe = paddle.to_tensor(x_np)
+    fe = F.batch_norm_act(xe, *bn_args, paddle.to_tensor(w_np),
+                          paddle.to_tensor(b_np), training=False)
+    ce = F.relu(F.batch_norm(xe, *bn_args, paddle.to_tensor(w_np),
+                             paddle.to_tensor(b_np), training=False))
+    np.testing.assert_allclose(fe.numpy(), ce.numpy(), rtol=1e-6)
+
+
+def test_resnet_blocks_custom_norm_and_frozen_stats():
+    """the fused bn+relu fast path must not hijack custom norm layers or
+    frozen-stats BN (use_global_stats=True keeps running stats untouched
+    and normalizes with them even in train mode)."""
+    import functools
+    from paddle_tpu.vision.models.resnet import BottleneckBlock
+    # custom norm layer: GroupNorm has none of BatchNorm's private attrs
+    blk = BottleneckBlock(64, 16, norm_layer=lambda c: nn.GroupNorm(4, c))
+    out = blk(paddle.to_tensor(np.random.randn(2, 64, 8, 8).astype("float32")))
+    assert out.shape == [2, 64, 8, 8]
+    # frozen-stats BN: running stats must survive a train-mode forward
+    frozen = functools.partial(nn.BatchNorm2D, use_global_stats=True)
+    blk2 = BottleneckBlock(64, 16, norm_layer=frozen)
+    rm_before = blk2.bn1._mean.numpy().copy()
+    blk2.train()
+    blk2(paddle.to_tensor(np.random.randn(2, 64, 8, 8).astype("float32")))
+    np.testing.assert_array_equal(blk2.bn1._mean.numpy(), rm_before)
+
+
 def test_losses_match_torch():
     logits = np.random.randn(8, 5).astype("float32")
     labels = np.random.randint(0, 5, 8)

@@ -1,0 +1,74 @@
+"""ResNet-50 perf variant sweep (round-4 carry-over: 2,606 -> >=2,800 imgs/s).
+
+Run one variant per process (XLA_FLAGS are process-level):
+    python tools/resnet_sweep.py <variant>
+Variants: base (fused bn+relu, the default), nofuse (FLAGS_fuse_bn_act=0,
+the round-3 path), lhs (latency-hiding scheduler), vmem (bigger scoped
+vmem), combo.
+
+Prints one JSON line {"variant": ..., "imgs_per_sec": ...}.
+"""
+import json
+import os
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+
+_FLAGS = {
+    "lhs": "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "vmem": "--xla_tpu_scoped_vmem_limit_kib=98304",
+    "combo": ("--xla_tpu_enable_latency_hiding_scheduler=true "
+              "--xla_tpu_scoped_vmem_limit_kib=98304"),
+}
+if VARIANT in _FLAGS:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " +
+                               _FLAGS[VARIANT]).strip()
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    if VARIANT == "nofuse":
+        paddle.set_flags({"FLAGS_fuse_bn_act": False})
+    model = resnet50(num_classes=1000)
+    optim = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    model, optim = paddle.amp.decorate(model, optim, level="O2",
+                                       dtype="bfloat16")
+    bs = 128
+    step = paddle.jit.TrainStep(
+        model, lambda m, x, y: paddle.nn.functional.cross_entropy(
+            m(x), y), optim)
+    x = paddle.to_tensor(
+        np.random.randn(bs, 3, 224, 224).astype(np.float32)).astype(
+            "bfloat16")
+    y = paddle.to_tensor(
+        np.random.randint(0, 1000, (bs, 1)).astype(np.int64))
+    import jax.numpy as jnp
+    drain = jax.jit(jnp.sum)
+
+    def _drain():
+        return float(np.asarray(drain(model.parameters()[-1]._value)))
+
+    step(x, y)
+    step(x, y)
+    _drain()
+
+    best = 0.0
+    for _rep in range(3):
+        n = 30
+        t0 = time.perf_counter()
+        for _ in range(n):
+            step(x, y)
+        _drain()
+        best = max(best, n * bs / (time.perf_counter() - t0))
+    print(json.dumps({"variant": VARIANT, "imgs_per_sec": round(best, 1)}))
+
+
+if __name__ == "__main__":
+    main()
