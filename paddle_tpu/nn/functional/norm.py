@@ -32,6 +32,14 @@ def _wrap(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _channel_axis(x, data_format):
+    """Channel axis under a paddle data_format string; 2-D inputs are
+    always [N, C] regardless of the format tag."""
+    if x.ndim == 2:
+        return 1
+    return x.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else 1
+
+
 def _apply_scale_shift(x, mean, var, weight, bias, eps, c_axis):
     """Fold (mean, var, weight, bias) into per-channel scale/shift computed
     in fp32, then apply in x's own dtype. For bf16 activations this keeps
@@ -191,7 +199,17 @@ def _bn_act_bwd(eps, c_axis, res, cts):
     if z is not None:
         pre = pre + z
     gym = jnp.where(pre > 0, gy, jnp.zeros((), gy.dtype))
-    dz = None if z is None else gym
+    if z is None:
+        dz = None
+    else:
+        # z may be broadcastable (e.g. [1, C, 1, 1]): reduce the cotangent
+        # back to z's shape like lax's broadcast transpose does
+        lead = gym.ndim - z.ndim
+        bcast = tuple(range(lead)) + tuple(
+            lead + i for i, d in enumerate(z.shape)
+            if d == 1 and gym.shape[lead + i] != 1)
+        dz = jnp.sum(gym, axis=bcast, keepdims=False).reshape(z.shape) \
+            if bcast else gym
     dx, dw, db = _bn_core_bwd(eps, c_axis, (x, weight, bias, mean, var),
                               (gym, g_mean, g_var))
     return dx, dz, dw, db
@@ -212,9 +230,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     running = momentum*running + (1-momentum)*batch, batch_norm_op.cc
     attr 'momentum' default 0.9)."""
     x = _wrap(x)
-    c_axis = x.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else 1
-    if x.ndim == 2:
-        c_axis = 1
+    c_axis = _channel_axis(x, data_format)
     use_stats = (not training) if use_global_stats is None else use_global_stats
     if use_stats:
         return _bn_infer(x, _wrap(running_mean), _wrap(running_var),
@@ -255,20 +271,25 @@ def _update_running_stats(running_mean, running_var, mean, var, momentum):
 
 def batch_norm_act(x, running_mean, running_var, weight=None, bias=None,
                    training=False, momentum=0.9, epsilon=1e-5,
-                   data_format="NCHW", add=None, name=None):
+                   data_format="NCHW", add=None, use_global_stats=None,
+                   name=None):
     """relu(batch_norm(x) [+ add]) with a residual-light fused backward:
     only the BN *input* is kept for autodiff (the relu mask is recomputed
     affine from it), vs the composed path's input + pre-relu output.
 
     TPU-native analogue of the reference's fuse_bn_act_pass.cc /
     fused_bn_add_activation_op.cc (act='relu'); the byte savings matter
-    because ResNet-class conv nets are HBM-bound on v5e."""
+    because ResNet-class conv nets are HBM-bound on v5e.
+
+    use_global_stats follows batch_norm's semantics exactly (None → infer
+    from `training`; explicit False → batch stats + EMA update even in
+    eval), so the fused and composed paths never diverge."""
     x = _wrap(x)
-    c_axis = x.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else 1
-    if x.ndim == 2:
-        c_axis = 1
+    c_axis = _channel_axis(x, data_format)
     z = None if add is None else _wrap(add)
-    if not training:
+    use_stats = (not training) if use_global_stats is None \
+        else use_global_stats
+    if use_stats:
         out = _bn_infer(x, _wrap(running_mean), _wrap(running_var),
                         None if weight is None else _wrap(weight),
                         None if bias is None else _wrap(bias),
@@ -413,9 +434,7 @@ def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
     """Cross-replica batch norm (reference: sync_batch_norm_op.cu +
     nn.SyncBatchNorm). sync_axes: mesh axes to average stats over."""
     xt = _wrap(x)
-    c_axis = xt.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else 1
-    if xt.ndim == 2:
-        c_axis = 1
+    c_axis = _channel_axis(xt, data_format)
     if not training:
         return batch_norm(x, running_mean, running_var, weight, bias,
                           training=False, momentum=momentum,

@@ -22,13 +22,16 @@ def _bn_relu(bn, x, add=None):
     from ...nn import functional as F
     from ...nn.layer.norm import _BatchNormBase
     # fused path only for plain BatchNorm layers: a custom norm_layer
-    # (GroupNorm, frozen-stats BN, ...) takes its own forward()
+    # (GroupNorm, a subclass with its own forward, ...) keeps its own path.
+    # use_global_stats passes through verbatim so explicit-False (batch
+    # stats even in eval) matches the composed batch_norm exactly.
     if (_flags.flag("fuse_bn_act") and isinstance(bn, _BatchNormBase)
-            and not bn._use_global_stats):
+            and type(bn).forward is _BatchNormBase.forward):
         return F.batch_norm_act(
             x, bn._mean, bn._variance, bn.weight, bn.bias,
             training=bn.training, momentum=bn._momentum,
-            epsilon=bn._epsilon, data_format=bn._data_format, add=add)
+            epsilon=bn._epsilon, data_format=bn._data_format, add=add,
+            use_global_stats=bn._use_global_stats)
     out = bn(x)
     if add is not None:
         out = out + add

@@ -182,6 +182,59 @@ def test_resnet_blocks_custom_norm_and_frozen_stats():
     np.testing.assert_array_equal(blk2.bn1._mean.numpy(), rm_before)
 
 
+def test_fused_bn_act_explicit_false_global_stats_in_eval():
+    """use_global_stats=False is NOT the same as None: in eval mode it
+    still normalizes with batch stats and updates the EMA (batch_norm
+    semantics). The fused path must match the composed path exactly."""
+    np.random.seed(3)
+    x_np = np.random.randn(4, 6, 5, 5).astype("float32") + 2.0
+    outs, stats = [], []
+    for fused in (False, True):
+        rm = paddle.to_tensor(np.zeros(6, "float32"))
+        rv = paddle.to_tensor(np.ones(6, "float32"))
+        x = paddle.to_tensor(x_np)
+        if fused:
+            out = F.batch_norm_act(x, rm, rv, training=False,
+                                   use_global_stats=False)
+        else:
+            out = F.relu(F.batch_norm(x, rm, rv, training=False,
+                                      use_global_stats=False))
+        outs.append(out.numpy())
+        stats.append((rm.numpy(), rv.numpy()))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-5, atol=2e-5)
+    for a, b in zip(stats[0], stats[1]):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+        assert not np.allclose(a, 0.0) or not np.allclose(b, 1.0)
+    # and the EMA actually moved (mean shifted toward the +2 batch mean)
+    assert stats[0][0].mean() > 0.05
+
+
+def test_fused_bn_act_broadcastable_add_backward():
+    """batch_norm_act with a broadcastable residual (e.g. a per-channel
+    bias [1, C, 1, 1]) must reduce the z-cotangent to z's shape instead of
+    crashing in the custom-vjp backward."""
+    np.random.seed(4)
+    x_np = np.random.randn(4, 6, 5, 5).astype("float32")
+    z_np = np.random.randn(1, 6, 1, 1).astype("float32")
+    grads = []
+    for fused in (False, True):
+        x = paddle.to_tensor(x_np); x.stop_gradient = False
+        z = paddle.to_tensor(z_np); z.stop_gradient = False
+        rm = paddle.to_tensor(np.zeros(6, "float32"))
+        rv = paddle.to_tensor(np.ones(6, "float32"))
+        if fused:
+            out = F.batch_norm_act(x, rm, rv, training=True, add=z)
+        else:
+            out = F.relu(F.batch_norm(x, rm, rv, training=True) + z)
+        (out * out).sum().backward()
+        grads.append((x.grad.numpy(), z.grad.numpy()))
+    assert grads[1][1].shape == z_np.shape
+    np.testing.assert_allclose(grads[0][0], grads[1][0], rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(grads[0][1], grads[1][1], rtol=2e-5,
+                               atol=2e-4)
+
+
 def test_losses_match_torch():
     logits = np.random.randn(8, 5).astype("float32")
     labels = np.random.randint(0, 5, 8)

@@ -4,7 +4,8 @@ Run one variant per process (XLA_FLAGS are process-level):
     python tools/resnet_sweep.py <variant>
 Variants: base (fused bn+relu, the default), nofuse (FLAGS_fuse_bn_act=0,
 the round-3 path), lhs (latency-hiding scheduler), vmem (bigger scoped
-vmem), combo.
+vmem), combo (lhs+vmem), nhwc (channel-last + s2d stem, no flags),
+nhwc_combo (nhwc + the combo flags), bs192 (batch 192).
 
 Prints one JSON line {"variant": ..., "imgs_per_sec": ...}.
 """
@@ -15,11 +16,13 @@ import time
 
 VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
 
+_COMBO = ("--xla_tpu_enable_latency_hiding_scheduler=true "
+          "--xla_tpu_scoped_vmem_limit_kib=98304")
 _FLAGS = {
     "lhs": "--xla_tpu_enable_latency_hiding_scheduler=true",
     "vmem": "--xla_tpu_scoped_vmem_limit_kib=98304",
-    "combo": ("--xla_tpu_enable_latency_hiding_scheduler=true "
-              "--xla_tpu_scoped_vmem_limit_kib=98304"),
+    "combo": _COMBO,
+    "nhwc_combo": _COMBO,
 }
 if VARIANT in _FLAGS:
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " +
@@ -36,17 +39,22 @@ def main():
     paddle.seed(0)
     if VARIANT == "nofuse":
         paddle.set_flags({"FLAGS_fuse_bn_act": False})
-    model = resnet50(num_classes=1000)
+    nhwc = VARIANT.startswith("nhwc")
+    if nhwc:
+        model = resnet50(num_classes=1000, data_format="NHWC",
+                         stem_space_to_depth=True)
+    else:
+        model = resnet50(num_classes=1000)
     optim = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
     model, optim = paddle.amp.decorate(model, optim, level="O2",
                                        dtype="bfloat16")
-    bs = 128
+    bs = 192 if VARIANT == "bs192" else 128
     step = paddle.jit.TrainStep(
         model, lambda m, x, y: paddle.nn.functional.cross_entropy(
             m(x), y), optim)
+    shp = (bs, 224, 224, 3) if nhwc else (bs, 3, 224, 224)
     x = paddle.to_tensor(
-        np.random.randn(bs, 3, 224, 224).astype(np.float32)).astype(
-            "bfloat16")
+        np.random.randn(*shp).astype(np.float32)).astype("bfloat16")
     y = paddle.to_tensor(
         np.random.randint(0, 1000, (bs, 1)).astype(np.int64))
     import jax.numpy as jnp
