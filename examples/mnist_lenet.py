@@ -27,7 +27,9 @@ def main():
 
     for epoch in range(args.epochs):
         losses = []
-        for x, y in loader:
+        # DeviceLoader double-buffers the host->HBM transfer: batch N+1 is
+        # already in flight while the compiled step runs batch N
+        for x, y in paddle.io.DeviceLoader(loader, size=2):
             losses.append(float(step(x, y).numpy()))
         print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
 

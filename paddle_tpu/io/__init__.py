@@ -20,4 +20,5 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .device_loader import DeviceLoader  # noqa: F401
 from .dataset_native import InMemoryDataset, QueueDataset  # noqa: F401,E402

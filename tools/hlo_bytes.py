@@ -40,10 +40,7 @@ def shape_bytes(text: str) -> int:
     return total
 
 
-def main():
-    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/rn_hlo.txt"
-    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
-    text = open(path).read()
+def audit_text(text: str, top_n: int = 30):
     # find ENTRY block
     i = text.index("\nENTRY ")
     entry = text[i + 1:]
@@ -98,6 +95,13 @@ def main():
     print(f"\n== >40MB fp32 outputs: {len(big_f32)} ==")
     for ob, name, line in big_f32[:15]:
         print(f"{ob/1e6:9.1f} {name[:60]}")
+    return rows
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/rn_hlo.txt"
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    audit_text(open(path).read(), top_n)
 
 
 if __name__ == "__main__":

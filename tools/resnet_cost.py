@@ -2,86 +2,21 @@
 
 Compiles the bench-identical step, then reports:
   1. compiled.cost_analysis() aggregate flops / bytes accessed
-  2. the top-N optimized-HLO instructions by (output + operand) bytes --
-     the byte hogs that set the step time on an HBM-bound net.
+  2. memory_analysis (args/output/temp sizes)
+  3. the optimized-HLO byte ranking via tools/hlo_bytes.py (shared parser)
+The optimized HLO text is also dumped to /tmp/rn_hlo.txt for ad-hoc greps.
 
-Usage:  python tools/resnet_cost.py [top_n]
+Usage:  PYTHONPATH=/root/repo:/root/.axon_site python tools/resnet_cost.py [top_n]
 """
 from __future__ import annotations
 
-import re
+import os
 import sys
 
 import numpy as np
 
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
-                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-                "f64": 8, "c64": 8, "c128": 16}
-
-_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
-
-
-def shape_bytes(text: str) -> int:
-    """Sum the byte sizes of every shape literal in an HLO type string
-    (handles tuples by summing members)."""
-    total = 0
-    for dt, dims in _SHAPE_RE.findall(text):
-        b = _DTYPE_BYTES.get(dt)
-        if b is None:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * b
-    return total
-
-
-def audit_hlo(hlo_text: str, top_n: int = 25):
-    """Rank instructions of the entry computation by bytes moved.
-
-    For fusions, operands are the parameters (shapes appear in the callsite
-    operand list) and the output is the lhs type. This over-counts reuse
-    inside XLA's scheduler but matches HBM traffic to first order.
-    """
-    rows = []
-    in_entry = False
-    for line in hlo_text.splitlines():
-        s = line.strip()
-        if s.startswith("ENTRY "):
-            in_entry = True
-            continue
-        if in_entry and s == "}":
-            break
-        if not in_entry or "=" not in s:
-            continue
-        lhs, rhs = s.split("=", 1)
-        m = re.match(r"\s*((?:\([^)]*\)|[a-z0-9_\[\],.]+))\s+"
-                     r"(%?[\w.-]+)\(", rhs.strip())
-        if not m:
-            continue
-        out_type, opname = m.group(1), m.group(2)
-        out_b = shape_bytes(out_type)
-        # operand shapes: everything inside the top-level parens
-        args = rhs[rhs.index("("):]
-        arg_b = shape_bytes(args)
-        kind = opname.lstrip("%").split(".")[0]
-        rows.append((out_b + arg_b, out_b, arg_b, kind,
-                     lhs.strip()[:48], s[:140]))
-    rows.sort(reverse=True)
-    total = sum(r[0] for r in rows)
-    print(f"\n== entry-computation byte audit: {total/1e9:.2f} GB touched "
-          f"(first-order; operand+output, no reuse credit) ==")
-    print(f"{'MB':>9} {'out MB':>8} {'kind':<12} name")
-    for tb, ob, ab, kind, name, _ in rows[:top_n]:
-        print(f"{tb/1e6:9.1f} {ob/1e6:8.1f} {kind:<12} {name}")
-    by_kind = {}
-    for tb, ob, ab, kind, name, _ in rows:
-        by_kind[kind] = by_kind.get(kind, 0) + tb
-    print("\n== bytes by op kind ==")
-    for kind, b in sorted(by_kind.items(), key=lambda kv: -kv[1])[:12]:
-        print(f"{b/1e9:8.2f} GB  {kind}")
-    return rows
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from hlo_bytes import audit_text  # noqa: E402
 
 
 def main():
@@ -133,7 +68,7 @@ def main():
     hlo = compiled.as_text()
     with open("/tmp/rn_hlo.txt", "w") as f:
         f.write(hlo)
-    audit_hlo(hlo, top_n)
+    audit_text(hlo, top_n)
 
 
 if __name__ == "__main__":
