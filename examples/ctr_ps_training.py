@@ -24,6 +24,11 @@ Run: python examples/ctr_ps_training.py [--device_cache]
 """
 import os
 import sys
+
+# runnable as `python examples/<name>.py` from anywhere: the repo
+# root (one level up) must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 import tempfile
 import time
 

@@ -2,8 +2,15 @@
 — greedy KV-cache, temperature sampling, beam search — and serve the
 exported StableHLO decoder without the model class.
 
-Run: PYTHONPATH=. python examples/gpt_generate.py
+Run: python examples/gpt_generate.py
 """
+import os
+import sys
+
+# runnable as `python examples/<name>.py` from anywhere: the repo
+# root (one level up) must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 import tempfile
 
 import numpy as np

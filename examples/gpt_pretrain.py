@@ -5,8 +5,14 @@ SPMD (dp/tp):  python examples/gpt_pretrain.py --dp 2 --tp 2 --sharding 2
 (Test multi-chip layouts anywhere with
  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu.)
 """
-import argparse
 import os
+import sys
+
+# runnable as `python examples/<name>.py` from anywhere: the repo
+# root (one level up) must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import argparse
 
 import jax
 

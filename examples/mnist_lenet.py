@@ -1,6 +1,13 @@
 """Train LeNet on (synthetic-fallback) MNIST — the minimum end-to-end slice
 (BASELINE config 1). Run: python examples/mnist_lenet.py [--epochs N]
 """
+import os
+import sys
+
+# runnable as `python examples/<name>.py` from anywhere: the repo
+# root (one level up) must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 import argparse
 
 import numpy as np

@@ -38,6 +38,8 @@ def _to_device(item, device):
         return Tensor(jax.device_put(np.asarray(item), device))
     if isinstance(item, dict):
         return {k: _to_device(v, device) for k, v in item.items()}
+    if isinstance(item, tuple) and hasattr(item, "_fields"):  # namedtuple
+        return type(item)(*(_to_device(v, device) for v in item))
     if isinstance(item, (list, tuple)):
         return type(item)(_to_device(v, device) for v in item)
     return item  # strings / None / scalars pass through
