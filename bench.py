@@ -203,20 +203,14 @@ def bench_bert(on_tpu: bool):
                                            dtype="bfloat16")
     step = paddle.jit.TrainStep(model, bert_pretrain_loss_fn, optim)
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (bs, seq),
-                                     dtype=np.int32))
-    tt = paddle.to_tensor(rng.randint(0, 2, (bs, seq), dtype=np.int32))
     # masked-position MLM (the reference design: gather mask_pos before
-    # the pretraining head, bert_dygraph_model.py:335): round(0.15*seq)
-    # masked positions/sample — the standard 15% masking rate (19 at
-    # seq 128)
-    P = max(1, int(round(seq * 0.15)))
-    pos = np.stack([rng.choice(seq, P, replace=False) for _ in range(bs)])
-    pos.sort(axis=1)
-    pos_t = paddle.to_tensor(pos.astype(np.int32))
-    mlm_t = paddle.to_tensor(
-        rng.randint(0, cfg.vocab_size, (bs, P)).astype(np.int64))
-    nsp = paddle.to_tensor(rng.randint(0, 2, (bs,)).astype(np.int64))
+    # the pretraining head, bert_dygraph_model.py:335), 15% masking rate
+    from paddle_tpu.models.bert import make_bert_pretrain_batch
+    x_np, tt_np, mlm_np, nsp_np, pos_np = make_bert_pretrain_batch(
+        rng, cfg.vocab_size, bs, seq)
+    x, tt, mlm_t, nsp, pos_t = (paddle.to_tensor(a) for a in
+                                (x_np, tt_np, mlm_np, nsp_np, pos_np))
+    P = pos_np.shape[1]
     step(x, tt, mlm_t, nsp, pos_t)
     step(x, tt, mlm_t, nsp, pos_t)
     _drain(model)

@@ -70,18 +70,12 @@ def main():
 
     bs, seq = args.batch_size, args.seq
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (bs, seq),
-                                     dtype=np.int32))
-    tt = paddle.to_tensor(rng.randint(0, 2, (bs, seq), dtype=np.int32))
     # masked-position MLM (15% of tokens, the reference design:
     # bert_dygraph_model.py:335 gathers mask positions before the head)
-    P = max(1, int(round(seq * 0.15)))
-    pos = np.stack([rng.choice(seq, P, replace=False) for _ in range(bs)])
-    pos.sort(axis=1)
-    pos_t = paddle.to_tensor(pos.astype(np.int32))
-    mlm = paddle.to_tensor(
-        rng.randint(0, cfg.vocab_size, (bs, P)).astype(np.int64))
-    nsp = paddle.to_tensor(rng.randint(0, 2, (bs,)).astype(np.int64))
+    from paddle_tpu.models.bert import make_bert_pretrain_batch
+    x, tt, mlm, nsp, pos_t = (paddle.to_tensor(a) for a in
+                              make_bert_pretrain_batch(
+                                  rng, cfg.vocab_size, bs, seq))
 
     step(x, tt, mlm, nsp, pos_t)  # trace 1: optimizer state
     step(x, tt, mlm, nsp, pos_t)  # trace 2: settled signature

@@ -26,7 +26,8 @@ from ..distributed.tp_layers import (ColumnParallelLinear, RowParallelLinear,
                                      VocabParallelEmbedding)
 
 __all__ = ["BertConfig", "Bert", "BertForPretraining",
-           "bert_pretrain_loss_fn", "bert_base", "ernie_large"]
+           "bert_pretrain_loss_fn", "bert_base", "ernie_large",
+           "make_bert_pretrain_batch"]
 
 
 @dataclass
@@ -189,3 +190,23 @@ def bert_pretrain_loss_fn(model, input_ids, token_type_ids, mlm_labels,
     """loss_fn signature for jit.TrainStep / parallel.ShardedTrainStep."""
     return model.loss(input_ids, token_type_ids, mlm_labels, nsp_labels,
                       masked_positions=masked_positions)
+
+
+def make_bert_pretrain_batch(rng, vocab_size, bs, seq, mask_rate=0.15):
+    """Synthetic MLM+NSP pretraining batch in the masked-position layout
+    the head expects (bench.py, examples/bert_pretrain.py, tools/bert_cost
+    all share this recipe — keep the contract in one place).
+
+    Returns numpy arrays (input_ids, token_type_ids, mlm_labels,
+    nsp_labels, masked_positions); P = round(mask_rate*seq) positions per
+    row, chosen without replacement and SORTED (the gather head's
+    contract)."""
+    import numpy as np
+    x = rng.randint(0, vocab_size, (bs, seq), dtype=np.int32)
+    tt = rng.randint(0, 2, (bs, seq), dtype=np.int32)
+    P = max(1, int(round(seq * mask_rate)))
+    pos = np.stack([rng.choice(seq, P, replace=False) for _ in range(bs)])
+    pos.sort(axis=1)
+    mlm = rng.randint(0, vocab_size, (bs, P)).astype(np.int64)
+    nsp = rng.randint(0, 2, (bs,)).astype(np.int64)
+    return x, tt, mlm, nsp, pos.astype(np.int32)

@@ -23,6 +23,15 @@ from ...core import flags as _flags
 
 _flags.define_flag("use_flash_attention", True,
                    "Use the pallas flash-attention kernel when applicable.")
+_flags.define_flag(
+    "flash_attention_min_seq", 512,
+    "Below this query length the composed XLA path is taken even when the "
+    "flash kernel applies. At short sequences the O(T^2) score matrix is "
+    "small (it is what flash exists to avoid), while the pallas "
+    "custom-call boundary forces materialised layout copies of q/k/v "
+    "around every layer: BERT-base at T=128/d=64 measured 1,029 samples/s "
+    "with flash vs 1,761 composed (+71%) on v5e; GPT at T=1024 measures "
+    "~1.5x the other way. 512 is the crossover region boundary.")
 
 
 def _wrap(x):
@@ -94,8 +103,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     head_dim = q.shape[-1]
     sc = scale if scale is not None else 1.0 / float(np.sqrt(head_dim))
     dropout_active = dropout_p > 0.0 and training
+    q_seq = q.shape[2] if _heads_major else q.shape[1]
     use_flash = (_flags.flag("use_flash_attention") and attn_mask is None
-                 and not dropout_active)
+                 and not dropout_active
+                 and q_seq >= _flags.flag("flash_attention_min_seq"))
     if use_flash:
         try:
             from ...ops.pallas.flash_attention import flash_attention
@@ -108,6 +119,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             # but LOUDLY: a silent fallback costs ~1.5x attention time with
             # green tests (round-3 verdict weak #4)
             _note_flash(False, e)
+    else:
+        # deliberate routing (mask/dropout/short-seq), not a fallback:
+        # record the path without the warning
+        global LAST_PATH
+        LAST_PATH = "composed"
     m = None if attn_mask is None else _wrap(attn_mask)
     drop_mask = None
     if dropout_active:

@@ -77,6 +77,26 @@ def test_device_loader_trains_a_model():
     assert losses[-1] < 0.01 * losses[0] + 1e-6, losses[-5:]
 
 
+def test_device_loader_namedtuple_batches():
+    """namedtuple batches must be rebuilt field-wise — type(item)(generator)
+    passes one generator to the constructor and crashes."""
+    import collections
+    Batch = collections.namedtuple("Batch", ["img", "label"])
+
+    def collate(samples):
+        from paddle_tpu.io import default_collate_fn
+        x, y = default_collate_fn(samples)
+        return Batch(img=x, label=y)
+
+    dl = DataLoader(_DS(8), batch_size=2, collate_fn=collate)
+    seen = []
+    for b in DeviceLoader(dl):
+        assert isinstance(b, Batch)
+        assert isinstance(b.img, paddle.Tensor)
+        seen.extend(int(v) for v in b.label.numpy())
+    assert seen == list(range(8))
+
+
 def test_device_loader_size_validation():
     with pytest.raises(ValueError):
         DeviceLoader([], size=0)
