@@ -187,7 +187,8 @@ def bench_bert(on_tpu: bool):
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
     from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
-                                        bert_pretrain_loss_fn)
+                                        bert_pretrain_loss_fn,
+                                        make_bert_pretrain_batch)
     paddle.seed(0)
     if on_tpu:
         cfg = BertConfig()  # bert-base: 30522 vocab, 768h, 12L
@@ -205,7 +206,6 @@ def bench_bert(on_tpu: bool):
     rng = np.random.RandomState(0)
     # masked-position MLM (the reference design: gather mask_pos before
     # the pretraining head, bert_dygraph_model.py:335), 15% masking rate
-    from paddle_tpu.models.bert import make_bert_pretrain_batch
     x_np, tt_np, mlm_np, nsp_np, pos_np = make_bert_pretrain_batch(
         rng, cfg.vocab_size, bs, seq)
     x, tt, mlm_t, nsp, pos_t = (paddle.to_tensor(a) for a in

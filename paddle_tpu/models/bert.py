@@ -201,7 +201,6 @@ def make_bert_pretrain_batch(rng, vocab_size, bs, seq, mask_rate=0.15):
     nsp_labels, masked_positions); P = round(mask_rate*seq) positions per
     row, chosen without replacement and SORTED (the gather head's
     contract)."""
-    import numpy as np
     x = rng.randint(0, vocab_size, (bs, seq), dtype=np.int32)
     tt = rng.randint(0, 2, (bs, seq), dtype=np.int32)
     P = max(1, int(round(seq * mask_rate)))
