@@ -27,6 +27,22 @@ __all__ = ["AutoCheckpointManager", "train_epoch_range", "register",
            "save_sharded_state", "load_sharded_state"]
 
 
+def _to_host(obj):
+    """Recursively fetch every Tensor / device array to host numpy,
+    preserving container structure (no Tensor reconstruction)."""
+    from ..core.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return obj.numpy()
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_host(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
 class AutoCheckpointManager:
     """Periodic save + resume of the full training state.
 
@@ -40,13 +56,16 @@ class AutoCheckpointManager:
 
     def __init__(self, save_dir: str, models=(), optimizers=(),
                  lr_schedulers=(), max_keep: int = 3,
-                 save_interval_epochs: int = 1):
+                 save_interval_epochs: int = 1, async_save: bool = False):
         self.save_dir = save_dir
         self.models = list(models)
         self.optimizers = list(optimizers)
         self.lr_schedulers = list(lr_schedulers)
         self.max_keep = max_keep
         self.save_interval = max(int(save_interval_epochs), 1)
+        self.async_save = async_save
+        self._pending = None  # in-flight async save (threading.Thread)
+        self._async_error = None
         os.makedirs(save_dir, exist_ok=True)
 
     # ---------------------------------------------------------------- state
@@ -78,12 +97,51 @@ class AutoCheckpointManager:
 
     def save(self, epoch: int):
         """Atomic snapshot: write to a temp dir, rename into place, then
-        prune old epochs (the reference's HDFS tmp+mv pattern)."""
+        prune old epochs (the reference's HDFS tmp+mv pattern). Joins any
+        in-flight async save first — two concurrent _write threads would
+        race _prune's '.tmp_*' sweep against the other's live temp dir."""
+        self.wait()
+        self._write(self._collect(epoch), epoch)
+
+    def save_async(self, epoch: int):
+        """Snapshot the state synchronously (cheap: the training state is
+        functional, so collecting is reference-capture + host fetch), then
+        serialize + write + rename in a background thread so disk/remote-fs
+        latency overlaps the next epoch's compute. At most one save is in
+        flight: a new save (or restore/exit) first joins the previous one.
+        A failed background save re-raises at the next save/wait call —
+        never silently dropped."""
+        import threading
+        self.wait()
+        # host-materialise now: after this the background thread touches
+        # no device state, so training may freely continue. (NOT tree_map:
+        # rebuilding Tensor nodes from numpy leaves would round-trip the
+        # data back to the device.)
+        state = _to_host(self._collect(epoch))
+
+        def work():
+            try:
+                self._write(state, epoch)
+            except BaseException as e:  # surfaced on next wait()
+                self._async_error = e
+
+        self._pending = threading.Thread(target=work, daemon=True)
+        self._pending.start()
+
+    def wait(self):
+        """Join the in-flight async save (if any); re-raise its failure."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        if self._async_error is not None:
+            err, self._async_error = self._async_error, None
+            raise err
+
+    def _write(self, state: dict, epoch: int):
         from .. import framework_io
         tmp = tempfile.mkdtemp(dir=self.save_dir, prefix=".tmp_")
         try:
-            framework_io.save(self._collect(epoch),
-                              os.path.join(tmp, "state.pdparams"))
+            framework_io.save(state, os.path.join(tmp, "state.pdparams"))
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"epoch": epoch, "time": time.time()}, f)
             final = self._epoch_dir(epoch)
@@ -127,6 +185,7 @@ class AutoCheckpointManager:
         next-newest snapshot is tried, so one bad file never bricks the
         resume path."""
         from .. import framework_io
+        self.wait()  # a restore racing an in-flight save would read torn
         for epoch in sorted(self._saved_epochs(), reverse=True):
             path = os.path.join(self._epoch_dir(epoch), "state.pdparams")
             try:
@@ -153,11 +212,20 @@ class AutoCheckpointManager:
         indices, skipping epochs already completed by a previous run."""
         last = self.restore_latest()
         start = 0 if last is None else last + 1
-        for epoch in range(start, max_epoch_num):
-            yield epoch
-            if (epoch + 1) % self.save_interval == 0 \
-                    or epoch == max_epoch_num - 1:
-                self.save(epoch)
+        try:
+            for epoch in range(start, max_epoch_num):
+                yield epoch
+                if (epoch + 1) % self.save_interval == 0 \
+                        or epoch == max_epoch_num - 1:
+                    if self.async_save:
+                        self.save_async(epoch)
+                    else:
+                        self.save(epoch)
+        finally:
+            # also runs on generator close (caller `break`): the last
+            # dispatched snapshot must be durable — the writer thread is a
+            # daemon and would be killed mid-rename at interpreter exit
+            self.wait()
 
 
 # module-level convenience mirroring the reference's implicit API ----------
