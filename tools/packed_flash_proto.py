@@ -1,0 +1,188 @@
+"""Packed-pair flash attention prototype (d=64 boundary-copy fix).
+
+Hypothesis (BENCH_DETAIL mfu_12head attribution): at head_dim 64, ~40% of
+the 12-head geometry's gap is [B,T,H,64]<->[B,H,T,64] transposes that XLA
+materialises around the pallas custom call (they fuse at d=128). Fix: keep
+the HBM arrays PACKED as [B, H/2, T, 128] (head 2i in lanes 0:64, head
+2i+1 in 64:128 — the natural reshape order) and run the UNCHANGED upstream
+d=64 kernel body over them via index maps (b, h) -> (b, h//2, t, h%2):
+the BlockSpec's 64-wide last-dim block selects the lane half. All
+boundary tensors are then 128-minor, so the surrounding transposes fuse.
+
+This file: FORWARD only — numerics check vs composed attention + slope
+timing of (proj -> attention fwd -> out-proj) packed vs unpacked. If the
+win shows, the bwd (dq/dkv kernels) gets the same index-map treatment.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/packed_flash_proto.py
+
+VERDICT (v5e, 2026-07-31): the index-map route is REJECTED by the Mosaic
+lowering — "the last two dimensions of your block shape [must be]
+divisible by 8 and 128 respectively, or be equal to the respective
+dimensions of the overall array". A 64-lane half-block over a 128-wide
+packed array is exactly the disallowed case (the existing d=64 kernel is
+legal only because its ARRAY last dim is 64). The surviving design is a
+custom kernel whose blocks are the full 128 lanes and which splits the
+halves in-register (two QK^T dots, two running softmaxes, two PV dots per
+tile) — requires new fwd AND bwd kernel bodies, not index maps; left as
+the known round-5 perf project for the 12-head geometry (projected ~+9%,
+MFU 0.476 -> ~0.52, from the 18.8 GB/step of boundary copies).
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def packed_flash_fwd(q, k, v, causal, sm_scale, block_q=1024,
+                     block_k_major=1024, block_k=1024, num_heads=None):
+    """q/k/v: [B, Hp, T, 2*D] packed (D=64 halves on lanes). Returns the
+    packed output [B, Hp, T, 2*D]. Mirrors upstream _flash_attention_impl
+    with half-selecting index maps; kernel body is upstream's, unchanged."""
+    import jax.experimental.pallas.ops.tpu.flash_attention as m
+
+    batch_size, hp, q_seq_len, d2 = q.shape
+    head_dim = d2 // 2
+    heads = num_heads or 2 * hp
+    kv_seq_len = k.shape[2]
+    block_q = min(block_q, q_seq_len)
+    block_k_major = min(block_k_major, kv_seq_len)
+    block_k = min(block_k, kv_seq_len)
+    block_b = 1
+
+    grid = (batch_size, heads, q_seq_len // block_q,
+            kv_seq_len // block_k_major)
+
+    def q_index_map(b, h, qi, _):
+        return (b, h // 2, qi, h % 2)
+
+    def kv_index_map(b, h, qi, ki):
+        if causal:
+            next_ki = lax.select(
+                m.below_or_on_diag(qi, block_q, ki, block_k_major), ki, 0)
+        else:
+            next_ki = ki
+        return (b, h // 2, next_ki, h % 2)
+
+    def o_index_map(b, h, qi, _):
+        return (b, h // 2, qi, h % 2)
+
+    kernel = functools.partial(
+        m._flash_attention_kernel, causal=causal,
+        mask_value=m.DEFAULT_MASK_VALUE, sm_scale=sm_scale,
+        block_k=block_k, kv_seq_len=kv_seq_len)
+    out_shape = [jax.ShapeDtypeStruct(shape=q.shape, dtype=q.dtype)]
+    out_specs = [pl.BlockSpec((block_b, 1, block_q, head_dim), o_index_map)]
+    scratch_shapes = []
+    if block_k != kv_seq_len:
+        scratch_shapes = [
+            pltpu.VMEM((block_b, 1, block_q, m.MIN_BLOCK_SIZE), jnp.float32),
+            pltpu.VMEM((block_b, 1, block_q, m.MIN_BLOCK_SIZE), jnp.float32),
+            pltpu.VMEM((block_b, 1, block_q, head_dim), jnp.float32)]
+
+    in_specs = [
+        pl.BlockSpec((block_b, 1, block_q, head_dim), q_index_map),
+        pl.BlockSpec((block_b, 1, block_k_major, head_dim), kv_index_map),
+        pl.BlockSpec((block_b, 1, block_k_major, head_dim), kv_index_map),
+        None,  # ab
+        None,  # q_segment_ids
+        None,  # kv_segment_ids
+    ]
+    with jax.enable_x64(False):
+        o, = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=scratch_shapes,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary")),
+        )(q, k, v, None, None, None)
+    return o
+
+
+# ---------------------------------------------------------------- harness
+def attention_block_unpacked(x, wq, wk, wv, wo, H, D, causal=True):
+    """Current path: [B,T,C] -> heads-major [B,H,T,D] -> flash -> out."""
+    from paddle_tpu.ops.pallas.flash_attention import _fa_core
+    B, T, C = x.shape
+    q = jnp.swapaxes((x @ wq).reshape(B, T, H, D), 1, 2)
+    k = jnp.swapaxes((x @ wk).reshape(B, T, H, D), 1, 2)
+    v = jnp.swapaxes((x @ wv).reshape(B, T, H, D), 1, 2)
+    o = _fa_core(q, k, v, causal, 1.0 / np.sqrt(D))
+    return jnp.swapaxes(o, 1, 2).reshape(B, T, C) @ wo
+
+
+def attention_block_packed(x, wq, wk, wv, wo, H, D, causal=True):
+    """Packed path: [B,T,C] -> [B,H/2,T,2D] (128-minor; transpose fuses)
+    -> packed kernel -> back."""
+    B, T, C = x.shape
+    q = jnp.swapaxes((x @ wq).reshape(B, T, H // 2, 2 * D), 1, 2)
+    k = jnp.swapaxes((x @ wk).reshape(B, T, H // 2, 2 * D), 1, 2)
+    v = jnp.swapaxes((x @ wv).reshape(B, T, H // 2, 2 * D), 1, 2)
+    o = packed_flash_fwd(q, k, v, causal, 1.0 / np.sqrt(D))
+    return jnp.swapaxes(o, 1, 2).reshape(B, T, C) @ wo
+
+
+def slope_time(fn, args, n1=5, n2=30):
+    def make(n):
+        @jax.jit
+        def loop(*a):
+            def body(i, carry):
+                scale = 1.0 + 0.001 * i.astype(jnp.float32)
+                o = fn(a[0] * scale.astype(a[0].dtype), *a[1:])
+                of = o.astype(jnp.float32)
+                return carry + jnp.sum(of * of)
+            return lax.fori_loop(0, n, body, jnp.float32(0))
+        return loop
+    l1, l2 = make(n1), make(n2)
+    float(np.asarray(l1(*args)))
+    float(np.asarray(l2(*args)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(np.asarray(l1(*args)))
+        t1 = time.perf_counter()
+        float(np.asarray(l2(*args)))
+        t2 = time.perf_counter()
+        best = min(best, ((t2 - t1) - (t1 - t0)) / (n2 - n1))
+    return best * 1e3
+
+
+def main():
+    B, T, H, D = 32, 1024, 12, 64
+    C = H * D
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, T, C) * 0.05, jnp.bfloat16)
+    ws = [jnp.asarray(rng.randn(C, C) / np.sqrt(C), jnp.bfloat16)
+          for _ in range(4)]
+
+    a = jax.jit(functools.partial(attention_block_unpacked, H=H, D=D))(
+        x, *ws)
+    b = jax.jit(functools.partial(attention_block_packed, H=H, D=D))(
+        x, *ws)
+    err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                - b.astype(jnp.float32))))
+    ref = float(jnp.max(jnp.abs(a.astype(jnp.float32))))
+    print(f"max|unpacked - packed| = {err:.4g} (scale {ref:.3g})")
+    assert err <= 0.02 * max(ref, 1.0), "numerics mismatch"
+
+    t_un = slope_time(functools.partial(attention_block_unpacked, H=H, D=D),
+                      (x, *ws))
+    t_pk = slope_time(functools.partial(attention_block_packed, H=H, D=D),
+                      (x, *ws))
+    print(f"fwd attention block (proj+attn+out, B{B} T{T} H{H} D{D}): "
+          f"unpacked {t_un:.3f} ms   packed {t_pk:.3f} ms   "
+          f"({t_un / t_pk:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()
