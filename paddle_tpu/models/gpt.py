@@ -68,7 +68,8 @@ class GPTConfig:
                          num_heads=4, max_seq_len=64)
 
 
-def sliced_qkv(x, qkv_layer, num_heads: int, head_dim: int):
+def sliced_qkv(x, qkv_layer, num_heads: int, head_dim: int,
+               pack_pairs: bool = False):
     """q/k/v heads-major [B, H, T, D] from a fused qkv projection.
 
     tp == 1 (the single-chip/dp fast path): THREE F.linear calls against
@@ -99,8 +100,15 @@ def sliced_qkv(x, qkv_layer, num_heads: int, head_dim: int):
     for i in range(3):
         o = F.linear(x, w[:, i * HD:(i + 1) * HD],
                      bias[i * HD:(i + 1) * HD])
-        o = M.reshape(o, [B, T, num_heads, head_dim])
-        out.append(M.transpose(o, [0, 2, 1, 3]))  # [B, H, T, D]
+        if pack_pairs:
+            # adjacent head pairs stay merged on the 128-lane minor dim:
+            # [B,T,H,64] -> [B,T,H/2,128] is a pure view, and THIS
+            # transpose fuses (128-minor), unlike the d=64 one —
+            # ops/pallas/packed_flash.py consumes the packed layout
+            o = M.reshape(o, [B, T, num_heads // 2, 2 * head_dim])
+        else:
+            o = M.reshape(o, [B, T, num_heads, head_dim])
+        out.append(M.transpose(o, [0, 2, 1, 3]))  # [B, H(, /2), T, D(*2)]
     return out
 
 
@@ -116,19 +124,41 @@ class GPTAttention(nn.Layer):
         self.out = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
                                      input_is_parallel=True)
 
+    def _pack_gate(self, T: int) -> bool:
+        """Packed-pair flash (head pairs on 128 lanes, ops/pallas/
+        packed_flash.py): at head_dim 64 it removes the layout copies the
+        custom-call boundary forces on 64-minor tensors. Same conditions
+        as the flash path (no mask/dropout) + the kernel's scope gate."""
+        from ..core import flags as _flags
+        from ..ops.pallas import packed_flash
+        from ..parallel.mesh import get_global_mesh
+        mesh = get_global_mesh()
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            return False  # sliced_qkv takes the fused tp path, unpacked
+        dropout_active = self.cfg.dropout > 0.0 and self.training
+        return (_flags.flag("use_flash_attention") and not dropout_active
+                and T >= _flags.flag("flash_attention_min_seq")
+                and packed_flash.supported(self.head_dim, self.num_heads,
+                                           T, T))
+
     def forward(self, x):
         B, T = x.shape[0], x.shape[1]
-        q, k, v = sliced_qkv(x, self.qkv, self.num_heads, self.head_dim)
         use_ring = False
         if self.cfg.context_parallel:
             from ..parallel.mesh import ensure_global_mesh
             use_ring = ensure_global_mesh().shape.get("sp", 1) > 1
+        pack = not use_ring and self._pack_gate(T)
+        q, k, v = sliced_qkv(x, self.qkv, self.num_heads, self.head_dim,
+                             pack_pairs=pack)
         if use_ring:
             out = self._ring_attention(q, k, v)  # [B, H, T, D]
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True, dropout_p=self.cfg.dropout,
-                training=self.training, _heads_major=True)  # [B, H, T, D]
+                training=self.training, _heads_major=True,
+                _packed_pairs=pack)  # [B, H, T, D] (packed: [B,H/2,T,2D])
+        # the [0,2,1,3] transpose + reshape maps BOTH layouts to [B, T, C]
+        # with heads in natural order (packed pairs are lane-adjacent)
         out = M.reshape(M.transpose(out, [0, 2, 1, 3]), [B, T, -1])
         return self.out(out)
 

@@ -92,14 +92,46 @@ def _sdpa(q, k, v, mask, causal, scale, drop_mask, dropout_p,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, scale=None, name=None,
-                                 _heads_major=False):
+                                 _heads_major=False, _packed_pairs=False):
     """q/k/v: [batch, seq, num_heads, head_dim] (paddle layout).
 
     _heads_major (internal, used by models.gpt): q/k/v arrive as
     [batch, heads, seq, head_dim] — the pallas kernel's native layout —
     and the output stays heads-major. Skips six 150 MB swapaxes copies
-    per block at GPT scale (the custom-call boundary materialises them)."""
+    per block at GPT scale (the custom-call boundary materialises them).
+
+    _packed_pairs (internal): q/k/v arrive as [batch, heads/2, seq,
+    2*head_dim] — adjacent head pairs merged on the 128-lane minor dim
+    for the head_dim-64 packed kernel (ops/pallas/packed_flash.py); the
+    output stays packed. Caller is responsible for the gate
+    (no mask/dropout, supported geometry)."""
     q, k, v = _wrap(query), _wrap(key), _wrap(value)
+    if _packed_pairs:
+        true_d = q.shape[-1] // 2
+        sc = scale if scale is not None else 1.0 / float(np.sqrt(true_d))
+        from ...ops.pallas.flash_attention import _packed_flash
+        try:
+            out = _packed_flash(q, k, v, is_causal, sc)
+            _note_flash(True)
+            return out
+        except Exception as e:
+            _note_flash(False, e)
+            # unpack to plain heads-major and continue composed:
+            # [B,Hp,T,128] -> [B,Hp,T,2,64] -> [B,Hp,2,T,64] -> [B,H,T,64]
+            from ...ops import manipulation as M
+            B, Hp, T = q.shape[0], q.shape[1], q.shape[2]
+
+            def unpack(t):
+                t = M.reshape(t, [B, Hp, T, 2, true_d])
+                return M.reshape(M.transpose(t, [0, 1, 3, 2, 4]),
+                                 [B, 2 * Hp, T, true_d])
+            q, k, v = unpack(q), unpack(k), unpack(v)
+            out = _sdpa(q, k, v, None, is_causal, sc, None, 0.0, True)
+            # repack so the caller's downstream reshape sees one layout
+            out = M.reshape(M.transpose(
+                M.reshape(out, [B, Hp, 2, T, true_d]), [0, 1, 3, 2, 4]),
+                [B, Hp, T, 2 * true_d])
+            return out
     head_dim = q.shape[-1]
     sc = scale if scale is not None else 1.0 / float(np.sqrt(head_dim))
     dropout_active = dropout_p > 0.0 and training

@@ -232,6 +232,14 @@ def _flash_hm(q, k, v, causal, scale):
     return _fa_core(q, k, v, causal, scale)
 
 
+@op("packed_flash_attention")
+def _packed_flash(q, k, v, causal, scale):
+    # [B, H/2, T, 128] packed head pairs (ops/pallas/packed_flash.py);
+    # scale is the TRUE per-head scale (1/sqrt(head_dim), not 1/sqrt(128))
+    from .packed_flash import packed_flash_attention as pf
+    return pf(q, k, v, causal, scale)
+
+
 def flash_attention(q, k, v, causal=False, scale=None, heads_major=False):
     """q/k/v: [batch, seq, heads, head_dim] Tensors (paddle layout), or
     [batch, heads, seq, head_dim] when heads_major=True (kernel-native —

@@ -17,7 +17,11 @@ os.environ.setdefault("XLA_FLAGS",
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# PADDLE_TPU_TEST_REAL_TPU=1 runs the suite against the real chip instead
+# of the virtual CPU mesh (used for the pallas-kernel parity tests, which
+# skip on CPU; most distributed tests then skip on the 1-chip topology)
+if os.environ.get("PADDLE_TPU_TEST_REAL_TPU") not in ("1", "true"):
+    jax.config.update("jax_platforms", "cpu")
 # This JAX build's DEFAULT matmul precision emulates TPU bf16 passes even on
 # the CPU backend (~1e-2 abs error on O(1) f32 matmuls). Tests compare
 # against f64 oracles, so pin the test harness to true f32 dots.

@@ -513,6 +513,9 @@ EXEMPT = {
     "lstm_cell", "simple_rnn_cell", "scaled_dot_product_attention",
     "flash_attention",  # registered lazily by ops.pallas; engaged in test_nn
     "flash_attention_hm",  # heads-major variant; parity in test_nn gpt test
+    # packed head-pair variant (d=64): parity in tests/test_packed_flash.py
+    # (TPU) + gate/fallback coverage in test_nn on CPU
+    "packed_flash_attention",
     "batch_norm_train", "batch_norm_infer", "group_norm", "instance_norm",
     # fused bn+(add+)relu: parity vs composed path (fwd+grads, eager+jit)
     # in test_nn.py::test_fused_bn_act_matches_composed
