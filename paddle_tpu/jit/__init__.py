@@ -411,6 +411,7 @@ class TrainStep:
             return loss, new_params, new_buffers, new_opt, rng_ctr + 1
 
         donate_argnums = (0, 3, 6) if donate else ()
+        self._raw_step = step  # unjitted; MultiStepTrainStep scans over it
         self._step = jax.jit(step, donate_argnums=donate_argnums)
         self._need_clip = {}
         # per-step dispatch caches (see __call__)
@@ -457,7 +458,14 @@ class TrainStep:
             self._state_cache = (params_t, frozen_t, buffers_t)
         return self._state_cache
 
-    def __call__(self, *args):
+    def _dispatch(self, fn, draws, args, validate=None):
+        """Shared per-call host path for the 1-step and K-step variants:
+        bind cached state, advance the RNG stream by `draws` (the counter
+        itself lives on device and is threaded through the compiled step,
+        so a steady-state step uploads nothing — resync only if other code
+        drew from the stream between calls: eager dropout, paddle.seed),
+        run `fn`, and write the new state back. Returns fn's trailing
+        extras (anything after the 5 carried slots)."""
         from ..profiler import RecordEvent
         params_t, frozen_t, buffers_t = self._collect_state()
         params = {k: p._value for k, p in params_t}
@@ -467,44 +475,105 @@ class TrainStep:
             self._opt_state = self.optimizer.init_opt_state(params)
         arr_args = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
                     for a in args]
+        if validate is not None:
+            validate(arr_args)
         lr = float(self.optimizer.get_lr())
         if lr != self._lr_host:
             self._lr_dev = jnp.asarray(lr, jnp.float32)
             self._lr_host = lr
-        # advance the global RNG stream by one draw per step (identical
-        # sequence to the old per-call next_key()); the counter itself
-        # lives on device and is threaded through the compiled step, so a
-        # steady-state step uploads nothing. If other code drew from the
-        # stream between steps (eager dropout, paddle.seed), resync.
-        _random._RNGState.counter += 1
+        _random._RNGState.counter += draws
         state_now = (_random._RNGState.seed, _random._RNGState.counter)
         if (self._rng_ctr is None
-                or self._rng_expected != (state_now[0], state_now[1] - 1)):
+                or self._rng_expected != (state_now[0],
+                                          state_now[1] - draws)):
+            # first inner step consumes counter c0+1 (the value the old
+            # per-call next_key() would have drawn); each step threads +1
             self._key_root = _random._RNGState.get_root_key()
-            self._rng_ctr = jnp.asarray(state_now[1], jnp.uint32)
-        with RecordEvent("TrainStep"):
-            res = self._step(params, frozen, buffers, self._opt_state,
-                             self._lr_dev, self._key_root, self._rng_ctr,
-                             *arr_args)
+            self._rng_ctr = jnp.asarray(state_now[1] - draws + 1,
+                                        jnp.uint32)
+        with RecordEvent(type(self).__name__):
+            res = fn(params, frozen, buffers, self._opt_state,
+                     self._lr_dev, self._key_root, self._rng_ctr,
+                     *arr_args)
         # only mark the host/device counters as in-sync once the step has
         # actually consumed the key — an exception above leaves
         # _rng_expected stale so the next call resyncs from the host
         # counter instead of silently running one draw behind
         self._rng_expected = state_now
-        if self.return_outputs:
-            (loss, new_params, new_buffers, self._opt_state,
-             self._rng_ctr, out) = res
-        else:
-            loss, new_params, new_buffers, self._opt_state, \
-                self._rng_ctr = res
+        loss, new_params, new_buffers, self._opt_state, self._rng_ctr = \
+            res[:5]
         for k, p in params_t:
             p._value = new_params[k]
         for k, b in buffers_t:
             b._value = new_buffers[k]
-        self.optimizer._global_step += 1
+        self.optimizer._global_step += draws
+        return loss, res[5:]
+
+    def __call__(self, *args):
+        loss, extras = self._dispatch(self._step, 1, args)
         if self.return_outputs:
-            return Tensor(loss), jax.tree_util.tree_map(Tensor, out)
+            return Tensor(loss), jax.tree_util.tree_map(Tensor, extras[0])
         return Tensor(loss)
+
+
+class MultiStepTrainStep(TrainStep):
+    """Run K full optimizer steps per dispatch: `lax.scan` over a stack of
+    K batches inside ONE compiled program.
+
+    The reference runs its hot loop outside Python too — `train_from_dataset`
+    hands the whole dataset to a C++ trainer (framework/multi_trainer.cc:1,
+    device worker loop in framework/device_worker.cc) so Python is out of
+    the per-step path. The TPU-native equivalent is a device-side loop: the
+    parameter/optimizer/RNG carry is threaded through `lax.scan`, so one
+    host dispatch trains K steps and nothing round-trips through the host
+    between them. On dispatch-bound workloads (small models, fast steps)
+    this removes the per-step host floor entirely.
+
+    Usage:
+        step = paddle.jit.MultiStepTrainStep(model, loss_fn, opt, steps=K)
+        losses = step(xs, ys)   # xs/ys stacked [K, ...]; returns [K] losses
+
+    Semantics vs. K sequential TrainStep calls: identical parameters,
+    buffers, optimizer state and RNG stream (parity-tested), EXCEPT the
+    learning rate is sampled once per dispatch — an LRScheduler ticks per
+    __call__, not per inner step (same granularity as the reference's
+    dataset trainers, which fetch lr from the program once per pass).
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 steps: int, donate: bool = True):
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        super().__init__(model, loss_fn, optimizer, donate=donate,
+                         return_outputs=False)
+        self.steps = int(steps)
+        raw = self._raw_step
+
+        def multi(params, frozen, buffers, opt_state, lr, key_root, rng_ctr,
+                  *stacked):
+            def body(carry, batch):
+                p, b, o, c = carry
+                loss, p, b, o, c = raw(p, frozen, b, o, lr, key_root, c,
+                                       *batch)
+                return (p, b, o, c), loss
+            (params, buffers, opt_state, rng_ctr), losses = jax.lax.scan(
+                body, (params, buffers, opt_state, rng_ctr), tuple(stacked))
+            return losses, params, buffers, opt_state, rng_ctr
+
+        donate_argnums = (0, 3, 6) if donate else ()
+        self._multi = jax.jit(multi, donate_argnums=donate_argnums)
+
+    def _validate_stacked(self, arr_args):
+        for a in arr_args:
+            if a.shape[:1] != (self.steps,):
+                raise ValueError(
+                    f"MultiStepTrainStep(steps={self.steps}) needs every "
+                    f"batch arg stacked [steps, ...]; got shape {a.shape}")
+
+    def __call__(self, *args):
+        losses, _ = self._dispatch(self._multi, self.steps, args,
+                                   validate=self._validate_stacked)
+        return Tensor(losses)
 
 
 class TracedLayer:

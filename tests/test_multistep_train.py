@@ -1,0 +1,81 @@
+"""MultiStepTrainStep: K optimizer steps per dispatch via lax.scan.
+
+Parity contract: K scanned steps == K sequential TrainStep calls —
+same losses, parameters, BN buffers and RNG (dropout) stream. The
+reference analogue is train_from_dataset handing the loop to the C++
+trainer (framework/multi_trainer.cc:1): Python leaves the per-step path.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def _make_model(seed):
+    paddle.seed(seed)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16),
+        paddle.nn.BatchNorm1D(16),
+        paddle.nn.ReLU(),
+        paddle.nn.Dropout(0.5),   # exercises the threaded RNG stream
+        paddle.nn.Linear(16, 4),
+    )
+
+
+def _loss_fn(m, x, y):
+    return F.cross_entropy(m(x), y)
+
+
+def _batches(n, rng):
+    xs = rng.standard_normal((n, 16, 8)).astype(np.float32)
+    ys = rng.integers(0, 4, size=(n, 16)).astype(np.int64)
+    return xs, ys
+
+
+def test_multistep_parity_with_sequential():
+    K, CALLS = 3, 2
+    rng = np.random.default_rng(0)
+    xs, ys = _batches(K * CALLS, rng)
+
+    # sequential oracle: 6 TrainStep calls
+    model_a = _make_model(7)
+    opt_a = opt.Adam(1e-2, parameters=model_a.parameters())
+    step_a = paddle.jit.TrainStep(model_a, _loss_fn, opt_a)
+    losses_a = [float(step_a(paddle.to_tensor(xs[i]),
+                             paddle.to_tensor(ys[i])).numpy())
+                for i in range(K * CALLS)]
+
+    # scanned path: 2 dispatches of 3 steps each
+    model_b = _make_model(7)
+    opt_b = opt.Adam(1e-2, parameters=model_b.parameters())
+    step_b = paddle.jit.MultiStepTrainStep(model_b, _loss_fn, opt_b,
+                                           steps=K)
+    losses_b = []
+    for c in range(CALLS):
+        out = step_b(paddle.to_tensor(xs[c * K:(c + 1) * K]),
+                     paddle.to_tensor(ys[c * K:(c + 1) * K]))
+        assert out.shape == [K]
+        losses_b.extend(np.asarray(out.numpy(), np.float64).tolist())
+
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-5,
+                               err_msg="loss trajectories diverge")
+    sd_a, sd_b = model_a.state_dict(), model_b.state_dict()
+    assert set(sd_a) == set(sd_b)
+    for k in sd_a:  # params AND BN running stats
+        np.testing.assert_allclose(sd_a[k].numpy(), sd_b[k].numpy(),
+                                   rtol=1e-5, atol=1e-7, err_msg=k)
+    assert opt_b._global_step == K * CALLS
+
+
+def test_multistep_rejects_unstacked_batch():
+    model = _make_model(0)
+    optim = opt.SGD(1e-2, parameters=model.parameters())
+    step = paddle.jit.MultiStepTrainStep(model, _loss_fn, optim, steps=4)
+    x = paddle.randn([16, 8])          # missing the [steps, ...] stack
+    y = paddle.to_tensor(np.zeros(16, np.int64))
+    with pytest.raises(ValueError, match="stacked"):
+        step(x, y)
+    with pytest.raises(ValueError):
+        paddle.jit.MultiStepTrainStep(model, _loss_fn, optim, steps=0)
