@@ -211,23 +211,20 @@ def bench_lenet_multistep(on_tpu: bool = True, k: int = 50):
     return calls * k * 64 / _best_of(window, 3 if on_tpu else 1)
 
 
-def bench_bert(on_tpu: bool):
-    """BASELINE.md config 3: BERT-base MLM+NSP pretraining samples/sec
-    (batch 64, seq 128 — the standard phase-1 geometry) + MFU."""
+def _bench_mlm_pretrain(cfg, bs: int, seq: int, iters: int,
+                        on_tpu: bool):
+    """Shared MLM+NSP pretraining bench recipe (configs 3 and 4): build
+    BertForPretraining(cfg), AMP O2 on TPU, masked-position batch
+    (the reference design: gather mask_pos before the pretraining head,
+    bert_dygraph_model.py:335; 15% masking), warmup x2, best-of-3 timed
+    windows. Returns (samples/sec, mfu_or_None)."""
     import jax
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
-    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+    from paddle_tpu.models.bert import (BertForPretraining,
                                         bert_pretrain_loss_fn,
                                         make_bert_pretrain_batch)
     paddle.seed(0)
-    if on_tpu:
-        cfg = BertConfig()  # bert-base: 30522 vocab, 768h, 12L
-        bs, seq, iters = 64, 128, 30
-    else:
-        cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
-                         num_heads=4, max_position=64)
-        bs, seq, iters = 2, 32, 2
     model = BertForPretraining(cfg)
     optim = opt.AdamW(1e-4, parameters=model.parameters())
     if on_tpu:
@@ -235,8 +232,6 @@ def bench_bert(on_tpu: bool):
                                            dtype="bfloat16")
     step = paddle.jit.TrainStep(model, bert_pretrain_loss_fn, optim)
     rng = np.random.RandomState(0)
-    # masked-position MLM (the reference design: gather mask_pos before
-    # the pretraining head, bert_dygraph_model.py:335), 15% masking rate
     x_np, tt_np, mlm_np, nsp_np, pos_np = make_bert_pretrain_batch(
         rng, cfg.vocab_size, bs, seq)
     x, tt, mlm_t, nsp, pos_t = (paddle.to_tensor(a) for a in
@@ -254,8 +249,7 @@ def bench_bert(on_tpu: bool):
     sps = iters * bs / _best_of(window, 3 if on_tpu else 1)
     mfu = None
     if on_tpu:
-        h, L, V, T = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
-                      seq)
+        h, L, V, T = cfg.hidden_size, cfg.num_layers, cfg.vocab_size, seq
         per_layer = 4 * h * h + 2 * cfg.ffn_mult * h * h
         # trunk matmuls run on all T tokens; the MLM transform + tied
         # unembed only on the P gathered positions — count what executes
@@ -263,6 +257,55 @@ def bench_bert(on_tpu: bool):
                             + 12 * L * h * T * T)
         mfu = sps * flops_per_sample / _peak_flops(jax.devices()[0])
     return sps, mfu
+
+
+def _tiny_mlm_cfg():
+    from paddle_tpu.models.bert import BertConfig
+    return BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                      num_heads=4, max_position=64)
+
+
+def bench_bert(on_tpu: bool):
+    """BASELINE.md config 3: BERT-base MLM+NSP pretraining samples/sec
+    (batch 64, seq 128 — the standard phase-1 geometry) + MFU."""
+    if not on_tpu:
+        return _bench_mlm_pretrain(_tiny_mlm_cfg(), 2, 32, 2, False)
+    from paddle_tpu.models.bert import BertConfig
+    return _bench_mlm_pretrain(BertConfig(), 64, 128, 30, True)
+
+
+def bench_ernie(on_tpu: bool, bs: int = 32):
+    """BASELINE.md config 4: ERNIE-large (24L/1024H/16 heads) MLM+NSP
+    pretraining at seq 512 with AMP O2, samples/sec + MFU. The reference
+    trains this config with Fleet sharding (ZeRO-2) + AMP over v5e-32; on
+    one chip ZeRO is the identity, so this measures the per-chip compute
+    path the sharded run replicates (the multi-chip sharding itself is
+    validated by dryrun_multichip's ZeRO-2 config).
+
+    bs=32 fits in 15.75G HBM only because the packed-pair attention path
+    is engaged (models/bert.py _pack_gate: the upstream flash kernel pads
+    d=64->128 and stages f32 outputs — 128 MB/layer of HLO temps, which
+    OOMed bs=32 by 379M). If compilation fails (e.g. the packed path
+    gated off by a regression), retry at bs//2 — LOUDLY, on stderr, and
+    with pauses: an HBM-OOM kills the axon compile helper, and an
+    immediate recompile races its restart (measured: the instant bs=16
+    retry died with a transient 'response body closed' tunnel error)."""
+    from paddle_tpu.models.bert import ernie_large
+    if not on_tpu:
+        return _bench_mlm_pretrain(_tiny_mlm_cfg(), 2, 32, 2, False)
+    import sys
+    last = None
+    for b, pause in ((bs, 0), (bs // 2, 30), (bs // 2, 60)):
+        if pause:
+            time.sleep(pause)
+        try:
+            return _bench_mlm_pretrain(ernie_large(), b, 512, 15, True)
+        except Exception as e:
+            last = e
+            print(f"bench_ernie: bs={b} attempt failed "
+                  f"({type(e).__name__}); retrying smaller/later",
+                  file=sys.stderr)
+    raise last
 
 
 def bench_resnet(on_tpu: bool):
@@ -355,6 +398,11 @@ def main():
             round(bt, 1)
         if bt_mfu is not None:
             line["mfu_bert"] = round(bt_mfu, 4)
+        er, er_mfu = bench_ernie(on_tpu)
+        line["ernie_large_samples_per_sec" + ("" if on_tpu else "_cpu")] = \
+            round(er, 1)
+        if er_mfu is not None:
+            line["mfu_ernie"] = round(er_mfu, 4)
         rn, rn_mfu = bench_resnet(on_tpu)
         line["resnet50_imgs_per_sec"] = round(rn, 1)
         if rn_mfu is not None:

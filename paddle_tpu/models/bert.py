@@ -66,14 +66,29 @@ class BertSelfAttention(nn.Layer):
         self.out = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
                                      input_is_parallel=True)
 
+    def _pack_gate(self, T: int, attn_mask) -> bool:
+        """Packed-pair flash routing (ops/pallas/packed_flash.route_gate).
+        At ERNIE-large geometry (T=512, d=64, 16 heads) the upstream
+        flash kernel pads head_dim 64->128 AND stages an f32 output —
+        128 MB/layer of HLO temps (the bs=32 OOM receipt in BENCH_DETAIL
+        notes); the packed kernel keeps pairs on the 128 lanes with bf16
+        in/out."""
+        from ..ops.pallas import packed_flash
+        return packed_flash.route_gate(
+            self.head_dim, self.num_heads, T, T,
+            dropout_active=self.cfg.dropout > 0.0 and self.training,
+            masked=attn_mask is not None)
+
     def forward(self, x, attn_mask=None):
         from .gpt import sliced_qkv
         B, T = x.shape[0], x.shape[1]
-        q, k, v = sliced_qkv(x, self.qkv, self.num_heads, self.head_dim)
+        pack = self._pack_gate(T, attn_mask)
+        q, k, v = sliced_qkv(x, self.qkv, self.num_heads, self.head_dim,
+                             pack_pairs=pack)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=False,
             dropout_p=self.cfg.dropout, training=self.training,
-            _heads_major=True)
+            _heads_major=True, _packed_pairs=pack)
         out = M.reshape(M.transpose(out, [0, 2, 1, 3]), [B, T, -1])
         return self.out(out)
 

@@ -127,19 +127,13 @@ class GPTAttention(nn.Layer):
     def _pack_gate(self, T: int) -> bool:
         """Packed-pair flash (head pairs on 128 lanes, ops/pallas/
         packed_flash.py): at head_dim 64 it removes the layout copies the
-        custom-call boundary forces on 64-minor tensors. Same conditions
-        as the flash path (no mask/dropout) + the kernel's scope gate."""
-        from ..core import flags as _flags
+        custom-call boundary forces on 64-minor tensors. Shared routing
+        gate: packed_flash.route_gate (flash conditions + kernel scope +
+        unpacked-tp exclusion)."""
         from ..ops.pallas import packed_flash
-        from ..parallel.mesh import get_global_mesh
-        mesh = get_global_mesh()
-        if mesh is not None and mesh.shape.get("tp", 1) > 1:
-            return False  # sliced_qkv takes the fused tp path, unpacked
-        dropout_active = self.cfg.dropout > 0.0 and self.training
-        return (_flags.flag("use_flash_attention") and not dropout_active
-                and T >= _flags.flag("flash_attention_min_seq")
-                and packed_flash.supported(self.head_dim, self.num_heads,
-                                           T, T))
+        return packed_flash.route_gate(
+            self.head_dim, self.num_heads, T, T,
+            dropout_active=self.cfg.dropout > 0.0 and self.training)
 
     def forward(self, x):
         B, T = x.shape[0], x.shape[1]

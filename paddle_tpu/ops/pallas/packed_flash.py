@@ -49,6 +49,25 @@ def supported(head_dim: int, num_heads: int, q_seq: int, kv_seq: int) -> bool:
             and q_seq == kv_seq and q_seq % 128 == 0 and q_seq <= MAX_SEQ)
 
 
+def route_gate(head_dim: int, num_heads: int, q_seq: int, kv_seq: int,
+               dropout_active: bool = False, masked: bool = False) -> bool:
+    """Model-side routing gate shared by GPTAttention/BertSelfAttention:
+    packed-pair kernels apply under the same conditions as the flash path
+    (no mask/dropout, seq past the flash threshold), outside a tp-sharded
+    fused-qkv region (sliced_qkv takes the unpacked tp path), and within
+    this kernel's scope (`supported`)."""
+    if masked or dropout_active:
+        return False
+    from ...core import flags as _flags
+    from ...parallel.mesh import get_global_mesh
+    mesh = get_global_mesh()
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        return False
+    return (_flags.flag("use_flash_attention")
+            and q_seq >= _flags.flag("flash_attention_min_seq")
+            and supported(head_dim, num_heads, q_seq, kv_seq))
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale, block_q,
                 head_dim):
     """One (batch, pair, q-block): full-lane 128 blocks; the two 64-wide

@@ -106,6 +106,38 @@ def test_gpt_12head_step_parity_packed_vs_standard():
     np.testing.assert_allclose(packed, standard, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.skipif(not on_tpu, reason="pallas kernel needs the TPU")
+def test_bert_step_parity_packed_vs_standard():
+    """BERT (non-causal) packed-pair routing: same losses with the packed
+    path engaged (T >= min_seq, d=64, even heads, no mask) and disabled."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        bert_pretrain_loss_fn,
+                                        make_bert_pretrain_batch)
+
+    def run(min_seq):
+        paddle.set_flags({"FLAGS_flash_attention_min_seq": min_seq})
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=256, hidden_size=256, num_layers=2,
+                         num_heads=4, max_position=512)
+        m = BertForPretraining(cfg)
+        optim = opt.AdamW(1e-3, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, bert_pretrain_loss_fn, optim)
+        rng = np.random.RandomState(0)
+        batch = make_bert_pretrain_batch(rng, cfg.vocab_size, 2, 512)
+        args = [paddle.to_tensor(a) for a in batch]
+        return [float(step(*args).numpy()) for _ in range(3)]
+
+    from paddle_tpu.core import flags as _flags
+    prev = _flags.flag("flash_attention_min_seq")
+    try:
+        packed = run(512)     # T=512, d=64 -> packed (non-causal) path
+        standard = run(4096)  # threshold above T -> composed path
+    finally:
+        paddle.set_flags({"FLAGS_flash_attention_min_seq": prev})
+    np.testing.assert_allclose(packed, standard, rtol=5e-3, atol=5e-3)
+
+
 def test_pack_gate_scope():
     from paddle_tpu.ops.pallas import packed_flash
     if not on_tpu:
