@@ -289,22 +289,32 @@ def bench_ernie(on_tpu: bool, bs: int = 32):
     gated off by a regression), retry at bs//2 — LOUDLY, on stderr, and
     with pauses: an HBM-OOM kills the axon compile helper, and an
     immediate recompile races its restart (measured: the instant bs=16
-    retry died with a transient 'response body closed' tunnel error)."""
+    retry died with a transient 'response body closed' tunnel error).
+
+    Returns (samples/sec, mfu, bs_used) — bs_used lands in the bench
+    JSON line so a silent fallback to a smaller batch is visible in the
+    recorded artifact, not just on stderr."""
     from paddle_tpu.models.bert import ernie_large
     if not on_tpu:
-        return _bench_mlm_pretrain(_tiny_mlm_cfg(), 2, 32, 2, False)
+        sps, mfu = _bench_mlm_pretrain(_tiny_mlm_cfg(), 2, 32, 2, False)
+        return sps, mfu, 2
+    import gc
     import sys
     last = None
     for b, pause in ((bs, 0), (bs // 2, 30), (bs // 2, 60)):
         if pause:
             time.sleep(pause)
         try:
-            return _bench_mlm_pretrain(ernie_large(), b, 512, 15, True)
+            sps, mfu = _bench_mlm_pretrain(ernie_large(), b, 512, 15, True)
+            return sps, mfu, b
         except Exception as e:
-            last = e
+            # drop the traceback: it pins the failed attempt's frames —
+            # params + AdamW state + AMP copies — in HBM through the retry
+            last = e.with_traceback(None)
             print(f"bench_ernie: bs={b} attempt failed "
                   f"({type(e).__name__}); retrying smaller/later",
                   file=sys.stderr)
+            gc.collect()
     raise last
 
 
@@ -398,9 +408,10 @@ def main():
             round(bt, 1)
         if bt_mfu is not None:
             line["mfu_bert"] = round(bt_mfu, 4)
-        er, er_mfu = bench_ernie(on_tpu)
+        er, er_mfu, er_bs = bench_ernie(on_tpu)
         line["ernie_large_samples_per_sec" + ("" if on_tpu else "_cpu")] = \
             round(er, 1)
+        line["ernie_bs"] = er_bs
         if er_mfu is not None:
             line["mfu_ernie"] = round(er_mfu, 4)
         rn, rn_mfu = bench_resnet(on_tpu)

@@ -1,0 +1,326 @@
+"""Op-level benchmark harness + CI regression gate.
+
+Reference infrastructure (SURVEY.md §6): the op micro-benchmark runner
+`paddle/fluid/operators/benchmark/op_tester.cc` (config-driven: build one
+operator from an OpDesc, feed synthetic inputs, time repeated runs fwd and
+grad) and the CI gate `tools/check_op_benchmark_result.py` (parse one JSON
+line per case from a logs dir, compare a PR run against a develop run,
+flag cases whose time regressed past a threshold).
+
+TPU-native redesign: cases call the PUBLIC functional API (the same
+`core.dispatch` path users hit) under `jax.jit`, so a case measures what
+the op costs inside a compiled program on the actual backend — fwd, and
+fwd+bwd via `jax.grad` for differentiable float cases — rather than a
+hand-built OpDesc interpreted by an executor. One JSON line per case
+(`{"name", "device", "fwd_ms", "fwd_bwd_ms", "repeat", "shapes"}`)
+written to a logs dir, and `compare_dirs` implements the develop-vs-PR
+gate with the reference's relative-diff semantics.
+
+CLI:
+    python -m paddle_tpu.testing.op_bench --out logs/        # run all
+    python -m paddle_tpu.testing.op_bench --ops matmul softmax --out logs/
+    python -m paddle_tpu.testing.op_bench --compare dev_logs pr_logs \
+        --threshold 0.05                                      # CI gate
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["OpBenchCase", "default_cases", "run_case", "run_cases",
+           "compare_dirs", "main"]
+
+
+@dataclasses.dataclass
+class OpBenchCase:
+    """One benchmark case: a named callable over synthetic inputs.
+
+    build() -> (fn, args): fn is pure (jax arrays -> jax array/tuple) and
+    will be jitted; args are jax arrays. The reference analogue is one
+    OpTesterConfig block (op_tester_config.h: op name, input shapes,
+    attrs, repeat count).
+    """
+    name: str
+    build: Callable[[], tuple]
+    differentiable: bool = True
+    repeat: int = 50
+    shapes: str = ""
+
+
+def _rand(shape, dtype="float32", seed=0):
+    import jax.numpy as jnp
+    rng = np.random.RandomState(hash((seed,) + tuple(shape)) % (2 ** 31))
+    if dtype in ("int32", "int64"):
+        return jnp.asarray(rng.randint(0, 64, shape), dtype)
+    return jnp.asarray(rng.randn(*shape).astype(np.float32), dtype)
+
+
+def default_cases(large: bool = True) -> list:
+    """Representative op corpus across the registry's categories —
+    elementwise, matmul/conv (MXU), reductions, data movement, norm,
+    loss, sparse lookup — the same coverage spread as the reference's
+    benchmark configs. `large=False` shrinks shapes for CPU CI."""
+    import jax
+    import jax.numpy as jnp
+
+    N = 1024 if large else 32
+    B = 32 if large else 2
+    cases = []
+
+    def case(name, build, differentiable=True, shapes="", repeat=50):
+        cases.append(OpBenchCase(name, build, differentiable,
+                                 repeat, shapes))
+
+    # -- elementwise / activation (VPU, bandwidth-bound)
+    for un in ("exp", "tanh", "sigmoid", "relu", "gelu", "sqrt", "rsqrt"):
+        def b(un=un):
+            if un == "rsqrt":  # jnp has no rsqrt; lax does
+                import jax.lax as lax
+                return lax.rsqrt, (jnp.abs(_rand((N, N))) + 1e-3,)
+            f = getattr(jax.nn, un, None) or getattr(jnp, un)
+            if un == "sqrt":  # keep the domain positive
+                return f, (jnp.abs(_rand((N, N))) + 1e-3,)
+            return f, (_rand((N, N)),)
+        case(un, b, shapes=f"[{N},{N}]")
+    for bi in ("add", "multiply", "maximum"):
+        def b(bi=bi):
+            return getattr(jnp, bi), (_rand((N, N)), _rand((N, N), seed=1))
+        case(f"elementwise_{bi}", b, shapes=f"[{N},{N}]x2")
+
+    # -- MXU
+    def b_matmul():
+        return jnp.matmul, (_rand((N, N)), _rand((N, N), seed=1))
+    case("matmul", b_matmul, shapes=f"[{N},{N}]@[{N},{N}]")
+
+    def b_conv():
+        import jax.lax as lax
+        x = _rand((B, 56 if large else 8, 56 if large else 8, 64))
+        w = _rand((3, 3, 64, 64), seed=1)
+
+        def conv(x, w):
+            return lax.conv_general_dilated(
+                x, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return conv, (x, w)
+    case("conv2d", b_conv, shapes=f"NHWC[{B},56,56,64] k3x3")
+
+    # -- reductions
+    for red in ("sum", "mean", "max"):
+        def b(red=red):
+            return getattr(jnp, red), (_rand((N, N)),)
+        case(f"reduce_{red}", b, shapes=f"[{N},{N}]")
+    def b_cumsum():
+        return jnp.cumsum, (_rand((N, N)),)
+    case("cumsum", b_cumsum, shapes=f"[{N},{N}]")
+
+    # -- data movement
+    def b_transpose():
+        return (lambda x: jnp.transpose(x, (1, 0))), (_rand((N, N)),)
+    case("transpose", b_transpose, shapes=f"[{N},{N}]")
+
+    def b_concat():
+        return (lambda a, b: jnp.concatenate([a, b], axis=0)), \
+            (_rand((N, N)), _rand((N, N), seed=1))
+    case("concat", b_concat, shapes=f"[{N},{N}]x2")
+
+    def b_gather():
+        idx = _rand((N,), "int32", seed=2) % N
+        return (lambda x, i: x[i]), (_rand((N, N)), idx)
+    case("gather", b_gather, shapes=f"[{N},{N}] idx[{N}]")
+
+    def b_topk():
+        import jax.lax as lax
+        return (lambda x: lax.top_k(x, 16)[0]), (_rand((N, N)),)
+    case("top_k", b_topk, differentiable=False, shapes=f"[{N},{N}] k16")
+
+    def b_where():
+        return (lambda c, a, b: jnp.where(c, a, b)), \
+            (_rand((N, N)) > 0, _rand((N, N)), _rand((N, N), seed=1))
+    case("where", b_where, shapes=f"[{N},{N}]")
+
+    # -- norm / softmax
+    def b_softmax():
+        return jax.nn.softmax, (_rand((N, N)),)
+    case("softmax", b_softmax, shapes=f"[{N},{N}]")
+
+    def b_layer_norm():
+        g, bta = _rand((N,), seed=1), _rand((N,), seed=2)
+
+        def ln(x, g, b):
+            mu = jnp.mean(x, -1, keepdims=True)
+            var = jnp.var(x, -1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+        return ln, (_rand((N, N)), g, bta)
+    case("layer_norm", b_layer_norm, shapes=f"[{N},{N}]")
+
+    # -- loss / lookup
+    def b_softmax_ce():
+        lbl = _rand((N,), "int32", seed=3) % N
+
+        def ce(x, y):
+            return -jnp.mean(jnp.take_along_axis(
+                jax.nn.log_softmax(x), y[:, None], axis=1))
+        return ce, (_rand((N, N)), lbl)
+    case("softmax_with_cross_entropy", b_softmax_ce,
+         shapes=f"logits[{N},{N}]")
+
+    def b_embedding():
+        ids = _rand((B, 128 if large else 8), "int32", seed=4) % N
+        return (lambda t, i: t[i]), (_rand((N, 256 if large else 16)), ids)
+    case("lookup_table_v2", b_embedding,
+         shapes=f"table[{N},256] ids[{B},128]")
+
+    return cases
+
+
+def run_case(c: OpBenchCase, device: Optional[str] = None) -> dict:
+    """Time one case: jitted fwd, and jitted value+grad when
+    differentiable. Returns the one-line JSON record (op_tester.cc
+    RunImpl: warmup then `repeat` timed runs; here the whole repeat-loop
+    cost is walled and divided, with a device sync at the window edge)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn, args = c.build()
+    fwd = jax.jit(fn)
+
+    def timed(f, *a):
+        out = f(*a)                                   # compile + warmup
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(c.repeat):
+            out = f(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / c.repeat * 1e3
+
+    rec = {"name": c.name, "shapes": c.shapes, "repeat": c.repeat,
+           "device": device or jax.default_backend(),
+           "fwd_ms": round(timed(fwd, *args), 4)}
+    if c.differentiable:
+        def loss(*a):
+            out = fn(*a)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            return jnp.sum(out.astype(jnp.float32))
+        # grad wrt every float arg
+        argnums = tuple(i for i, a in enumerate(args)
+                        if jnp.issubdtype(jnp.asarray(a).dtype,
+                                          jnp.floating))
+        if argnums:
+            g = jax.jit(jax.value_and_grad(loss, argnums=argnums))
+            rec["fwd_bwd_ms"] = round(timed(g, *args), 4)
+    return rec
+
+
+def run_cases(cases: Sequence[OpBenchCase], out_dir: Optional[str] = None,
+              verbose: bool = True) -> list:
+    """Run cases; one JSON line per case, one log file per case when
+    out_dir is given (the layout check_op_benchmark_result.py's
+    load_benchmark_result_from_logs_dir expects: a dir of per-case
+    files whose LAST parseable JSON line is the record)."""
+    records = []
+    for c in cases:
+        try:
+            rec = run_case(c)
+        except Exception as e:  # a broken op must not hide later cases
+            rec = {"name": c.name, "error": f"{type(e).__name__}: {e}"}
+        records.append(rec)
+        line = json.dumps(rec)
+        if verbose:
+            print(line)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            with open(os.path.join(out_dir, f"{c.name}.log"), "w") as f:
+                f.write(line + "\n")
+    return records
+
+
+def _load_dir(d: str) -> dict:
+    out = {}
+    for fn in sorted(os.listdir(d)):
+        rec = None
+        with open(os.path.join(d, fn)) as f:
+            for line in reversed(f.read().strip().splitlines()):
+                try:
+                    rec = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if rec and "error" not in rec:
+            out[rec["name"]] = rec
+    return out
+
+
+def compare_dirs(develop_dir: str, pr_dir: str,
+                 threshold: float = 0.05) -> list:
+    """The check_op_benchmark_result.py gate: relative time diff
+    (pr - develop) / develop per case and metric; cases above
+    `threshold` are regressions. Returns [{name, metric, develop_ms,
+    pr_ms, diff, regressed}]."""
+    dev, pr = _load_dir(develop_dir), _load_dir(pr_dir)
+    rows = []
+    for name in sorted(set(dev) & set(pr)):
+        for metric in ("fwd_ms", "fwd_bwd_ms"):
+            if metric in dev[name] and metric in pr[name]:
+                d, p = dev[name][metric], pr[name][metric]
+                diff = (p - d) / d if d else 0.0
+                rows.append({"name": name, "metric": metric,
+                             "develop_ms": d, "pr_ms": p,
+                             "diff": round(diff, 4),
+                             "regressed": diff > threshold})
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ops", nargs="*", default=None,
+                    help="subset of case names (default: all)")
+    ap.add_argument("--out", default=None, help="logs dir to write")
+    ap.add_argument("--small", action="store_true",
+                    help="small shapes (CPU CI)")
+    ap.add_argument("--repeat", type=int, default=None)
+    ap.add_argument("--compare", nargs=2, metavar=("DEVELOP", "PR"),
+                    help="gate mode: compare two logs dirs")
+    ap.add_argument("--threshold", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        for d in args.compare:  # reference check_path_exists
+            if not os.path.isdir(d):
+                print(f"logs dir does not exist: {d}", file=sys.stderr)
+                return 2
+        rows = compare_dirs(args.compare[0], args.compare[1],
+                            args.threshold)
+        bad = [r for r in rows if r["regressed"]]
+        for r in rows:
+            flag = " REGRESSED" if r["regressed"] else ""
+            print(f"{r['name']}.{r['metric']}: {r['develop_ms']} -> "
+                  f"{r['pr_ms']} ms ({r['diff']:+.1%}){flag}")
+        print(f"{len(bad)} regressed / {len(rows)} checked "
+              f"(threshold {args.threshold:.0%})")
+        return 1 if bad else 0
+
+    cases = default_cases(large=not args.small)
+    if args.ops:
+        sel = set(args.ops)
+        unknown = sel - {c.name for c in cases}
+        if unknown:
+            print(f"unknown cases: {sorted(unknown)}", file=sys.stderr)
+            return 2
+        cases = [c for c in cases if c.name in sel]
+    if args.repeat:
+        for c in cases:
+            c.repeat = args.repeat
+    run_cases(cases, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

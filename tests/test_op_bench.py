@@ -1,0 +1,73 @@
+"""Op benchmark harness (testing/op_bench.py): run, logs-dir layout,
+and the develop-vs-PR regression gate (reference op_tester.cc +
+tools/check_op_benchmark_result.py)."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.testing import op_bench
+
+
+def test_run_small_corpus(tmp_path):
+    cases = [c for c in op_bench.default_cases(large=False)
+             if c.name in ("matmul", "softmax", "top_k", "reduce_sum")]
+    assert len(cases) == 4
+    for c in cases:
+        c.repeat = 2
+    recs = op_bench.run_cases(cases, str(tmp_path), verbose=False)
+    by_name = {r["name"]: r for r in recs}
+    # every case produced a timing, none errored
+    for name in ("matmul", "softmax", "reduce_sum", "top_k"):
+        assert "error" not in by_name[name], by_name[name]
+        assert by_name[name]["fwd_ms"] > 0
+    # differentiable cases also time fwd+bwd; top_k (int indices) doesn't
+    assert "fwd_bwd_ms" in by_name["matmul"]
+    assert "fwd_bwd_ms" not in by_name["top_k"]
+    # one log file per case, last line parseable (the logs-dir layout the
+    # reference gate consumes)
+    for name in by_name:
+        path = tmp_path / f"{name}.log"
+        assert path.exists()
+        rec = json.loads(path.read_text().strip().splitlines()[-1])
+        assert rec["name"] == name
+
+
+def test_compare_gate(tmp_path):
+    dev, pr = tmp_path / "dev", tmp_path / "pr"
+    os.makedirs(dev), os.makedirs(pr)
+
+    def write(d, name, fwd, bwd=None):
+        rec = {"name": name, "fwd_ms": fwd}
+        if bwd is not None:
+            rec["fwd_bwd_ms"] = bwd
+        (d / f"{name}.log").write_text(json.dumps(rec) + "\n")
+
+    write(dev, "matmul", 1.0, 3.0)
+    write(pr, "matmul", 1.2, 3.0)          # fwd +20%: regression
+    write(dev, "softmax", 2.0)
+    write(pr, "softmax", 1.8)              # improvement
+    write(dev, "only_dev", 1.0)            # unmatched: ignored
+
+    rows = op_bench.compare_dirs(str(dev), str(pr), threshold=0.05)
+    by = {(r["name"], r["metric"]): r for r in rows}
+    assert by[("matmul", "fwd_ms")]["regressed"]
+    assert not by[("matmul", "fwd_bwd_ms")]["regressed"]
+    assert not by[("softmax", "fwd_ms")]["regressed"]
+    assert ("only_dev", "fwd_ms") not in by
+    # CLI gate exit code: 1 when any regression
+    assert op_bench.main(["--compare", str(dev), str(pr)]) == 1
+    assert op_bench.main(["--compare", str(dev), str(pr),
+                          "--threshold", "0.5"]) == 0
+
+
+def test_cli_runs_subset(tmp_path, capsys):
+    rc = op_bench.main(["--ops", "matmul", "--small", "--repeat", "2",
+                        "--out", str(tmp_path / "logs")])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["name"] == "matmul" and rec["fwd_ms"] > 0
+    assert (tmp_path / "logs" / "matmul.log").exists()
+    # unknown op name -> exit 2
+    assert op_bench.main(["--ops", "nope", "--small"]) == 2

@@ -129,10 +129,17 @@ def test_bert_step_parity_packed_vs_standard():
         return [float(step(*args).numpy()) for _ in range(3)]
 
     from paddle_tpu.core import flags as _flags
+    from paddle_tpu.nn.functional import attention as A
     prev = _flags.flag("flash_attention_min_seq")
     try:
         packed = run(512)     # T=512, d=64 -> packed (non-causal) path
+        # guard against a vacuous pass: if the packed kernel regressed,
+        # SDPA silently unpacks to the composed path and both runs would
+        # agree without the kernel ever executing
+        assert A.LAST_PATH == "flash", (
+            f"packed path did not engage (LAST_PATH={A.LAST_PATH})")
         standard = run(4096)  # threshold above T -> composed path
+        assert A.LAST_PATH == "composed"
     finally:
         paddle.set_flags({"FLAGS_flash_attention_min_seq": prev})
     np.testing.assert_allclose(packed, standard, rtol=5e-3, atol=5e-3)
