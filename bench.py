@@ -318,6 +318,54 @@ def bench_ernie(on_tpu: bool, bs: int = 32):
     raise last
 
 
+def bench_decode(on_tpu: bool):
+    """Serving throughput: greedy KV-cache decode on the flagship GPT
+    (models/generation.py — prefill + lax.scan of decode_step, the
+    exported-Predictor substrate). Reports decode tokens/s at a serving
+    batch (the reference's inference product axis: inference/api/
+    analysis_predictor.cc capi/ serving; here the decode loop runs as ONE
+    compiled on-device scan instead of an executor stepping an op graph).
+    Returns (decode_tokens_per_sec, None)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.models.generation import generate
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=6, max_seq_len=1024)
+        bs, prompt, new = 8, 128, 384
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+        bs, prompt, new = 2, 8, 8
+    model = GPT(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (bs, prompt), dtype=np.int32)
+    short = new // 3
+    # PURE decode throughput via the two-length slope: one generate call
+    # also pays the prompt prefill + per-call host work (extract_params
+    # walk, output concat), which would bias a tokens/new accounting;
+    # timing two new-token lengths and taking the difference cancels
+    # every length-independent term.
+    out = generate(model, ids, max_new_tokens=new)    # compile + warmup
+    assert out.shape == (bs, prompt + new)
+    generate(model, ids, max_new_tokens=short)        # compile short
+
+    def window_long():
+        generate(model, ids, max_new_tokens=new)
+
+    def window_short():
+        generate(model, ids, max_new_tokens=short)
+
+    reps = 3 if on_tpu else 1
+    dt = _best_of(window_long, reps) - _best_of(window_short, reps)
+    if dt <= 0:  # CPU smoke / noise floor: fall back to end-to-end
+        return bs * new / _best_of(window_long, 1), None
+    return bs * (new - short) / dt, None
+
+
 def bench_resnet(on_tpu: bool):
     """BASELINE.md config 2: ResNet-50-class conv workload imgs/sec
     (synthetic ImageNet batch, train step). Returns (imgs/sec, mfu)."""
@@ -418,6 +466,8 @@ def main():
         line["resnet50_imgs_per_sec"] = round(rn, 1)
         if rn_mfu is not None:
             line["mfu_resnet"] = round(rn_mfu, 4)
+        dc, _ = bench_decode(on_tpu)
+        line["gpt_decode_tokens_per_sec"] = round(dc, 1)
     print(json.dumps(line))
 
 

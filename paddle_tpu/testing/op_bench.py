@@ -54,11 +54,14 @@ class OpBenchCase:
     shapes: str = ""
 
 
-def _rand(shape, dtype="float32", seed=0):
+def _rand(shape, dtype="float32", seed=0, high=None):
     import jax.numpy as jnp
     rng = np.random.RandomState(hash((seed,) + tuple(shape)) % (2 ** 31))
     if dtype in ("int32", "int64"):
-        return jnp.asarray(rng.randint(0, 64, shape), dtype)
+        # callers pass the index domain via `high`; a fixed small range
+        # would make gather/lookup cases measure a degenerate cache-hot
+        # pattern over a sliver of the table
+        return jnp.asarray(rng.randint(0, high or 64, shape), dtype)
     return jnp.asarray(rng.randn(*shape).astype(np.float32), dtype)
 
 
@@ -131,7 +134,7 @@ def default_cases(large: bool = True) -> list:
     case("concat", b_concat, shapes=f"[{N},{N}]x2")
 
     def b_gather():
-        idx = _rand((N,), "int32", seed=2) % N
+        idx = _rand((N,), "int32", seed=2, high=N)
         return (lambda x, i: x[i]), (_rand((N, N)), idx)
     case("gather", b_gather, shapes=f"[{N},{N}] idx[{N}]")
 
@@ -162,7 +165,7 @@ def default_cases(large: bool = True) -> list:
 
     # -- loss / lookup
     def b_softmax_ce():
-        lbl = _rand((N,), "int32", seed=3) % N
+        lbl = _rand((N,), "int32", seed=3, high=N)
 
         def ce(x, y):
             return -jnp.mean(jnp.take_along_axis(
@@ -172,7 +175,7 @@ def default_cases(large: bool = True) -> list:
          shapes=f"logits[{N},{N}]")
 
     def b_embedding():
-        ids = _rand((B, 128 if large else 8), "int32", seed=4) % N
+        ids = _rand((B, 128 if large else 8), "int32", seed=4, high=N)
         return (lambda t, i: t[i]), (_rand((N, 256 if large else 16)), ids)
     case("lookup_table_v2", b_embedding,
          shapes=f"table[{N},256] ids[{B},128]")
@@ -253,8 +256,9 @@ def _load_dir(d: str) -> dict:
                     break
                 except ValueError:
                     continue
-        if rec and "error" not in rec:
-            out[rec["name"]] = rec
+        if rec:
+            out[rec["name"]] = rec  # errored records kept: the gate
+            # must see them (a broken op is the worst regression)
     return out
 
 
@@ -262,14 +266,29 @@ def compare_dirs(develop_dir: str, pr_dir: str,
                  threshold: float = 0.05) -> list:
     """The check_op_benchmark_result.py gate: relative time diff
     (pr - develop) / develop per case and metric; cases above
-    `threshold` are regressions. Returns [{name, metric, develop_ms,
-    pr_ms, diff, regressed}]."""
+    `threshold` are regressions. A case that ran on develop but errors
+    in (or is missing from) the PR logs is ALSO a regression — a PR
+    that breaks an op entirely must not sail through the speed gate.
+    Returns [{name, metric, develop_ms, pr_ms, diff, regressed}] plus
+    status rows for broken/missing cases."""
     dev, pr = _load_dir(develop_dir), _load_dir(pr_dir)
     rows = []
-    for name in sorted(set(dev) & set(pr)):
+    for name in sorted(dev):
+        d_rec = dev[name]
+        p_rec = pr.get(name)
+        if "error" in d_rec:
+            continue  # case was already broken on develop: no baseline
+        if p_rec is None or "error" in p_rec:
+            status = ("missing from PR logs" if p_rec is None
+                      else p_rec["error"])
+            rows.append({"name": name, "metric": "status",
+                         "develop_ms": None, "pr_ms": None,
+                         "diff": None, "regressed": True,
+                         "detail": status})
+            continue
         for metric in ("fwd_ms", "fwd_bwd_ms"):
-            if metric in dev[name] and metric in pr[name]:
-                d, p = dev[name][metric], pr[name][metric]
+            if metric in d_rec and metric in p_rec:
+                d, p = d_rec[metric], p_rec[metric]
                 diff = (p - d) / d if d else 0.0
                 rows.append({"name": name, "metric": metric,
                              "develop_ms": d, "pr_ms": p,
@@ -301,6 +320,9 @@ def main(argv=None):
         bad = [r for r in rows if r["regressed"]]
         for r in rows:
             flag = " REGRESSED" if r["regressed"] else ""
+            if r["metric"] == "status":
+                print(f"{r['name']}: {r['detail']}{flag}")
+                continue
             print(f"{r['name']}.{r['metric']}: {r['develop_ms']} -> "
                   f"{r['pr_ms']} ms ({r['diff']:+.1%}){flag}")
         print(f"{len(bad)} regressed / {len(rows)} checked "

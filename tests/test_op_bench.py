@@ -47,18 +47,39 @@ def test_compare_gate(tmp_path):
     write(pr, "matmul", 1.2, 3.0)          # fwd +20%: regression
     write(dev, "softmax", 2.0)
     write(pr, "softmax", 1.8)              # improvement
-    write(dev, "only_dev", 1.0)            # unmatched: ignored
 
     rows = op_bench.compare_dirs(str(dev), str(pr), threshold=0.05)
     by = {(r["name"], r["metric"]): r for r in rows}
     assert by[("matmul", "fwd_ms")]["regressed"]
     assert not by[("matmul", "fwd_bwd_ms")]["regressed"]
     assert not by[("softmax", "fwd_ms")]["regressed"]
-    assert ("only_dev", "fwd_ms") not in by
     # CLI gate exit code: 1 when any regression
     assert op_bench.main(["--compare", str(dev), str(pr)]) == 1
     assert op_bench.main(["--compare", str(dev), str(pr),
                           "--threshold", "0.5"]) == 0
+
+    # a case that ran on develop but is MISSING from (or ERRORED in) the
+    # PR logs is a regression — a PR that breaks an op entirely must not
+    # sail through the speed gate
+    write(dev, "only_dev", 1.0)
+    rows = op_bench.compare_dirs(str(dev), str(pr), threshold=0.5)
+    by = {(r["name"], r["metric"]): r for r in rows}
+    assert by[("only_dev", "status")]["regressed"]
+    (pr / "only_dev.log").write_text(json.dumps(
+        {"name": "only_dev", "error": "TypeError: boom"}) + "\n")
+    rows = op_bench.compare_dirs(str(dev), str(pr), threshold=0.5)
+    by = {(r["name"], r["metric"]): r for r in rows}
+    assert by[("only_dev", "status")]["regressed"]
+    assert "boom" in by[("only_dev", "status")]["detail"]
+    assert op_bench.main(["--compare", str(dev), str(pr),
+                          "--threshold", "0.5"]) == 1
+    # already-broken-on-develop cases have no baseline: not compared
+    write(dev, "pre_broken", 1.0)
+    (dev / "pre_broken.log").write_text(json.dumps(
+        {"name": "pre_broken", "error": "old"}) + "\n")
+    rows = op_bench.compare_dirs(str(dev), str(pr), threshold=0.5)
+    assert ("pre_broken", "status") not in {(r["name"], r["metric"])
+                                            for r in rows}
 
 
 def test_cli_runs_subset(tmp_path, capsys):
