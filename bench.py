@@ -318,6 +318,58 @@ def bench_ernie(on_tpu: bool, bs: int = 32):
     raise last
 
 
+def run_gpt_probe(cfg, bs: int, iters: int, label: str,
+                  require_flash: bool = True):
+    """Shared harness for the tools/ GPT probes (gpt_medium_probe,
+    gpt_long_probe): build GPT(cfg), AMP O2 + AdamW, warmup x2, best-of-3
+    timed windows, print one line with tokens/s + MFU + attention path.
+    Asserts the flash path engaged (a silent composed fallback records a
+    ~1.5x-slower number as the datapoint) unless require_flash=False."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.gpt import GPT, gpt_loss_fn
+
+    paddle.seed(0)
+    T = cfg.max_seq_len
+    model = GPT(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    optim = opt.AdamW(1e-4, parameters=model.parameters(),
+                      grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    model, optim = paddle.amp.decorate(model, optim, level="O2",
+                                       dtype="bfloat16")
+    step = paddle.jit.TrainStep(model, gpt_loss_fn, optim)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (bs, T),
+                                     dtype=np.int32))
+    y = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (bs, T),
+                                     dtype=np.int32))
+    step(x, y); step(x, y)
+
+    def drain():
+        return float(np.asarray(
+            jax.jit(jnp.sum)(model.parameters()[-1]._value)))
+    drain()
+
+    def window():
+        for _ in range(iters):
+            step(x, y)
+        drain()
+
+    dt = _best_of(window, 3)
+    toks = iters * bs * T / dt
+    mfu = toks * _gpt_flops_per_token(cfg) / _peak_flops(jax.devices()[0])
+    from paddle_tpu.nn.functional import attention as A
+    if require_flash:
+        assert A.LAST_PATH == "flash", (
+            f"flash path did not engage (LAST_PATH={A.LAST_PATH}); the "
+            "probe would record a composed-attention number")
+    print(f"{label}({n_params/1e6:.0f}M params) bs={bs} T={T}: "
+          f"{toks:,.0f} tok/s, MFU {mfu:.4f}, path={A.LAST_PATH}")
+    return toks, mfu
+
+
 def bench_decode(on_tpu: bool):
     """Serving throughput: greedy KV-cache decode on the flagship GPT
     (models/generation.py — prefill + lax.scan of decode_step, the
