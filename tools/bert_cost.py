@@ -27,7 +27,7 @@ def main():
                                         make_bert_pretrain_batch)
     paddle.seed(0)
     cfg = BertConfig()
-    bs, seq = 64, 128
+    bs, seq = 128, 128  # match the bench geometry (bench_bert bs=128)
     model = BertForPretraining(cfg)
     optim = opt.AdamW(1e-4, parameters=model.parameters())
     model, optim = paddle.amp.decorate(model, optim, level="O2",
@@ -64,9 +64,10 @@ def main():
           f"{ba/bw*1e3:.1f} ms"
     try:
         import json
-        sps = json.load(open(os.path.join(_ROOT, "BENCH_DETAIL.json")))[
-            "bert_base_samples_per_sec"]
-        msg += f" | measured ~{bs/sps*1e3:.0f} ms (BENCH_DETAIL)"
+        d = json.load(open(os.path.join(_ROOT, "BENCH_DETAIL.json")))
+        sps = d["bert_base_samples_per_sec"]
+        if d.get("bert_bs", bs) == bs:  # only if geometries match
+            msg += f" | measured ~{bs/sps*1e3:.0f} ms (BENCH_DETAIL)"
     except Exception:
         pass
     print(msg)
