@@ -13,6 +13,16 @@ import paddle_tpu.nn.functional as F
 torch = pytest.importorskip("torch")
 import torch.nn.functional as tF  # noqa: E402
 
+import jax  # noqa: E402
+
+# vs-torch-CPU tolerances: TPU hardware transcendentals (erf/tanh/exp
+# approximations) and float reassociation differ from torch's CPU libm
+# at the 1e-5 level (measured: activations 2.2e-05 max abs, pooling
+# 1.3e-08 under a strict-equal default), so the real-chip lane runs the
+# same oracles at a looser tolerance
+_ATOL = 1e-4 if jax.default_backend() == "tpu" else 1e-5
+_RTOL = 1e-3 if jax.default_backend() == "tpu" else 1e-4
+
 
 def test_linear_matches_torch():
     x = np.random.randn(4, 6).astype("float32")
@@ -74,7 +84,8 @@ def test_pooling_matches_torch():
     np.testing.assert_allclose(
         F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1).numpy(),
         tF.avg_pool2d(torch.tensor(x), 3, 2, 1,
-                      count_include_pad=False).numpy(), rtol=1e-5)
+                      count_include_pad=False).numpy(), rtol=1e-5,
+        atol=_ATOL)
     np.testing.assert_allclose(
         F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy(),
         tF.adaptive_avg_pool2d(torch.tensor(x), 3).numpy(), rtol=1e-4,
@@ -337,7 +348,7 @@ def test_activations_match_torch():
     for mine, ref in cases:
         np.testing.assert_allclose(
             mine(paddle.to_tensor(x)).numpy(),
-            ref(torch.tensor(x)).numpy(), rtol=1e-4, atol=1e-5)
+            ref(torch.tensor(x)).numpy(), rtol=_RTOL, atol=_ATOL)
 
 
 def test_dropout_semantics():

@@ -66,7 +66,7 @@ def main():
         import json
         d = json.load(open(os.path.join(_ROOT, "BENCH_DETAIL.json")))
         sps = d["bert_base_samples_per_sec"]
-        if d.get("bert_bs", bs) == bs:  # only if geometries match
+        if d.get("bert_bs") == bs:  # only if geometry is KNOWN to match
             msg += f" | measured ~{bs/sps*1e3:.0f} ms (BENCH_DETAIL)"
     except Exception:
         pass
