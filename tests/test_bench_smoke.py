@@ -1,0 +1,37 @@
+"""bench.py smoke: the driver's round-end artifact must stay runnable.
+
+Every workload's CPU-sized variant runs one tiny window and returns a
+positive rate — catches import errors, signature drift between bench.py
+and the models/jit APIs, and broken BENCH_FULL sub-benches before the
+driver (or a judge) hits them on the real chip. Marked slow: ~2-3 min
+of tiny compiles on the CPU mesh.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def test_gpt_headline_cpu():
+    tps, mfu = bench.bench_gpt(False)
+    assert tps > 0
+    assert mfu is None  # MFU only reported on the chip
+
+
+def test_full_subbenches_cpu():
+    assert bench.bench_lenet(False) > 0
+    assert bench.bench_lenet_multistep(False) > 0
+    bt, _ = bench.bench_bert(False)
+    assert bt > 0
+    er, _, er_bs = bench.bench_ernie(False)
+    assert er > 0 and er_bs == 2  # CPU smoke geometry
+    rn, _ = bench.bench_resnet(False)
+    assert rn > 0
+    dc, _ = bench.bench_decode(False)
+    assert dc > 0

@@ -85,7 +85,7 @@ def test_pooling_matches_torch():
         F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1).numpy(),
         tF.avg_pool2d(torch.tensor(x), 3, 2, 1,
                       count_include_pad=False).numpy(), rtol=1e-5,
-        atol=_ATOL)
+        atol=1e-6)  # measured TPU deviation 1.3e-08; keep a tight oracle
     np.testing.assert_allclose(
         F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy(),
         tF.adaptive_avg_pool2d(torch.tensor(x), 3).numpy(), rtol=1e-4,
