@@ -21,15 +21,15 @@ recomputed from q/k, full T x T rectangle) outruns upstream's blocked
 bwd at this geometry despite no causal block-skipping.
 
 Scope gate (see `supported`): head_dim 64, even head count, no mask/
-dropout, T <= MAX_SEQ (2048 — a measured win boundary, see the MAX_SEQ
-comment). Up to 1024 the backward runs as one program per (batch, pair)
-holding the full [T, T] f32 rectangle in VMEM (~4 MB each at 1024 —
-measured faster than blocking at short T); above that it switches to a
-q-blocked backward (`_bwd_blocked_kernel`): each program sees its q
-rows against the full kv so the softmax is exact per row (no saved
-l/m), dq is exact per block, and dk/dv accumulate in f32 across the
-sequential q-block grid dim. This lifted the honest d=64 12-head
-geometry at T=2048 from MFU 0.459 (upstream padded path) to 0.501.
+dropout, T <= MAX_SEQ (4096 — every boundary is a measured win
+boundary, see the MAX_SEQ comment). Up to 1024 the backward runs as one
+program per (batch, pair) holding the full [T, T] f32 rectangle in VMEM
+(~4 MB each at 1024 — fewer passes win at short T); above that it runs
+FA2-style (`_dq_kernel`/`_dkv_kernel`): the forward stages each row's
+logsumexp, delta = rowsum(do*o) replaces the in-kernel correction, and
+2D q-block x kv-block grids SKIP fully-masked causal blocks. 12-head
+GPT: T=2048 MFU 0.459 (upstream padded path) -> 0.5077; T=4096 0.458
+-> 0.4771.
 """
 from __future__ import annotations
 
@@ -41,22 +41,22 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-MAX_SEQ = 2048
-# Above BWD_SINGLE_MAX the backward switches from the single-program
-# [T, T] rectangle to the q-blocked kernel (full-row softmax per q
-# block, dk/dv accumulated in f32 across sequential grid steps) — VMEM
-# stays bounded at [BWD_BLOCK_Q, T] while the single-program form
-# measured faster at short T. MAX_SEQ is a MEASURED win boundary, not a
-# VMEM one: the blocked bwd computes the full causal rectangle (no
-# block-skipping, and no saved l/m to enable it), whose 2x flop waste
-# grows with T — 12-head GPT A/B on v5e: T=2048 packed 0.501 MFU vs
-# upstream flash 0.459 (packed wins); T=4096 packed 0.291 vs upstream
-# 0.458 (packed loses, block_q also forced to 64 by the f32 dk/dv
-# accumulator refs sharing scoped VMEM). An FA2-style bwd (saved lse +
-# 2D grid + causal skip) is the known next step if T>2048 d=64
-# geometries ever matter.
+MAX_SEQ = 4096
+# Backward dispatch (all boundaries MEASURED on the 12-head GPT A/B,
+# v5e, not VMEM limits):
+# - T <= BWD_SINGLE_MAX: one program per (batch, pair) holding the full
+#   [T, T] rectangle -- fewer passes win at short T (MFU 0.607 vs 0.537
+#   for the FA2 kernels at T=1024).
+# - BWD_SINGLE_MAX < T <= MAX_SEQ: FA2-style kernels (fwd-saved lse,
+#   2D q-block x kv-block grids, causal block skipping, delta =
+#   rowsum(do*o)): T=2048 MFU 0.5077 vs upstream padded flash 0.459;
+#   T=4096 0.4771 vs 0.458. (An intermediate full-kv q-blocked bwd
+#   without lse measured 0.5013 @ 2048 but collapsed to 0.291 @ 4096 --
+#   the full causal rectangle's 2x flop waste -- and was removed once
+#   FA2 dominated it everywhere.)
+# - T > MAX_SEQ: upstream flash keeps the geometry (its deeper-pipelined
+#   kernels win back at 8192: 0.4617 vs FA2 0.4529).
 BWD_SINGLE_MAX = 1024
-BWD_BLOCK_Q = 256
 
 
 def supported(head_dim: int, num_heads: int, q_seq: int, kv_seq: int) -> bool:
@@ -88,34 +88,48 @@ def route_gate(head_dim: int, num_heads: int, q_seq: int, kv_seq: int,
             and supported(head_dim, num_heads, q_seq, kv_seq))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale, block_q,
-                head_dim):
+def _half_fwd(qh, kh, vh, sm_scale, causal, row_offset):
+    """Forward for ONE 64-wide half against the full kv: exact per-row
+    softmax (every program sees full rows). Returns (normalized output
+    [bq, 64] f32, lse [bq] f32 — the logsumexp the FA2 backward
+    re-exponentiates against)."""
+    s = lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=lax.Precision.DEFAULT) * sm_scale
+    if causal:
+        row = row_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(row >= col, s, jnp.float32(-1e30))
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=1, keepdims=True)
+    oh = lax.dot_general(e.astype(qh.dtype), vh, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32,
+                         precision=lax.Precision.DEFAULT)
+    return oh / l, (m + jnp.log(l))[:, 0]
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, causal,
+                sm_scale, block_q, head_dim):
     """One (batch, pair, q-block): full-lane 128 blocks; the two 64-wide
     heads are sliced as values, each gets its own scores/softmax/PV, and
-    the halves concat back for a single 128-lane store."""
+    the halves concat back for a single 128-lane store. With a second
+    output bound (with_lse), also stages each half's row logsumexp for
+    the FA2 backward (lse_ref block [1, 1, 2, bq] f32)."""
     qi = pl.program_id(2)
     q = q_ref[0, 0]                                   # [bq, 128]
     k = k_ref[0, 0]                                   # [T, 128]
     v = v_ref[0, 0]
-    halves = []
+    halves, lses = [], []
     for h in (0, 1):
         sl = slice(h * head_dim, (h + 1) * head_dim)
-        qh, kh, vh = q[:, sl], k[:, sl], v[:, sl]
-        s = lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32,
-                             precision=lax.Precision.DEFAULT) * sm_scale
-        if causal:
-            row = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, jnp.float32(-1e30))
-        m = jnp.max(s, axis=1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=1, keepdims=True)
-        oh = lax.dot_general(p.astype(q.dtype), vh, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32,
-                             precision=lax.Precision.DEFAULT)
-        halves.append(oh / l)
+        oh, lse = _half_fwd(q[:, sl], k[:, sl], v[:, sl], sm_scale, causal,
+                            qi * block_q)
+        halves.append(oh)
+        lses.append(lse)
     o_ref[0, 0] = jnp.concatenate(halves, axis=-1).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse_ref[0, 0] = jnp.stack(lses)
 
 
 def _half_bwd(qh, kh, vh, doh, sm_scale, causal, row_offset):
@@ -123,8 +137,9 @@ def _half_bwd(qh, kh, vh, doh, sm_scale, causal, row_offset):
     global row `row_offset` against the full kv: recompute the softmax
     from q/k (exact — every program sees full rows), then
     dv = P^T do;  ds = P*(dp - rowsum(dp*P))*scale;  dq = ds k;
-    dk = ds^T q. Returns (dq_h, dk_h, dv_h) as f32. Shared by the
-    single-program and q-blocked kernels so the algebra cannot drift."""
+    dk = ds^T q. Returns (dq_h, dk_h, dv_h) as f32. Used by the
+    single-program (T <= BWD_SINGLE_MAX) backward; the FA2 kernels use
+    the saved-lse form of the same algebra."""
     s = lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32,
                         precision=lax.Precision.DEFAULT) * sm_scale
@@ -173,30 +188,46 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref, *,
     dv_ref[0, 0] = jnp.concatenate(dvs, axis=-1).astype(dv_ref.dtype)
 
 
-def _fwd_call(q, k, v, causal, sm_scale, block_q=512):
-    B, Hp, T, d2 = q.shape
-    # bound the in-VMEM [block_q, T] f32 score/prob matrices to ~2 MB as
-    # T grows (T=1024 keeps the tuned 512; 2048 -> 256), FLOORED to a
-    # power of two — the divisor-halving below assumes it (a raw bound
-    # like 341 at T=1536 would halve to a degenerate block of 2)
+def _choose_block_q(T: int, block_q: int = 512) -> int:
+    """Forward q-block: bound the in-VMEM [block_q, T] f32 score/prob
+    matrices to ~2 MB as T grows (T=1024 keeps the tuned 512;
+    2048 -> 256), FLOORED to a power of two — the divisor-halving
+    assumes it (a raw bound like 341 at T=1536 would halve to a
+    degenerate block of 2). The result must DIVIDE T: floor-div grids
+    silently skip the tail rows (supported() admits any T % 128 == 0,
+    e.g. 640/768/896)."""
     bound = max(128, (1 << 21) // (4 * T))
     bound = 1 << (bound.bit_length() - 1)
     block_q = min(block_q, T, bound)
-    # block_q must DIVIDE T: floor-div grids silently skip the tail rows
-    # (supported() admits any T % 128 == 0, e.g. 640/768/896)
     while T % block_q:
         block_q //= 2
+    return block_q
+
+
+def _fwd_call(q, k, v, causal, sm_scale, with_lse=False):
+    """Packed forward; with_lse also returns lse [B, Hp, 2, T] f32 for
+    the FA2 backward."""
+    B, Hp, T, d2 = q.shape
+    block_q = _choose_block_q(T)
     spec_q = pl.BlockSpec((1, 1, block_q, d2), lambda b, h, i: (b, h, i, 0))
     spec_kv = pl.BlockSpec((1, 1, T, d2), lambda b, h, i: (b, h, 0, 0))
     kern = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
                              block_q=block_q, head_dim=d2 // 2)
+    out_specs = spec_q
+    out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if with_lse:
+        spec_lse = pl.BlockSpec((1, 1, 2, block_q),
+                                lambda b, h, i: (b, h, 0, i))
+        out_specs = [spec_q, spec_lse]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((B, Hp, 2, T), jnp.float32)]
     with jax.enable_x64(False):
         return pl.pallas_call(
             kern,
             grid=(B, Hp, T // block_q),
             in_specs=[spec_q, spec_kv, spec_kv],
-            out_specs=spec_q,
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            out_specs=out_specs,
+            out_shape=out_shape,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
         )(q, k, v)
@@ -220,60 +251,172 @@ def _bwd_call(q, k, v, do, causal, sm_scale):
         )(q, k, v, do)
 
 
-def _bwd_blocked_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref,
-                        dv_ref, *, causal, sm_scale, block_q, head_dim):
-    """One (batch, pair, q-block). Each program sees its q rows against
-    the FULL kv (so the softmax is exact per row — no saved l/m needed);
-    dq is exact per block, dk/dv accumulate in f32 refs across the
-    sequential q-block grid dim (init at qi == 0, the k-loop matmul
-    idiom)."""
+def _half_bwd_lse(qh, kh, vh, doh, lse_h, delta_h, sm_scale, causal,
+                  row0, col0):
+    """Saved-lse flash backward algebra for ONE 64-wide half of one
+    q-block x kv-block tile: p = exp(s - lse) is the TRUE softmax prob
+    (no in-tile max/denominator), and delta = rowsum(do*o) replaces the
+    in-kernel rowsum(dp*p) correction. Returns (p_cast, ds) — shared by
+    _dq_kernel and _dkv_kernel so the algebra cannot drift."""
+    s = lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=lax.Precision.DEFAULT) * sm_scale
+    if causal:
+        row = row0 + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = col0 + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(row >= col, s, jnp.float32(-1e30))
+    p = jnp.exp(s - lse_h[:, None])
+    dp = lax.dot_general(doh, vh, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32,
+                         precision=lax.Precision.DEFAULT)
+    ds = (p * (dp - delta_h[:, None]) * sm_scale).astype(qh.dtype)
+    return p.astype(qh.dtype), ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               causal, sm_scale, block_q, block_k, head_dim):
+    """FA2 dq: one (batch, pair, q-block, kv-block); kv innermost
+    sequential, dq accumulates in its f32 ref across kv blocks. Fully
+    masked kv blocks are SKIPPED (the causal flop saving the full-kv
+    kernels cannot have)."""
     qi = pl.program_id(2)
-    q = q_ref[0, 0]                                   # [bq, 128]
-    k = k_ref[0, 0]                                   # [T, 128]
-    v = v_ref[0, 0]
-    do = do_ref[0, 0]
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    def compute():
+        q = q_ref[0, 0]                               # [bq, 128]
+        k = k_ref[0, 0]                               # [bk, 128]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                           # [2, bq]
+        delta = delta_ref[0, 0]
+        dqs = []
+        for h in (0, 1):
+            sl = slice(h * head_dim, (h + 1) * head_dim)
+            _, ds = _half_bwd_lse(q[:, sl], k[:, sl], v[:, sl], do[:, sl],
+                                  lse[h], delta[h], sm_scale, causal,
+                                  qi * block_q, kj * block_k)
+            dqs.append(lax.dot_general(
+                ds, k[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT))
+        dq_ref[0, 0] += jnp.concatenate(dqs, axis=-1)
+
+    if causal:
+        # block live iff some col <= some row: kj*bk <= qi*bq + bq - 1
+        @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, causal, sm_scale, block_q, block_k, head_dim):
+    """FA2 dk/dv: one (batch, pair, kv-block, q-block); q innermost
+    sequential, dk/dv accumulate in their f32 refs across q blocks, with
+    fully masked q blocks skipped."""
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
 
     @pl.when(qi == 0)
     def _init():
         dk_ref[...] = jnp.zeros_like(dk_ref)
         dv_ref[...] = jnp.zeros_like(dv_ref)
 
-    dqs = []
-    for h in (0, 1):
-        sl = slice(h * head_dim, (h + 1) * head_dim)
-        dq, dk, dv = _half_bwd(q[:, sl], k[:, sl], v[:, sl], do[:, sl],
-                               sm_scale, causal, qi * block_q)
-        dqs.append(dq)
-        dk_ref[0, 0, :, sl] += dk
-        dv_ref[0, 0, :, sl] += dv
-    dq_ref[0, 0] = jnp.concatenate(dqs, axis=-1).astype(dq_ref.dtype)
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        for h in (0, 1):
+            sl = slice(h * head_dim, (h + 1) * head_dim)
+            pb, ds = _half_bwd_lse(q[:, sl], k[:, sl], v[:, sl],
+                                   do[:, sl], lse[h], delta[h], sm_scale,
+                                   causal, qi * block_q, kj * block_k)
+            dv_ref[0, 0, :, sl] += lax.dot_general(
+                pb, do[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+            dk_ref[0, 0, :, sl] += lax.dot_general(
+                ds, q[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+
+    if causal:
+        # block live iff some row >= some col: qi*bq + bq - 1 >= kj*bk
+        @pl.when(qi * block_q + block_q - 1 >= kj * block_k)
+        def _():
+            compute()
+    else:
+        compute()
 
 
-def _bwd_call_blocked(q, k, v, do, causal, sm_scale):
+FA2_BLOCK = 512
+
+
+def _bwd_call_fa2(q, k, v, do, o, lse, causal, sm_scale):
+    """FA2-style backward: saved-lse 2D-grid kernels with causal block
+    skipping. delta = rowsum(do*o) per half is computed OUTSIDE pallas
+    (XLA fuses it into one cheap pass over do/o)."""
     B, Hp, T, d2 = q.shape
-    block_q = min(BWD_BLOCK_Q, T)
-    while T % block_q:
-        block_q //= 2
-    spec_q = pl.BlockSpec((1, 1, block_q, d2), lambda b, h, i: (b, h, i, 0))
-    spec_kv = pl.BlockSpec((1, 1, T, d2), lambda b, h, i: (b, h, 0, 0))
-    kern = functools.partial(_bwd_blocked_kernel, causal=causal,
-                             sm_scale=sm_scale, block_q=block_q,
-                             head_dim=d2 // 2)
-    # dk/dv accumulate across q blocks: f32 refs (bf16 += would round
-    # T/block_q times), cast back at the caller
-    shp_f32 = jax.ShapeDtypeStruct(q.shape, jnp.float32)
+    hd = d2 // 2
+    # blocks must DIVIDE T (supported() admits any T % 128 == 0, e.g.
+    # 1152/1280/2176): a floor-divided grid would silently never visit
+    # the tail rows/cols — uninitialized dq tail, missing dk/dv blocks
+    bq = bk = min(FA2_BLOCK, T)
+    while T % bq:
+        bq //= 2
+    bk = bq
+    dof = do.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    delta = jnp.stack(
+        [jnp.sum(dof[..., :hd] * of[..., :hd], axis=-1),
+         jnp.sum(dof[..., hd:] * of[..., hd:], axis=-1)],
+        axis=2)                                       # [B, Hp, 2, T]
+    spec_q = pl.BlockSpec((1, 1, bq, d2), lambda b, h, i, j: (b, h, i, 0))
+    spec_kv = pl.BlockSpec((1, 1, bk, d2), lambda b, h, i, j: (b, h, j, 0))
+    spec_row = pl.BlockSpec((1, 1, 2, bq), lambda b, h, i, j: (b, h, 0, i))
+    kw = dict(causal=causal, sm_scale=sm_scale, block_q=bq, block_k=bk,
+              head_dim=hd)
+    f32 = jnp.float32
     with jax.enable_x64(False):
-        dq, dk, dv = pl.pallas_call(
-            kern,
-            grid=(B, Hp, T // block_q),
-            in_specs=[spec_q, spec_kv, spec_kv, spec_q],
-            out_specs=[spec_q, spec_kv, spec_kv],
-            out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
-                       shp_f32, shp_f32],
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, **kw),
+            grid=(B, Hp, T // bq, T // bk),
+            in_specs=[spec_q, spec_kv, spec_kv, spec_q, spec_row,
+                      spec_row],
+            out_specs=spec_q,
+            out_shape=jax.ShapeDtypeStruct(q.shape, f32),
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary")),
-        )(q, k, v, do)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary")),
+        )(q, k, v, do, lse, delta)
+        # dkv: swap grid roles — kv blocks parallel, q blocks innermost
+        spec_q2 = pl.BlockSpec((1, 1, bq, d2),
+                               lambda b, h, j, i: (b, h, i, 0))
+        spec_kv2 = pl.BlockSpec((1, 1, bk, d2),
+                                lambda b, h, j, i: (b, h, j, 0))
+        spec_row2 = pl.BlockSpec((1, 1, 2, bq),
+                                 lambda b, h, j, i: (b, h, 0, i))
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, **kw),
+            grid=(B, Hp, T // bk, T // bq),
+            in_specs=[spec_q2, spec_kv2, spec_kv2, spec_q2, spec_row2,
+                      spec_row2],
+            out_specs=[spec_kv2, spec_kv2],
+            out_shape=[jax.ShapeDtypeStruct(q.shape, f32),
+                       jax.ShapeDtypeStruct(q.shape, f32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary")),
+        )(q, k, v, do, lse, delta)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -286,14 +429,17 @@ def packed_flash_attention(q, k, v, causal, scale):
 
 
 def _pf_fwd(q, k, v, causal, scale):
-    return _fwd_call(q, k, v, causal, scale), (q, k, v)
+    if q.shape[2] <= BWD_SINGLE_MAX:
+        return _fwd_call(q, k, v, causal, scale), (q, k, v, None, None)
+    out, lse = _fwd_call(q, k, v, causal, scale, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _pf_bwd(causal, scale, res, do):
-    q, k, v = res
+    q, k, v, o, lse = res
     if q.shape[2] <= BWD_SINGLE_MAX:
         return _bwd_call(q, k, v, do, causal, scale)
-    return _bwd_call_blocked(q, k, v, do, causal, scale)
+    return _bwd_call_fa2(q, k, v, do, o, lse, causal, scale)
 
 
 packed_flash_attention.defvjp(_pf_fwd, _pf_bwd)

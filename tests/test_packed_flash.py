@@ -29,15 +29,17 @@ def _unpack(a, D):
 
 
 @pytest.mark.skipif(not on_tpu, reason="pallas kernel needs the TPU")
-@pytest.mark.parametrize("T", [256, 768, 1536, 2048])
+@pytest.mark.parametrize("T", [256, 768, 1152, 2048])
 def test_packed_kernel_matches_composed_fwd_bwd(T):
     """T=768 regression: supported() admits any T % 128 == 0 but 512 does
     not divide 768 — the fwd grid must round block_q down to a divisor or
-    the tail q-rows are silently never written. T=1536 regression: the
-    fwd VMEM bound must floor to a power of two or the divisor-halving
-    walks 341→…→2 (a degenerate sub-tile block). T=2048 exercises the
-    q-blocked backward (dk/dv accumulated across sequential grid steps;
-    T > BWD_SINGLE_MAX)."""
+    the tail q-rows are silently never written. T=1152 regression (both
+    hazards at once, on the FA2 path): the fwd VMEM bound must floor to
+    a power of two (a raw bound like 455 halves to a degenerate block)
+    AND the FA2 backward blocks must divide T or the 2D grid leaves the
+    dq tail uninitialized and skips the last dk/dv block. T=1152/2048
+    exercise the FA2 backward (fwd-saved lse, 2D grids with causal block
+    skipping, f32 dq/dk/dv accumulator refs; T > BWD_SINGLE_MAX)."""
     from paddle_tpu.ops.pallas.packed_flash import packed_flash_attention
     B, H, D = 2, 4, 64
     rng = np.random.RandomState(0)
@@ -155,10 +157,11 @@ def test_pack_gate_scope():
         assert not packed_flash.supported(64, 12, 1024, 1024)
         return
     assert packed_flash.supported(64, 12, 1024, 1024)
-    assert packed_flash.supported(64, 12, 2048, 2048)   # q-blocked bwd
+    assert packed_flash.supported(64, 12, 2048, 2048)   # FA2 bwd
+    assert packed_flash.supported(64, 12, 4096, 4096)   # FA2 bwd
     assert not packed_flash.supported(128, 6, 1024, 1024)   # d=128: no need
     assert not packed_flash.supported(64, 11, 1024, 1024)   # odd heads
-    # MAX_SEQ is a measured win boundary: at 4096 the full-rectangle
-    # blocked bwd loses to upstream flash (MFU 0.291 vs 0.458 A/B)
-    assert not packed_flash.supported(64, 12, 4096, 4096)
+    # MAX_SEQ is a measured win boundary: upstream flash wins back at
+    # 8192 (MFU 0.4617 vs FA2 0.4529 A/B)
+    assert not packed_flash.supported(64, 12, 8192, 8192)
     assert not packed_flash.supported(64, 12, 1024, 512)    # cross-attn
