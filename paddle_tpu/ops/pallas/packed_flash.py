@@ -21,15 +21,15 @@ recomputed from q/k, full T x T rectangle) outruns upstream's blocked
 bwd at this geometry despite no causal block-skipping.
 
 Scope gate (see `supported`): head_dim 64, even head count, no mask/
-dropout, T <= MAX_SEQ (4096 — every boundary is a measured win
-boundary, see the MAX_SEQ comment). Up to 1024 the backward runs as one
+dropout, T <= MAX_SEQ (8192 — the longest length MEASURED as a win;
+see the MAX_SEQ comment). Up to 1024 the backward runs as one
 program per (batch, pair) holding the full [T, T] f32 rectangle in VMEM
 (~4 MB each at 1024 — fewer passes win at short T); above that it runs
 FA2-style (`_dq_kernel`/`_dkv_kernel`): the forward stages each row's
 logsumexp, delta = rowsum(do*o) replaces the in-kernel correction, and
 2D q-block x kv-block grids SKIP fully-masked causal blocks. 12-head
-GPT: T=2048 MFU 0.459 (upstream padded path) -> 0.5077; T=4096 0.458
--> 0.4771.
+GPT vs the upstream padded path: T=2048 MFU 0.459 -> 0.511; T=4096
+0.458 -> 0.4907; T=8192 0.4617 -> 0.4780.
 """
 from __future__ import annotations
 
@@ -41,7 +41,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-MAX_SEQ = 4096
+MAX_SEQ = 8192
 # Backward dispatch (all boundaries MEASURED on the 12-head GPT A/B,
 # v5e, not VMEM limits):
 # - T <= BWD_SINGLE_MAX: one program per (batch, pair) holding the full
@@ -49,13 +49,18 @@ MAX_SEQ = 4096
 #   for the FA2 kernels at T=1024).
 # - BWD_SINGLE_MAX < T <= MAX_SEQ: FA2-style kernels (fwd-saved lse,
 #   2D q-block x kv-block grids, causal block skipping, delta =
-#   rowsum(do*o)): T=2048 MFU 0.5077 vs upstream padded flash 0.459;
-#   T=4096 0.4771 vs 0.458. (An intermediate full-kv q-blocked bwd
-#   without lse measured 0.5013 @ 2048 but collapsed to 0.291 @ 4096 --
-#   the full causal rectangle's 2x flop waste -- and was removed once
-#   FA2 dominated it everywhere.)
-# - T > MAX_SEQ: upstream flash keeps the geometry (its deeper-pipelined
-#   kernels win back at 8192: 0.4617 vs FA2 0.4529).
+#   rowsum(do*o)) at FA2_BLOCK=1024 (block sweep: 256 -> MFU 0.431,
+#   512 -> 0.511, 1024 -> 0.511 at T=2048; 1024 beats 512 outright at
+#   4096, 0.4907 vs 0.4771, and flips T=8192 from a loss to a win,
+#   0.4780 vs 0.4529). A/B vs upstream padded flash: T=2048 0.511 vs
+#   0.459; T=4096 0.4907 vs 0.458; T=8192 0.4780 vs 0.4617. (An
+#   intermediate full-kv q-blocked bwd without lse measured 0.5013 @
+#   2048 but collapsed to 0.291 @ 4096 -- the full causal rectangle's
+#   2x flop waste -- and was removed once FA2 dominated it.)
+# - T > MAX_SEQ: upstream flash. 8192 is the longest length A/B'd,
+#   not a measured loss boundary -- the trend at 8192 still favours
+#   FA2 (+3.5%), so a 16k-context d=64 model should re-run the A/B
+#   before assuming either path.
 BWD_SINGLE_MAX = 1024
 
 
@@ -357,7 +362,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         compute()
 
 
-FA2_BLOCK = 512
+FA2_BLOCK = 1024
 
 
 def _bwd_call_fa2(q, k, v, do, o, lse, causal, sm_scale):

@@ -29,7 +29,7 @@ def _unpack(a, D):
 
 
 @pytest.mark.skipif(not on_tpu, reason="pallas kernel needs the TPU")
-@pytest.mark.parametrize("T", [256, 768, 1152, 2048])
+@pytest.mark.parametrize("T", [256, 768, 1152, 2048, 6400])
 def test_packed_kernel_matches_composed_fwd_bwd(T):
     """T=768 regression: supported() admits any T % 128 == 0 but 512 does
     not divide 768 — the fwd grid must round block_q down to a divisor or
@@ -37,11 +37,14 @@ def test_packed_kernel_matches_composed_fwd_bwd(T):
     hazards at once, on the FA2 path): the fwd VMEM bound must floor to
     a power of two (a raw bound like 455 halves to a degenerate block)
     AND the FA2 backward blocks must divide T or the 2D grid leaves the
-    dq tail uninitialized and skips the last dk/dv block. T=1152/2048
-    exercise the FA2 backward (fwd-saved lse, 2D grids with causal block
-    skipping, f32 dq/dk/dv accumulator refs; T > BWD_SINGLE_MAX)."""
+    dq tail uninitialized and skips the last dk/dv block. T=1152/2048/
+    6400 exercise the FA2 backward (fwd-saved lse, 2D grids with causal
+    block skipping, f32 accumulator refs; T > BWD_SINGLE_MAX); 6400 also
+    walks the FA2 block halving 1024→512→256 (6400 % 1024 = 256)."""
     from paddle_tpu.ops.pallas.packed_flash import packed_flash_attention
-    B, H, D = 2, 4, 64
+    # the composed ORACLE materialises [B, H, T, T] f32 scores: at
+    # T=6400 the B2/H4 geometry needs >17G hbm, so large T shrinks it
+    B, H, D = (2, 4, 64) if T <= 2048 else (1, 2, 64)
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(B, H, T, D) * 0.3, jnp.bfloat16)
     k = jnp.asarray(rng.randn(B, H, T, D) * 0.3, jnp.bfloat16)
@@ -158,10 +161,8 @@ def test_pack_gate_scope():
         return
     assert packed_flash.supported(64, 12, 1024, 1024)
     assert packed_flash.supported(64, 12, 2048, 2048)   # FA2 bwd
-    assert packed_flash.supported(64, 12, 4096, 4096)   # FA2 bwd
+    assert packed_flash.supported(64, 12, 8192, 8192)   # FA2 bwd blk1024
     assert not packed_flash.supported(128, 6, 1024, 1024)   # d=128: no need
     assert not packed_flash.supported(64, 11, 1024, 1024)   # odd heads
-    # MAX_SEQ is a measured win boundary: upstream flash wins back at
-    # 8192 (MFU 0.4617 vs FA2 0.4529 A/B)
-    assert not packed_flash.supported(64, 12, 8192, 8192)
+    assert not packed_flash.supported(64, 12, 16384, 16384)  # MAX_SEQ gate
     assert not packed_flash.supported(64, 12, 1024, 512)    # cross-attn
