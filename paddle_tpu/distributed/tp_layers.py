@@ -24,6 +24,17 @@ from ..parallel import mesh as _mesh
 from ..core.tensor import Tensor
 
 
+def _tp_spec(ndim, last):
+    """Constraint touching ONLY the tp-relevant last dim; every other dim
+    is UNCONSTRAINED so the batch/seq layout chosen elsewhere (dp/sp/
+    sharding) passes through. Constraining leading dims to None (observed
+    pre-round-4) forced the partitioner to REPLICATE the batch dim at
+    every tp boundary — all-gathering activations to the global batch and
+    silently destroying data-parallel compute scaling."""
+    from jax.sharding import PartitionSpec as P
+    return [P.UNCONSTRAINED] * (ndim - 1) + [last]
+
+
 class ColumnParallelLinear(Layer):
     """Weight [in, out] sharded on OUT columns over 'tp'
     (reference: _parallel_linear axis=1, collective.py:659)."""
@@ -43,9 +54,9 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            out = shard_activation(out, *([None] * (out.ndim - 1) + [None]))
+            out = shard_activation(out, *_tp_spec(out.ndim, None))
         else:
-            out = shard_activation(out, *([None] * (out.ndim - 1) + ["tp"]))
+            out = shard_activation(out, *_tp_spec(out.ndim, "tp"))
         return out
 
 
@@ -68,10 +79,10 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            x = shard_activation(x, *([None] * (x.ndim - 1) + ["tp"]))
+            x = shard_activation(x, *_tp_spec(x.ndim, "tp"))
         out = F.linear(x, self.weight, None)
         # force the contraction's partial sums to reduce here (psum over tp)
-        out = shard_activation(out, *([None] * out.ndim))
+        out = shard_activation(out, *_tp_spec(out.ndim, None))
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -93,7 +104,7 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        return shard_activation(out, *([None] * out.ndim))
+        return shard_activation(out, *_tp_spec(out.ndim, None))
 
 
 class ParallelCrossEntropy(Layer):

@@ -110,9 +110,10 @@ class BertLayer(nn.Layer):
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x, attn_mask=None):
+        from ..parallel.api import shard_batch_activation
         x = self.ln1(x + self.drop(self.attn(x, attn_mask)))
         h = self.down(F.gelu(self.up(x), approximate=True))
-        return self.ln2(x + self.drop(h))
+        return shard_batch_activation(self.ln2(x + self.drop(h)))
 
 
 class Bert(nn.Layer):
@@ -141,6 +142,8 @@ class Bert(nn.Layer):
         if token_type_ids is not None:
             x = x + self.type_emb(token_type_ids)
         x = self.drop(self.emb_ln(x))
+        from ..parallel.api import shard_batch_activation
+        x = shard_batch_activation(x)
         for layer in self.layers:
             x = layer(x, attn_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))

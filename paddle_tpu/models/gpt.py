@@ -27,7 +27,8 @@ from .. import nn
 from ..nn import functional as F
 from ..core.tensor import Tensor
 from ..ops import manipulation as M
-from ..parallel.api import shard_activation, mark_sharding
+from ..parallel.api import (shard_activation, shard_batch_activation,
+                            mark_sharding)
 from ..distributed.tp_layers import (ColumnParallelLinear, RowParallelLinear,
                                      VocabParallelEmbedding)
 
@@ -216,8 +217,7 @@ class GPTBlock(nn.Layer):
     def _body(self, x):
         x = x + self.attn(self.ln1(x))
         x = x + self.mlp(self.ln2(x))
-        if self.cfg.sequence_parallel:
-            x = shard_activation(x, "dp", "sp", None)
+        x = shard_batch_activation(x)
         return x
 
     def forward(self, x):
@@ -262,8 +262,7 @@ class GPT(nn.Layer):
         pos = Tensor(jnp.arange(T, dtype=jnp.int32)[None, :])
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        if self.cfg.sequence_parallel:
-            x = shard_activation(x, "dp", "sp", None)
+        x = shard_batch_activation(x)
         for blk in self.blocks:
             x = blk(x)
         x = self.ln_f(x)

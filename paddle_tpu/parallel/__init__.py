@@ -7,7 +7,7 @@ from .mesh import (  # noqa: F401
 )
 from .api import (  # noqa: F401
     ShardedTrainStep, ShardingStage, shard_activation, shard_batch,
-    mark_sharding,
+    shard_batch_activation, mark_sharding,
     param_spec,
 )
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401

@@ -56,6 +56,25 @@ def shard_activation(x, *spec):
     return _shard_constraint(x, tuple(spec))
 
 
+def shard_batch_activation(x):
+    """Constrain a [batch, seq, ...] activation to the canonical data
+    layout: batch over (dp, sharding), seq over sp. The scaling-book
+    recipe — annotate activations, let GSPMD insert collectives. Without
+    this the partitioner is free to resolve the replicated-params vs
+    sharded-batch conflict by ALL-GATHERING the trunk (observed on the
+    CPU partitioner: the embedding output was gathered to the global
+    batch and every device ran the full forward/backward — numerically
+    identical to dp, so parity tests pass, but zero compute scaling).
+    Safe no-op when no mesh is active or axes are shard_map-manual."""
+    if _mesh.get_global_mesh() is None:
+        return x
+    ndim = getattr(x, "ndim", 0)
+    if ndim < 2:
+        return x
+    spec = (("dp", "sharding"), "sp") + (None,) * (ndim - 2)
+    return _shard_constraint(x, spec)
+
+
 def shard_batch(data, mesh: Mesh = None, spec=("dp",)):
     """Build a GLOBAL batch array from this process's local shard.
 
@@ -278,30 +297,7 @@ class ShardedTrainStep:
         self.optimizer._global_step += 1
         return Tensor(loss)
 
-    def lowered_text(self, *args):
-        params, frozen = self._split_params()
-        buffers = {k: b._value for k, b in self.model.named_buffers()
-                   if b is not None}
-        opt_state = self._opt_state or self.optimizer.init_opt_state(params)
-        acc = self._acc if self._acc is not None else \
-            jax.tree_util.tree_map(jnp.zeros_like, params)
-        arr_args = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
-                    for a in args]
-        if self._jitted is None:
-            self._build(params, frozen, buffers, opt_state, arr_args)
-        lr = jnp.asarray(0.001, jnp.float32)
-        key = jax.random.PRNGKey(0)
-        return self._jitted.lower(params, frozen, buffers, opt_state, acc,
-                                  jnp.asarray(True), lr, key,
-                                  *arr_args).as_text()
-
-    def compiled_text(self, *args) -> str:
-        """Post-GSPMD-partitioning HLO of the step executable — the
-        collectives XLA actually inserted (reduce-scatter for ZeRO>=2,
-        all-gather for ZeRO-3 params, collective-permute for pipeline)
-        are visible here, the compile-time analogue of the reference's
-        meta-optimizer ProgramDesc assertions
-        (test_fleet_sharding_meta_optimizer.py)."""
+    def _lowered(self, *args):
         params, frozen = self._split_params()
         buffers = {k: b._value for k, b in self.model.named_buffers()
                    if b is not None}
@@ -315,6 +311,24 @@ class ShardedTrainStep:
         lr = jnp.asarray(0.001, jnp.float32)
         key = jax.random.PRNGKey(0)
         with self.mesh:
-            return self._jitted.lower(
-                params, frozen, buffers, opt_state, acc,
-                jnp.asarray(True), lr, key, *arr_args).compile().as_text()
+            return self._jitted.lower(params, frozen, buffers, opt_state,
+                                      acc, jnp.asarray(True), lr, key,
+                                      *arr_args)
+
+    def lowered_text(self, *args):
+        return self._lowered(*args).as_text()
+
+    def compiled_step(self, *args):
+        """Compiled step executable — exposes cost_analysis() (per-device
+        flops/bytes from XLA's own cost model) and as_text() (partitioned
+        HLO) for compile-level scaling receipts (tools/scaling_analysis.py)."""
+        return self._lowered(*args).compile()
+
+    def compiled_text(self, *args) -> str:
+        """Post-GSPMD-partitioning HLO of the step executable — the
+        collectives XLA actually inserted (reduce-scatter for ZeRO>=2,
+        all-gather for ZeRO-3 params, collective-permute for pipeline)
+        are visible here, the compile-time analogue of the reference's
+        meta-optimizer ProgramDesc assertions
+        (test_fleet_sharding_meta_optimizer.py)."""
+        return self.compiled_step(*args).as_text()

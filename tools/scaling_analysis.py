@@ -1,0 +1,181 @@
+"""Data-parallel weak-scaling receipt: 8 -> 256 devices (BASELINE metric 3).
+
+The BASELINE north star asks for "Fleet data-parallel scaling efficiency
+measured 8 -> 256 chips". This environment has ONE physical chip, so this
+tool produces the honest compile-level counterpart, in two layers:
+
+1. MEASURED (virtual mesh, per device count, own subprocess because XLA
+   fixes the device count at backend init): build the dp=N mesh, compile
+   the real ShardedTrainStep over it, and extract from the PARTITIONED
+   artifact
+     - per-device flops from XLA's own cost model (cost_analysis) —
+       weak scaling demands this stays CONSTANT as N grows;
+     - the gradient all-reduce payload bytes parsed from the partitioned
+       HLO — ring all-reduce moves 2*(N-1)/N * payload per device, so
+       the per-device wire bytes must stay ~CONSTANT as N grows.
+   These are the same invariants the reference's fleet meta-optimizer
+   tests assert on ProgramDesc (test_fleet_sharding_meta_optimizer.py),
+   checked on the artifact XLA will actually run.
+
+2. PROJECTED (clearly labeled as a model, not a measurement): scaling
+   efficiency = t_compute / (t_compute + t_allreduce) anchored to
+   (a) the real-chip measured flagship step time (BENCH_DETAIL.json) and
+   (b) the payload verified in layer 1, over v5e ICI ring bandwidth.
+   No overlap is assumed (worst case); XLA's latency-hiding scheduler
+   overlaps the grad all-reduce with the backward pass in practice, so
+   real efficiency sits between this floor and 1.0.
+
+Run: python tools/scaling_analysis.py [N ...]   (default 8 64 256)
+Child: python tools/scaling_analysis.py --child N
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+          "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+          "pred": 1}
+
+
+def allreduce_payload(hlo: str):
+    """Sum payload bytes over all-reduce ops in partitioned HLO text.
+
+    Shapes appear as `f32[1576960]{0} all-reduce(` or, for multi-operand
+    ops, `(f32[8], f32[16384]) all-reduce(`. Counts each op once (the
+    defining line, not operand uses).
+    """
+    total, count = 0, 0
+    shape_re = re.compile(r"(\w+)\[([\d,]*)\]")
+    for line in hlo.splitlines():
+        m = re.search(r"=\s+(\([^)]*\)|\S+)\s+all-reduce(?:-start)?\(", line)
+        if not m:
+            continue
+        count += 1
+        for dt, dims in shape_re.findall(m.group(1)):
+            if dt not in _BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _BYTES[dt]
+    return total, count
+
+
+def child(n_devices: int):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    # env JAX_PLATFORMS is overridden by the axon plugin's sitecustomize
+    # registration; explicit config selection wins (same as tests/conftest)
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+    from paddle_tpu.parallel import (ShardedTrainStep, build_mesh,
+                                     set_global_mesh)
+
+    mesh = build_mesh(dp=n_devices)
+    set_global_mesh(mesh)
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=64)
+    model = GPT(cfg)
+    optim = opt.AdamW(1e-3, parameters=model.parameters())
+    step = ShardedTrainStep(model, gpt_loss_fn, optim, mesh=mesh)
+    per_dev_batch = 2
+    B = per_dev_batch * n_devices
+    x = paddle.to_tensor(np.zeros((B, 64), np.int64))
+    y = paddle.to_tensor(np.zeros((B, 64), np.int64))
+    t0 = time.perf_counter()
+    compiled = step.compiled_step(x, y)
+    compile_s = time.perf_counter() - t0
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax: one dict per program
+        ca = ca[0]
+    payload, n_ar = allreduce_payload(compiled.as_text())
+    print(json.dumps({
+        "devices": n_devices,
+        "per_device_batch": per_dev_batch,
+        "per_device_gflops": round(float(ca.get("flops", 0.0)) / 1e9, 4),
+        "allreduce_payload_bytes": payload,
+        "allreduce_count": n_ar,
+        "compile_s": round(compile_s, 1),
+    }))
+
+
+# v5e interconnect: 2D torus, 4 ICI links/chip at ~45 GB/s each direction.
+# A bidirectional ring all-reduce rides 2 links; payload crossing the wire
+# per device is 2*(N-1)/N * bytes (reduce-scatter + all-gather phases).
+_ICI_RING_BW = 2 * 45e9
+
+
+def project(results, step_s: float, grad_bytes: int):
+    """Efficiency floor per device count: compute / (compute + unoverlapped
+    ring all-reduce of grad_bytes over ICI)."""
+    rows = []
+    for r in results:
+        n = r["devices"]
+        t_comm = 2 * (n - 1) / n * grad_bytes / _ICI_RING_BW
+        rows.append({"devices": n,
+                     "efficiency_floor": round(step_s / (step_s + t_comm), 4)})
+    return rows
+
+
+def main(counts):
+    results = []
+    for n in counts:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", str(n)],
+            env=env, capture_output=True, text=True, cwd=ROOT, timeout=1800)
+        if out.returncode != 0:
+            print(f"devices={n} FAILED:\n{out.stderr[-2000:]}",
+                  file=sys.stderr)
+            continue
+        line = out.stdout.strip().splitlines()[-1]
+        results.append(json.loads(line))
+        print(line, flush=True)
+
+    if len(results) >= 2:
+        g = [r["per_device_gflops"] for r in results]
+        p = [r["allreduce_payload_bytes"] for r in results]
+        drift = (max(g) - min(g)) / max(g)
+        print(json.dumps({
+            "weak_scaling_flops_drift": round(drift, 4),
+            "payload_constant": max(p) == min(p),
+            "verdict": "per-device flops constant and all-reduce payload "
+                       "constant across device counts — compile-level weak "
+                       "scaling holds" if drift < 0.02 and max(p) == min(p)
+                       else "DRIFT DETECTED — inspect per-device partitioning",
+        }))
+        # projection anchored to the real-chip flagship step: 124M-param
+        # GPT, measured 199.6 ms/step (BENCH_DETAIL.json r4), grads
+        # all-reduced in bf16 (fp16_allreduce comm-opt) = 248 MB
+        print(json.dumps({
+            "projection_note": "efficiency floor = compute/(compute+"
+            "unoverlapped ICI ring all-reduce); anchored to measured "
+            "flagship step 199.6 ms, bf16 grads 248 MB",
+            "rows": project(results, 0.1996, 248_000_000)}))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]))
+    else:
+        ns = [int(a) for a in sys.argv[1:]] or [8, 64, 256]
+        main(ns)
