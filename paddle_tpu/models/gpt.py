@@ -43,6 +43,12 @@ class GPTConfig:
     ffn_mult: int = 4
     dropout: float = 0.0
     use_recompute: bool = False
+    # NOTE: block outputs are unconditionally constrained to the canonical
+    # [batch=(dp,sharding), seq=sp] layout regardless of this flag; on
+    # build_mesh meshes sp defaults to size 1 so this is a no-op, but a
+    # custom mesh with sp>1 gets sequence-sharded activations even with
+    # sequence_parallel=False. This flag still controls the ln/dropout
+    # scatter-gather placement choices.
     sequence_parallel: bool = False
     # context parallelism: attention itself runs ring-sharded over the
     # 'sp' mesh axis (parallel/ring_attention.py) — the long-context path

@@ -24,7 +24,7 @@ except ImportError:
 # vision/extension functionals unified in ops (reference keeps them under
 # nn.functional too: python/paddle/nn/functional/__init__.py)
 from ...ops.vision_ops import (  # noqa: F401,E402
-    affine_grid, grid_sample, temporal_shift,
+    affine_grid, fold, grid_sample, pixel_unshuffle, temporal_shift,
 )
 from ...ops.creation import diag_embed  # noqa: F401,E402
 from ...ops.extra_ops import gather_tree, sigmoid_focal_loss  # noqa: F401,E402

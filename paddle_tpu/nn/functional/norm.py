@@ -45,8 +45,10 @@ def _apply_scale_shift(x, mean, var, weight, bias, eps, c_axis):
     in fp32, then apply in x's own dtype. For bf16 activations this keeps
     the full-tensor elementwise in bf16 (HBM-bandwidth bound) while the
     tiny per-channel math stays fp32 — the cuDNN BN recipe
-    (batch_norm_op.cu keeps saved stats fp32 for __half inputs)."""
-    f32 = jnp.float32
+    (batch_norm_op.cu keeps saved stats fp32 for __half inputs). f64
+    inputs (FD-grad harness) keep f64 stats — f32 rounding of the
+    per-channel scale quantizes the stats-derivative path."""
+    f32 = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
     inv = jax.lax.rsqrt(var.astype(f32) + eps)
     scale = inv if weight is None else inv * weight.astype(f32)
     shift = -mean.astype(f32) * scale
@@ -102,7 +104,7 @@ def _bn_core_bwd(eps, c_axis, res, cts):
     this form."""
     gy, g_mean, g_var = cts
     x, weight, bias, mean, var = res
-    f32 = jnp.float32
+    f32 = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
     axes = tuple(i for i in range(x.ndim) if i != c_axis)
     n = 1
     for i in axes:

@@ -190,9 +190,17 @@ def _gru_cell_op(x, h, wi, wh, bi, bh):
 
 
 # -------------------------------------------------------------- scan drivers
+def _promote_carry(x, wi, *states):
+    """lax.scan needs carry-in/out dtypes to match; promote the initial
+    states to the step result dtype (mixed f32 state + f64 input case)."""
+    dt = jnp.result_type(x.dtype, wi.dtype, *[s.dtype for s in states])
+    return (x.astype(dt),) + tuple(s.astype(dt) for s in states)
+
+
 @op("rnn_scan_simple")
 def _scan_simple(x, h0, wi, wh, bi, bh, activation, reverse):
     # x: [B, T, I] time-major scan
+    x, h0 = _promote_carry(x, wi, h0)
     xs = jnp.swapaxes(x, 0, 1)
 
     def step(h, x_t):
@@ -204,6 +212,7 @@ def _scan_simple(x, h0, wi, wh, bi, bh, activation, reverse):
 
 @op("rnn_scan_lstm")
 def _scan_lstm(x, h0, c0, wi, wh, bi, bh, reverse):
+    x, h0, c0 = _promote_carry(x, wi, h0, c0)
     xs = jnp.swapaxes(x, 0, 1)
 
     def step(carry, x_t):
@@ -216,6 +225,7 @@ def _scan_lstm(x, h0, c0, wi, wh, bi, bh, reverse):
 
 @op("rnn_scan_gru")
 def _scan_gru(x, h0, wi, wh, bi, bh, reverse):
+    x, h0 = _promote_carry(x, wi, h0)
     xs = jnp.swapaxes(x, 0, 1)
 
     def step(h, x_t):

@@ -234,8 +234,10 @@ def matrix_power(x, n, name=None):
     return _matrix_power(_wrap(x), n)
 
 
-@op("svd", differentiable=False)
+@op("svd")
 def _svd(x, full_matrices):
+    # differentiable: jax defines the svd vjp for full_matrices=False
+    # (the paddle default); the full form errors loudly on backward
     return jnp.linalg.svd(x, full_matrices=full_matrices)
 
 
@@ -245,7 +247,7 @@ def svd(x, full_matrices=False, name=None):
     return u, s, Tensor(jnp.swapaxes(vh._value, -1, -2))
 
 
-@op("qr", differentiable=False)
+@op("qr")
 def _qr(x, mode):
     return jnp.linalg.qr(x, mode=mode)
 
@@ -263,8 +265,9 @@ def eig(x, name=None):
     return _eig(_wrap(x))
 
 
-@op("eigh", differentiable=False)
+@op("eigh")
 def _eigh(x, UPLO):
+    # differentiable for distinct eigenvalues (jax's eigh vjp)
     return jnp.linalg.eigh(x, UPLO=UPLO)
 
 

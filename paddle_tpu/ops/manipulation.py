@@ -387,11 +387,13 @@ def _put_along_axis(x, indices, values, axis, reduce):
         return jnp.put_along_axis(x, indices, values, axis=axis,
                                   inplace=False)
     dims = list(range(x.ndim))
-    idx = [jnp.broadcast_to(
-        jnp.arange(x.shape[d]).reshape([-1 if i == d else 1
-                                        for i in dims]), indices.shape)
+    # open-grid coordinates sized to the INDICES shape (scatter region),
+    # not x's shape — and never materialised for d == axis, where the
+    # caller's indices take over
+    idx = [indices if d == axis else jnp.broadcast_to(
+        jnp.arange(indices.shape[d]).reshape([-1 if i == d else 1
+                                              for i in dims]), indices.shape)
         for d in dims]
-    idx[axis] = indices
     if reduce == "add":
         return x.at[tuple(idx)].add(jnp.broadcast_to(values, indices.shape))
     if reduce == "multiply" or reduce == "mul":

@@ -396,17 +396,31 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return out
 
 
-@op("cummax", differentiable=False)
-def _cummax(x, axis):
-    return jax.lax.cummax(x, axis=axis)
+@op("cummax")
+def _cummax(x, axis, dtype):
+    """reference: cummax returns (values, indices of the running max).
+
+    The values path is differentiable: indices are computed under
+    stop_gradient (first position attaining each running max), then the
+    values gather through take_along_axis so the cotangent scatters back
+    to the attaining element.
+    """
+    xs = jax.lax.stop_gradient(x)
+    vals = jax.lax.cummax(xs, axis=axis)
+    ar = jnp.arange(x.shape[axis]).reshape(
+        [-1 if i == axis else 1 for i in range(x.ndim)])
+    prev = jnp.roll(vals, 1, axis)
+    is_new = (xs == vals) & ((ar == 0) | (xs > prev))
+    idx = jax.lax.cummax(jnp.where(is_new, ar, 0), axis=axis)
+    return (jnp.take_along_axis(x, idx, axis=axis),
+            idx.astype(convert_dtype(dtype)))
 
 
 def cummax(x, axis=None, dtype="int64", name=None):
     x = _wrap(x)
     if axis is None:
         x, axis = x.reshape([-1]), 0
-    vals = _cummax(x, axis)
-    return vals
+    return _cummax(x, axis, dtype)
 
 
 @op("logcumsumexp")
@@ -562,7 +576,7 @@ def renorm(x, p, axis, max_norm, name=None):
     return _renorm(_wrap(x), float(p), int(axis), float(max_norm))
 
 
-@op("take", differentiable=False)
+@op("take")
 def _take(x, index, mode):
     flat = x.reshape(-1)
     idx = index.astype(jnp.int64)

@@ -39,12 +39,25 @@ from . import mesh as _mesh
 
 
 # ---------------------------------------------------------------- annotation
+_warned_dropped_constraint = set()
+
+
 @op("shard_constraint")
 def _shard_constraint(x, spec):
     try:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(_mesh.get_global_mesh(), P(*spec)))
-    except (ValueError, RuntimeError):
+    except (ValueError, RuntimeError) as e:
+        # Dropping a constraint silently is the exact failure mode this
+        # API exists to prevent (trunk all-gather: parity passes, zero
+        # scaling) — warn once per spec so it is visible.
+        key = (spec, type(e).__name__)
+        if key not in _warned_dropped_constraint:
+            _warned_dropped_constraint.add(key)
+            import warnings
+            warnings.warn(
+                f"shard_constraint {spec} dropped ({type(e).__name__}: {e}); "
+                "layout falls back to the partitioner's choice", stacklevel=2)
         return x  # no mesh / axis not present: no-op
 
 
@@ -71,7 +84,12 @@ def shard_batch_activation(x):
     ndim = getattr(x, "ndim", 0)
     if ndim < 2:
         return x
-    spec = (("dp", "sharding"), "sp") + (None,) * (ndim - 2)
+    # Only rank>=3 activations have a sequence dim; a 2D [batch, features]
+    # input must not get its feature dim constrained over 'sp'.
+    if ndim >= 3:
+        spec = (("dp", "sharding"), "sp") + (None,) * (ndim - 2)
+    else:
+        spec = (("dp", "sharding"), None)
     return _shard_constraint(x, spec)
 
 

@@ -30,6 +30,20 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Third CI lane (round-4 verdict weak #7): the compile-heaviest
+# single-process suites get the `heavy` marker so the fast lane stays
+# fast. Module-level so the list lives in one place.
+_HEAVY_MODULES = {
+    "test_op_suite", "test_dy2static", "test_bert", "test_op_tail",
+    "test_op_tail3",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _HEAVY_MODULES:
+            item.add_marker(pytest.mark.heavy)
+
 
 @pytest.fixture(autouse=True)
 def _seed_everything():
