@@ -38,6 +38,7 @@ from .core.place import (  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
 from .core import errors  # noqa: F401
 from .core import monitor  # noqa: F401
+from .core import anomaly  # noqa: F401
 
 # -- tensor + autograd ------------------------------------------------------
 from .core.tensor import Tensor, to_tensor  # noqa: F401

@@ -41,6 +41,7 @@ class GradScaler:
     def _unscale(self, optimizer):
         if not self._enable or self._unscaled:
             return
+        from ..core.anomaly import tree_not_finite
         inv = 1.0 / self._scale
         found = False
         with no_grad():
@@ -48,7 +49,9 @@ class GradScaler:
                 if p.grad is None:
                     continue
                 g = p.grad._value * inv
-                if not bool(jnp.isfinite(g).all()):
+                # shared found-inf sweep with the anomaly guard (one
+                # detection primitive owns the semantics for both)
+                if bool(tree_not_finite(g)):
                     found = True
                 p.grad._value = g
         self._found_inf = found
@@ -68,6 +71,15 @@ class GradScaler:
         self._unscale(optimizer)
         if not self._found_inf:
             optimizer.step()
+        else:
+            # an overflow step is an anomaly skip in all but name: report
+            # it to the active guard so ONE counter covers both recovery
+            # paths ('raise' still defers to the scaler — dropping an
+            # overflow step is the scaler's contract, not an error)
+            from ..core.anomaly import current_guard
+            guard = current_guard()
+            if guard is not None and guard.policy != "raise":
+                guard.record(True, where="amp overflow")
         self._unscaled = False
 
     def update(self):

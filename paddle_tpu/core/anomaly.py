@@ -1,0 +1,160 @@
+"""Anomaly guard: NaN/Inf detection on losses and gradients with policies.
+
+Reference: Paddle's FLAGS_check_nan_inf hook (operator.cc:1172, surfaced
+here as core.flags 'check_nan_inf' + core.dispatch._check_finite) aborts on
+the FIRST non-finite op output — right for debugging, wrong for a week-long
+pod run where one flaky step should not cost the job. This module adds the
+production policy layer:
+
+  raise      — fail fast with the offending parameter names (debug parity
+               with FLAGS_check_nan_inf, but at step granularity)
+  skip_step  — drop the poisoned update entirely (params, accumulators and
+               scheduler state unchanged), count it, continue — the same
+               recovery the AMP GradScaler applies to overflow steps
+  zero_grads — zero the non-finite gradient entries and apply the rest of
+               the update (useful when a single layer overflows but the
+               global step is still informative)
+
+All detection primitives are jit-compatible (pure jnp reductions, no host
+sync), so the same guard drives the eager `optimizer.step` path, the AMP
+scaler, and the fused TrainStep used by hapi's fit loop — the compiled step
+gates the whole parameter/optimizer update through `jnp.where` exactly like
+the static-graph found_inf path. Skipped/zeroed steps are counted on the
+guard so silent recovery is still observable.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+__all__ = ["AnomalyGuard", "anomaly_guard", "set_anomaly_guard",
+           "current_guard", "tree_not_finite", "sanitize_tree",
+           "POLICIES"]
+
+POLICIES = ("raise", "skip_step", "zero_grads")
+
+
+# ---------------------------------------------------------------- primitives
+def _leaf_not_finite(a):
+    a = jnp.asarray(a)
+    if not jnp.issubdtype(a.dtype, jnp.inexact):
+        return jnp.asarray(False)
+    return ~jnp.isfinite(a).all()
+
+
+def tree_not_finite(tree):
+    """True iff ANY inexact leaf of `tree` contains NaN/Inf. Pure jnp —
+    safe inside jit (returns a traced bool scalar) and reused by the AMP
+    scaler's found-inf sweep."""
+    flags = [_leaf_not_finite(a) for a in jtu.tree_leaves(tree)]
+    if not flags:
+        return jnp.asarray(False)
+    out = flags[0]
+    for f in flags[1:]:
+        out = out | f
+    return out
+
+
+def sanitize_tree(tree):
+    """Replace non-finite entries with 0 in every inexact leaf (the
+    zero_grads policy's repair step). jit-compatible."""
+    def fix(a):
+        a = jnp.asarray(a)
+        if not jnp.issubdtype(a.dtype, jnp.inexact):
+            return a
+        return jnp.where(jnp.isfinite(a), a, jnp.zeros((), a.dtype))
+    return jtu.tree_map(fix, tree)
+
+
+# --------------------------------------------------------------------- guard
+class AnomalyGuard:
+    """Policy + counters for non-finite losses/gradients.
+
+    Counters (host-side ints, surfaced so silent recovery stays
+    observable):
+      skipped_steps   updates dropped under skip_step (incl. AMP-overflow
+                      skips reported by GradScaler when a guard is active)
+      zeroed_steps    updates applied with sanitized grads under zero_grads
+      raised          anomalies that escalated to FloatingPointError
+      checked_steps   total guarded step checks
+    """
+
+    def __init__(self, policy: str = "raise"):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"anomaly policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.skipped_steps = 0
+        self.zeroed_steps = 0
+        self.raised = 0
+        self.checked_steps = 0
+
+    # ------------------------------------------------------------- counters
+    def record(self, bad: bool, where: str = "step") -> bool:
+        """Count one guarded check whose anomaly flag is `bad` (a host
+        bool); applies the policy's counter and raises under 'raise'.
+        Returns bad for chaining."""
+        self.checked_steps += 1
+        if not bad:
+            return False
+        if self.policy == "raise":
+            self.raised += 1
+            raise FloatingPointError(
+                f"anomaly guard: non-finite values detected in {where} "
+                f"(policy='raise'; use 'skip_step'/'zero_grads' to ride "
+                f"through)")
+        if self.policy == "zero_grads":
+            self.zeroed_steps += 1
+        else:
+            self.skipped_steps += 1
+        return True
+
+    # --------------------------------------------------------- eager checks
+    def check_loss(self, loss) -> bool:
+        """Eager loss check (host sync). True → caller should skip."""
+        arr = loss._value if hasattr(loss, "_value") else loss
+        return self.record(bool(tree_not_finite(arr)), where="loss")
+
+    def state_dict(self):
+        return {"policy": self.policy, "skipped_steps": self.skipped_steps,
+                "zeroed_steps": self.zeroed_steps, "raised": self.raised,
+                "checked_steps": self.checked_steps}
+
+    def __repr__(self):
+        return (f"AnomalyGuard(policy={self.policy!r}, "
+                f"checked={self.checked_steps}, "
+                f"skipped={self.skipped_steps}, zeroed={self.zeroed_steps}, "
+                f"raised={self.raised})")
+
+
+# ------------------------------------------------------------- global guard
+_current: Optional[AnomalyGuard] = None
+
+
+def set_anomaly_guard(guard) -> Optional[AnomalyGuard]:
+    """Install a process-wide guard consulted by optimizer.step and the AMP
+    scaler. Accepts an AnomalyGuard, a policy string, or None (disable).
+    Returns the installed guard."""
+    global _current
+    if isinstance(guard, str):
+        guard = AnomalyGuard(guard)
+    _current = guard
+    return guard
+
+
+def current_guard() -> Optional[AnomalyGuard]:
+    return _current
+
+
+@contextmanager
+def anomaly_guard(policy_or_guard="raise"):
+    """Scoped guard: `with anomaly_guard('skip_step') as g: train()`."""
+    prev = _current
+    g = set_anomaly_guard(policy_or_guard)
+    try:
+        yield g
+    finally:
+        set_anomaly_guard(prev)

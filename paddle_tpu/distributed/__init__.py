@@ -18,6 +18,10 @@ from .moe import MoEMLP  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .launch import launch  # noqa: F401
+from . import elastic  # noqa: F401
+from .elastic import (  # noqa: F401
+    ElasticSupervisor, ElasticJobError, WorkerSpec, elastic_spawn,
+)
 
 # meta_parallel namespace parity (later paddle exposes these there)
 class meta_parallel:

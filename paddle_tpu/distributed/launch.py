@@ -26,6 +26,12 @@ def _parse():
     ap.add_argument("--node_rank", type=int,
                     default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
     ap.add_argument("--port", type=int, default=6170)
+    ap.add_argument("--max_restarts", type=int, default=0,
+                    help="elastic mode: restart a crashed/hung worker up to "
+                         "N times (0 = classic fail-fast pod teardown)")
+    ap.add_argument("--heartbeat_timeout", type=float, default=None,
+                    help="elastic mode: seconds without a worker heartbeat "
+                         "before it is treated as hung")
     ap.add_argument("training_script")
     ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return ap.parse_args()
@@ -41,6 +47,27 @@ def launch():
     procs = []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    if args.max_restarts > 0:
+        # supervised elastic path: crashed/hung workers restart with capped
+        # backoff and resume via auto-checkpoint instead of killing the pod
+        from .elastic import ElasticSupervisor, WorkerSpec
+        specs = []
+        for local_rank in range(args.nproc_per_node):
+            rank = args.node_rank * args.nproc_per_node + local_rank
+            env = {
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "FLAGS_selected_tpus": str(local_rank),
+            }
+            log = (os.path.join(args.log_dir, f"worker.{rank}.log")
+                   if args.log_dir else None)
+            specs.append(WorkerSpec(
+                [sys.executable, args.training_script]
+                + args.training_script_args, env=env, log_path=log))
+        sup = ElasticSupervisor(max_restarts=args.max_restarts,
+                                heartbeat_timeout=args.heartbeat_timeout)
+        sup.run(specs)
+        return
     for local_rank in range(args.nproc_per_node):
         rank = args.node_rank * args.nproc_per_node + local_rank
         env = dict(os.environ)

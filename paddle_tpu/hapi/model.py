@@ -58,11 +58,19 @@ class Model:
         self._predict_fn = None
         self.stop_training = False
         self._save_dir = None
+        self._anomaly_guard = None
 
     # ------------------------------------------------------------- prepare
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
-        """reference: model.py prepare:1244."""
+                amp_configs=None, anomaly=None):
+        """reference: model.py prepare:1244.
+
+        anomaly: None, a policy string ('raise' | 'skip_step' |
+        'zero_grads'), or a core.anomaly.AnomalyGuard — guards every
+        train_batch against NaN/Inf loss/gradients inside the compiled
+        step; skipped steps are counted on the guard and surfaced in the
+        fit-loop logs as 'anomaly_skipped'."""
+        from ..core.anomaly import AnomalyGuard
         self._optimizer = optimizer
         if loss is not None and not isinstance(loss, Layer) \
                 and not callable(loss):
@@ -73,6 +81,9 @@ class Model:
             if not isinstance(m, Metric):
                 raise TypeError(f"metrics must be paddle.metric.Metric, "
                                 f"got {type(m)}")
+        if isinstance(anomaly, str):
+            anomaly = AnomalyGuard(anomaly)
+        self._anomaly_guard = anomaly
         self._train_step = None
         self._eval_fn = None
         self._predict_fn = None
@@ -113,7 +124,8 @@ class Model:
 
             self._train_step = TrainStep(self.network, loss_fn,
                                          self._optimizer,
-                                         return_outputs=True)
+                                         return_outputs=True,
+                                         anomaly_guard=self._anomaly_guard)
         args = _as_arrays(_to_list(inputs) + _to_list(labels))
         loss, out = self._train_step(*args)
         outputs = list(out)[1:]
@@ -234,6 +246,11 @@ class Model:
             else:
                 losses = res
             logs = {"loss": losses}
+            if self._anomaly_guard is not None:
+                # silent recovery must stay observable (skip_step/zero_grads
+                # drop work without raising)
+                logs["anomaly_skipped"] = (self._anomaly_guard.skipped_steps
+                                           + self._anomaly_guard.zeroed_steps)
             for m in self._metrics:
                 r = m.accumulate()
                 name = m.name()

@@ -56,23 +56,32 @@ class AutoCheckpointManager:
 
     def __init__(self, save_dir: str, models=(), optimizers=(),
                  lr_schedulers=(), max_keep: int = 3,
-                 save_interval_epochs: int = 1, async_save: bool = False):
+                 save_interval_epochs: int = 1, async_save: bool = False,
+                 save_every_n_steps: Optional[int] = None):
         self.save_dir = save_dir
         self.models = list(models)
         self.optimizers = list(optimizers)
         self.lr_schedulers = list(lr_schedulers)
         self.max_keep = max_keep
         self.save_interval = max(int(save_interval_epochs), 1)
+        # step-granular mode (elastic restart window bound): train_step_range
+        # snapshots every N steps into step_N dirs, so a supervised worker
+        # killed mid-epoch resumes at most N-1 steps back, not epoch-0
+        self.save_every_n_steps = (None if save_every_n_steps is None
+                                   else max(int(save_every_n_steps), 1))
         self.async_save = async_save
         self._pending = None  # in-flight async save (threading.Thread)
         self._async_error = None
+        # (kind, index) of the snapshot restore_latest() actually loaded
+        self.restored_kind: Optional[str] = None
+        self.restored_index: Optional[int] = None
         os.makedirs(save_dir, exist_ok=True)
 
     # ---------------------------------------------------------------- state
-    def _collect(self, epoch: int) -> dict:
+    def _collect(self, epoch: int, step: Optional[int] = None) -> dict:
         from .. import framework_io  # noqa: F401  (format owner)
         from ..core import random as _random
-        state = {"epoch": epoch, "time": time.time(),
+        state = {"epoch": epoch, "step": step, "time": time.time(),
                  "models": [m.state_dict() for m in self.models],
                  "optimizers": [o.state_dict() for o in self.optimizers],
                  "lr_schedulers": [s.state_dict()
@@ -92,8 +101,11 @@ class AutoCheckpointManager:
             _random.set_rng_state(np.asarray(state["rng"]))
 
     # ----------------------------------------------------------------- save
+    def _snap_dir(self, kind: str, idx: int) -> str:
+        return os.path.join(self.save_dir, f"{kind}_{idx}")
+
     def _epoch_dir(self, epoch: int) -> str:
-        return os.path.join(self.save_dir, f"epoch_{epoch}")
+        return self._snap_dir("epoch", epoch)
 
     def save(self, epoch: int):
         """Atomic snapshot: write to a temp dir, rename into place, then
@@ -103,6 +115,14 @@ class AutoCheckpointManager:
         self.wait()
         self._write(self._collect(epoch), epoch)
 
+    def save_step(self, step: int, epoch: int = 0):
+        """Step-granular atomic snapshot (step_N dir). Same durability
+        contract as save(); used by train_step_range so an elastic restart
+        replays at most save_every_n_steps-1 steps."""
+        self.wait()
+        self._write(self._collect(epoch, step=step), epoch,
+                    kind="step", idx=step)
+
     def save_async(self, epoch: int):
         """Snapshot the state synchronously (cheap: the training state is
         functional, so collecting is reference-capture + host fetch), then
@@ -111,17 +131,28 @@ class AutoCheckpointManager:
         flight: a new save (or restore/exit) first joins the previous one.
         A failed background save re-raises at the next save/wait call —
         never silently dropped."""
+        self._save_async_snapshot(self._collect(epoch), epoch)
+
+    def save_step_async(self, step: int, epoch: int = 0):
+        """Async twin of save_step (same contract as save_async)."""
+        self._save_async_snapshot(self._collect(epoch, step=step), epoch,
+                                  kind="step", idx=step)
+
+    def _save_async_snapshot(self, state, epoch, kind="epoch", idx=None):
         import threading
         self.wait()
         # host-materialise now: after this the background thread touches
         # no device state, so training may freely continue. (NOT tree_map:
         # rebuilding Tensor nodes from numpy leaves would round-trip the
         # data back to the device.)
-        state = _to_host(self._collect(epoch))
+        state = _to_host(state)
 
         def work():
             try:
-                self._write(state, epoch)
+                if kind == "epoch":  # two-arg form: the stable test seam
+                    self._write(state, epoch)
+                else:
+                    self._write(state, epoch, kind=kind, idx=idx)
             except BaseException as e:  # surfaced on next wait()
                 self._async_error = e
 
@@ -137,14 +168,17 @@ class AutoCheckpointManager:
             err, self._async_error = self._async_error, None
             raise err
 
-    def _write(self, state: dict, epoch: int):
+    def _write(self, state: dict, epoch: int, kind: str = "epoch",
+               idx: Optional[int] = None):
         from .. import framework_io
+        idx = epoch if idx is None else idx
         tmp = tempfile.mkdtemp(dir=self.save_dir, prefix=".tmp_")
         try:
             framework_io.save(state, os.path.join(tmp, "state.pdparams"))
             with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump({"epoch": epoch, "time": time.time()}, f)
-            final = self._epoch_dir(epoch)
+                json.dump({"epoch": epoch, "kind": kind, "index": idx,
+                           "time": time.time()}, f)
+            final = self._snap_dir(kind, idx)
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)
@@ -154,9 +188,10 @@ class AutoCheckpointManager:
         self._prune()
 
     def _prune(self):
-        done = sorted(self._saved_epochs())
-        for e in done[:-self.max_keep]:
-            shutil.rmtree(self._epoch_dir(e), ignore_errors=True)
+        for kind in ("epoch", "step"):
+            done = sorted(self._saved(kind))
+            for e in done[:-self.max_keep]:
+                shutil.rmtree(self._snap_dir(kind, e), ignore_errors=True)
         # stale temp dirs from crashed saves (the writer died before its
         # rename): harmless to restores (no meta outside a renamed dir)
         # but they accumulate on slow/remote filesystems — sweep them
@@ -165,20 +200,44 @@ class AutoCheckpointManager:
                 shutil.rmtree(os.path.join(self.save_dir, name),
                               ignore_errors=True)
 
-    def _saved_epochs(self) -> List[int]:
+    def _saved(self, kind: str) -> List[int]:
         out = []
+        pre = kind + "_"
         if not os.path.isdir(self.save_dir):
             return out
         for name in os.listdir(self.save_dir):
-            if name.startswith("epoch_") and name[6:].isdigit():
-                # (quarantined epoch_N.corrupt dirs don't count)
+            if name.startswith(pre) and name[len(pre):].isdigit():
+                # (quarantined *.corrupt dirs don't count)
                 meta = os.path.join(self.save_dir, name, "meta.json")
                 if os.path.exists(meta):
-                    out.append(int(name[6:]))
+                    out.append(int(name[len(pre):]))
         return out
 
+    def _saved_epochs(self) -> List[int]:
+        return self._saved("epoch")
+
+    def _snapshots_newest_first(self):
+        """All complete snapshots as (kind, idx), newest save first (by
+        meta save time; epoch and step snapshots share one ordering so a
+        mixed-mode run resumes from whichever landed last)."""
+        snaps = []
+        for kind in ("epoch", "step"):
+            for idx in self._saved(kind):
+                t = idx
+                try:
+                    with open(os.path.join(self._snap_dir(kind, idx),
+                                           "meta.json")) as f:
+                        t = json.load(f).get("time", idx)
+                except (OSError, ValueError):
+                    pass
+                snaps.append((t, kind, idx))
+        snaps.sort(reverse=True)
+        return [(kind, idx) for _, kind, idx in snaps]
+
     def restore_latest(self) -> Optional[int]:
-        """Load the newest complete snapshot; returns its epoch or None.
+        """Load the newest complete snapshot; returns its epoch (or step,
+        for step-granular snapshots) or None. Which kind was restored is
+        left in .restored_kind/.restored_index.
         A snapshot that fails to parse (disk-level truncation/corruption
         AFTER the atomic rename — the failure mode remote filesystems add
         beyond the tmp+mv contract) is quarantined with a warning and the
@@ -186,15 +245,15 @@ class AutoCheckpointManager:
         resume path."""
         from .. import framework_io
         self.wait()  # a restore racing an in-flight save would read torn
-        for epoch in sorted(self._saved_epochs(), reverse=True):
-            path = os.path.join(self._epoch_dir(epoch), "state.pdparams")
+        for kind, idx in self._snapshots_newest_first():
+            path = os.path.join(self._snap_dir(kind, idx), "state.pdparams")
             try:
                 state = framework_io.load(path)
             except Exception as e:
                 import warnings
-                bad = self._epoch_dir(epoch)
+                bad = self._snap_dir(kind, idx)
                 warnings.warn(
-                    f"auto-checkpoint: snapshot epoch_{epoch} is corrupt "
+                    f"auto-checkpoint: snapshot {kind}_{idx} is corrupt "
                     f"({e!r}); quarantining {bad} and falling back",
                     RuntimeWarning)
                 try:
@@ -203,17 +262,21 @@ class AutoCheckpointManager:
                     shutil.rmtree(bad, ignore_errors=True)
                 continue
             self._restore(state)
-            return epoch
+            self.restored_kind, self.restored_index = kind, idx
+            return idx
+        self.restored_kind = self.restored_index = None
         return None
 
     # ---------------------------------------------------------------- range
     def train_epoch_range(self, max_epoch_num: int) -> Iterator[int]:
         """reference: auto_checkpoint.py train_epoch_range — yields epoch
         indices, skipping epochs already completed by a previous run."""
+        from ..distributed import elastic
         last = self.restore_latest()
         start = 0 if last is None else last + 1
         try:
             for epoch in range(start, max_epoch_num):
+                elastic.heartbeat()  # no-op outside a supervised run
                 yield epoch
                 if (epoch + 1) % self.save_interval == 0 \
                         or epoch == max_epoch_num - 1:
@@ -225,6 +288,30 @@ class AutoCheckpointManager:
             # also runs on generator close (caller `break`): the last
             # dispatched snapshot must be durable — the writer thread is a
             # daemon and would be killed mid-rename at interpreter exit
+            self.wait()
+
+    def train_step_range(self, max_steps: int) -> Iterator[int]:
+        """Step-granular twin of train_epoch_range for supervised elastic
+        workers: yields step indices, snapshotting every
+        `save_every_n_steps` (and at the final step), and resumes from the
+        newest step snapshot after a kill — the restart window is bounded
+        by the save interval instead of an epoch. Each step also beats the
+        elastic heartbeat, so a hung step is detectable by the
+        supervisor."""
+        from ..distributed import elastic
+        every = self.save_every_n_steps or 1
+        last = self.restore_latest()
+        start = 0 if self.restored_kind != "step" else last + 1
+        try:
+            for step in range(start, max_steps):
+                elastic.heartbeat()
+                yield step
+                if (step + 1) % every == 0 or step == max_steps - 1:
+                    if self.async_save:
+                        self.save_step_async(step)
+                    else:
+                        self.save_step(step)
+        finally:
             self.wait()
 
 

@@ -371,14 +371,21 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True, return_outputs: bool = False):
+                 donate: bool = True, return_outputs: bool = False,
+                 anomaly_guard=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.return_outputs = return_outputs
+        # core.anomaly.AnomalyGuard: the NaN/Inf check runs INSIDE the
+        # compiled step (pure jnp) and the update is gated through
+        # jnp.where, same shape as the static-graph found_inf path; only
+        # the counter update needs the host
+        self._guard = anomaly_guard
         self._opt_state = None
         inner = _FunctionalizedLayer(
             lambda *args: loss_fn(model, *args), model)
+        guard = anomaly_guard
 
         def step(params, frozen, buffers, opt_state, lr, key_root, rng_ctr,
                  *args):
@@ -397,6 +404,12 @@ class TrainStep:
                 return loss, aux
             (loss, (out, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
+            bad = None
+            if guard is not None:
+                from ..core import anomaly as _anomaly
+                bad = _anomaly.tree_not_finite((loss, grads))
+                if guard.policy == "zero_grads":
+                    grads = _anomaly.sanitize_tree(grads)
             if optimizer._grad_clip is not None:
                 names = sorted(grads)
                 need_clip = [self._need_clip.get(k, True) for k in names]
@@ -405,10 +418,21 @@ class TrainStep:
                 grads = dict(zip(names, clipped))
             new_params, new_opt = optimizer.apply_updates(
                 params, grads, opt_state, lr)
+            if guard is not None and guard.policy == "skip_step":
+                # drop the whole poisoned update: params, accumulators and
+                # buffers roll back to the pre-step values
+                def keep(old, new):
+                    return jax.tree_util.tree_map(
+                        lambda o, n: jnp.where(bad, o, n), old, new)
+                new_params = keep(params, new_params)
+                new_opt = keep(opt_state, new_opt)
+                new_buffers = keep(buffers, new_buffers)
+            tail = () if bad is None else (bad,)
             if return_outputs:
-                return loss, new_params, new_buffers, new_opt, \
-                    rng_ctr + 1, out
-            return loss, new_params, new_buffers, new_opt, rng_ctr + 1
+                return (loss, new_params, new_buffers, new_opt,
+                        rng_ctr + 1, out) + tail
+            return (loss, new_params, new_buffers, new_opt,
+                    rng_ctr + 1) + tail
 
         donate_argnums = (0, 3, 6) if donate else ()
         self._raw_step = step  # unjitted; MultiStepTrainStep scans over it
@@ -511,6 +535,11 @@ class TrainStep:
 
     def __call__(self, *args):
         loss, extras = self._dispatch(self._step, 1, args)
+        if self._guard is not None:
+            # one host bool per step; hapi's fit loop already syncs on the
+            # loss scalar each step, so this adds no extra round-trip there
+            self._guard.record(bool(extras[-1]), where="train step")
+            extras = extras[:-1]
         if self.return_outputs:
             return Tensor(loss), jax.tree_util.tree_map(Tensor, extras[0])
         return Tensor(loss)

@@ -383,6 +383,10 @@ def take_along_axis(arr, indices, axis, name=None):
 
 @op("put_along_axis")
 def _put_along_axis(x, indices, values, axis, reduce):
+    # normalize BEFORE the d == axis comparison below: a negative axis
+    # never equals a non-negative dim index, which silently dropped the
+    # caller's indices on the add/mul paths (ADVICE round 5, high)
+    axis = axis + x.ndim if axis < 0 else axis
     if reduce == "assign":
         return jnp.put_along_axis(x, indices, values, axis=axis,
                                   inplace=False)

@@ -420,6 +420,9 @@ def cummax(x, axis=None, dtype="int64", name=None):
     x = _wrap(x)
     if axis is None:
         x, axis = x.reshape([-1]), 0
+    # lax.cummax rejects negative axes and the index-grid reshape's
+    # `-1 if i == axis` never matches them (ADVICE round 5)
+    axis = axis + x.ndim if axis < 0 else axis
     return _cummax(x, axis, dtype)
 
 

@@ -112,12 +112,39 @@ class Optimizer:
     def _param_lr(self, p):
         return getattr(p, "optimize_attr", None) or {"learning_rate": 1.0}
 
+    def _guard_grads(self, params_grads) -> bool:
+        """Apply the active anomaly guard (core.anomaly) to this step's
+        gradients BEFORE clipping touches them (clipping a NaN grad just
+        spreads the NaN through the global norm). Returns False when the
+        whole update must be skipped; under zero_grads the offending
+        entries are repaired in place and the step proceeds."""
+        from ..core import anomaly
+        from ..core.selected_rows import SelectedRows
+        guard = anomaly.current_guard()
+        if guard is None or not params_grads:
+            return True
+        vals = [g._value.values if isinstance(g._value, SelectedRows)
+                else g._value for _, g in params_grads]
+        bad = bool(anomaly.tree_not_finite(vals))
+        if not guard.record(bad, where="gradients"):  # raises under 'raise'
+            return True
+        if guard.policy == "zero_grads":
+            for _, g in params_grads:
+                if isinstance(g._value, SelectedRows):
+                    g._value.values = anomaly.sanitize_tree(g._value.values)
+                else:
+                    g._value = anomaly.sanitize_tree(g._value)
+            return True
+        return False  # skip_step
+
     def step(self):
         from ..core.selected_rows import SelectedRows, rowwise_update
         with no_grad():
             params_grads = [(p, p.grad) for p in self._parameter_list
                             if p.grad is not None
                             and getattr(p, "trainable", True)]
+            if not self._guard_grads(params_grads):
+                return  # anomalous step dropped under policy skip_step
             if self._grad_clip is not None:
                 # global-norm clipping needs dense values; densify sparse
                 # grads first (reference: clip merges SelectedRows too)

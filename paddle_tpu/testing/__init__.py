@@ -7,3 +7,4 @@ every registered place, check_grad compares analytic gradients against
 numeric finite differences (get_numeric_gradient:101).
 """
 from .op_test import OpTestCase, run_case, numeric_grad  # noqa: F401
+from .faults import FaultInjector, corrupt_checkpoint  # noqa: F401

@@ -1,0 +1,168 @@
+"""Deterministic fault injectors for exercising the elastic runtime.
+
+The reference validates its fault-tolerance stack by actually killing
+trainers (test_dist_base.py's signal-based kill paths, PS heartbeat fake
+death in fleet tests); this module packages those patterns as deterministic,
+step-addressed injectors so tests/test_fault_tolerance.py can prove
+kill→restart→resume equivalence instead of hoping a sleep races correctly.
+
+Spec grammar (env `PADDLE_TPU_FAULTS` or constructor arg) — comma-separated
+`fault@step[:arg]` items:
+
+    kill@12          SIGKILL-style death (os._exit) at the top of step 12
+    nan@5            poison the loss with NaN at step 5
+    stall@7:3600     hang for 3600s at step 7 (exercises heartbeat timeout)
+    corrupt@12       truncate the NEWEST checkpoint snapshot at step 12
+                     (compose `corrupt@N,kill@N` to model a crash that
+                     tears the latest snapshot)
+
+Every injector fires ONCE per fault-injection state dir (`PADDLE_TPU_
+FAULT_STATE_DIR`): the fire is recorded as a marker file created with
+O_CREAT|O_EXCL, so a restarted worker incarnation sails past the step that
+killed its predecessor — exactly the transient-fault model the supervisor
+is built for.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["FaultInjector", "corrupt_checkpoint"]
+
+SPEC_ENV = "PADDLE_TPU_FAULTS"
+STATE_DIR_ENV = "PADDLE_TPU_FAULT_STATE_DIR"
+
+KINDS = ("kill", "nan", "stall", "corrupt")
+KILL_EXIT_CODE = 37  # distinctive, so supervisors/tests can assert on it
+
+
+def _parse(spec: str) -> List[Tuple[str, int, Optional[float]]]:
+    out = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        kind, _, rest = item.partition("@")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in {spec!r} "
+                             f"(known: {KINDS})")
+        step_s, _, arg_s = rest.partition(":")
+        out.append((kind, int(step_s), float(arg_s) if arg_s else None))
+    return out
+
+
+def corrupt_checkpoint(save_dir: str, mode: str = "truncate"):
+    """Damage the NEWEST complete snapshot under `save_dir` the way a
+    remote filesystem does (truncation after the atomic rename), to drive
+    AutoCheckpointManager.restore_latest's quarantine path. Returns the
+    path corrupted, or None when no snapshot exists."""
+    import json
+    snaps = []
+    for name in os.listdir(save_dir):
+        kind, _, idx = name.partition("_")
+        if kind in ("epoch", "step") and idx.isdigit():
+            meta = os.path.join(save_dir, name, "meta.json")
+            if os.path.exists(meta):
+                try:
+                    with open(meta) as f:
+                        t = json.load(f).get("time", 0)
+                except (OSError, ValueError):
+                    t = 0
+                snaps.append((t, os.path.join(save_dir, name)))
+    if not snaps:
+        return None
+    snaps.sort(reverse=True)
+    target = os.path.join(snaps[0][1], "state.pdparams")
+    if mode == "truncate":
+        with open(target, "rb") as f:
+            head = f.read(10)
+        with open(target, "wb") as f:
+            f.write(head)
+    elif mode == "delete":
+        os.remove(target)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return target
+
+
+class FaultInjector:
+    """Step-addressed fault injection with fire-once semantics.
+
+    Construct with an explicit spec, or leave both args None to read the
+    `PADDLE_TPU_FAULTS` / `PADDLE_TPU_FAULT_STATE_DIR` env contract — the
+    form a supervised worker uses, since env survives the restart while
+    process state does not. With no spec the injector is inert (every call
+    is a cheap no-op), so production code paths may call it
+    unconditionally.
+    """
+
+    def __init__(self, spec: Optional[str] = None,
+                 state_dir: Optional[str] = None):
+        spec = os.environ.get(SPEC_ENV) if spec is None else spec
+        self.state_dir = (os.environ.get(STATE_DIR_ENV)
+                          if state_dir is None else state_dir)
+        self.faults: Dict[int, List[Tuple[str, Optional[float]]]] = {}
+        for kind, step, arg in _parse(spec or ""):
+            self.faults.setdefault(step, []).append((kind, arg))
+        if self.faults and self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.faults)
+
+    # ------------------------------------------------------------ markers
+    def _fire_once(self, kind: str, step: int) -> bool:
+        """Atomically claim this (kind, step) fault; False if a previous
+        incarnation already fired it (or no state dir tracks firing)."""
+        if not self.state_dir:
+            return True  # untracked: fire every time (unit-test mode)
+        marker = os.path.join(self.state_dir, f"fired.{kind}.{step}")
+        try:
+            fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.write(fd, str(time.time()).encode())
+        os.close(fd)
+        return True
+
+    def fired(self, kind: str, step: int) -> bool:
+        if not self.state_dir:
+            return False
+        return os.path.exists(os.path.join(self.state_dir,
+                                           f"fired.{kind}.{step}"))
+
+    # ------------------------------------------------------------- firing
+    def step(self, step: int, checkpoint_dir: Optional[str] = None):
+        """Trigger the non-loss faults scheduled for `step`; call at the
+        top of the training step. Order within a step: stall → corrupt →
+        kill, so `corrupt@N,kill@N` models a crash that tears the newest
+        snapshot on its way down."""
+        if not self.enabled or step not in self.faults:
+            return
+        planned = dict()
+        for kind, arg in self.faults[step]:
+            planned[kind] = arg
+        if "stall" in planned and self._fire_once("stall", step):
+            time.sleep(planned["stall"] if planned["stall"] else 3600.0)
+        if "corrupt" in planned and self._fire_once("corrupt", step):
+            if checkpoint_dir is None:
+                raise ValueError("corrupt@N needs checkpoint_dir")
+            corrupt_checkpoint(checkpoint_dir)
+        if "kill" in planned and self._fire_once("kill", step):
+            # os._exit: no atexit/finally handlers — models SIGKILL-grade
+            # preemption where nothing gets to clean up or checkpoint
+            os.sys.stdout.flush()
+            os.sys.stderr.flush()
+            os._exit(KILL_EXIT_CODE)
+
+    def poison_loss(self, step: int, loss):
+        """Return `loss` NaN-poisoned if a nan@step fault is armed (framework
+        Tensor or jax/numpy array; preserves type via multiplication)."""
+        if not self.enabled or step not in self.faults:
+            return loss
+        if any(k == "nan" for k, _ in self.faults[step]) \
+                and self._fire_once("nan", step):
+            return loss * float("nan")
+        return loss

@@ -35,7 +35,7 @@ import pytest  # noqa: E402
 # fast. Module-level so the list lives in one place.
 _HEAVY_MODULES = {
     "test_op_suite", "test_dy2static", "test_bert", "test_op_tail",
-    "test_op_tail3",
+    "test_op_tail3", "test_op_grad_suite",
 }
 
 

@@ -1,0 +1,89 @@
+"""Supervised training worker for tests/test_fault_tolerance.py.
+
+Trains a deterministic linear regression for FT_TOTAL_STEPS steps under an
+AutoCheckpointManager in step-granular mode, with env-driven fault
+injection (PADDLE_TPU_FAULTS). Every batch is a pure function of the step
+index, so a killed-and-resumed run MUST reach bitwise-identical final
+parameters to an uninterrupted one — any divergence is a checkpoint/restore
+bug, not test noise.
+
+Env contract:
+    FT_CKPT_DIR          checkpoint directory (shared across restarts)
+    FT_OUT               result JSON path (written atomically at the end)
+    FT_TOTAL_STEPS       default 12
+    FT_SAVE_EVERY        default 4
+    FT_ANOMALY_POLICY    optional: raise | skip_step | zero_grads
+plus the supervisor's PADDLE_ELASTIC_* vars and the fault-injector's
+PADDLE_TPU_FAULTS / PADDLE_TPU_FAULT_STATE_DIR.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.optimizer as opt  # noqa: E402
+from paddle_tpu.core import anomaly  # noqa: E402
+from paddle_tpu.incubate.checkpoint import AutoCheckpointManager  # noqa: E402
+from paddle_tpu.testing.faults import FaultInjector  # noqa: E402
+
+
+def batch(step):
+    """Deterministic per-step data: replaying a step after restore sees
+    exactly the bytes the killed incarnation saw."""
+    rs = np.random.RandomState(1000 + step)
+    X = rs.randn(8, 4).astype("float32")
+    Y = rs.randn(8, 2).astype("float32")
+    return X, Y
+
+
+def main():
+    ckpt_dir = os.environ["FT_CKPT_DIR"]
+    out_path = os.environ["FT_OUT"]
+    total = int(os.environ.get("FT_TOTAL_STEPS", "12"))
+    save_every = int(os.environ.get("FT_SAVE_EVERY", "4"))
+    policy = os.environ.get("FT_ANOMALY_POLICY")
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(7)
+        model = paddle.nn.Linear(4, 2)
+        optim = opt.Adam(1e-2, parameters=model.parameters())
+
+    guard = anomaly.set_anomaly_guard(policy) if policy else None
+    inj = FaultInjector()  # env-driven; inert without PADDLE_TPU_FAULTS
+    acp = AutoCheckpointManager(ckpt_dir, models=[model], optimizers=[optim],
+                                save_every_n_steps=save_every)
+
+    steps_run = []
+    for step in acp.train_step_range(total):
+        inj.step(step, checkpoint_dir=ckpt_dir)
+        X, Y = batch(step)
+        loss = ((model(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        loss = inj.poison_loss(step, loss)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        steps_run.append(step)
+
+    result = {
+        "params": {k: np.asarray(v.numpy()).tolist()
+                   for k, v in model.state_dict().items()},
+        "first_step": steps_run[0] if steps_run else None,
+        "steps_run": len(steps_run),
+        "restart_count": int(os.environ.get("PADDLE_ELASTIC_RESTART_COUNT",
+                                            "0")),
+        "anomaly": guard.state_dict() if guard else None,
+        "quarantined": sorted(n for n in os.listdir(ckpt_dir)
+                              if n.endswith(".corrupt")),
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.rename(tmp, out_path)  # atomic: the test never reads a torn file
+
+
+if __name__ == "__main__":
+    sys.exit(main())

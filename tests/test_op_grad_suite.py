@@ -7,8 +7,9 @@ on the output and compares the eager-tape gradient against float64 central
 differences — the reference's OpTest.check_grad contract
 (/root/reference/python/paddle/fluid/tests/unittests/op_test.py:1329).
 
-tests/test_grad_coverage.py consumes GRAD_CASES mechanically: every case
-with `grad` present marks its `op_types` as FD-grad-checked.
+tests/test_grad_coverage.py audits GRAD_CASES mechanically: every case
+must declare `grad` and `op_types`, and the FD-grad-checked op set must
+not silently shrink below its recorded floor.
 
 Kink discipline: inputs are placed away from non-smooth points (clip bounds,
 hinge margins, max ties — order-statistics ops draw from a shuffled linspace

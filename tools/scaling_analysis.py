@@ -42,6 +42,31 @@ _BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
           "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
           "pred": 1}
 
+FLAGSHIP_METRIC = "gpt_small_train_tokens_per_sec"
+
+
+def read_flagship_anchor(root):
+    """(step_seconds, source_label) for the projection anchor. BENCH_DETAIL
+    stores the flagship headline as {"metric": ..., "value": ...} — the
+    value key, NOT a metric-named top-level key (ADVICE round 5: reading
+    the latter silently pinned the anchor to the fallback forever). The
+    metric name is asserted so a re-pointed headline can't be misread as
+    the flagship throughput."""
+    step_s, src = 0.1996, "fallback constant (r4 measurement)"
+    try:
+        with open(os.path.join(root, "BENCH_DETAIL.json")) as f:
+            d = json.load(f)
+        if d.get("metric") != FLAGSHIP_METRIC:
+            raise ValueError(
+                f"BENCH_DETAIL.json headline metric is {d.get('metric')!r},"
+                f" expected {FLAGSHIP_METRIC!r}")
+        tok_s = float(d["value"])
+        step_s = round(32 * 1024 / tok_s, 4)  # flagship bs32 seq1024
+        src = f"BENCH_DETAIL.json live ({tok_s:.0f} tok/s)"
+    except (OSError, KeyError, ValueError):
+        pass
+    return step_s, src
+
 
 def allreduce_payload(hlo: str):
     """Sum payload bytes over all-reduce ops in partitioned HLO text.
@@ -167,14 +192,9 @@ def main(counts):
         # GPT, bs32 x seq1024, bf16 grad all-reduce = 248 MB). The step
         # time is read from BENCH_DETAIL.json so re-running the flagship
         # bench keeps this receipt synchronized with the measurement.
-        step_s, anchor_src = 0.1996, "fallback constant (r4 measurement)"
-        try:
-            with open(os.path.join(ROOT, "BENCH_DETAIL.json")) as f:
-                tok_s = json.load(f)["gpt_small_train_tokens_per_sec"]
-            step_s = round(32 * 1024 / tok_s, 4)  # flagship bs32 seq1024
-            anchor_src = f"BENCH_DETAIL.json ({tok_s:.0f} tok/s)"
-        except (OSError, KeyError, ValueError):
-            pass
+        step_s, anchor_src = read_flagship_anchor(ROOT)
+        print(json.dumps({"anchor_source": anchor_src,
+                          "anchor_step_s": step_s}), flush=True)
         print(json.dumps({
             "projection_note": "efficiency floor = compute/(compute+"
             "unoverlapped ICI ring all-reduce); anchored to measured "
