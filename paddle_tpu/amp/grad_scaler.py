@@ -79,7 +79,9 @@ class GradScaler:
             from ..core.anomaly import current_guard
             guard = current_guard()
             if guard is not None and guard.policy != "raise":
-                guard.record(True, where="amp overflow")
+                # the update was dropped, not zero-repaired — always a
+                # skipped step, even under a zero_grads guard
+                guard.record(True, where="amp overflow", counter="skipped")
         self._unscaled = False
 
     def update(self):

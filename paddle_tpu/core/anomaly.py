@@ -93,10 +93,15 @@ class AnomalyGuard:
         self.checked_steps = 0
 
     # ------------------------------------------------------------- counters
-    def record(self, bad: bool, where: str = "step") -> bool:
+    def record(self, bad: bool, where: str = "step",
+               counter: Optional[str] = None) -> bool:
         """Count one guarded check whose anomaly flag is `bad` (a host
         bool); applies the policy's counter and raises under 'raise'.
-        Returns bad for chaining."""
+        `counter` ('skipped'|'zeroed') overrides the policy-derived choice
+        for callers that know what ACTUALLY happened — e.g. the AMP scaler
+        drops an overflow step entirely even when the guard's policy is
+        zero_grads, so it must land in skipped_steps. Returns bad for
+        chaining."""
         self.checked_steps += 1
         if not bad:
             return False
@@ -106,7 +111,9 @@ class AnomalyGuard:
                 f"anomaly guard: non-finite values detected in {where} "
                 f"(policy='raise'; use 'skip_step'/'zero_grads' to ride "
                 f"through)")
-        if self.policy == "zero_grads":
+        if counter is None:
+            counter = "zeroed" if self.policy == "zero_grads" else "skipped"
+        if counter == "zeroed":
             self.zeroed_steps += 1
         else:
             self.skipped_steps += 1

@@ -55,6 +55,12 @@ def launch():
         for local_rank in range(args.nproc_per_node):
             rank = args.node_rank * args.nproc_per_node + local_rank
             env = {
+                # global rank/world must ride in spec.env: the supervisor's
+                # defaults are the LOCAL spec index and gang size, which on
+                # a multi-node launch would silently shrink every node to
+                # an independent nproc_per_node-sized job
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
                 "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
                 "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
                 "FLAGS_selected_tpus": str(local_rank),

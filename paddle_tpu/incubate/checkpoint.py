@@ -273,7 +273,10 @@ class AutoCheckpointManager:
         indices, skipping epochs already completed by a previous run."""
         from ..distributed import elastic
         last = self.restore_latest()
-        start = 0 if last is None else last + 1
+        # restore_latest returns the newest snapshot of EITHER kind; a step
+        # snapshot's index is not an epoch, so only an epoch snapshot may
+        # advance the start (mirrors train_step_range's symmetric guard)
+        start = 0 if self.restored_kind != "epoch" else last + 1
         try:
             for epoch in range(start, max_epoch_num):
                 elastic.heartbeat()  # no-op outside a supervised run

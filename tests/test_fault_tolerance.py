@@ -214,6 +214,61 @@ def test_fault_injector_rejects_bad_spec():
         FaultInjector("explode@3")
 
 
+def test_launch_elastic_env_carries_global_rank(monkeypatch):
+    """REVIEW high: the elastic launch branch must put the globally
+    numbered PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM into spec.env —
+    otherwise the supervisor defaults them to the local spec index and
+    gang size, and a multi-node launch silently degenerates into
+    independent per-node jobs."""
+    import importlib
+    # the package re-exports the launch() function under the same name, so
+    # attribute access yields the function — import the module explicitly
+    launch_mod = importlib.import_module("paddle_tpu.distributed.launch")
+
+    captured = {}
+
+    def fake_run(self, specs):
+        captured["specs"] = specs
+        return {}
+
+    monkeypatch.setattr(ElasticSupervisor, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", [
+        "launch", "--ips", "10.0.0.1,10.0.0.2", "--nproc_per_node", "2",
+        "--node_rank", "1", "--max_restarts", "2", "train.py"])
+    launch_mod.launch()
+
+    specs = captured["specs"]
+    assert [s.env["PADDLE_TRAINER_ID"] for s in specs] == ["2", "3"]
+    assert [s.env["PADDLE_TRAINERS_NUM"] for s in specs] == ["4", "4"]
+    # rank/endpoint consistency: the endpoint indexed by the global rank
+    assert [s.env["PADDLE_CURRENT_ENDPOINT"] for s in specs] == \
+        ["10.0.0.2:6170", "10.0.0.2:6171"]
+
+
+def test_epoch_range_ignores_newer_step_snapshot(tmp_path):
+    """REVIEW medium: restore_latest returns the newest snapshot of EITHER
+    kind; train_epoch_range must not read a step snapshot's index as an
+    epoch (which would silently skip up to that many epochs)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.incubate.checkpoint import AutoCheckpointManager
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(3)
+        m = paddle.nn.Linear(4, 2)
+        o = opt.SGD(0.1, parameters=m.parameters())
+    d = str(tmp_path / "mixed")
+    acp = AutoCheckpointManager(d, models=[m], optimizers=[o],
+                                save_every_n_steps=2)
+    for _ in acp.train_step_range(6):
+        pass  # leaves step snapshots, newest step_5
+
+    acp2 = AutoCheckpointManager(d, models=[m], optimizers=[o])
+    epochs = list(acp2.train_epoch_range(3))
+    assert acp2.restored_kind == "step"  # step_5 WAS the newest snapshot
+    assert epochs == [0, 1, 2]  # ...but must not fast-forward the epochs
+
+
 def test_step_range_resumes_in_process(tmp_path):
     """train_step_range unit check (no subprocess): a run broken at step 6
     resumes at the step after its last step-granular snapshot."""

@@ -162,6 +162,27 @@ def test_grad_scaler_skips_on_inf():
     assert scaler.get_init_loss_scaling() == pytest.approx(1.0)
 
 
+def test_grad_scaler_overflow_counts_skipped_under_zero_grads():
+    """REVIEW: an AMP overflow drops the update entirely, so under an
+    active zero_grads guard it must land in skipped_steps — counting it as
+    zeroed would misreport a dropped step as an applied one."""
+    from paddle_tpu.core.anomaly import anomaly_guard
+
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    p.trainable = True
+    o = opt.SGD(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0,
+                                   decr_every_n_nan_or_inf=1)
+    with anomaly_guard("zero_grads") as g:
+        scaled = scaler.scale((p * float("inf")).sum())
+        scaled.backward()
+        scaler.step(o)
+        scaler.update()
+    np.testing.assert_allclose(p.numpy(), [1.0])  # update dropped
+    assert g.skipped_steps == 1
+    assert g.zeroed_steps == 0
+
+
 def test_auto_cast_bf16():
     with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
         a = paddle.randn([4, 4])

@@ -87,12 +87,20 @@ def test_scaling_anchor_reads_bench_detail(tmp_path):
     assert step_s == pytest.approx(32 * 1024 / 163840.0, abs=1e-4)
     assert "live" in src
 
-    # wrong metric (re-pointed headline) → falls back, and says so
+    # wrong metric (re-pointed headline) → raises LOUDLY; before the REVIEW
+    # fix this ValueError was swallowed by the function's own except and
+    # silently pinned the fallback
     (tmp_path / "BENCH_DETAIL.json").write_text(json.dumps(
         {"metric": "resnet_imgs_per_sec", "value": 9999.0}))
-    step_s, src = read_flagship_anchor(str(tmp_path))
-    assert step_s == 0.1996 and "fallback" in src
+    with pytest.raises(ValueError, match="headline metric"):
+        read_flagship_anchor(str(tmp_path))
 
-    # missing file → fallback
+    # right metric but malformed value → also loud, not fallback
+    (tmp_path / "BENCH_DETAIL.json").write_text(json.dumps(
+        {"metric": FLAGSHIP_METRIC}))
+    with pytest.raises(KeyError):
+        read_flagship_anchor(str(tmp_path))
+
+    # missing file → fallback (the only silent path left)
     step_s, src = read_flagship_anchor(str(tmp_path / "nope"))
     assert step_s == 0.1996 and "fallback" in src
