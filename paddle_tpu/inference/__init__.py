@@ -55,6 +55,9 @@ class Config:
         self._cpu_math_threads = 1
         self._enable_profile = False
         self._glog_info = True
+        self._llm_engine = False
+        self._llm_model = None
+        self._llm_options: Dict = {}
 
     # --------------------------------------------------------------- model
     def set_model(self, prog_file: str, params_file: str = None):
@@ -136,13 +139,38 @@ class Config:
     def disable_glog_info(self):
         self._glog_info = False
 
+    def enable_llm_engine(self, model=None, **options):
+        """Route create_predictor to the continuous-batching LLM serving
+        engine (inference/serving/) instead of the one-shot artifact
+        Predictor — the dispatch mirror of enable_tensorrt_engine on the
+        reference AnalysisConfig, for the engine that DOES exist here.
+
+        model: a models.gpt.GPT-shaped Layer (live parameters; serving
+        decodes through models.generation math, not a serialized
+        artifact). options: EngineConfig fields (block_size, num_blocks,
+        max_num_seqs, max_prefill_tokens) + default SamplingParams
+        fields (max_tokens, temperature, top_k, top_p, eos_token_id,
+        seed). See docs/serving.md."""
+        self._llm_engine = True
+        self._llm_model = model
+        self._llm_options = dict(options)
+
+    def llm_engine_enabled(self) -> bool:
+        return self._llm_engine
+
     def summary(self) -> str:
+        if self._llm_engine:
+            prefix = "<llm serving engine>"
+        else:
+            prefix = self._artifact_prefix()
         lines = ["----- paddle_tpu inference config -----",
-                 f"model prefix: {self._artifact_prefix()}",
+                 f"model prefix: {prefix}",
                  f"backend: {jax.default_backend()}",
                  f"ir_optim (XLA): {self._ir_optim}",
                  f"memory_optim: {self._memory_optim}",
                  f"profiling: {self._enable_profile}"]
+        if self._llm_engine:
+            lines.append(f"llm engine: {self._llm_options}")
         return "\n".join(lines)
 
 
@@ -257,8 +285,14 @@ class Predictor:
         pass
 
 
-def create_predictor(config: Config) -> Predictor:
-    """reference: paddle_infer::CreatePredictor."""
+def create_predictor(config: Config):
+    """reference: paddle_infer::CreatePredictor. Dispatches on config
+    flags like AnalysisPredictor: enable_llm_engine() routes to the
+    continuous-batching serving engine (inference/serving/), else the
+    one-shot StableHLO artifact Predictor."""
+    if config.llm_engine_enabled():
+        from .serving import ServingPredictor
+        return ServingPredictor(config)
     return Predictor(config)
 
 

@@ -1,0 +1,32 @@
+"""paddle_tpu.inference.serving — continuous-batching LLM serving.
+
+The TPU-native serving subsystem (reference capability:
+paddle/fluid/inference/, the ~38k-LoC deployment layer; design shape:
+vLLM continuous batching + the TPU Ragged Paged Attention kernel,
+PAPERS.md arxiv 2604.15464). Four cooperating modules:
+
+- paged_cache:  PagedKVCache — block-pooled KV storage, block tables,
+                alloc/free with CacheExhausted reporting, counters.
+- attention:    ragged paged-attention decode step (pure-JAX reference,
+                bitwise-pinned to models.generation.decode_step).
+- scheduler:    FCFS continuous batching — admission, prefill/decode
+                interleaving, preemption + requeue under pool pressure.
+- engine:       LLMEngine (add_request/step/streamed outputs, profiler
+                spans, throughput/latency stats) + ServingPredictor
+                (the inference.create_predictor dispatch target).
+
+See docs/serving.md for architecture and tuning.
+"""
+from .paged_cache import CacheExhausted, PagedKVCache  # noqa: F401
+from .attention import gather_block_kv, paged_decode_step  # noqa: F401
+from .scheduler import (Request, RequestState, SamplingParams,  # noqa: F401
+                        ScheduledBatch, Scheduler, SchedulerConfig)
+from .engine import (EngineConfig, EngineStats, LLMEngine,  # noqa: F401
+                     RequestOutput, ServingPredictor)
+
+__all__ = [
+    "PagedKVCache", "CacheExhausted", "gather_block_kv",
+    "paged_decode_step", "SamplingParams", "Request", "RequestState",
+    "Scheduler", "SchedulerConfig", "ScheduledBatch", "EngineConfig",
+    "EngineStats", "LLMEngine", "RequestOutput", "ServingPredictor",
+]

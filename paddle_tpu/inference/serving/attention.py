@@ -1,0 +1,98 @@
+"""Ragged paged-attention decode step (pure-JAX reference).
+
+One decode step for N sequences at DIFFERENT positions against the
+block-pooled KV cache (serving/paged_cache.py): per layer, the new
+token's K/V is scattered into each sequence's reserved (block, offset)
+slot, the sequence's context is gathered back through its block table,
+and attention is masked per-sequence by length. This is the reference
+semantics of the TPU Ragged Paged Attention kernel (PAPERS.md, arxiv
+2604.15464) — block-table gather + ragged length masking — kept in
+plain jnp so XLA owns the schedule; a pallas kernel can swap in under
+the same signature later.
+
+Parity contract: the math is NOT re-implemented — embedding, per-layer
+qkv, the attention block and the LM head are the SAME top-level jitted
+sub-programs generation.decode_step is composed of (_token_embed,
+_decode_qkv, _decode_attn, _decode_head). When max_blocks_per_seq *
+block_size == max_seq_len the gathered context has the exact dense
+cache layout (position p = block p//bs, slot p%bs) and the same shape,
+so XLA reuses the identical compiled executables for both paths; since
+out-of-length positions are masked to -1e30 before softmax (erasing
+pool garbage exactly: masked probs are exact zeros), the logits are
+bitwise-identical to generation.decode_step (tests/test_serving.py
+pins this). Padded bucket rows write out of bounds (dropped) and
+attend only to block-table padding that their mask erases; their
+logits are garbage and the engine ignores them.
+
+Shape bucketing: everything here is shape-polymorphic only in
+(N, max_blocks_per_seq, num_blocks); the engine pads N to a power-of-two
+bucket capped at max_num_seqs and keeps the other two fixed, so XLA
+compiles once per bucket and NEVER recompiles per request mix.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...models.generation import (_decode_attn, _decode_head, _decode_qkv,
+                                  _token_embed)
+
+__all__ = ["gather_block_kv", "paged_decode_step"]
+
+
+def gather_block_kv(pool, block_tables):
+    """[num_blocks, bs, H, D] pool + [N, MB] tables -> [N, H, MB*bs, D]
+    contiguous per-sequence context, positions in block-table order."""
+    n, mb = block_tables.shape
+    bs, h, d = pool.shape[1], pool.shape[2], pool.shape[3]
+    ctx = pool[block_tables]                     # [N, MB, bs, H, D]
+    return ctx.reshape(n, mb * bs, h, d).transpose(0, 2, 1, 3)
+
+
+@jax.jit
+def _pool_write_gather(kp, vp, k_new, v_new, slot_blocks, slot_offsets,
+                       block_tables):
+    """Scatter the new token's K/V [N, H, 1, D] into each sequence's
+    (block, offset) slot — out-of-range slot_blocks (padded rows) are
+    dropped — then gather each sequence's context back through its
+    block table."""
+    kp = kp.at[slot_blocks, slot_offsets].set(k_new[:, :, 0], mode="drop")
+    vp = vp.at[slot_blocks, slot_offsets].set(v_new[:, :, 0], mode="drop")
+    return (kp, vp,
+            gather_block_kv(kp, block_tables),
+            gather_block_kv(vp, block_tables))
+
+
+def paged_decode_step(params, pools, tokens, positions, block_tables,
+                      slot_blocks, slot_offsets, geom):
+    """One ragged decode step over the block pool.
+
+    params: the models.generation.extract_params dict.
+    pools: L-tuple of (k_pool, v_pool) [num_blocks, bs, H, D].
+    tokens [N] int32 — last sampled token per sequence.
+    positions [N] int32 — cached length per sequence (the new token's
+        position); padded rows use 0.
+    block_tables [N, MB] int32 — block ids padded with 0.
+    slot_blocks/slot_offsets [N] int32 — write slot for the new token's
+        K/V; padded rows point slot_blocks out of bounds (num_blocks) so
+        the scatter drops them.
+    geom: static (num_layers, num_heads, head_dim, max_seq_len), the
+        models.generation geometry tuple.
+
+    Returns (logits [N, V], updated pools). Composed of the shared
+    jitted sub-programs of generation.decode_step plus the pool
+    scatter/gather above — see the parity contract in the module
+    docstring.
+    """
+    tokens = jnp.asarray(tokens, jnp.int32)
+    positions = jnp.asarray(positions, jnp.int32)
+    x = _token_embed(params, tokens, positions)   # [N, 1, C]
+    new_pools = []
+    for i, (kp, vp) in enumerate(pools):
+        qkv = _decode_qkv(params, i, x, geom)     # [3, N, H, 1, D]
+        kp, vp, kc, vc = _pool_write_gather(
+            kp, vp, qkv[1], qkv[2], slot_blocks, slot_offsets,
+            block_tables)
+        new_pools.append((kp, vp))
+        x = _decode_attn(params, i, x, qkv[0], kc, vc, positions, geom)
+    return _decode_head(params, x), tuple(new_pools)

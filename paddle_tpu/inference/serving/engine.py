@@ -1,0 +1,390 @@
+"""LLMEngine: continuous-batching serving loop over the paged KV cache.
+
+The serving analogue of the reference inference layer's
+AnalysisPredictor::Run — but instead of one synchronous batch per call,
+requests stream in (add_request), the engine interleaves prefill and
+decode per step() under the scheduler's FCFS/preemption policy, and
+outputs stream back token by token.
+
+Device work per step:
+- prefill: models.generation.prefill (the SAME jitted program the dense
+  generate() path uses — one compilation per prompt-length bucket),
+  scattered into the sequence's blocks (PagedKVCache.write_prefill);
+- decode: serving.attention.paged_decode_step over ALL running
+  sequences at once, padded to a power-of-two bucket capped at
+  max_num_seqs, so XLA compiles once per bucket and never recompiles
+  per request mix.
+
+Sampling is host-side numpy (greedy argmax / temperature + top-k/top-p)
+with a per-request RNG: continuous batching must not change results, so
+greedy engine output token-matches models.generation.generate
+(tests/test_serving.py pins this end to end, preemptions included).
+
+Every phase runs under a profiler.RecordEvent span (cat="serving") so a
+serving trace exported with profiler.export_chrome_tracing shows
+schedule/prefill/decode per engine step, with request counts in args.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...models import generation as gen
+from ...profiler import RecordEvent
+from .attention import paged_decode_step
+from .paged_cache import PagedKVCache
+from .scheduler import (Request, RequestState, SamplingParams,
+                        ScheduledBatch, Scheduler, SchedulerConfig)
+
+__all__ = ["EngineConfig", "EngineStats", "LLMEngine", "RequestOutput",
+           "ServingPredictor"]
+
+
+@dataclass
+class EngineConfig:
+    block_size: int = 16
+    num_blocks: int = 256
+    max_num_seqs: int = 8
+    max_prefill_tokens: int = 2048
+
+
+@dataclass
+class RequestOutput:
+    """One streamed step result for one request."""
+    request_id: str
+    new_token: Optional[int]
+    token_ids: List[int]                 # all generated tokens so far
+    finished: bool
+    finish_reason: Optional[str] = None  # 'stop' | 'length' | 'cancelled'
+
+
+@dataclass
+class EngineStats:
+    steps: int = 0
+    prefill_tokens: int = 0
+    generated_tokens: int = 0
+    preemptions: int = 0
+    completed: int = 0
+    cancelled: int = 0
+    time_schedule: float = 0.0
+    time_prefill: float = 0.0
+    time_decode: float = 0.0
+    ttft_sum: float = 0.0                # time-to-first-token accumulator
+    latency_sum: float = 0.0             # request wall time accumulator
+
+    def as_dict(self) -> dict:
+        d = dict(self.__dict__)
+        done = max(self.completed, 1)
+        d["avg_ttft_s"] = self.ttft_sum / done
+        d["avg_request_latency_s"] = self.latency_sum / done
+        busy = self.time_prefill + self.time_decode
+        d["decode_tokens_per_sec"] = (
+            self.generated_tokens / busy if busy > 0 else 0.0)
+        return d
+
+
+def _bucket(n: int, cap: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class LLMEngine:
+    """Continuous-batching engine over (params, geom) — the pure-JAX
+    decode substrate of models.generation, served paged."""
+
+    def __init__(self, params, geom, config: EngineConfig = None):
+        config = config or EngineConfig()
+        L, H, D, S = geom
+        if S % config.block_size != 0:
+            # divisibility keeps the gathered context bitwise-identical
+            # to the dense cache layout (and write_prefill rectangular)
+            raise ValueError(
+                f"block_size {config.block_size} must divide "
+                f"max_seq_len {S}")
+        self.params = params
+        self.geom = geom
+        self.config = config
+        self.max_blocks_per_seq = S // config.block_size
+        self.cache = PagedKVCache(L, H, D, config.num_blocks,
+                                  config.block_size)
+        self.scheduler = Scheduler(
+            SchedulerConfig(max_num_seqs=config.max_num_seqs,
+                            max_prefill_tokens=config.max_prefill_tokens),
+            self.cache)
+        self.stats = EngineStats()
+        self._requests: Dict[str, Request] = {}
+        self._rngs: Dict[str, np.random.RandomState] = {}
+        self._next_id = 0
+
+    @classmethod
+    def from_model(cls, model, config: EngineConfig = None):
+        cfg = model.cfg
+        geom = (cfg.num_layers, cfg.num_heads,
+                cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
+        return cls(gen.extract_params(model), geom, config)
+
+    # ------------------------------------------------------------ intake
+    def add_request(self, prompt_ids, sampling: SamplingParams = None,
+                    request_id: str = None) -> str:
+        sampling = sampling or SamplingParams()
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        S = self.geom[3]
+        if ids.size + sampling.max_tokens > S:
+            raise ValueError(
+                f"prompt {ids.size} + max_tokens {sampling.max_tokens} "
+                f"exceeds max_seq_len {S}")
+        if request_id is None:
+            request_id = f"req-{self._next_id}"
+            self._next_id += 1
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request_id {request_id!r}")
+        req = Request(request_id=request_id, prompt_ids=ids,
+                      params=sampling, arrival_time=time.perf_counter())
+        self.scheduler.add(req)              # validates pool fit
+        self._requests[request_id] = req
+        self._rngs[request_id] = np.random.RandomState(
+            sampling.seed & 0x7FFFFFFF)
+        return request_id
+
+    def cancel(self, request_id: str) -> bool:
+        ok = self.scheduler.cancel(request_id)
+        if ok:
+            self.stats.cancelled += 1
+            req = self._requests[request_id]
+            req.finish_time = time.perf_counter()
+        return ok
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished()
+
+    def get_request(self, request_id: str) -> Request:
+        return self._requests[request_id]
+
+    # ---------------------------------------------------------- sampling
+    def _sample(self, req: Request, logits: np.ndarray) -> int:
+        p = req.params
+        if p.temperature <= 0.0:
+            return int(np.argmax(logits))
+        lg = logits.astype(np.float64) / p.temperature
+        if p.top_k:
+            kth = np.sort(lg)[-p.top_k]
+            lg = np.where(lg < kth, -np.inf, lg)
+        if 0.0 < p.top_p < 1.0:
+            srt = np.sort(lg)[::-1]
+            probs = np.exp(srt - srt.max())
+            probs /= probs.sum()
+            excl = np.cumsum(probs) - probs
+            kth = srt[int((excl < p.top_p).sum()) - 1]
+            lg = np.where(lg < kth, -np.inf, lg)
+        probs = np.exp(lg - lg.max())
+        probs /= probs.sum()
+        return int(self._rngs[req.request_id].choice(len(probs), p=probs))
+
+    def _emit(self, req: Request, tok: int, outs: List[RequestOutput]):
+        """Record one sampled token, handle completion, stream it out."""
+        now = time.perf_counter()
+        if req.first_token_time is None:
+            req.first_token_time = now
+        req.output_ids.append(tok)
+        self.stats.generated_tokens += 1
+        finished, reason = False, None
+        if req.params.eos_token_id is not None \
+                and tok == req.params.eos_token_id:
+            finished, reason = True, "stop"
+            state = RequestState.FINISHED_STOPPED
+        elif len(req.output_ids) >= req.params.max_tokens:
+            finished, reason = True, "length"
+            state = RequestState.FINISHED_LENGTH
+        if finished:
+            self.scheduler.finish(req, state)
+            req.finish_time = now
+            self.stats.completed += 1
+            self.stats.ttft_sum += req.first_token_time - req.arrival_time
+            self.stats.latency_sum += now - req.arrival_time
+        outs.append(RequestOutput(req.request_id, tok,
+                                  list(req.output_ids), finished, reason))
+
+    # -------------------------------------------------------------- step
+    def step(self) -> List[RequestOutput]:
+        """One engine iteration: schedule, prefill admitted requests,
+        decode every running sequence, stream the new tokens."""
+        outs: List[RequestOutput] = []
+        self.stats.steps += 1
+        with RecordEvent("serving.engine_step", cat="serving") as step_ev:
+            t0 = time.perf_counter()
+            with RecordEvent("serving.schedule", cat="serving") as ev:
+                batch = self.scheduler.schedule()
+                ev.args = {"prefill": len(batch.prefill),
+                           "decode": len(batch.decode),
+                           "preempted": len(batch.preempted),
+                           "free_blocks": self.cache.num_free()}
+            self.stats.preemptions += len(batch.preempted)
+            self.stats.time_schedule += time.perf_counter() - t0
+
+            for req in batch.prefill:
+                t0 = time.perf_counter()
+                tokens = req.all_token_ids()
+                with RecordEvent("serving.prefill", cat="serving") as ev:
+                    ev.args = {"request_id": req.request_id,
+                               "tokens": int(tokens.size)}
+                    logits = self._prefill(req, tokens)
+                self.stats.prefill_tokens += int(tokens.size)
+                self.stats.time_prefill += time.perf_counter() - t0
+                self._emit(req, self._sample(req, logits), outs)
+
+            # requests finished right at prefill release their blocks
+            # before the decode gather builds its tables
+            decode = [r for r in batch.decode if not r.finished]
+            if decode:
+                t0 = time.perf_counter()
+                with RecordEvent("serving.decode", cat="serving") as ev:
+                    ev.args = {"num_seqs": len(decode)}
+                    logits = self._decode(decode)
+                self.stats.time_decode += time.perf_counter() - t0
+                for i, req in enumerate(decode):
+                    self._emit(req, self._sample(req, logits[i]), outs)
+            step_ev.args = {"step": self.stats.steps,
+                            "outputs": len(outs)}
+        return outs
+
+    def _prefill(self, req: Request, tokens: np.ndarray) -> np.ndarray:
+        """Dense prefill (shared jitted program with generate()),
+        scattered into the sequence's blocks. Returns last-position
+        logits [V]."""
+        logits, dense_cache = gen.prefill(
+            self.params, jnp.asarray(tokens[None], jnp.int32), self.geom)
+        self.cache.write_prefill(req.request_id, dense_cache, tokens.size)
+        return np.asarray(logits[0])
+
+    def _decode(self, reqs: List[Request]) -> np.ndarray:
+        """Ragged paged decode for all running sequences, padded to the
+        power-of-two bucket. Returns logits [len(reqs), V]."""
+        n = _bucket(len(reqs), self.config.max_num_seqs)
+        mb, nb = self.max_blocks_per_seq, self.config.num_blocks
+        tokens = np.zeros(n, np.int32)
+        positions = np.zeros(n, np.int32)
+        tables = np.zeros((n, mb), np.int32)
+        # padded rows scatter out of bounds -> dropped by the kernel
+        slot_blocks = np.full(n, nb, np.int32)
+        slot_offsets = np.zeros(n, np.int32)
+        for i, req in enumerate(reqs):
+            block, offset, pos = req.slot
+            tokens[i] = req.last_token
+            positions[i] = pos
+            slot_blocks[i] = block
+            slot_offsets[i] = offset
+            table = self.cache.block_table(req.request_id)
+            tables[i, :len(table)] = table
+        logits, pools = paged_decode_step(
+            self.params, self.cache.pools, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(slot_blocks), jnp.asarray(slot_offsets),
+            self.geom)
+        self.cache.pools = pools
+        return np.asarray(logits)[:len(reqs)]
+
+    # ------------------------------------------------------- convenience
+    def run(self, max_steps: int = None) -> Dict[str, np.ndarray]:
+        """Drive every queued request to completion; returns
+        {request_id: np.ndarray of generated token ids}."""
+        steps = 0
+        while self.has_unfinished():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps")
+        return {rid: np.asarray(r.output_ids, np.int64)
+                for rid, r in self._requests.items()
+                if r.state != RequestState.CANCELLED}
+
+
+class ServingPredictor:
+    """Paddle-parity predictor facade over LLMEngine (the serving twin
+    of inference.Predictor, dispatched by create_predictor when
+    Config.enable_llm_engine was called — mirroring how
+    AnalysisPredictor picks its engine off config flags).
+
+    IO surface: input 'input_ids' [B, T] (right-padded) + optional
+    'prompt_lens' [B]; output 'sequences' [B, T_out] right-padded with
+    the pad token (eos when set, else 0).
+    """
+
+    def __init__(self, config):
+        model = getattr(config, "_llm_model", None)
+        if model is None:
+            raise ValueError(
+                "Config.enable_llm_engine(model=...) must receive the "
+                "model object; serving runs live parameters, not a "
+                "serialized artifact")
+        opts = dict(getattr(config, "_llm_options", {}) or {})
+        self._sampling = SamplingParams(**{
+            k: opts.pop(k) for k in list(opts)
+            if k in SamplingParams.__dataclass_fields__})
+        self.engine = LLMEngine.from_model(model, EngineConfig(**opts))
+        from .. import Tensor
+        self._inputs = {n: Tensor(n)
+                        for n in ("input_ids", "prompt_lens")}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return ["input_ids", "prompt_lens"]
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return ["sequences"]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[list] = None):
+        if inputs is not None:
+            self._inputs["input_ids"].copy_from_cpu(
+                np.asarray(inputs[0]))
+            if len(inputs) > 1:
+                self._inputs["prompt_lens"].copy_from_cpu(
+                    np.asarray(inputs[1]))
+        ids_h = self._inputs["input_ids"]
+        if ids_h._arr is None:
+            raise RuntimeError("input 'input_ids' not set")
+        ids = np.asarray(ids_h._arr)
+        lens_h = self._inputs["prompt_lens"]
+        lens = (np.asarray(lens_h._arr).astype(int).reshape(-1)
+                if lens_h._arr is not None
+                else np.full(ids.shape[0], ids.shape[1]))
+        rids = [self.engine.add_request(ids[b, :lens[b]], self._sampling)
+                for b in range(ids.shape[0])]
+        results = self.engine.run()
+        pad = self._sampling.eos_token_id
+        pad = 0 if pad is None else int(pad)
+        width = max(int(lens[b]) + len(results[r].tolist())
+                    for b, r in enumerate(rids))
+        out = np.full((ids.shape[0], width), pad, np.int64)
+        for b, rid in enumerate(rids):
+            seq = np.concatenate([ids[b, :lens[b]].astype(np.int64),
+                                  results[rid]])
+            out[b, :seq.size] = seq
+        from .. import Tensor
+        t = Tensor("sequences")
+        t._arr = jnp.asarray(out)
+        self._outputs = {"sequences": t}
+        if inputs is not None:
+            return [out]
+        return None
+
+    # Predictor-surface parity no-ops
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass

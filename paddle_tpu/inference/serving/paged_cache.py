@@ -1,0 +1,179 @@
+"""Paged KV cache: a fixed block pool + per-sequence block tables.
+
+The dense decode cache in models/generation.py is [B, H, max_seq, D] per
+layer — every sequence pays for max_seq_len positions and a batch slot,
+so a serving mix of short and long requests wastes most of HBM. Here KV
+lives in a per-layer block pool [num_blocks, block_size, H, D]; a
+sequence owns an ordered list of block ids (its *block table*) and only
+ever holds ceil(len/block_size) blocks. This is the TPU-native shape of
+the Ragged Paged Attention kernel (PAPERS.md, arxiv 2604.15464) and of
+vLLM's PagedAttention, with the pool as one jnp array per layer so the
+ragged decode step (serving/attention.py) gathers it with one
+block-table index per layer.
+
+Host/device split: block accounting (free list, tables, lengths,
+counters) is plain Python — it feeds the scheduler and never traces.
+The pools themselves are jax arrays; `write_prefill` scatters a dense
+prefill cache into a sequence's blocks, and the decode step returns
+updated pools that the engine assigns back.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "CacheExhausted"]
+
+
+class CacheExhausted(RuntimeError):
+    """Block pool exhaustion report: who needed how much vs. what's free.
+
+    The scheduler catches this to preempt; anyone else sees a precise
+    message instead of a silent mis-allocation."""
+
+    def __init__(self, seq_id, needed: int, free: int, total: int):
+        self.seq_id = seq_id
+        self.needed = needed
+        self.free = free
+        self.total = total
+        super().__init__(
+            f"KV block pool exhausted: seq {seq_id!r} needs {needed} "
+            f"block(s), {free}/{total} free")
+
+
+class PagedKVCache:
+    """Fixed-size per-layer KV block pools with alloc/free accounting.
+
+    Pools: L-tuple of (k_pool, v_pool), each [num_blocks, block_size, H,
+    D]. Token position p of a sequence lives in its block table entry
+    p // block_size at slot offset p % block_size — the identity layout
+    that makes the gathered context bitwise-match the dense cache.
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_blocks: int, block_size: int, dtype=jnp.float32):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        shape = (num_blocks, block_size, num_heads, head_dim)
+        self.pools: Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...] = tuple(
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers))
+        # ----------------------------------------------- host accounting
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lens: Dict[object, int] = {}
+        # lifetime counters (the zero-leak invariant is
+        # blocks_allocated == blocks_freed once every sequence is freed)
+        self.blocks_allocated = 0
+        self.blocks_freed = 0
+        self.alloc_failures = 0
+        self.high_water = 0
+
+    # ------------------------------------------------------------ queries
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def num_used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def utilization(self) -> float:
+        return self.num_used() / self.num_blocks
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+    def has_seq(self, seq_id) -> bool:
+        return seq_id in self._tables
+
+    def seq_len(self, seq_id) -> int:
+        return self._lens[seq_id]
+
+    def block_table(self, seq_id) -> List[int]:
+        return list(self._tables[seq_id])
+
+    # ------------------------------------------------------- alloc / free
+    def _take_blocks(self, seq_id, n: int) -> List[int]:
+        if n > len(self._free):
+            self.alloc_failures += 1
+            raise CacheExhausted(seq_id, n, len(self._free),
+                                 self.num_blocks)
+        got = [self._free.pop() for _ in range(n)]
+        self.blocks_allocated += n
+        self.high_water = max(self.high_water, self.num_used())
+        return got
+
+    def allocate(self, seq_id, num_tokens: int) -> List[int]:
+        """Claim blocks for a new sequence of num_tokens cached tokens
+        (prefill). Raises CacheExhausted without side effects."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id!r} already allocated")
+        ids = self._take_blocks(seq_id, self.blocks_needed(num_tokens))
+        self._tables[seq_id] = ids
+        self._lens[seq_id] = num_tokens
+        return ids
+
+    def append_slot(self, seq_id) -> Tuple[int, int, int]:
+        """Reserve the slot for the sequence's next token; grows the
+        block table by one block on a block boundary. Returns
+        (block_id, offset, position); raises CacheExhausted (leaving the
+        sequence untouched) when a new block is needed but none is free.
+        """
+        pos = self._lens[seq_id]
+        table = self._tables[seq_id]
+        if pos % self.block_size == 0 and len(table) * self.block_size \
+                <= pos:
+            table.extend(self._take_blocks(seq_id, 1))
+        self._lens[seq_id] = pos + 1
+        block = table[pos // self.block_size]
+        return block, pos % self.block_size, pos
+
+    def free(self, seq_id) -> int:
+        """Return every block of seq_id to the pool (completion,
+        preemption or cancellation)."""
+        ids = self._tables.pop(seq_id)
+        self._lens.pop(seq_id)
+        self._free.extend(reversed(ids))
+        self.blocks_freed += len(ids)
+        return len(ids)
+
+    # ------------------------------------------------------- device side
+    def write_prefill(self, seq_id, dense_cache, num_tokens: int,
+                      batch_index: int = 0):
+        """Scatter one sequence's dense prefill cache (the L-tuple of
+        (k [B, H, S, D], v) from models.generation.prefill) into its
+        allocated blocks. Positions past num_tokens inside the last
+        block stay zero (prefill zero-fills past the prompt), matching
+        a fresh pool block bit-for-bit."""
+        ids = self._tables[seq_id]
+        n_blocks, bs = len(ids), self.block_size
+        t_pad = n_blocks * bs
+        idx = jnp.asarray(ids, jnp.int32)
+
+        def scatter(pool, dense):
+            # [H, S, D] -> [S, H, D] -> [n_blocks, bs, H, D]
+            blk = dense[batch_index].transpose(1, 0, 2)[:t_pad]
+            blk = blk.reshape(n_blocks, bs, self.num_heads, self.head_dim)
+            return pool.at[idx].set(blk)
+
+        self.pools = tuple(
+            (scatter(kp, kc), scatter(vp, vc))
+            for (kp, vp), (kc, vc) in zip(self.pools, dense_cache))
+
+    def stats(self) -> dict:
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "free": self.num_free(),
+            "used": self.num_used(),
+            "utilization": self.utilization(),
+            "blocks_allocated": self.blocks_allocated,
+            "blocks_freed": self.blocks_freed,
+            "alloc_failures": self.alloc_failures,
+            "high_water": self.high_water,
+        }

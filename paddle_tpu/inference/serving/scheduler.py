@@ -1,0 +1,206 @@
+"""Continuous-batching scheduler: FCFS admission, decode interleaving,
+preemption under block-pool pressure.
+
+Reference shape: vLLM's scheduler (and the fluid inference executor's
+batch dispatch, reference paddle/fluid/inference/), specialised to the
+paged cache in serving/paged_cache.py. Per engine step:
+
+1. DECODE — every RUNNING sequence reserves the slot for its next token
+   (cache.append_slot), earliest arrival first. If the pool is
+   exhausted, the LATEST-arrived running sequence is preempted: its
+   blocks are freed and it re-queues at the FRONT of the waiting line
+   with prompt := prompt + generated-so-far (recompute-style preemption
+   — cheap on TPU where prefill is one fused forward). FCFS priority is
+   therefore strict: an earlier request can never be starved by a later
+   one.
+2. PREFILL/ADMIT — waiting requests are admitted in arrival order while
+   the running set is under max_num_seqs, the per-step prefill token
+   budget holds (at least one admission may overflow the budget so a
+   long prompt is never starved), and the pool can hold their tokens.
+   Admission never preempts: running sequences outrank new ones.
+
+The scheduler only does host-side accounting; all device work (prefill
+forward, paged decode) belongs to the engine.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from .paged_cache import CacheExhausted, PagedKVCache
+
+__all__ = ["SamplingParams", "Request", "RequestState", "Scheduler",
+           "SchedulerConfig", "ScheduledBatch"]
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode knobs (vLLM SamplingParams analogue)."""
+    max_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+
+
+class RequestState:
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED_STOPPED = "finished_stopped"    # sampled eos
+    FINISHED_LENGTH = "finished_length"      # hit max_tokens
+    CANCELLED = "cancelled"
+
+    FINISHED = (FINISHED_STOPPED, FINISHED_LENGTH, CANCELLED)
+
+
+_arrival_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt_ids: np.ndarray                   # int32 [T], never mutated
+    params: SamplingParams
+    output_ids: List[int] = field(default_factory=list)
+    state: str = RequestState.WAITING
+    arrival: int = field(default_factory=lambda: next(_arrival_counter))
+    num_preemptions: int = 0
+    # engine bookkeeping
+    slot: Optional[tuple] = None             # (block, offset, pos)
+    arrival_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    def all_token_ids(self) -> np.ndarray:
+        """prompt + generated — the effective prompt after preemption."""
+        if not self.output_ids:
+            return self.prompt_ids
+        return np.concatenate(
+            [self.prompt_ids, np.asarray(self.output_ids, np.int32)])
+
+    @property
+    def last_token(self) -> int:
+        return int(self.output_ids[-1]) if self.output_ids \
+            else int(self.prompt_ids[-1])
+
+    @property
+    def finished(self) -> bool:
+        return self.state in RequestState.FINISHED
+
+
+@dataclass
+class SchedulerConfig:
+    max_num_seqs: int = 8                    # decode bucket ceiling
+    max_prefill_tokens: int = 2048           # per-step admission budget
+
+
+@dataclass
+class ScheduledBatch:
+    prefill: List[Request] = field(default_factory=list)
+    decode: List[Request] = field(default_factory=list)
+    preempted: List[Request] = field(default_factory=list)
+
+
+class Scheduler:
+    def __init__(self, config: SchedulerConfig, cache: PagedKVCache):
+        self.config = config
+        self.cache = cache
+        self.waiting: deque = deque()
+        self.running: List[Request] = []
+        self.num_preemptions = 0
+
+    # ------------------------------------------------------------- intake
+    def add(self, req: Request):
+        # a request that can never fit the pool would livelock the
+        # preemption loop — refuse it up front, loudly
+        worst = len(req.prompt_ids) + req.params.max_tokens
+        if self.cache.blocks_needed(worst) > self.cache.num_blocks:
+            raise ValueError(
+                f"request {req.request_id!r} needs "
+                f"{self.cache.blocks_needed(worst)} blocks at its longest"
+                f" ({worst} tokens) but the pool only has "
+                f"{self.cache.num_blocks}; grow num_blocks or shrink the"
+                f" request")
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    def cancel(self, request_id: str) -> bool:
+        for req in list(self.waiting):
+            if req.request_id == request_id:
+                self.waiting.remove(req)
+                req.state = RequestState.CANCELLED
+                return True
+        for req in self.running:
+            if req.request_id == request_id:
+                self.running.remove(req)
+                self.cache.free(request_id)
+                req.state = RequestState.CANCELLED
+                return True
+        return False
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ---------------------------------------------------------- scheduling
+    def _preempt(self, victim: Request, batch: ScheduledBatch):
+        """Recompute-style preemption: drop the cache, requeue at the
+        head of the line with the generated tokens folded into the
+        prompt (all_token_ids)."""
+        self.running.remove(victim)
+        if victim in batch.decode:
+            batch.decode.remove(victim)
+        self.cache.free(victim.request_id)
+        victim.slot = None
+        victim.state = RequestState.WAITING
+        victim.num_preemptions += 1
+        self.num_preemptions += 1
+        self.waiting.appendleft(victim)
+        batch.preempted.append(victim)
+
+    def schedule(self) -> ScheduledBatch:
+        batch = ScheduledBatch()
+        # 1. decode slots, earliest arrival first; preempt from the back
+        for req in sorted(self.running, key=lambda r: r.arrival):
+            if req not in self.running:      # preempted below, this step
+                continue
+            while True:
+                try:
+                    req.slot = self.cache.append_slot(req.request_id)
+                    batch.decode.append(req)
+                    break
+                except CacheExhausted:
+                    victim = max(self.running, key=lambda r: r.arrival)
+                    self._preempt(victim, batch)
+                    if victim is req:
+                        break                # preempted itself; move on
+        # 2. FCFS admission under seq count + prefill token budget
+        budget = self.config.max_prefill_tokens
+        while self.waiting and len(self.running) \
+                < self.config.max_num_seqs:
+            req = self.waiting[0]
+            tokens = req.all_token_ids()
+            if len(tokens) > budget and batch.prefill:
+                break                        # budget spent; next step
+            try:
+                self.cache.allocate(req.request_id, len(tokens))
+            except CacheExhausted:
+                break                        # never preempt to admit
+            self.waiting.popleft()
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+            batch.prefill.append(req)
+            budget -= len(tokens)
+        return batch
+
+    # ------------------------------------------------------------ results
+    def finish(self, req: Request, state: str):
+        """Completion path: release blocks, detach from running."""
+        self.running.remove(req)
+        self.cache.free(req.request_id)
+        req.slot = None
+        req.state = state

@@ -63,13 +63,16 @@ def _qkv_proj(p, i, x, geom):
 def _block(p, i, x, q, k_cache, v_cache, pos_mask, geom):
     """One pre-LN block over x [B, t, H*D]: attention of the precomputed
     q [B, H, t, D] against the cache, then the MLP.
-    k_cache/v_cache: [B, H, S, D]; pos_mask [t, S] True=attend."""
+    k_cache/v_cache: [B, H, S, D]; pos_mask True=attend — [t, S] shared
+    across the batch (dense decode) or [B, 1, t, S] per-sequence (the
+    ragged paged-attention path, inference/serving/attention.py)."""
     _, H, D, _ = geom
     pre = f"blocks.{i}."
     B, t = x.shape[0], x.shape[1]
     scores = jnp.einsum("bhtd,bhsd->bhts", q, k_cache) \
         * jnp.asarray(1.0 / np.sqrt(D), q.dtype)
-    scores = jnp.where(pos_mask[None, None], scores,
+    mask = pos_mask if pos_mask.ndim == 4 else pos_mask[None, None]
+    scores = jnp.where(mask, scores,
                        jnp.asarray(-1e30, scores.dtype))
     probs = jax.nn.softmax(scores, axis=-1)
     att = jnp.einsum("bhts,bhsd->bhtd", probs, v_cache)
@@ -119,28 +122,78 @@ def prefill(params, input_ids, geom):
     return logits, tuple(cache)
 
 
-@functools.partial(jax.jit, static_argnums=(4,))
+# --------------------------------------------------------------------------
+# The decode step is DECOMPOSED into top-level jitted sub-programs shared
+# with the paged serving path (inference/serving/attention.py): embed,
+# per-layer qkv, per-layer attention+MLP, final head. Two monolithic jits
+# (dense decode_step vs paged decode) fuse differently and drift by ~1e-7
+# per step (measured on the CPU backend); routing BOTH paths through the
+# SAME compiled executables makes paged decode bitwise-identical to the
+# dense path by construction — positions beyond a sequence's length are
+# masked to -1e30 before softmax, so cache garbage is erased exactly.
+# Under an enclosing jit (the generate()/beam rollout scans, jax.export)
+# these sub-jits inline and fuse into one program, exactly as before.
+
+@jax.jit
+def _token_embed(params, tokens, positions):
+    """Per-row embedding: tokens [B] at per-sequence positions [B] ->
+    [B, 1, C]. Same gather+add as _embed at a shared scalar position."""
+    return params["wte.weight"][tokens[:, None]] \
+        + params["wpe.weight"][positions][:, None]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 3))
+def _decode_qkv(params, i, x, geom):
+    return _qkv_proj(params, i, x, geom)
+
+
+@jax.jit
+def _cache_write(kc, vc, k_new, v_new, pos):
+    """Write the new token's K/V [B, H, 1, D] at position pos (scalar)
+    of the dense [B, H, S, D] cache."""
+    z = jnp.asarray(0, pos.dtype)
+    return (jax.lax.dynamic_update_slice(kc, k_new, (z, z, pos, z)),
+            jax.lax.dynamic_update_slice(vc, v_new, (z, z, pos, z)))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 7))
+def _decode_attn(params, i, x, q, kc, vc, positions, geom):
+    """One block over the (dense-layout) context [B, H, S, D], attending
+    row b to positions <= positions[b]."""
+    S = kc.shape[2]
+    attend = (jnp.arange(S)[None, :]
+              <= positions[:, None])[:, None, None, :]  # [B, 1, 1, S]
+    return _block(params, i, x, q, kc, vc, attend, geom)
+
+
+@jax.jit
+def _decode_head(params, x):
+    x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
+    return x[:, 0] @ params["lm_head.weight"]
+
+
 def decode_step(params, cache, token, pos, geom):
     """One cached decode step. cache: the per-layer pytree from
     `prefill`; token [B], pos scalar (int32). Returns (logits [B, V],
-    updated cache)."""
-    L, H, D, S = geom
-    x = _embed(params, token[:, None], pos)           # [B, 1, H]
-    attend = jnp.arange(S)[None, :] <= pos            # [1, S]
+    updated cache). Composed of the shared jitted sub-programs above;
+    call it under jax.jit (as the generate()/beam scans do) to fuse the
+    whole step into one program."""
+    token = jnp.asarray(token, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    positions = jnp.broadcast_to(pos, token.shape)
+    x = _token_embed(params, token, positions)        # [B, 1, H]
     new_cache = []
     for i, (kc, vc) in enumerate(cache):
-        qkv = _qkv_proj(params, i, x, geom)           # once per layer
-        z = jnp.asarray(0, pos.dtype)
-        kc = jax.lax.dynamic_update_slice(kc, qkv[1], (z, z, pos, z))
-        vc = jax.lax.dynamic_update_slice(vc, qkv[2], (z, z, pos, z))
+        qkv = _decode_qkv(params, i, x, geom)         # once per layer
+        kc, vc = _cache_write(kc, vc, qkv[1], qkv[2], pos)
         new_cache.append((kc, vc))
-        x = _block(params, i, x, qkv[0], kc, vc, attend, geom)
-    x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
-    return x[:, 0] @ params["lm_head.weight"], tuple(new_cache)
+        x = _decode_attn(params, i, x, qkv[0], kc, vc, positions, geom)
+    return _decode_head(params, x), tuple(new_cache)
 
 
 @functools.lru_cache(maxsize=32)
-def _sampling_rollout(geom, max_new: int, temperature: float, top_k: int):
+def _sampling_rollout(geom, max_new: int, temperature: float, top_k: int,
+                      top_p: float = 1.0, eos: int = -1):
     """One jitted (prefill + decode scan) program per static config.
 
     generate() used to run its lax.scan eagerly with per-call closures;
@@ -148,10 +201,17 @@ def _sampling_rollout(geom, max_new: int, temperature: float, top_k: int):
     rollout (~8.5 s host time per WARM call on the bench box, vs 0.15 ms
     for a cached decode_step — measured before this factory existed).
     Caching the jitted program by its static knobs makes warm generate
-    calls pure device time."""
+    calls pure device time.
+
+    top_p >= 1.0 compiles the EXACT plain-temperature program (the
+    nucleus mask is dropped at trace time), so top_p=1.0 is bitwise
+    identical to not passing it. eos >= 0 adds a per-row finished flag
+    to the scan carry: finished rows emit eos forever; shapes stay
+    static, the scan still runs all max_new steps."""
 
     def run(params, ids, key):
         T = ids.shape[1]
+        B = ids.shape[0]
         logits, cache = prefill(params, ids, geom)
 
         def sample(logits, key):
@@ -161,19 +221,33 @@ def _sampling_rollout(geom, max_new: int, temperature: float, top_k: int):
             if top_k:
                 kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
                 lg = jnp.where(lg < kth, -1e30, lg)
+            if 0.0 < top_p < 1.0:
+                # nucleus: keep the smallest rank-prefix whose mass
+                # reaches top_p (rank 0 always kept — exclusive cumsum)
+                srt = jnp.sort(lg, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                excl = jnp.cumsum(probs, axis=-1) - probs
+                n_keep = jnp.sum(excl < top_p, axis=-1)
+                kth = jnp.take_along_axis(srt, (n_keep - 1)[:, None],
+                                          axis=-1)
+                lg = jnp.where(lg < kth, -1e30, lg)
             return jax.random.categorical(key, lg, axis=-1).astype(
                 jnp.int32)
 
         def body(carry, _):
-            logits, cache, pos, key = carry
+            logits, cache, pos, key, finished = carry
             key, sub = jax.random.split(key)
             tok = sample(logits, sub)
+            if eos >= 0:
+                tok = jnp.where(finished, jnp.asarray(eos, tok.dtype),
+                                tok)
+                finished = finished | (tok == eos)
             logits, cache = decode_step(params, cache, tok, pos, geom)
-            return (logits, cache, pos + 1, key), tok
+            return (logits, cache, pos + 1, key, finished), tok
 
-        _, toks = jax.lax.scan(
-            body, (logits, cache, jnp.asarray(T, jnp.int32), key), None,
-            length=max_new)
+        carry0 = (logits, cache, jnp.asarray(T, jnp.int32), key,
+                  jnp.zeros((B,), bool))
+        _, toks = jax.lax.scan(body, carry0, None, length=max_new)
         return toks
 
     return jax.jit(run)
@@ -181,10 +255,13 @@ def _sampling_rollout(geom, max_new: int, temperature: float, top_k: int):
 
 def generate(model, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             seed: int = 0):
+             top_p: Optional[float] = None,
+             eos_token_id: Optional[int] = None, seed: int = 0):
     """Autoregressive sampling: greedy at temperature 0, else
-    temperature(+top-k) sampling. input_ids: [B, T] array-like; returns
-    np.ndarray [B, T + max_new_tokens]."""
+    temperature(+top-k/top-p) sampling. eos_token_id stops finished rows
+    early: once a row samples eos, every later position is frozen to eos
+    (masked inside the jitted scan — shapes stay static). input_ids:
+    [B, T] array-like; returns np.ndarray [B, T + max_new_tokens]."""
     from ..core.tensor import Tensor
     cfg = model.cfg
     geom = (cfg.num_layers, cfg.num_heads,
@@ -198,7 +275,9 @@ def generate(model, input_ids, max_new_tokens: int,
             f"prompt {T} + new {max_new_tokens} exceeds max_seq_len "
             f"{cfg.max_seq_len}")
     fn = _sampling_rollout(geom, int(max_new_tokens), float(temperature),
-                           int(top_k) if top_k else 0)
+                           int(top_k) if top_k else 0,
+                           float(top_p) if top_p is not None else 1.0,
+                           -1 if eos_token_id is None else int(eos_token_id))
     toks = fn(params, jnp.asarray(ids, jnp.int32),
               jax.random.PRNGKey(seed))
     return np.concatenate([ids, np.asarray(toks).T], axis=1)

@@ -31,14 +31,16 @@ __all__ = [
 
 
 class _Event:
-    __slots__ = ("name", "start", "end", "tid", "depth")
+    __slots__ = ("name", "start", "end", "tid", "depth", "cat", "args")
 
-    def __init__(self, name, start, end, tid, depth):
+    def __init__(self, name, start, end, tid, depth, cat=None, args=None):
         self.name = name
         self.start = start
         self.end = end
         self.tid = tid
         self.depth = depth
+        self.cat = cat
+        self.args = args
 
 
 class _ProfState:
@@ -61,10 +63,18 @@ class RecordEvent:
     Usable as context manager or decorator. Host side: wall-time event in
     the global table. Device side: a jax.profiler.TraceAnnotation so the
     scope appears in XLA traces viewed in TensorBoard/perfetto.
+
+    cat tags the chrome-trace category (default "op"); args is an
+    optional dict written into the trace event's args — set it at
+    construction or mutate `ev.args` inside the scope (the serving
+    engine records per-step request counts this way), it is read at
+    end().
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, cat: str = None, args: dict = None):
         self.name = name
+        self.cat = cat
+        self.args = args
         self._t0 = None
         self._ann = None
 
@@ -84,7 +94,8 @@ class RecordEvent:
             with _ProfState.lock:
                 _ProfState.events.append(_Event(
                     self.name, self._t0, t1,
-                    threading.get_ident(), _ProfState.tls.depth))
+                    threading.get_ident(), _ProfState.tls.depth,
+                    self.cat, self.args))
             if self._ann is not None:
                 self._ann.__exit__(None, None, None)
                 self._ann = None
@@ -198,14 +209,19 @@ def summary(sorted_key: str = "total") -> str:
 
 def export_chrome_tracing(path: str):
     """Write recorded host events as a chrome://tracing JSON file
-    (reference: tools/timeline.py Timeline generation)."""
+    (reference: tools/timeline.py Timeline generation). Events carry
+    their category (e.g. the serving engine's prefill/decode/schedule
+    spans are cat="serving" with request counts in args), so an
+    LLMEngine trace is inspectable end to end in chrome://tracing or
+    perfetto."""
     with _ProfState.lock:
         events = list(_ProfState.events)
     trace = {"traceEvents": [
-        {"name": e.name, "ph": "X", "cat": "op",
-         "ts": (e.start - _ProfState.t0) * 1e6,
-         "dur": (e.end - e.start) * 1e6,
-         "pid": os.getpid(), "tid": e.tid}
+        dict({"name": e.name, "ph": "X", "cat": e.cat or "op",
+              "ts": (e.start - _ProfState.t0) * 1e6,
+              "dur": (e.end - e.start) * 1e6,
+              "pid": os.getpid(), "tid": e.tid},
+             **({"args": e.args} if e.args else {}))
         for e in events
     ]}
     d = os.path.dirname(path)

@@ -35,3 +35,7 @@ def test_full_subbenches_cpu():
     assert rn > 0
     dc, _ = bench.bench_decode(False)
     assert dc > 0
+    sd, sd_detail = bench.bench_serve_decode(False)
+    assert sd > 0
+    assert sd_detail["generated_tokens"] > 0
+    assert sd_detail["steps"] > 0

@@ -81,6 +81,73 @@ def test_sampled_generate_runs_and_respects_budget():
         generate(m, np.zeros((1, 20), np.int64), max_new_tokens=10)
 
 
+def test_generate_eos_token_stops_and_pads():
+    """eos_token_id: once greedy emits the eos, every later position is
+    frozen to eos (static shapes — the scan still runs max_new steps,
+    masked); tokens before the eos are untouched."""
+    m, geom = _model()
+    rng = np.random.RandomState(8)
+    ids = rng.randint(0, 97, (2, 4))
+    free = np.asarray(generate(m, ids, max_new_tokens=8))
+    eos = int(free[0, 4 + 2])                # row 0's 3rd greedy token
+    out = np.asarray(generate(m, ids, max_new_tokens=8,
+                              eos_token_id=eos))
+    assert out.shape == free.shape
+    for r in range(2):
+        row, ref = out[r, 4:], free[r, 4:]
+        hits = np.nonzero(ref == eos)[0]
+        if hits.size:                        # row 0 by construction
+            k = hits[0]
+            np.testing.assert_array_equal(row[:k + 1], ref[:k + 1])
+            assert (row[k:] == eos).all()
+        else:
+            np.testing.assert_array_equal(row, ref)
+    assert (out[0, 4 + 2:] == eos).all()
+
+
+def test_generate_top_p_one_is_bitwise_plain_temperature():
+    """top_p=1.0 must compile to the EXACT plain-temperature program —
+    the nucleus mask drops at trace time, so the sampled ids are
+    bitwise-identical to not passing top_p at all."""
+    m, geom = _model()
+    ids = np.zeros((2, 4), np.int64)
+    plain = np.asarray(generate(m, ids, max_new_tokens=10,
+                                temperature=0.8, seed=5))
+    nucleus = np.asarray(generate(m, ids, max_new_tokens=10,
+                                  temperature=0.8, top_p=1.0, seed=5))
+    np.testing.assert_array_equal(plain, nucleus)
+
+
+def test_generate_top_p_tiny_collapses_to_greedy():
+    """top_p -> 0 keeps only the top-ranked token (the rank-0 prefix is
+    always kept), so sampling at any temperature becomes greedy."""
+    m, geom = _model()
+    rng = np.random.RandomState(9)
+    ids = rng.randint(0, 97, (2, 4))
+    greedy = np.asarray(generate(m, ids, max_new_tokens=8))
+    sampled = np.asarray(generate(m, ids, max_new_tokens=8,
+                                  temperature=1.3, top_p=1e-6, seed=11))
+    np.testing.assert_array_equal(sampled, greedy)
+
+
+def test_generate_top_p_restricts_support():
+    """With a mid top_p the sampled tokens stay inside the nucleus of
+    the step distribution (checked on the first sampled position)."""
+    m, geom = _model()
+    ids = np.zeros((1, 4), np.int64)
+    logits = m(paddle.to_tensor(ids)).numpy()[0, -1].astype(np.float64)
+    lg = logits / 0.9
+    srt = np.sort(lg)[::-1]
+    probs = np.exp(srt - srt.max())
+    probs /= probs.sum()
+    keep = int(((np.cumsum(probs) - probs) < 0.7).sum())
+    nucleus = set(np.argsort(lg)[::-1][:keep].tolist())
+    firsts = {int(np.asarray(generate(
+        m, ids, max_new_tokens=1, temperature=0.9, top_p=0.7,
+        seed=s))[0, 4]) for s in range(12)}
+    assert firsts <= nucleus
+
+
 def test_beam_search_beam1_equals_greedy():
     m, geom = _model()
     rng = np.random.RandomState(4)

@@ -1,0 +1,462 @@
+"""Continuous-batching serving engine — paged cache, ragged attention,
+scheduler and LLMEngine (paddle_tpu/inference/serving/).
+
+The load-bearing pins:
+- paged decode logits are BITWISE-identical to the dense
+  models.generation.decode_step path (shared compiled sub-programs);
+- the block pool never leaks: allocated == freed after any mix of
+  completed / preempted / cancelled requests;
+- continuous batching never changes results: greedy engine output
+  token-matches generate() per request, preemptions included.
+"""
+import json
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+import paddle_tpu.models.generation as gen
+from paddle_tpu.inference.serving import (CacheExhausted, EngineConfig,
+                                          LLMEngine, PagedKVCache,
+                                          SamplingParams, gather_block_kv,
+                                          paged_decode_step)
+
+VOCAB = 97
+
+
+def _model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=24)
+    m = GPT(cfg)
+    m.eval()
+    geom = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
+    return m, geom
+
+
+def _engine(model, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("max_num_seqs", 4)
+    return LLMEngine.from_model(model, EngineConfig(**kw))
+
+
+def _reference_tokens(model, prompt, max_new):
+    """generate()'s greedy continuation for one prompt (new tokens only)."""
+    out = np.asarray(gen.generate(
+        model, jnp.asarray(np.asarray(prompt)[None], jnp.int32), max_new))
+    return out[0, len(prompt):]
+
+
+# ------------------------------------------------------------ paged cache
+def test_paged_cache_alloc_free_and_exhaustion():
+    pc = PagedKVCache(num_layers=2, num_heads=4, head_dim=8,
+                      num_blocks=4, block_size=4)
+    assert pc.num_free() == 4 and pc.utilization() == 0.0
+    ids = pc.allocate("a", 7)                 # ceil(7/4) = 2 blocks
+    assert len(ids) == 2 and pc.num_used() == 2
+    assert pc.block_table("a") == ids and pc.seq_len("a") == 7
+
+    # slot 7 fits block 1; slot 8 crosses the boundary -> grows by one
+    blk, off, pos = pc.append_slot("a")
+    assert (blk, off, pos) == (ids[1], 3, 7)
+    blk, off, pos = pc.append_slot("a")
+    assert off == 0 and pos == 8 and len(pc.block_table("a")) == 3
+
+    pc.allocate("b", 4)
+    with pytest.raises(CacheExhausted) as ei:
+        pc.allocate("c", 5)                   # needs 2, 0 free
+    assert ei.value.needed == 2 and ei.value.free == 0
+    assert ei.value.total == 4 and ei.value.seq_id == "c"
+    assert pc.alloc_failures == 1
+    assert not pc.has_seq("c")                # failed alloc left no trace
+
+    assert pc.free("a") == 3
+    assert pc.free("b") == 1
+    assert pc.num_free() == 4
+    st = pc.stats()
+    assert st["blocks_allocated"] == st["blocks_freed"] == 4
+    assert st["high_water"] == 4
+
+    with pytest.raises(ValueError):
+        pc.allocate("d", 1) and pc.allocate("d", 1)
+
+
+def test_write_prefill_roundtrips_dense_cache():
+    """Scattering a dense prefill cache into blocks and gathering it back
+    through the block table reproduces the dense layout bit-for-bit."""
+    m, geom = _model()
+    L, H, D, S = geom
+    params = gen.extract_params(m)
+    rng = np.random.RandomState(0)
+    T = 7
+    ids = rng.randint(0, VOCAB, (2, T)).astype(np.int32)
+    _, dense = gen.prefill(params, jnp.asarray(ids), geom)
+
+    pc = PagedKVCache(L, H, D, num_blocks=16, block_size=4)
+    for b, sid in enumerate(("s0", "s1")):
+        pc.allocate(sid, T)
+        pc.write_prefill(sid, dense, T, batch_index=b)
+    for b, sid in enumerate(("s0", "s1")):
+        table = jnp.asarray([pc.block_table(sid)], jnp.int32)
+        for i in range(L):
+            for j in range(2):  # k, v
+                got = np.asarray(gather_block_kv(pc.pools[i][j], table))
+                want = np.asarray(dense[i][j][b])[:, :got.shape[2]]
+                np.testing.assert_array_equal(got[0], want)
+
+
+# ------------------------------------------------- bitwise decode parity
+def test_paged_decode_bitwise_matches_dense_decode_step():
+    """The acceptance pin: multi-step paged decode logits are
+    bitwise-identical (np.array_equal, not allclose) to the dense
+    decode_step path — both fully jitted."""
+    m, geom = _model()
+    L, H, D, S = geom
+    bs = 4
+    params = gen.extract_params(m)
+    rng = np.random.RandomState(0)
+    B, T = 3, 7
+    prompts = rng.randint(0, VOCAB, (B, T)).astype(np.int32)
+
+    logits, cache = gen.prefill(params, jnp.asarray(prompts), geom)
+    tok = np.argmax(np.asarray(logits), -1).astype(np.int32)
+
+    pc = PagedKVCache(L, H, D, num_blocks=16, block_size=bs)
+    for b in range(B):
+        pc.allocate(b, T)
+        pc.write_prefill(b, cache, T, batch_index=b)
+
+    tables = np.zeros((B, S // bs), np.int32)
+    for step in range(6):
+        pos = T + step
+        dl, cache = gen.decode_step(params, cache, jnp.asarray(tok),
+                                    jnp.asarray(pos, jnp.int32), geom)
+        slots = [pc.append_slot(b) for b in range(B)]
+        for b in range(B):
+            t = pc.block_table(b)
+            tables[b, :len(t)] = t
+        pl, pc.pools = paged_decode_step(
+            params, pc.pools, jnp.asarray(tok),
+            jnp.asarray([pos] * B, jnp.int32), jnp.asarray(tables),
+            jnp.asarray([s[0] for s in slots], jnp.int32),
+            jnp.asarray([s[1] for s in slots], jnp.int32), geom)
+        np.testing.assert_array_equal(np.asarray(dl), np.asarray(pl))
+        tok = np.argmax(np.asarray(dl), -1).astype(np.int32)
+
+
+def test_paged_decode_ragged_positions_match_per_row_dense():
+    """Rows at DIFFERENT positions in one ragged batch reproduce each
+    row's own single-sequence dense decode (argmax-identical, logits to
+    float32 resolution) — raggedness must not couple sequences."""
+    m, geom = _model()
+    L, H, D, S = geom
+    bs = 4
+    params = gen.extract_params(m)
+    rng = np.random.RandomState(1)
+    lens = [3, 7, 5]
+    prompts = [rng.randint(0, VOCAB, (t,)).astype(np.int32) for t in lens]
+
+    pc = PagedKVCache(L, H, D, num_blocks=16, block_size=bs)
+    dense_rows, toks = [], []
+    for b, p in enumerate(prompts):
+        lg, dc = gen.prefill(params, jnp.asarray(p[None], jnp.int32), geom)
+        dense_rows.append(dc)
+        toks.append(int(np.argmax(np.asarray(lg)[0])))
+        pc.allocate(b, len(p))
+        pc.write_prefill(b, dc, len(p))
+
+    B = len(prompts)
+    slots = [pc.append_slot(b) for b in range(B)]
+    tables = np.zeros((B, S // bs), np.int32)
+    for b in range(B):
+        t = pc.block_table(b)
+        tables[b, :len(t)] = t
+    pl, _ = paged_decode_step(
+        params, pc.pools, jnp.asarray(toks, jnp.int32),
+        jnp.asarray(lens, jnp.int32), jnp.asarray(tables),
+        jnp.asarray([s[0] for s in slots], jnp.int32),
+        jnp.asarray([s[1] for s in slots], jnp.int32), geom)
+    pl = np.asarray(pl)
+
+    for b, p in enumerate(prompts):
+        dl, _ = gen.decode_step(params, dense_rows[b],
+                                jnp.asarray([toks[b]], jnp.int32),
+                                jnp.asarray(lens[b], jnp.int32), geom)
+        dl = np.asarray(dl)[0]
+        np.testing.assert_allclose(pl[b], dl, rtol=1e-5, atol=1e-5)
+        assert int(np.argmax(pl[b])) == int(np.argmax(dl))
+
+
+# ------------------------------------------------------------- scheduler
+def test_scheduler_zero_leaked_blocks_under_random_churn():
+    """Property test: after any mix of completed, preempted and
+    cancelled requests the pool is whole — blocks_allocated ==
+    blocks_freed and every block is back on the free list."""
+    m, _ = _model()
+    rng = np.random.RandomState(7)
+    eng = _engine(m, num_blocks=10, max_num_seqs=4)
+    rids = []
+    for i in range(10):
+        prompt = rng.randint(0, VOCAB, (int(rng.randint(2, 9)),))
+        rids.append(eng.add_request(
+            prompt, SamplingParams(max_tokens=int(rng.randint(1, 8)))))
+    cancelled = 0
+    steps = 0
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+        if steps in (2, 5) and rids:        # cancel someone mid-flight
+            victim = rids[int(rng.randint(len(rids)))]
+            cancelled += eng.cancel(victim)
+        assert steps < 200
+    st = eng.cache.stats()
+    assert st["blocks_allocated"] == st["blocks_freed"]
+    assert eng.cache.num_free() == eng.config.num_blocks
+    assert eng.cache.num_used() == 0
+    # churn actually happened: completions, and the cancel attempts ran
+    assert eng.stats.completed >= 1
+    assert eng.stats.cancelled == cancelled
+
+
+def test_scheduler_rejects_request_that_can_never_fit():
+    m, _ = _model()
+    eng = _engine(m, num_blocks=2)           # 8 token positions total
+    with pytest.raises(ValueError, match="grow num_blocks"):
+        eng.add_request(np.zeros(6, np.int32),
+                        SamplingParams(max_tokens=8))
+
+
+# ---------------------------------------------------------------- engine
+def test_engine_greedy_matches_generate_simple():
+    m, _ = _model()
+    eng = _engine(m)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, VOCAB, (n,)).astype(np.int32)
+               for n in (5, 3, 7)]
+    for i, p in enumerate(prompts):
+        eng.add_request(p, SamplingParams(max_tokens=8),
+                        request_id=f"r{i}")
+    outs = eng.run(max_steps=100)
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(outs[f"r{i}"],
+                                      _reference_tokens(m, p, 8))
+
+
+def test_engine_mixed_workload_with_preemption_acceptance():
+    """The ISSUE acceptance workload: 8 requests, staggered arrivals,
+    differing prompt/output lengths, a pool tight enough to force at
+    least one preemption — all must complete, greedy outputs must
+    token-match generate(), and the pool must not leak a single block."""
+    m, _ = _model()
+    # 10 blocks x 4 slots for up to 4 concurrent sequences of worst case
+    # 16 tokens each -> guaranteed pressure, but every request fits alone
+    eng = _engine(m, num_blocks=10, max_num_seqs=4)
+    rng = np.random.RandomState(3)
+    lens = [3, 6, 2, 8, 5, 4, 7, 3]
+    max_toks = [8, 5, 10, 6, 8, 12, 4, 9]
+    prompts = [rng.randint(0, VOCAB, (n,)).astype(np.int32) for n in lens]
+
+    arrived = 0
+
+    def arrive(k):
+        nonlocal arrived
+        for i in range(arrived, min(arrived + k, 8)):
+            eng.add_request(prompts[i],
+                            SamplingParams(max_tokens=max_toks[i]),
+                            request_id=f"r{i}")
+        arrived = min(arrived + k, 8)
+
+    arrive(3)                                # staggered arrivals
+    steps = 0
+    while eng.has_unfinished() or arrived < 8:
+        eng.step()
+        steps += 1
+        if steps % 2 == 0:
+            arrive(2)
+        assert steps < 300
+    assert arrived == 8
+
+    for i in range(8):
+        req = eng.get_request(f"r{i}")
+        assert req.state in ("finished_stopped", "finished_length")
+        np.testing.assert_array_equal(
+            np.asarray(req.output_ids),
+            _reference_tokens(m, prompts[i], max_toks[i]),
+            err_msg=f"request r{i} diverged "
+                    f"(preemptions={req.num_preemptions})")
+
+    assert eng.stats.preemptions >= 1        # pressure actually happened
+    st = eng.cache.stats()
+    assert st["blocks_allocated"] == st["blocks_freed"]
+    assert eng.cache.num_free() == eng.config.num_blocks
+    d = eng.stats.as_dict()
+    assert d["completed"] == 8
+    assert d["generated_tokens"] == sum(max_toks) \
+        and d["decode_tokens_per_sec"] > 0
+    assert d["avg_ttft_s"] >= 0 and d["avg_request_latency_s"] > 0
+
+
+def test_engine_eos_stops_early_with_stop_reason():
+    m, _ = _model()
+    p = np.arange(1, 6, dtype=np.int32)
+    ref = _reference_tokens(m, p, 8)
+    eos = int(ref[2])                        # greedy emits this 3rd
+    eng = _engine(m)
+    rid = eng.add_request(
+        p, SamplingParams(max_tokens=8, eos_token_id=eos))
+    eng.run(max_steps=50)
+    req = eng.get_request(rid)
+    assert req.state == "finished_stopped"
+    assert req.output_ids == list(ref[:3])   # stops AT the eos token
+    assert eng.cache.num_free() == eng.config.num_blocks
+
+
+def test_engine_streams_request_outputs():
+    m, _ = _model()
+    eng = _engine(m)
+    rid = eng.add_request(np.arange(1, 5, dtype=np.int32),
+                          SamplingParams(max_tokens=3))
+    seen = []
+    while eng.has_unfinished():
+        for out in eng.step():
+            assert out.request_id == rid
+            seen.append(out.new_token)
+            last = out
+    assert len(seen) == 3 and last.finished \
+        and last.finish_reason == "length"
+    assert last.token_ids == seen
+
+
+def test_engine_temperature_sampling_stays_in_bounds_and_drains():
+    m, _ = _model()
+    eng = _engine(m)
+    rng = np.random.RandomState(11)
+    for i in range(4):
+        eng.add_request(
+            rng.randint(0, VOCAB, (4,)),
+            SamplingParams(max_tokens=6, temperature=0.9, top_k=9,
+                           top_p=0.8, seed=i))
+    outs = eng.run(max_steps=100)
+    for toks in outs.values():
+        assert toks.shape == (6,)
+        assert ((0 <= toks) & (toks < VOCAB)).all()
+    assert eng.cache.num_free() == eng.config.num_blocks
+
+
+def test_engine_rejects_invalid_requests():
+    m, _ = _model()
+    eng = _engine(m)
+    with pytest.raises(ValueError, match="empty"):
+        eng.add_request(np.zeros(0, np.int32))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.add_request(np.zeros(20, np.int32),
+                        SamplingParams(max_tokens=8))
+    eng.add_request(np.zeros(3, np.int32), request_id="dup")
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.add_request(np.zeros(3, np.int32), request_id="dup")
+    with pytest.raises(ValueError, match="must divide"):
+        _engine(m, block_size=5)             # 24 % 5 != 0
+
+
+# --------------------------------------------------- profiler integration
+def test_engine_steps_appear_in_chrome_trace(tmp_path):
+    from paddle_tpu import profiler
+    m, _ = _model()
+    eng = _engine(m)
+    eng.add_request(np.arange(1, 6, dtype=np.int32),
+                    SamplingParams(max_tokens=4))
+    profiler.start_profiler()
+    try:
+        eng.run(max_steps=50)
+        path = profiler.export_chrome_tracing(
+            str(tmp_path / "serve_trace.json"))
+    finally:
+        profiler._ProfState.enabled = False
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    serving = [e for e in events if e.get("cat") == "serving"]
+    names = {e["name"] for e in serving}
+    assert {"serving.engine_step", "serving.schedule",
+            "serving.prefill", "serving.decode"} <= names
+    sched = next(e for e in serving if e["name"] == "serving.schedule")
+    assert {"prefill", "decode", "free_blocks"} <= set(sched["args"])
+    pre = next(e for e in serving if e["name"] == "serving.prefill")
+    assert pre["args"]["tokens"] == 5
+
+
+# ------------------------------------------------- predictor integration
+def test_create_predictor_dispatches_to_serving_engine():
+    from paddle_tpu import inference
+    from paddle_tpu.inference.serving import ServingPredictor
+    m, _ = _model()
+    cfg = inference.Config()
+    cfg.enable_llm_engine(model=m, block_size=4, num_blocks=16,
+                          max_num_seqs=4, max_tokens=5)
+    assert cfg.llm_engine_enabled()
+    assert "<llm serving engine>" in cfg.summary()
+    pred = inference.create_predictor(cfg)
+    assert isinstance(pred, ServingPredictor)
+    assert pred.get_input_names() == ["input_ids", "prompt_lens"]
+
+    rng = np.random.RandomState(0)
+    lens = np.asarray([5, 3])
+    ids = np.zeros((2, 5), np.int64)
+    for b, n in enumerate(lens):
+        ids[b, :n] = rng.randint(0, VOCAB, (n,))
+    [seqs] = pred.run([ids, lens])
+    assert seqs.shape[0] == 2
+    for b, n in enumerate(lens):
+        ref = _reference_tokens(m, ids[b, :n], 5)
+        np.testing.assert_array_equal(seqs[b, n:n + 5], ref)
+
+    with pytest.raises(ValueError, match="enable_llm_engine"):
+        c2 = inference.Config()
+        c2.enable_llm_engine()               # no model object
+        inference.create_predictor(c2)
+
+
+# ---------------------------------------------------------------- stress
+@pytest.mark.slow
+def test_engine_serving_stress_many_requests():
+    """Sustained churn: 24 requests with random lengths, temperatures and
+    staggered arrivals against a small pool — drains, matches greedy
+    references for the greedy subset, zero leaks."""
+    m, _ = _model()
+    eng = _engine(m, num_blocks=12, max_num_seqs=4)
+    rng = np.random.RandomState(42)
+    specs = []
+    for i in range(24):
+        n = int(rng.randint(2, 10))
+        mt = int(rng.randint(1, 10))
+        greedy = bool(rng.randint(2))
+        specs.append((f"s{i}", rng.randint(0, VOCAB, (n,)), mt, greedy))
+    it = iter(specs)
+    for _ in range(4):
+        rid, p, mt, greedy = next(it)
+        eng.add_request(p, SamplingParams(
+            max_tokens=mt, temperature=0.0 if greedy else 0.8,
+            top_p=0.9, seed=1), request_id=rid)
+    steps = 0
+    pending = list(it)
+    while eng.has_unfinished() or pending:
+        eng.step()
+        steps += 1
+        if steps % 3 == 0 and pending:
+            rid, p, mt, greedy = pending.pop(0)
+            eng.add_request(p, SamplingParams(
+                max_tokens=mt, temperature=0.0 if greedy else 0.8,
+                top_p=0.9, seed=1), request_id=rid)
+        assert steps < 2000
+    for rid, p, mt, greedy in specs:
+        req = eng.get_request(rid)
+        assert req.finished and len(req.output_ids) <= mt
+        if greedy:
+            np.testing.assert_array_equal(
+                np.asarray(req.output_ids), _reference_tokens(m, p, mt))
+    st = eng.cache.stats()
+    assert st["blocks_allocated"] == st["blocks_freed"]
+    assert eng.cache.num_free() == eng.config.num_blocks
