@@ -468,6 +468,7 @@ def bench_serve_decode(on_tpu: bool):
             if steps % 2 == 0 and pending:      # staggered arrivals
                 p, mt = pending.pop(0)
                 eng.add_request(p, SamplingParams(max_tokens=mt))
+        eng.cache.check_integrity()             # zero-leak audit post-drain
         return eng
 
     run_once()                                  # compile every bucket

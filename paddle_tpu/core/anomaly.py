@@ -31,8 +31,8 @@ import jax.numpy as jnp
 import jax.tree_util as jtu
 
 __all__ = ["AnomalyGuard", "anomaly_guard", "set_anomaly_guard",
-           "current_guard", "tree_not_finite", "sanitize_tree",
-           "POLICIES"]
+           "current_guard", "tree_not_finite", "rows_not_finite",
+           "sanitize_tree", "POLICIES"]
 
 POLICIES = ("raise", "skip_step", "zero_grads")
 
@@ -56,6 +56,20 @@ def tree_not_finite(tree):
     for f in flags[1:]:
         out = out | f
     return out
+
+
+def rows_not_finite(a):
+    """Per-row anomaly flags for a [N, ...] batch of values: True where
+    row i contains any NaN/Inf. The attribution primitive of the serving
+    engine's step guard — one poisoned request's logits must cost that
+    request, not the batch. Returns a [N] bool array (jnp; jit-safe);
+    1-D input is treated as a single row → [1]."""
+    a = jnp.asarray(a)
+    if a.ndim == 0:
+        a = a[None]
+    if a.ndim == 1:
+        a = a[None]
+    return ~jnp.isfinite(a).reshape(a.shape[0], -1).all(axis=1)
 
 
 def sanitize_tree(tree):

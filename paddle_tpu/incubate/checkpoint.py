@@ -13,6 +13,7 @@ files so multi-host jobs write only addressable shards.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
@@ -41,6 +42,26 @@ def _to_host(obj):
         t = [_to_host(v) for v in obj]
         return t if isinstance(obj, list) else tuple(t)
     return obj
+
+
+CHECKSUM_FILE = "checksums.json"
+
+
+def _array_manifest(state, prefix="$"):
+    """sha256 per array leaf of a (host-side) state tree, keyed by JSON
+    path — the integrity manifest written next to every snapshot. Hashes
+    contiguous raw bytes so the digest is layout-independent."""
+    out = {}
+    if isinstance(state, np.ndarray):
+        out[prefix] = hashlib.sha256(
+            np.ascontiguousarray(state).tobytes()).hexdigest()
+    elif isinstance(state, dict):
+        for k in sorted(state):
+            out.update(_array_manifest(state[k], f"{prefix}.{k}"))
+    elif isinstance(state, (list, tuple)):
+        for i, v in enumerate(state):
+            out.update(_array_manifest(v, f"{prefix}[{i}]"))
+    return out
 
 
 class AutoCheckpointManager:
@@ -175,6 +196,13 @@ class AutoCheckpointManager:
         tmp = tempfile.mkdtemp(dir=self.save_dir, prefix=".tmp_")
         try:
             framework_io.save(state, os.path.join(tmp, "state.pdparams"))
+            # integrity manifest: hash what a verifier will actually load
+            # back (round-trip through the serialized file), so dtype
+            # normalisation inside save/load can't drift the digests
+            digests = _array_manifest(framework_io.load(
+                os.path.join(tmp, "state.pdparams"), return_numpy=True))
+            with open(os.path.join(tmp, CHECKSUM_FILE), "w") as f:
+                json.dump(digests, f)
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"epoch": epoch, "kind": kind, "index": idx,
                            "time": time.time()}, f)
@@ -240,15 +268,18 @@ class AutoCheckpointManager:
         left in .restored_kind/.restored_index.
         A snapshot that fails to parse (disk-level truncation/corruption
         AFTER the atomic rename — the failure mode remote filesystems add
-        beyond the tmp+mv contract) is quarantined with a warning and the
-        next-newest snapshot is tried, so one bad file never bricks the
-        resume path."""
+        beyond the tmp+mv contract) OR whose per-array sha256 digests no
+        longer match its checksums.json manifest (silent bit rot: the
+        pickle still parses, the data is wrong) is quarantined with a
+        warning and the next-newest snapshot is tried, so one bad file
+        never bricks the resume path."""
         from .. import framework_io
         self.wait()  # a restore racing an in-flight save would read torn
         for kind, idx in self._snapshots_newest_first():
             path = os.path.join(self._snap_dir(kind, idx), "state.pdparams")
             try:
                 state = framework_io.load(path)
+                self._verify_checksums(kind, idx, path)
             except Exception as e:
                 import warnings
                 bad = self._snap_dir(kind, idx)
@@ -266,6 +297,27 @@ class AutoCheckpointManager:
             return idx
         self.restored_kind = self.restored_index = None
         return None
+
+    def _verify_checksums(self, kind: str, idx: int, path: str):
+        """Recompute every array digest of a snapshot and compare against
+        its checksums.json. Raises on any mismatch (missing manifest is
+        tolerated: pre-manifest snapshots stay restorable). The data is
+        re-loaded with return_numpy=True so digests see exactly the bytes
+        the manifest hashed at save time."""
+        manifest_path = os.path.join(os.path.dirname(path), CHECKSUM_FILE)
+        if not os.path.exists(manifest_path):
+            return
+        with open(manifest_path) as f:
+            want = json.load(f)
+        from .. import framework_io
+        got = _array_manifest(framework_io.load(path, return_numpy=True))
+        bad = sorted(k for k in set(want) | set(got)
+                     if want.get(k) != got.get(k))
+        if bad:
+            raise IOError(
+                f"checksum mismatch in snapshot {kind}_{idx} at "
+                f"{bad[:3]}{'...' if len(bad) > 3 else ''} "
+                f"({len(bad)}/{len(want)} arrays)")
 
     # ---------------------------------------------------------------- range
     def train_epoch_range(self, max_epoch_num: int) -> Iterator[int]:

@@ -19,13 +19,15 @@ See docs/serving.md for architecture and tuning.
 """
 from .paged_cache import CacheExhausted, PagedKVCache  # noqa: F401
 from .attention import gather_block_kv, paged_decode_step  # noqa: F401
-from .scheduler import (Request, RequestState, SamplingParams,  # noqa: F401
-                        ScheduledBatch, Scheduler, SchedulerConfig)
+from .scheduler import (EngineOverloaded, Request,  # noqa: F401
+                        RequestState, SamplingParams, ScheduledBatch,
+                        Scheduler, SchedulerConfig)
 from .engine import (EngineConfig, EngineStats, LLMEngine,  # noqa: F401
                      RequestOutput, ServingPredictor)
 
 __all__ = [
-    "PagedKVCache", "CacheExhausted", "gather_block_kv",
+    "PagedKVCache", "CacheExhausted", "EngineOverloaded",
+    "gather_block_kv",
     "paged_decode_step", "SamplingParams", "Request", "RequestState",
     "Scheduler", "SchedulerConfig", "ScheduledBatch", "EngineConfig",
     "EngineStats", "LLMEngine", "RequestOutput", "ServingPredictor",

@@ -20,6 +20,18 @@ with a per-request RNG: continuous batching must not change results, so
 greedy engine output token-matches models.generation.generate
 (tests/test_serving.py pins this end to end, preemptions included).
 
+Hardened step (docs/serving.md "Failure semantics"): every step first
+expires overdue requests (deadline_s / queue_ttl_s → 'timeout'), then
+runs prefill/decode under an anomaly guard (core/anomaly NaN/Inf
+detection on the logits) and a step-progress watchdog
+(step_timeout_s). A poisoned or wedged step quarantines the offending
+request ('error'), scrubs+frees its blocks, and REBUILDS the remaining
+running requests by requeueing them for re-prefill from their token
+logs — bitwise-equivalent to an undisturbed run for the survivors, so
+one bad request costs one request, not the fleet. Admission control
+(max_waiting + admission_policy, cache_high_watermark) bounds the queue
+('shed' / EngineOverloaded) before overload can strand decodes.
+
 Every phase runs under a profiler.RecordEvent span (cat="serving") so a
 serving trace exported with profiler.export_chrome_tracing shows
 schedule/prefill/decode per engine step, with request counts in args.
@@ -33,6 +45,7 @@ from typing import Dict, List, Optional
 import numpy as np
 import jax.numpy as jnp
 
+from ...core import anomaly
 from ...models import generation as gen
 from ...profiler import RecordEvent
 from .attention import paged_decode_step
@@ -50,16 +63,25 @@ class EngineConfig:
     num_blocks: int = 256
     max_num_seqs: int = 8
     max_prefill_tokens: int = 2048
+    # ----------------------------- robustness layer (docs/serving.md)
+    max_waiting: Optional[int] = None    # bounded waiting queue (None=∞)
+    admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
+    cache_high_watermark: float = 1.0    # pause prefill admission above
+    step_timeout_s: Optional[float] = None  # watchdog budget per step
 
 
 @dataclass
 class RequestOutput:
-    """One streamed step result for one request."""
+    """One streamed step result for one request. finish_reason taxonomy
+    (docs/serving.md): 'stop' | 'length' | 'cancelled' | 'timeout'
+    (deadline_s / queue_ttl_s) | 'shed' (admission eviction) | 'error'
+    (quarantined by the anomaly guard / watchdog). Abnormal terminals
+    carry new_token=None."""
     request_id: str
     new_token: Optional[int]
     token_ids: List[int]                 # all generated tokens so far
     finished: bool
-    finish_reason: Optional[str] = None  # 'stop' | 'length' | 'cancelled'
+    finish_reason: Optional[str] = None
 
 
 @dataclass
@@ -70,6 +92,14 @@ class EngineStats:
     preemptions: int = 0
     completed: int = 0
     cancelled: int = 0
+    # ------------------------------------------- robustness counters
+    expired: int = 0                     # queued requests timed out
+    timeouts: int = 0                    # running requests past deadline
+    shed: int = 0                        # evicted by admission control
+    errors: int = 0                      # quarantined (poisoned/wedged)
+    recoveries: int = 0                  # poisoned/wedged steps recovered
+    rebuilt: int = 0                     # survivors re-prefilled after one
+    watchdog_trips: int = 0              # steps over step_timeout_s
     time_schedule: float = 0.0
     time_prefill: float = 0.0
     time_decode: float = 0.0
@@ -98,7 +128,8 @@ class LLMEngine:
     """Continuous-batching engine over (params, geom) — the pure-JAX
     decode substrate of models.generation, served paged."""
 
-    def __init__(self, params, geom, config: EngineConfig = None):
+    def __init__(self, params, geom, config: EngineConfig = None,
+                 faults=None):
         config = config or EngineConfig()
         L, H, D, S = geom
         if S % config.block_size != 0:
@@ -114,24 +145,42 @@ class LLMEngine:
         self.cache = PagedKVCache(L, H, D, config.num_blocks,
                                   config.block_size)
         self.scheduler = Scheduler(
-            SchedulerConfig(max_num_seqs=config.max_num_seqs,
-                            max_prefill_tokens=config.max_prefill_tokens),
+            SchedulerConfig(
+                max_num_seqs=config.max_num_seqs,
+                max_prefill_tokens=config.max_prefill_tokens,
+                max_waiting=config.max_waiting,
+                admission_policy=config.admission_policy,
+                cache_high_watermark=config.cache_high_watermark),
             self.cache)
         self.stats = EngineStats()
         self._requests: Dict[str, Request] = {}
         self._rngs: Dict[str, np.random.RandomState] = {}
         self._next_id = 0
+        self._pending_outputs: List[RequestOutput] = []
+        self._step_start = 0.0
+        if faults is None:
+            # env-driven (PADDLE_TPU_SERVE_FAULTS), inert without a spec
+            # — same unconditional-call contract as training's
+            # FaultInjector. Lazy import: testing pulls the op harness.
+            from ...testing.faults import ServingFaultInjector
+            faults = ServingFaultInjector()
+        self.faults = faults
 
     @classmethod
-    def from_model(cls, model, config: EngineConfig = None):
+    def from_model(cls, model, config: EngineConfig = None, faults=None):
         cfg = model.cfg
         geom = (cfg.num_layers, cfg.num_heads,
                 cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
-        return cls(gen.extract_params(model), geom, config)
+        return cls(gen.extract_params(model), geom, config, faults=faults)
 
     # ------------------------------------------------------------ intake
     def add_request(self, prompt_ids, sampling: SamplingParams = None,
                     request_id: str = None) -> str:
+        """Queue one request. Raises EngineOverloaded when the bounded
+        waiting queue is full under admission_policy='reject'; under
+        'shed_oldest' the oldest waiting request is evicted instead
+        (terminal RequestOutput with finish_reason='shed', streamed from
+        the next step())."""
         sampling = sampling or SamplingParams()
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
@@ -148,7 +197,13 @@ class LLMEngine:
             raise ValueError(f"duplicate request_id {request_id!r}")
         req = Request(request_id=request_id, prompt_ids=ids,
                       params=sampling, arrival_time=time.perf_counter())
-        self.scheduler.add(req)              # validates pool fit
+        shed = self.scheduler.add(req)       # validates pool fit / bound
+        for victim in shed:
+            victim.finish_time = time.perf_counter()
+            self.stats.shed += 1
+            self._pending_outputs.append(RequestOutput(
+                victim.request_id, None, list(victim.output_ids),
+                True, "shed"))
         self._requests[request_id] = req
         self._rngs[request_id] = np.random.RandomState(
             sampling.seed & 0x7FFFFFFF)
@@ -160,6 +215,8 @@ class LLMEngine:
             self.stats.cancelled += 1
             req = self._requests[request_id]
             req.finish_time = time.perf_counter()
+            self._pending_outputs.append(RequestOutput(
+                request_id, None, list(req.output_ids), True, "cancelled"))
         return ok
 
     def has_unfinished(self) -> bool:
@@ -212,19 +269,92 @@ class LLMEngine:
         outs.append(RequestOutput(req.request_id, tok,
                                   list(req.output_ids), finished, reason))
 
+    # --------------------------------------------- robustness primitives
+    def _finish_abnormal(self, req: Request, state: str, reason: str,
+                         outs: List[RequestOutput], scrub: bool = False):
+        """Terminal path for timeout/shed/error: detach (freeing blocks
+        iff running), stamp, stream the terminal RequestOutput."""
+        if req.state == RequestState.RUNNING:
+            self.scheduler.finish(req, state, scrub=scrub)
+        else:
+            req.state = state
+        req.finish_time = time.perf_counter()
+        outs.append(RequestOutput(req.request_id, None,
+                                  list(req.output_ids), True, reason))
+
+    def _expire_and_abort(self, outs: List[RequestOutput]):
+        """Step-boundary deadline enforcement: expire queued requests
+        past queue_ttl_s/deadline_s, abort running ones past
+        deadline_s."""
+        now = time.perf_counter()
+        for req in self.scheduler.expire_waiting(now):
+            self.stats.expired += 1
+            req.finish_time = now
+            outs.append(RequestOutput(req.request_id, None,
+                                      list(req.output_ids), True,
+                                      "timeout"))
+        for req in self.scheduler.overdue_running(now):
+            self.stats.timeouts += 1
+            self._finish_abnormal(req, RequestState.FINISHED_TIMEOUT,
+                                  "timeout", outs)
+
+    def _wedged(self) -> bool:
+        """Watchdog check at phase boundaries: has this step overrun its
+        step_timeout_s budget? (A hard device hang blocks Python
+        entirely — that is what the elastic supervisor's heartbeat
+        catches; this watchdog handles the soft case where a phase
+        returns but has already blown the step's latency budget.)"""
+        t = self.config.step_timeout_s
+        return t is not None and \
+            (time.perf_counter() - self._step_start) > t
+
+    def _quarantine(self, req: Request, outs: List[RequestOutput],
+                    why: str):
+        """One poisoned/wedged request costs one request: error-terminal,
+        blocks scrubbed (NaN survives the attention mask) and freed."""
+        self.stats.errors += 1
+        self._finish_abnormal(req, RequestState.FINISHED_ERROR, "error",
+                              outs, scrub=True)
+
+    def _recover(self, decode: List[Request], offenders: List[Request],
+                 outs: List[RequestOutput], why: str):
+        """Crash recovery for a poisoned/wedged decode step: the step's
+        outputs are already discarded (nothing was emitted); quarantine
+        the offenders and rebuild every surviving decode request by
+        scrub-freeing its blocks and requeueing it (arrival-ordered) for
+        re-prefill from its token log — proven bitwise-equivalent to an
+        unfaulted run for the survivors (tests/test_serving_robustness)."""
+        self.stats.recoveries += 1
+        for req in offenders:
+            self._quarantine(req, outs, why)
+        survivors = [r for r in decode if r not in offenders]
+        for req in survivors:
+            self.scheduler.requeue_for_recovery(req)
+            self.stats.rebuilt += 1
+
     # -------------------------------------------------------------- step
     def step(self) -> List[RequestOutput]:
-        """One engine iteration: schedule, prefill admitted requests,
-        decode every running sequence, stream the new tokens."""
-        outs: List[RequestOutput] = []
+        """One engine iteration: expire/abort overdue requests, schedule,
+        prefill admitted requests, decode every running sequence, stream
+        the new tokens — under the anomaly guard + watchdog (module
+        docstring)."""
+        from ...distributed import elastic
+        elastic.heartbeat()                  # no-op when unsupervised
+        outs: List[RequestOutput] = list(self._pending_outputs)
+        self._pending_outputs.clear()
         self.stats.steps += 1
+        step_no = self.stats.steps
+        self._step_start = time.perf_counter()
         with RecordEvent("serving.engine_step", cat="serving") as step_ev:
+            self.faults.corrupt_cache(step_no, self.cache)
+            self._expire_and_abort(outs)
             t0 = time.perf_counter()
             with RecordEvent("serving.schedule", cat="serving") as ev:
                 batch = self.scheduler.schedule()
                 ev.args = {"prefill": len(batch.prefill),
                            "decode": len(batch.decode),
                            "preempted": len(batch.preempted),
+                           "waiting": len(self.scheduler.waiting),
                            "free_blocks": self.cache.num_free()}
             self.stats.preemptions += len(batch.preempted)
             self.stats.time_schedule += time.perf_counter() - t0
@@ -235,10 +365,24 @@ class LLMEngine:
                 with RecordEvent("serving.prefill", cat="serving") as ev:
                     ev.args = {"request_id": req.request_id,
                                "tokens": int(tokens.size)}
-                    logits = self._prefill(req, tokens)
+                    try:
+                        logits = self._prefill(req, tokens)
+                    except Exception as e:
+                        self._quarantine(req, outs, f"prefill raised: {e}")
+                        continue
                 self.stats.prefill_tokens += int(tokens.size)
                 self.stats.time_prefill += time.perf_counter() - t0
+                logits = self.faults.poison_logits(step_no, logits)
+                if bool(np.asarray(anomaly.tree_not_finite(logits))):
+                    self._quarantine(req, outs,
+                                     "non-finite prefill logits")
+                    continue
                 self._emit(req, self._sample(req, logits), outs)
+                if not req.finished and self._wedged():
+                    # prefill attribution is exact: the request whose
+                    # forward blew the budget is the one in hand
+                    self.stats.watchdog_trips += 1
+                    self._quarantine(req, outs, "wedged prefill")
 
             # requests finished right at prefill release their blocks
             # before the decode gather builds its tables
@@ -247,12 +391,39 @@ class LLMEngine:
                 t0 = time.perf_counter()
                 with RecordEvent("serving.decode", cat="serving") as ev:
                     ev.args = {"num_seqs": len(decode)}
-                    logits = self._decode(decode)
+                    self.faults.stall(step_no)
+                    try:
+                        logits = self._decode(decode)
+                    except Exception as e:
+                        logits = None
+                        self._recover(decode, [decode[0]], outs,
+                                      f"decode raised: {e}")
                 self.stats.time_decode += time.perf_counter() - t0
-                for i, req in enumerate(decode):
-                    self._emit(req, self._sample(req, logits[i]), outs)
-            step_ev.args = {"step": self.stats.steps,
-                            "outputs": len(outs)}
+                if logits is not None:
+                    logits = self.faults.poison_logits(step_no, logits)
+                    bad = np.asarray(anomaly.rows_not_finite(logits))
+                    if bad.any():
+                        self._recover(
+                            decode,
+                            [r for i, r in enumerate(decode) if bad[i]],
+                            outs, "non-finite decode logits")
+                    elif self._wedged():
+                        # a wedged batched decode cannot be attributed;
+                        # quarantine its head (deterministic) and rebuild
+                        # the rest — the whole step's tokens are dropped
+                        # so survivors stay bitwise on the replay
+                        self.stats.watchdog_trips += 1
+                        self._recover(decode, [decode[0]], outs,
+                                      "wedged decode step (watchdog)")
+                    else:
+                        for i, req in enumerate(decode):
+                            self._emit(req, self._sample(req, logits[i]),
+                                       outs)
+            step_ev.args = {"step": step_no, "outputs": len(outs),
+                            "errors": self.stats.errors,
+                            "expired": self.stats.expired,
+                            "shed": self.stats.shed,
+                            "recoveries": self.stats.recoveries}
         return outs
 
     def _prefill(self, req: Request, tokens: np.ndarray) -> np.ndarray:
@@ -348,6 +519,8 @@ class ServingPredictor:
         return self._outputs[name]
 
     def run(self, inputs: Optional[list] = None):
+        from ...distributed import elastic
+        elastic.heartbeat()                  # no-op when unsupervised
         if inputs is not None:
             self._inputs["input_ids"].copy_from_cpu(
                 np.asarray(inputs[0]))

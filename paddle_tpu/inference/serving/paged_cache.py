@@ -133,14 +133,52 @@ class PagedKVCache:
         block = table[pos // self.block_size]
         return block, pos % self.block_size, pos
 
-    def free(self, seq_id) -> int:
+    def free(self, seq_id, scrub: bool = False) -> int:
         """Return every block of seq_id to the pool (completion,
-        preemption or cancellation)."""
+        preemption or cancellation). `scrub=True` also zeroes the blocks'
+        device contents — mandatory on the quarantine/recovery paths:
+        finite stale garbage is erased exactly by the attention length
+        mask (masked probs are exact zeros), but NaN survives it
+        (0 * NaN = NaN), so a poisoned block must not re-enter the free
+        list carrying NaN."""
         ids = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
         self._free.extend(reversed(ids))
         self.blocks_freed += len(ids)
+        if scrub:
+            self.scrub_blocks(ids)
         return len(ids)
+
+    def scrub_blocks(self, block_ids) -> None:
+        """Zero the given blocks in every layer's pools, restoring the
+        fresh-block invariant the bitwise-parity contract relies on."""
+        if not block_ids:
+            return
+        idx = jnp.asarray(list(block_ids), jnp.int32)
+        self.pools = tuple(
+            (kp.at[idx].set(0.0), vp.at[idx].set(0.0))
+            for kp, vp in self.pools)
+
+    def check_integrity(self) -> dict:
+        """Invariant audit for the chaos harness: the free list and the
+        live block tables must exactly partition the pool, with lifetime
+        counters consistent. Returns the audit dict; raises RuntimeError
+        on any violation (a leaked or double-owned block)."""
+        in_tables = [b for ids in self._tables.values() for b in ids]
+        owned = set(in_tables)
+        free = set(self._free)
+        report = {
+            "leaked": self.num_blocks - len(owned) - len(free),
+            "double_owned": len(in_tables) - len(owned),
+            "free_and_owned": len(owned & free),
+            "counter_drift": (self.blocks_allocated - self.blocks_freed)
+            - len(in_tables),
+        }
+        if any(report.values()):
+            raise RuntimeError(f"paged cache integrity violated: {report} "
+                               f"(tables={len(self._tables)}, "
+                               f"free={len(free)}/{self.num_blocks})")
+        return report
 
     # ------------------------------------------------------- device side
     def write_prefill(self, seq_id, dense_cache, num_tokens: int,

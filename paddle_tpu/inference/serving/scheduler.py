@@ -16,8 +16,25 @@ paged cache in serving/paged_cache.py. Per engine step:
 2. PREFILL/ADMIT — waiting requests are admitted in arrival order while
    the running set is under max_num_seqs, the per-step prefill token
    budget holds (at least one admission may overflow the budget so a
-   long prompt is never starved), and the pool can hold their tokens.
-   Admission never preempts: running sequences outrank new ones.
+   long prompt is never starved), the pool can hold their tokens, AND
+   post-admission occupancy stays under `cache_high_watermark` — the
+   backpressure valve that keeps decode headroom so admission can never
+   strand running sequences into a preemption storm. Admission never
+   preempts: running sequences outrank new ones.
+
+Robustness surface (the hardened-serving layer):
+
+- the waiting queue is bounded (`max_waiting`): a full queue either
+  rejects new arrivals with `EngineOverloaded` (policy 'reject') or
+  evicts the oldest waiting request (policy 'shed_oldest');
+- queued requests expire (`expire_waiting`) once their `queue_ttl_s` /
+  `deadline_s` elapses, and running requests past `deadline_s` are
+  reported by `overdue_running` for the engine to abort at the step
+  boundary;
+- every requeue (preemption, engine crash recovery) goes through
+  `_requeue`, an arrival-ordered insert, so a repeatedly-preempted
+  request keeps its FCFS priority and can never be starved by later
+  arrivals.
 
 The scheduler only does host-side accounting; all device work (prefill
 forward, paged decode) belongs to the engine.
@@ -33,19 +50,46 @@ import numpy as np
 
 from .paged_cache import CacheExhausted, PagedKVCache
 
-__all__ = ["SamplingParams", "Request", "RequestState", "Scheduler",
-           "SchedulerConfig", "ScheduledBatch"]
+__all__ = ["EngineOverloaded", "SamplingParams", "Request", "RequestState",
+           "Scheduler", "SchedulerConfig", "ScheduledBatch"]
+
+ADMISSION_POLICIES = ("reject", "shed_oldest")
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission refused: the bounded waiting queue is full (policy
+    'reject'). Carries the queue depth so callers can surface
+    retry-after semantics."""
+
+    def __init__(self, request_id, depth: int, limit: int):
+        self.request_id = request_id
+        self.depth = depth
+        self.limit = limit
+        super().__init__(
+            f"engine overloaded: request {request_id!r} rejected, waiting "
+            f"queue at {depth}/{limit} (admission_policy='reject'; use "
+            f"'shed_oldest' to evict instead)")
 
 
 @dataclass(frozen=True)
 class SamplingParams:
-    """Per-request decode knobs (vLLM SamplingParams analogue)."""
+    """Per-request decode knobs (vLLM SamplingParams analogue).
+
+    deadline_s: wall-clock budget for the WHOLE request (queue + decode),
+        measured from arrival; the engine aborts an overdue request at
+        the next step boundary with finish_reason='timeout'.
+    queue_ttl_s: how long the request may sit in the waiting queue before
+        it expires unserved (finish_reason='timeout'); unlike deadline_s
+        it only guards queueing, so an admitted request never re-arms it.
+    """
     max_tokens: int = 16
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: int = 0
+    deadline_s: Optional[float] = None
+    queue_ttl_s: Optional[float] = None
 
 
 class RequestState:
@@ -53,9 +97,13 @@ class RequestState:
     RUNNING = "running"
     FINISHED_STOPPED = "finished_stopped"    # sampled eos
     FINISHED_LENGTH = "finished_length"      # hit max_tokens
+    FINISHED_TIMEOUT = "finished_timeout"    # deadline_s / queue_ttl_s hit
+    FINISHED_SHED = "finished_shed"          # evicted by admission control
+    FINISHED_ERROR = "finished_error"        # quarantined by the watchdog
     CANCELLED = "cancelled"
 
-    FINISHED = (FINISHED_STOPPED, FINISHED_LENGTH, CANCELLED)
+    FINISHED = (FINISHED_STOPPED, FINISHED_LENGTH, FINISHED_TIMEOUT,
+                FINISHED_SHED, FINISHED_ERROR, CANCELLED)
 
 
 _arrival_counter = itertools.count()
@@ -97,6 +145,13 @@ class Request:
 class SchedulerConfig:
     max_num_seqs: int = 8                    # decode bucket ceiling
     max_prefill_tokens: int = 2048           # per-step admission budget
+    # ------------------------------ admission control / backpressure
+    max_waiting: Optional[int] = None        # waiting-queue bound (None=∞)
+    admission_policy: str = "reject"         # 'reject' | 'shed_oldest'
+    # pause prefill admission once post-admission pool occupancy would
+    # exceed this fraction — reserves decode headroom so CacheExhausted
+    # cannot strand running sequences. 1.0 disables the watermark.
+    cache_high_watermark: float = 1.0
 
 
 @dataclass
@@ -108,14 +163,26 @@ class ScheduledBatch:
 
 class Scheduler:
     def __init__(self, config: SchedulerConfig, cache: PagedKVCache):
+        if config.admission_policy not in ADMISSION_POLICIES:
+            raise ValueError(
+                f"admission_policy must be one of {ADMISSION_POLICIES}, "
+                f"got {config.admission_policy!r}")
+        if not 0.0 < config.cache_high_watermark <= 1.0:
+            raise ValueError(
+                f"cache_high_watermark must be in (0, 1], got "
+                f"{config.cache_high_watermark}")
         self.config = config
         self.cache = cache
         self.waiting: deque = deque()
         self.running: List[Request] = []
         self.num_preemptions = 0
+        self.watermark_holds = 0             # admissions paused by watermark
 
     # ------------------------------------------------------------- intake
-    def add(self, req: Request):
+    def add(self, req: Request) -> List[Request]:
+        """Queue a request; returns the waiting requests shed to make
+        room (empty normally). Raises EngineOverloaded when the bounded
+        queue is full under the 'reject' policy."""
         # a request that can never fit the pool would livelock the
         # preemption loop — refuse it up front, loudly
         worst = len(req.prompt_ids) + req.params.max_tokens
@@ -126,8 +193,21 @@ class Scheduler:
                 f" ({worst} tokens) but the pool only has "
                 f"{self.cache.num_blocks}; grow num_blocks or shrink the"
                 f" request")
+        shed: List[Request] = []
+        limit = self.config.max_waiting
+        if limit is not None:
+            if self.config.admission_policy == "reject":
+                if len(self.waiting) >= limit:
+                    raise EngineOverloaded(req.request_id,
+                                           len(self.waiting), limit)
+            else:                            # shed_oldest
+                while len(self.waiting) >= limit:
+                    victim = self.waiting.popleft()
+                    victim.state = RequestState.FINISHED_SHED
+                    shed.append(victim)
         req.state = RequestState.WAITING
         self.waiting.append(req)
+        return shed
 
     def cancel(self, request_id: str) -> bool:
         for req in list(self.waiting):
@@ -146,21 +226,68 @@ class Scheduler:
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.running)
 
+    # ----------------------------------------------------- expiry / abort
+    def expire_waiting(self, now: float) -> List[Request]:
+        """Remove waiting requests whose queue_ttl_s or deadline_s has
+        elapsed (both measured from arrival_time). Returns them with
+        state FINISHED_TIMEOUT; the engine emits the terminal outputs."""
+        expired = []
+        for req in list(self.waiting):
+            p = req.params
+            age = now - req.arrival_time
+            if (p.queue_ttl_s is not None and age > p.queue_ttl_s) or \
+                    (p.deadline_s is not None and age > p.deadline_s):
+                self.waiting.remove(req)
+                req.state = RequestState.FINISHED_TIMEOUT
+                expired.append(req)
+        return expired
+
+    def overdue_running(self, now: float) -> List[Request]:
+        """Running requests past their deadline_s; the engine aborts them
+        (finish + terminal output) at the step boundary."""
+        return [r for r in self.running
+                if r.params.deadline_s is not None
+                and (now - r.arrival_time) > r.params.deadline_s]
+
     # ---------------------------------------------------------- scheduling
+    def _requeue(self, req: Request):
+        """Arrival-ordered insert into the waiting queue. Preemption and
+        crash recovery both requeue through here so a bumped request
+        keeps its ORIGINAL FCFS priority — appendleft would invert the
+        relative order of a multi-request requeue and let later arrivals
+        starve a repeatedly-preempted earlier one."""
+        req.slot = None
+        req.state = RequestState.WAITING
+        for i, w in enumerate(self.waiting):
+            if w.arrival > req.arrival:
+                self.waiting.insert(i, req)
+                return
+        self.waiting.append(req)
+
     def _preempt(self, victim: Request, batch: ScheduledBatch):
-        """Recompute-style preemption: drop the cache, requeue at the
-        head of the line with the generated tokens folded into the
-        prompt (all_token_ids)."""
+        """Recompute-style preemption: drop the cache, requeue in arrival
+        order with the generated tokens folded into the prompt
+        (all_token_ids)."""
         self.running.remove(victim)
         if victim in batch.decode:
             batch.decode.remove(victim)
         self.cache.free(victim.request_id)
-        victim.slot = None
-        victim.state = RequestState.WAITING
         victim.num_preemptions += 1
         self.num_preemptions += 1
-        self.waiting.appendleft(victim)
+        self._requeue(victim)
         batch.preempted.append(victim)
+
+    def requeue_for_recovery(self, req: Request):
+        """Crash-recovery rebuild: drop the (possibly tainted) cache
+        state of a surviving RUNNING request and requeue it in arrival
+        order; the next admission re-prefills it from its token log
+        (all_token_ids), which the parity pins prove bitwise-equivalent
+        to having never been disturbed. Freed blocks are scrubbed — a
+        poisoned step may have scattered NaN into them, and NaN (unlike
+        finite garbage) survives the attention length-mask via 0*NaN."""
+        self.running.remove(req)
+        self.cache.free(req.request_id, scrub=True)
+        self._requeue(req)
 
     def schedule(self) -> ScheduledBatch:
         batch = ScheduledBatch()
@@ -178,14 +305,25 @@ class Scheduler:
                     self._preempt(victim, batch)
                     if victim is req:
                         break                # preempted itself; move on
-        # 2. FCFS admission under seq count + prefill token budget
+        # 2. FCFS admission under seq count + prefill token budget +
+        #    the cache occupancy high-watermark (decode headroom)
         budget = self.config.max_prefill_tokens
+        mark = self.config.cache_high_watermark
         while self.waiting and len(self.running) \
                 < self.config.max_num_seqs:
             req = self.waiting[0]
             tokens = req.all_token_ids()
             if len(tokens) > budget and batch.prefill:
                 break                        # budget spent; next step
+            needed = self.cache.blocks_needed(len(tokens))
+            if (self.cache.num_used() + needed) > mark * self.cache.num_blocks \
+                    and self.running:
+                # above the watermark with live decodes: hold admission
+                # so their growth can't hit CacheExhausted. With nothing
+                # running there is nothing to strand — admit (the head
+                # alone may legitimately exceed the watermark).
+                self.watermark_holds += 1
+                break
             try:
                 self.cache.allocate(req.request_id, len(tokens))
             except CacheExhausted:
@@ -198,9 +336,12 @@ class Scheduler:
         return batch
 
     # ------------------------------------------------------------ results
-    def finish(self, req: Request, state: str):
-        """Completion path: release blocks, detach from running."""
+    def finish(self, req: Request, state: str, scrub: bool = False):
+        """Completion path: release blocks, detach from running. `scrub`
+        zeroes the freed blocks device-side — required when quarantining
+        a poisoned request whose blocks may hold NaN (see
+        requeue_for_recovery)."""
         self.running.remove(req)
-        self.cache.free(req.request_id)
+        self.cache.free(req.request_id, scrub=scrub)
         req.slot = None
         req.state = state

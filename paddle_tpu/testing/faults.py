@@ -28,25 +28,29 @@ import os
 import time
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["FaultInjector", "corrupt_checkpoint"]
+__all__ = ["FaultInjector", "ServingFaultInjector", "corrupt_checkpoint"]
 
 SPEC_ENV = "PADDLE_TPU_FAULTS"
 STATE_DIR_ENV = "PADDLE_TPU_FAULT_STATE_DIR"
+SERVE_SPEC_ENV = "PADDLE_TPU_SERVE_FAULTS"
 
 KINDS = ("kill", "nan", "stall", "corrupt")
+SERVE_KINDS = ("nan_logits", "stall", "cache_corrupt", "burst")
 KILL_EXIT_CODE = 37  # distinctive, so supervisors/tests can assert on it
 
 
-def _parse(spec: str) -> List[Tuple[str, int, Optional[float]]]:
+def _parse(spec: str,
+           kinds: Tuple[str, ...] = KINDS
+           ) -> List[Tuple[str, int, Optional[float]]]:
     out = []
     for item in spec.split(","):
         item = item.strip()
         if not item:
             continue
         kind, _, rest = item.partition("@")
-        if kind not in KINDS:
+        if kind not in kinds:
             raise ValueError(f"unknown fault kind {kind!r} in {spec!r} "
-                             f"(known: {KINDS})")
+                             f"(known: {kinds})")
         step_s, _, arg_s = rest.partition(":")
         out.append((kind, int(step_s), float(arg_s) if arg_s else None))
     return out
@@ -166,3 +170,108 @@ class FaultInjector:
                 and self._fire_once("nan", step):
             return loss * float("nan")
         return loss
+
+
+class ServingFaultInjector:
+    """Deterministic step-addressed fault injection for the serving
+    engine — the serving twin of FaultInjector, exercising the hardened
+    LLMEngine step (anomaly quarantine, watchdog, cache rebuild,
+    admission control) instead of the training supervisor.
+
+    Spec grammar (env `PADDLE_TPU_SERVE_FAULTS` or constructor arg),
+    comma-separated `fault@step[:arg]`:
+
+        nan_logits@5[:row]    poison row `row` (default 0) of the first
+                              logits computed at/after engine step 5 —
+                              models a poisoned device step
+        stall@7:0.2           sleep 0.2s inside the decode phase of step
+                              7 — models a stuck device call; trips the
+                              engine watchdog when step_timeout_s < arg
+        cache_corrupt@9       overwrite the first allocated block of the
+                              earliest live sequence with NaN — models
+                              torn paged-cache state; detected as
+                              non-finite logits on that sequence's next
+                              decode
+        burst@3:8             report 8 extra arrivals due at step 3 —
+                              consumed by chaos harnesses (burst())
+                              to drive admission control
+
+    Each fault fires ONCE per injector instance, at the first
+    opportunity AT OR AFTER its step (a fault armed for a step where its
+    hook has nothing to act on — no live sequences, empty decode — slides
+    to the next step), which keeps seeded chaos schedules deterministic
+    without hand-aligning them to the engine's phase timing. With no
+    spec the injector is inert and every hook is a cheap no-op, so the
+    engine calls it unconditionally."""
+
+    def __init__(self, spec: Optional[str] = None):
+        spec = os.environ.get(SERVE_SPEC_ENV) if spec is None else spec
+        self.faults = _parse(spec or "", kinds=SERVE_KINDS)
+        self._fired = set()
+        self.fired_log: List[Tuple[str, int]] = []  # (kind, engine step)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.faults)
+
+    def _claim(self, kind: str, step: int) -> Optional[float]:
+        """First unfired `kind` fault armed for a step <= `step`; marks
+        it fired and returns its arg (None if nothing due)."""
+        for i, (k, s, arg) in enumerate(self.faults):
+            if k == kind and s <= step and i not in self._fired:
+                self._fired.add(i)
+                self.fired_log.append((kind, step))
+                return arg if arg is not None else float("nan")
+        return None
+
+    # ------------------------------------------------------------- hooks
+    def stall(self, step: int):
+        """Engine hook, top of the decode phase: sleep `arg` seconds
+        (default 0.05) — long enough to overrun a test-sized
+        step_timeout_s, short enough for CI."""
+        if not self.enabled:
+            return
+        arg = self._claim("stall", step)
+        if arg is not None:
+            time.sleep(0.05 if arg != arg else arg)   # NaN -> default
+
+    def poison_logits(self, step: int, logits):
+        """Engine hook on every host-side logits array ([V] prefill row
+        or [N, V] decode batch): NaN-poison the armed row of the first
+        logits seen at/after the armed step."""
+        if not self.enabled:
+            return logits
+        arg = self._claim("nan_logits", step)
+        if arg is None:
+            return logits
+        import numpy as np
+        logits = np.array(logits)                     # private copy
+        if logits.ndim == 1:
+            logits[0] = np.nan
+        else:
+            row = 0 if arg != arg else int(arg)
+            logits[min(row, logits.shape[0] - 1), 0] = np.nan
+        return logits
+
+    def corrupt_cache(self, step: int, cache):
+        """Engine hook, top of step: overwrite the first block of the
+        earliest live sequence with NaN in layer 0's K pool (enough to
+        poison that sequence's next decode logits). Slides to a later
+        step while no sequence holds blocks."""
+        if not self.enabled or not cache._tables:
+            return
+        if self._claim("cache_corrupt", step) is None:
+            return
+        import jax.numpy as jnp
+        seq_id = next(iter(cache._tables))
+        block = cache._tables[seq_id][0]
+        (kp, vp), rest = cache.pools[0], cache.pools[1:]
+        cache.pools = ((kp.at[block].set(jnp.nan), vp),) + tuple(rest)
+
+    def burst(self, step: int) -> int:
+        """Harness hook: number of extra arrivals due now (0 if none) —
+        drives admission-control/shed paths in chaos runs."""
+        if not self.enabled:
+            return 0
+        arg = self._claim("burst", step)
+        return 0 if arg is None or arg != arg else int(arg)

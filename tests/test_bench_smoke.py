@@ -39,3 +39,14 @@ def test_full_subbenches_cpu():
     assert sd > 0
     assert sd_detail["generated_tokens"] > 0
     assert sd_detail["steps"] > 0
+
+
+def test_chaos_serve_runner_cpu():
+    """tools/chaos_serve.py smoke: a short seeded fault schedule drains
+    with zero leaked blocks and bitwise-clean survivors (exit 0)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import chaos_serve
+    rc = chaos_serve.main(["--seed", "1", "--requests", "8",
+                           "--faults", "nan_logits@3,stall@5:0.05"])
+    assert rc == 0
