@@ -1,0 +1,45 @@
+"""paddle_tpu.analysis: framework-aware static analysis (ptlint).
+
+The runtime invariants this package guards are the ones no unit test can
+see until they break in production (docs/static_analysis.md):
+
+- trace safety: jitted programs must stay trace-pure and recompile-free
+  (rules/trace_safety.py — tracer branching, host materialization,
+  Python side effects under trace, jit-in-loop recompile churn,
+  non-hashable statics, host RNG under trace);
+- jaxpr health: the compiled entry points (jit.TrainStep, the decode
+  sub-programs) must not grow host callbacks, captured-constant bloat
+  or silent dtype downcasts (jaxpr_audit.py — a trace-time check, the
+  analogue of the reference's graph-pass validation in
+  paddle/fluid/framework/ir);
+- lock discipline: shared serving state annotated in a `_GUARDED_BY`
+  map is only touched while holding its lock (rules/concurrency.py).
+
+The lint core (ast_core + rules) is stdlib-only so `tools/ptlint.py`
+runs without importing jax; `jaxpr_audit` needs jax and is imported on
+demand.
+"""
+from __future__ import annotations
+
+from .ast_core import (Finding, LintEngine, LintReport, load_baseline,
+                       write_baseline)
+from .rules import RULE_CATALOG, default_rules
+
+__all__ = ["Finding", "LintEngine", "LintReport", "RULE_CATALOG",
+           "default_rules", "holds_lock", "load_baseline",
+           "write_baseline"]
+
+
+def holds_lock(*locks):
+    """Annotate a method as requiring its CALLER to already hold the
+    named lock attribute(s) (e.g. ``@holds_lock("_lock")``).
+
+    Runtime no-op; the ptlint concurrency rule (PT-C001) treats every
+    access to a `_GUARDED_BY` field inside a decorated method as guarded.
+    The annotation is a promise the call graph must keep — public entry
+    points take the lock with ``with self._lock:`` and only they may call
+    a ``holds_lock`` helper."""
+    def deco(fn):
+        fn._ptlint_holds_locks = tuple(locks)
+        return fn
+    return deco

@@ -1,0 +1,264 @@
+"""ptlint AST engine: findings, suppressions, baselines, the file driver.
+
+Stdlib-only by design — the linter must run (and gate CI) without
+importing jax or the framework it lints. Rules live in
+analysis/rules/; each rule walks a parsed module and yields Finding
+records. Suppression is pylint-style:
+
+    risky_line()            # ptlint: disable=PT-T004  <reason>
+    # ptlint: disable-file=PT-T003  <reason>   (anywhere in the file)
+
+A disable comment suppresses only the named rule(s) on its own line
+(or, for a comment-only line, on the next CODE line — a multi-line
+reason comment carries the disable through to the statement below);
+`disable=all` mutes every rule. Suppressed findings are kept on the report so `--show-
+suppressed` and the fixture tests can still see them.
+
+Baselines (`--baseline write|check`) snapshot current findings by
+(path, rule, line) fingerprint so a legacy tree can gate on NEW
+findings only; this repo ships an EMPTY baseline — the tree itself is
+clean and must stay so.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "LintEngine", "LintReport", "ModuleContext", "Rule",
+           "collect_suppressions", "load_baseline", "write_baseline"]
+
+SEVERITIES = ("error", "warning")
+
+_DISABLE_RE = re.compile(r"ptlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+_DISABLE_FILE_RE = re.compile(r"ptlint:\s*disable-file=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a source line."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    severity: str
+    message: str
+
+    def fingerprint(self) -> str:
+        """Baseline identity. Line-anchored: a baseline entry goes stale
+        when the code around it moves — that is a feature (the finding
+        resurfaces for a fresh look), not a bug."""
+        return f"{self.path}:{self.rule}:{self.line}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "severity": self.severity,
+                "message": self.message}
+
+
+class Rule:
+    """Base rule: `check_module(ctx)` yields Findings. One Rule object
+    may emit several rule ids (the trace-safety rules share one taint
+    analysis); `ids` lists everything it can emit so --select works."""
+
+    ids: Tuple[str, ...] = ()
+
+    def check_module(self, ctx: "ModuleContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule needs about one parsed file."""
+    path: str
+    source: str
+    tree: ast.Module
+
+    def finding(self, rule_id: str, node, message: str,
+                severity: str = "error") -> Finding:
+        return Finding(rule=rule_id, path=self.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       severity=severity, message=message)
+
+
+def collect_suppressions(source: str) -> Tuple[Dict[int, Set[str]],
+                                               Set[str]]:
+    """Parse `# ptlint: disable=...` comments via tokenize (robust
+    against '#' inside strings). Returns ({line: {rules}}, file_rules).
+    A comment-only line's disable also applies to the NEXT line, so long
+    statements can carry their suppression above themselves."""
+    per_line: Dict[int, Set[str]] = {}
+    file_level: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, file_level
+    lines = source.splitlines()
+
+    def _comment_only(lineno: int) -> bool:
+        text = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        stripped = text.strip()
+        return stripped.startswith("#")
+
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DISABLE_FILE_RE.search(tok.string)
+        if m:
+            file_level |= {r.strip() for r in m.group(1).split(",")}
+            continue
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",")}
+        line = tok.start[0]
+        per_line.setdefault(line, set()).update(rules)
+        # comment-only line → the disable rides through any following
+        # comment lines (a multi-line reason) onto the next code line
+        prefix = tok.line[:tok.start[1]]
+        if not prefix.strip():
+            nxt = line + 1
+            while nxt <= len(lines) and _comment_only(nxt):
+                per_line.setdefault(nxt, set()).update(rules)
+                nxt += 1
+            per_line.setdefault(nxt, set()).update(rules)
+    return per_line, file_level
+
+
+def _is_suppressed(f: Finding, per_line: Dict[int, Set[str]],
+                   file_level: Set[str]) -> bool:
+    if f.rule in file_level or "all" in file_level:
+        return True
+    rules = per_line.get(f.line, ())
+    return f.rule in rules or "all" in rules
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)   # unsuppressed
+    suppressed: List[Finding] = field(default_factory=list)
+    files: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+    def extend(self, other: "LintReport"):
+        self.findings.extend(other.findings)
+        self.suppressed.extend(other.suppressed)
+        self.files += other.files
+        self.parse_errors.extend(other.parse_errors)
+
+    def sorted_findings(self) -> List[Finding]:
+        return sorted(self.findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    def as_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "findings": [f.as_dict() for f in self.sorted_findings()],
+            "suppressed": len(self.suppressed),
+            "parse_errors": self.parse_errors,
+        }
+
+
+class LintEngine:
+    """Runs a rule set over files/trees and applies suppressions."""
+
+    def __init__(self, rules: Optional[Sequence[Rule]] = None,
+                 select: Optional[Set[str]] = None,
+                 ignore: Optional[Set[str]] = None):
+        if rules is None:
+            from .rules import default_rules
+            rules = default_rules()
+        self.rules = list(rules)
+        self.select = set(select) if select else None
+        self.ignore = set(ignore) if ignore else set()
+
+    def _wanted(self, rule_id: str) -> bool:
+        if rule_id in self.ignore:
+            return False
+        return self.select is None or rule_id in self.select
+
+    def lint_source(self, source: str, path: str) -> LintReport:
+        report = LintReport(files=1)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            report.parse_errors.append(f"{path}: {e}")
+            return report
+        ctx = ModuleContext(path=path, source=source, tree=tree)
+        per_line, file_level = collect_suppressions(source)
+        for rule in self.rules:
+            for f in rule.check_module(ctx):
+                if not self._wanted(f.rule):
+                    continue
+                if _is_suppressed(f, per_line, file_level):
+                    report.suppressed.append(f)
+                else:
+                    report.findings.append(f)
+        return report
+
+    def lint_file(self, path: str, display_path: Optional[str] = None
+                  ) -> LintReport:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        return self.lint_source(source, display_path or path)
+
+    def lint_paths(self, paths: Sequence[str],
+                   root: Optional[str] = None) -> LintReport:
+        """Lint every .py under the given files/directories. Paths in
+        findings are reported relative to `root` (default: cwd) so
+        baselines are machine-portable."""
+        root = os.path.abspath(root or os.getcwd())
+        report = LintReport()
+        for p in paths:
+            for f in sorted(_iter_py_files(p)):
+                rel = os.path.relpath(os.path.abspath(f), root)
+                report.extend(self.lint_file(f, display_path=rel))
+        return report
+
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        if path.endswith(".py"):
+            yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+# ------------------------------------------------------------------ baseline
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        "version": 1,
+        "findings": sorted(
+            ({"path": f.path, "rule": f.rule, "line": f.line,
+              "message": f.message} for f in findings),
+            key=lambda d: (d["path"], d["line"], d["rule"])),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Returns the set of baselined fingerprints (empty if no file)."""
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {f"{d['path']}:{d['rule']}:{d['line']}"
+            for d in data.get("findings", [])}

@@ -1,0 +1,19 @@
+"""ptlint rule registry.
+
+RULE_CATALOG maps every rule id to (severity, one-line description);
+docs/static_analysis.md is the narrative catalog. default_rules() is
+what the engine and CLI run when no explicit rule set is given.
+"""
+from __future__ import annotations
+
+from .concurrency import CONCURRENCY_RULES, LockDisciplineRule
+from .trace_safety import TRACE_RULES, TraceSafetyRule
+
+__all__ = ["RULE_CATALOG", "default_rules",
+           "TraceSafetyRule", "LockDisciplineRule"]
+
+RULE_CATALOG = {**TRACE_RULES, **CONCURRENCY_RULES}
+
+
+def default_rules():
+    return [TraceSafetyRule(), LockDisciplineRule()]

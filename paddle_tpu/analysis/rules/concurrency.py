@@ -1,0 +1,193 @@
+"""Lock-discipline lint (PT-C001): `_GUARDED_BY`-annotated fields must
+only be touched while holding their lock.
+
+A class opts in by declaring, as a class attribute, a dict literal
+mapping field names to the lock attribute that guards them:
+
+    class LLMEngine:
+        _GUARDED_BY = {
+            "_requests": "_lock",
+            "_pending_outputs": "_lock",
+        }
+
+Inside that class, every read or write of ``self.<field>`` for a field
+in the map must be lexically inside ``with self.<lock>:`` (or a with
+statement over a local alias of it), OR inside a method decorated
+``@holds_lock("<lock>")`` (the runtime no-op from paddle_tpu.analysis
+— a promise that every caller takes the lock first). ``__init__`` is
+exempt: construction happens before the object is shared.
+
+The check is lexical, per-method, and intra-class — it does not chase
+aliases of self or cross-class access. That keeps it sound on the
+serving engine's actual shape (public entry points lock, helpers are
+annotated) without a whole-program escape analysis.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..ast_core import Finding, ModuleContext, Rule
+
+__all__ = ["LockDisciplineRule", "CONCURRENCY_RULES"]
+
+CONCURRENCY_RULES = {
+    "PT-C001": ("error",
+                "access to a _GUARDED_BY field without holding its lock"),
+}
+
+_HOLDS_NAMES = {"holds_lock", "analysis.holds_lock"}
+_EXEMPT_METHODS = {"__init__", "__new__", "__repr__", "__del__"}
+
+
+def _dotted(node) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _guarded_map(cls: ast.ClassDef) -> Dict[str, str]:
+    """Extract the `_GUARDED_BY = {...}` dict literal, if any."""
+    for stmt in cls.body:
+        targets = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "_GUARDED_BY" \
+                    and isinstance(value, ast.Dict):
+                out: Dict[str, str] = {}
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str) \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        out[k.value] = v.value
+                return out
+    return {}
+
+
+def _held_by_decorator(fn: ast.FunctionDef) -> Set[str]:
+    """Locks promised held via @holds_lock("_lock", ...)."""
+    held: Set[str] = set()
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = _dotted(dec.func)
+            if name and name.split(".")[-1] == "holds_lock":
+                for a in dec.args:
+                    if isinstance(a, ast.Constant) \
+                            and isinstance(a.value, str):
+                        held.add(a.value)
+    return held
+
+
+class LockDisciplineRule(Rule):
+    ids = tuple(CONCURRENCY_RULES)
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                guarded = _guarded_map(node)
+                if guarded:
+                    self._check_class(ctx, node, guarded, findings)
+        return findings
+
+    def _check_class(self, ctx: ModuleContext, cls: ast.ClassDef,
+                     guarded: Dict[str, str],
+                     findings: List[Finding]):
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name in _EXEMPT_METHODS:
+                    continue
+                held0 = _held_by_decorator(stmt)
+                self._scan(ctx, cls, stmt, stmt.body, guarded,
+                           held0, findings)
+
+    def _scan(self, ctx: ModuleContext, cls: ast.ClassDef,
+              method: ast.FunctionDef, body: List[ast.stmt],
+              guarded: Dict[str, str], held: Set[str],
+              findings: List[Finding]):
+        """Walk statements tracking the set of held locks lexically."""
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                newly = set()
+                for item in stmt.items:
+                    lock = self._lock_of(item.context_expr)
+                    if lock is not None:
+                        newly.add(lock)
+                    # the with-item expression itself (e.g. self._lock)
+                    # is a lock attribute, not guarded data — no check
+                self._scan(ctx, cls, method, stmt.body, guarded,
+                           held | newly, findings)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: runs later, with no lock guarantee
+                self._scan(ctx, cls, method, stmt.body, guarded,
+                           _held_by_decorator(stmt), findings)
+                continue
+            if isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._scan(ctx, cls, method, blk, guarded, held,
+                               findings)
+                for h in stmt.handlers:
+                    if h.type is not None:
+                        self._check_expr(ctx, method, h.type, guarded,
+                                         held, findings)
+                    self._scan(ctx, cls, method, h.body, guarded, held,
+                               findings)
+                continue
+            # compound statements: recurse into sub-blocks with the
+            # same held set, and check expressions hanging off them
+            for field_name, value in ast.iter_fields(stmt):
+                if isinstance(value, list) and value and \
+                        isinstance(value[0], ast.stmt):
+                    self._scan(ctx, cls, method, value, guarded,
+                               held, findings)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.AST):
+                            self._check_expr(ctx, method, v, guarded,
+                                             held, findings)
+                elif isinstance(value, ast.AST):
+                    self._check_expr(ctx, method, value, guarded,
+                                     held, findings)
+
+    def _lock_of(self, expr) -> Optional[str]:
+        """`with self._lock:` → '_lock' (also unwraps common wrappers
+        like `self._lock.acquire_timeout(...)` call expressions)."""
+        name = _dotted(expr)
+        if name and name.startswith("self."):
+            return name[len("self."):]
+        if isinstance(expr, ast.Call):
+            return self._lock_of(expr.func)
+        if isinstance(expr, ast.Attribute):
+            return self._lock_of(expr.value)
+        return None
+
+    def _check_expr(self, ctx: ModuleContext, method: ast.FunctionDef,
+                    expr: ast.AST, guarded: Dict[str, str],
+                    held: Set[str], findings: List[Finding]):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            lock = guarded.get(node.attr)
+            if lock is None or lock in held:
+                continue
+            findings.append(ctx.finding(
+                "PT-C001", node,
+                f"'self.{node.attr}' is _GUARDED_BY '{lock}' but "
+                f"'{method.name}' accesses it without holding the lock; "
+                f"wrap in `with self.{lock}:` or mark the method "
+                f"@holds_lock(\"{lock}\") and lock in every caller",
+                severity="error"))

@@ -1,0 +1,825 @@
+"""Trace-safety rules: keep jitted programs pure and recompile-free.
+
+The defect class: jax.jit hard-stages Python at trace time, so Python
+constructs that LOOK innocent change meaning under trace — a branch on
+a traced value either throws (ConcretizationTypeError) or silently
+specializes; `.item()`/`np.asarray` forces a device→host sync inside
+the hot program; list/global mutation runs ONCE at trace time and then
+never again; a `jax.jit(...)` constructed per call throws the compile
+cache away every step ("Operator Fusion in XLA", PAPERS.md, measures
+how much semantics/perf ride on stable compiled programs).
+
+Rules (catalog in docs/static_analysis.md):
+
+  PT-T001  tracer-dependent Python branching (if/while/assert/ternary
+           on a value derived from traced arguments)
+  PT-T002  host materialization under trace (.item()/.tolist()/
+           .numpy()/float()/int()/bool()/np.* on traced values,
+           jax.device_get)
+  PT-T003  Python side effects under trace (mutating closure/global/
+           self state from inside a traced function)
+  PT-T004  jit constructed inside a function or loop body (recompile
+           churn; exempt: module scope, `self.attr = jax.jit(...)`
+           one-time bindings, lru_cache-memoized factories)
+  PT-T005  unhashable static args (static_argnums/static_argnames
+           pointing at list/dict/set parameters or call sites)
+  PT-T006  host RNG under trace (np.random.* / stdlib random.* inside
+           a traced scope — trace-time constants, NOT per-call
+           randomness; use jax.random with a threaded key)
+
+Scope marking is lexical and conservative: a function is "traced" when
+it is decorated with jax.jit (directly or via functools.partial), is
+passed by name to jax.jit / jax.vmap / grad / lax control flow, or is
+bound to `self.attr` and jitted through that attribute — plus every
+def nested inside one. Taint starts at the traced function's
+parameters (minus static_argnums/static_argnames) and flows through
+assignments; shape/dtype metadata (`x.shape`, `x.ndim`, `x.dtype`,
+`len(x)`, `isinstance(x, ...)`) is static under jax tracing and
+deliberately does NOT taint, so shape-polymorphic branching stays
+legal. Cross-module calls are not followed — helpers called FROM a
+traced scope with tainted values are each rule's blind spot, kept so
+the zero-findings gate stays free of false positives.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..ast_core import Finding, ModuleContext, Rule
+
+__all__ = ["TraceSafetyRule", "TRACE_RULES"]
+
+TRACE_RULES = {
+    "PT-T001": ("error",
+                "tracer-dependent Python branching inside a jitted scope"),
+    "PT-T002": ("error",
+                "host materialization of a traced value inside a jitted "
+                "scope"),
+    "PT-T003": ("warning",
+                "Python side effect (closure/global/attribute mutation) "
+                "inside a jitted scope"),
+    "PT-T004": ("warning",
+                "jax.jit constructed inside a function or loop body "
+                "(recompile churn)"),
+    "PT-T005": ("error",
+                "unhashable value routed through static_argnums/"
+                "static_argnames"),
+    "PT-T006": ("error",
+                "host RNG (np.random/stdlib random) inside a jitted "
+                "scope"),
+}
+
+# attribute reads that are static under jax tracing (never taint)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type",
+                 "sharding", "itemsize", "nbytes"}
+# calls whose result is static under jax tracing
+_STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr", "id",
+                 "repr", "str", "issubclass", "callable", "range",
+                 "enumerate", "zip"}
+# host materialization method names (device → host sync under trace)
+_HOST_METHODS = {"item", "tolist", "numpy", "block_until_ready",
+                 "copy_to_host_async"}
+_HOST_BUILTINS = {"float", "int", "bool", "complex"}
+# in-place mutators for the side-effect rule
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "sort", "reverse", "appendleft", "popleft", "extendleft"}
+_MEMO_DECORATORS = {"lru_cache", "cache", "functools.lru_cache",
+                    "functools.cache"}
+
+
+def _dotted(node) -> Optional[str]:
+    """Best-effort dotted name of an expression ('jax.lax.scan',
+    'self._step_fn'); None when it isn't a plain name chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _is_jit_callee(name: Optional[str]) -> bool:
+    return name in ("jax.jit", "jit") or (
+        name is not None and name.endswith(".jit"))
+
+
+def _is_trace_wrapper(name: Optional[str]) -> bool:
+    """Callables whose function argument gets traced."""
+    if name is None:
+        return False
+    if _is_jit_callee(name):
+        return True
+    tail = name.split(".")[-1]
+    return tail in ("vmap", "pmap", "grad", "value_and_grad", "make_jaxpr",
+                    "checkpoint", "remat", "scan", "cond", "while_loop",
+                    "fori_loop", "switch", "map", "associative_scan",
+                    "custom_jvp", "custom_vjp", "shard_map")
+
+
+def _jit_partial(call: ast.Call) -> Optional[ast.Call]:
+    """For `functools.partial(jax.jit, ...)` returns the partial call."""
+    name = _dotted(call.func)
+    if name in ("functools.partial", "partial") and call.args:
+        if _is_jit_callee(_dotted(call.args[0])):
+            return call
+    return None
+
+
+def _static_names_from_call(call: ast.Call, fn: ast.FunctionDef
+                            ) -> Set[str]:
+    """Resolve static_argnums/static_argnames of a jit construction to
+    parameter NAMES of the target def."""
+    statics: Set[str] = set()
+    posargs = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in _int_values(kw.value):
+                if 0 <= n < len(posargs):
+                    statics.add(posargs[n])
+        elif kw.arg == "static_argnames":
+            for s in _str_values(kw.value):
+                statics.add(s)
+    return statics
+
+
+def _int_values(node) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_int_values(e))
+        return out
+    return []
+
+
+def _str_values(node) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_str_values(e))
+        return out
+    return []
+
+
+class _FuncInfo:
+    def __init__(self, node: ast.FunctionDef, parent: Optional["_FuncInfo"],
+                 cls: Optional[ast.ClassDef]):
+        self.node = node
+        self.parent = parent
+        self.cls = cls
+        self.traced = False
+        self.static_params: Set[str] = set()
+        self.children: List["_FuncInfo"] = []
+        # names bound anywhere in this def (params, assigns, for/with
+        # targets, nested defs, imports) — the side-effect rule's notion
+        # of "local"
+        self.local_names: Set[str] = _bound_names(node)
+        self.memoized = any(
+            _dotted(d) in _MEMO_DECORATORS
+            or (isinstance(d, ast.Call) and _dotted(d.func)
+                in _MEMO_DECORATORS)
+            for d in node.decorator_list)
+
+
+def _bound_names(fn: ast.FunctionDef) -> Set[str]:
+    names: Set[str] = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+
+    class V(ast.NodeVisitor):
+        def _target(self, t):
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._target(e)
+            elif isinstance(t, ast.Starred):
+                self._target(t.value)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._target(t)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_NamedExpr(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_withitem(self, node):
+            if node.optional_vars is not None:
+                self._target(node.optional_vars)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            names.add(node.name)
+            # do not recurse: nested defs bind their own scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_comprehension(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+    for stmt in fn.body:
+        V().visit(stmt)
+    return names
+
+
+class TraceSafetyRule(Rule):
+    """One analysis pass per module emitting PT-T001..PT-T006."""
+
+    ids = tuple(TRACE_RULES)
+
+    # ------------------------------------------------------------- driver
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self.funcs: Dict[ast.FunctionDef, _FuncInfo] = {}
+        self._index_functions(ctx.tree)
+        self._mark_traced_roots(ctx.tree)
+        self._check_jit_construction(ctx.tree)      # PT-T004 / PT-T005
+        self._check_static_defaults()               # PT-T005 on defaults
+        self._check_callsite_statics()              # PT-T005 at call sites
+        for info in self.funcs.values():
+            if info.traced and (info.parent is None
+                                or not info.parent.traced):
+                self._check_traced_unit(info)       # PT-T001/2/3/6
+        return self.findings
+
+    def _emit(self, rule_id: str, node, message: str):
+        sev = TRACE_RULES[rule_id][0]
+        self.findings.append(
+            self.ctx.finding(rule_id, node, message, severity=sev))
+
+    # -------------------------------------------------------- function map
+    def _index_functions(self, tree: ast.Module):
+        rule = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: List[_FuncInfo] = []
+                self.cls: List[ast.ClassDef] = []
+
+            def visit_ClassDef(self, node):
+                self.cls.append(node)
+                self.generic_visit(node)
+                self.cls.pop()
+
+            def visit_FunctionDef(self, node):
+                info = _FuncInfo(node,
+                                 self.stack[-1] if self.stack else None,
+                                 self.cls[-1] if self.cls else None)
+                if info.parent is not None:
+                    info.parent.children.append(info)
+                rule.funcs[node] = info
+                self.stack.append(info)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+        V().visit(tree)
+
+    def _resolve_def(self, name: Optional[str],
+                     cls: Optional[ast.ClassDef]) -> Optional[_FuncInfo]:
+        """Resolve a plain / `self.attr` name to a def in this module.
+        `self.attr` is resolved through `self.attr = local_def`
+        rebindings collected per class."""
+        if name is None:
+            return None
+        if name.startswith("self."):
+            attr = name[len("self."):]
+            target = self._self_aliases.get((cls, attr))
+            if target is not None:
+                return target
+            if cls is not None:
+                for stmt in cls.body:
+                    if isinstance(stmt, ast.FunctionDef) \
+                            and stmt.name == attr:
+                        return self.funcs.get(stmt)
+            return None
+        if "." in name:
+            return None
+        for info in self.funcs.values():
+            if info.node.name == name:
+                return info
+        return None
+
+    def _mark_traced_roots(self, tree: ast.Module):
+        # pass 0: collect `self.attr = <local def>` aliases per class
+        self._self_aliases: Dict[Tuple[Optional[ast.ClassDef], str],
+                                 _FuncInfo] = {}
+        for info in self.funcs.values():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                src = self._resolve_def(_dotted(node.value), info.cls)
+                if src is None:
+                    continue
+                for t in node.targets:
+                    nm = _dotted(t)
+                    if nm and nm.startswith("self."):
+                        self._self_aliases[(info.cls,
+                                            nm[len("self."):])] = src
+
+        # pass 1: decorators
+        for info in self.funcs.values():
+            for dec in info.node.decorator_list:
+                if _is_jit_callee(_dotted(dec)):
+                    info.traced = True
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_callee(_dotted(dec.func)):
+                        info.traced = True
+                        info.static_params |= _static_names_from_call(
+                            dec, info.node)
+                    else:
+                        p = _jit_partial(dec)
+                        if p is not None:
+                            info.traced = True
+                            info.static_params |= _static_names_from_call(
+                                p, info.node)
+
+        # pass 2: functions passed by name to jit / trace wrappers
+        class V(ast.NodeVisitor):
+            def __init__(self, rule):
+                self.rule = rule
+                self.cls: List[ast.ClassDef] = []
+
+            def visit_ClassDef(self, node):
+                self.cls.append(node)
+                self.generic_visit(node)
+                self.cls.pop()
+
+            def visit_Call(self, node):
+                name = _dotted(node.func)
+                cls = self.cls[-1] if self.cls else None
+                if _is_trace_wrapper(name):
+                    for i, arg in enumerate(node.args):
+                        target = self.rule._resolve_def(_dotted(arg), cls)
+                        if target is None:
+                            continue
+                        target.traced = True
+                        if i == 0 and _is_jit_callee(name):
+                            target.static_params |= \
+                                _static_names_from_call(node, target.node)
+                self.generic_visit(node)
+
+        V(self).visit(tree)
+
+    # ---------------------------------------------- PT-T004 / PT-T005
+    def _enclosing_chain(self, tree):
+        """Yields (call_node, enclosing_def_or_None, in_loop, target) for
+        every jit construction in the module. `target` is the Assign
+        target's dotted name when the call is an assignment RHS."""
+        rule = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.def_stack: List[_FuncInfo] = []
+                self.loop_depth = 0
+                self.assign_target: List[Optional[str]] = [None]
+                self.out = []
+
+            def visit_FunctionDef(self, node):
+                self.def_stack.append(rule.funcs[node])
+                # decorators evaluate in the ENCLOSING scope
+                saved, self.def_stack = self.def_stack, self.def_stack[:-1]
+                for d in node.decorator_list:
+                    self.visit(d)
+                self.def_stack = saved
+                for item in node.body:
+                    self.visit(item)
+                self.def_stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_For(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            visit_While = visit_For
+            visit_AsyncFor = visit_For
+
+            def visit_Assign(self, node):
+                tname = _dotted(node.targets[0]) \
+                    if len(node.targets) == 1 else None
+                self.assign_target.append(tname)
+                self.visit(node.value)
+                self.assign_target.pop()
+                for t in node.targets:
+                    self.visit(t)
+
+            def visit_Call(self, node):
+                if _is_jit_callee(_dotted(node.func)) \
+                        or _jit_partial(node) is not None:
+                    self.out.append(
+                        (node,
+                         self.def_stack[-1] if self.def_stack else None,
+                         self.loop_depth > 0,
+                         self.assign_target[-1]))
+                self.assign_target.append(None)
+                self.generic_visit(node)
+                self.assign_target.pop()
+
+        v = V()
+        v.visit(tree)
+        return v.out
+
+    def _check_jit_construction(self, tree: ast.Module):
+        for call, encl, in_loop, target in self._enclosing_chain(tree):
+            # ---- PT-T005 on the construction itself
+            self._check_static_hashability(call, encl)
+            # ---- PT-T004
+            if in_loop:
+                self._emit("PT-T004", call,
+                           "jax.jit constructed inside a loop: every "
+                           "iteration builds a fresh compile cache "
+                           "(recompile churn); hoist the jit out of the "
+                           "loop")
+                continue
+            if encl is None:
+                continue                      # module scope: fine
+            if target is not None and target.startswith("self."):
+                continue                      # one-time instance binding
+            if any(f.memoized for f in self._chain(encl)):
+                continue                      # lru_cache factory
+            self._emit("PT-T004", call,
+                       f"jax.jit constructed inside function "
+                       f"'{encl.node.name}': each call recompiles from "
+                       f"scratch; hoist to module scope, memoize the "
+                       f"factory (functools.lru_cache), or bind once to "
+                       f"an instance attribute")
+
+    def _chain(self, info: Optional[_FuncInfo]):
+        while info is not None:
+            yield info
+            info = info.parent
+
+    def _check_static_hashability(self, call: ast.Call,
+                                  encl: Optional[_FuncInfo]):
+        # resolve the jitted target def (jax.jit(f, ...) or partial deco)
+        target: Optional[_FuncInfo] = None
+        if call.args and _is_jit_callee(_dotted(call.func)):
+            target = self._resolve_def(
+                _dotted(call.args[0]), encl.cls if encl else None)
+        statics: Set[str] = set()
+        if target is not None:
+            statics = _static_names_from_call(call, target.node)
+        if not statics or target is None:
+            return
+        target.static_params |= statics
+
+    def _check_static_defaults(self):
+        """Unhashable defaults on static parameters, for every def whose
+        static_params were discovered (decorator, partial, or jit(f,...)
+        form alike)."""
+        for info in self.funcs.values():
+            statics = info.static_params
+            if not statics:
+                continue
+            defaults = info.node.args.defaults
+            posargs = (info.node.args.posonlyargs + info.node.args.args)
+            offset = len(posargs) - len(defaults)
+            for i, d in enumerate(defaults):
+                pname = posargs[offset + i].arg
+                if pname in statics and isinstance(
+                        d, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                            ast.DictComp, ast.SetComp)):
+                    self._emit("PT-T005", d,
+                               f"static parameter '{pname}' of "
+                               f"'{info.node.name}' defaults to an "
+                               f"unhashable {type(d).__name__.lower()}; "
+                               f"static args are jit cache keys and must "
+                               f"hash (use a tuple)")
+
+    def _check_callsite_statics(self):
+        """Direct calls to known-jitted defs with unhashable literals in
+        static positions (checked module-wide, not just traced scopes)."""
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._resolve_def(_dotted(node.func), None)
+            if target is None or not target.static_params:
+                continue
+            posargs = [a.arg for a in (target.node.args.posonlyargs
+                                       + target.node.args.args)]
+            for i, arg in enumerate(node.args):
+                if i < len(posargs) and posargs[i] in target.static_params:
+                    if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                        self._emit(
+                            "PT-T005", arg,
+                            f"call to jitted '{target.node.name}' passes "
+                            f"an unhashable {type(arg).__name__.lower()} "
+                            f"for static parameter '{posargs[i]}'; every "
+                            f"call would fail or recompile — pass a "
+                            f"tuple/frozen value")
+            for kw in node.keywords:
+                if kw.arg in target.static_params and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    self._emit(
+                        "PT-T005", kw.value,
+                        f"call to jitted '{target.node.name}' passes an "
+                        f"unhashable {type(kw.value).__name__.lower()} "
+                        f"for static parameter '{kw.arg}'")
+
+    # ------------------------------------------------- traced-unit checks
+    def _check_traced_unit(self, root: _FuncInfo):
+        """Taint + purity checks over one maximal traced subtree."""
+        unit: List[_FuncInfo] = []
+
+        def collect(info):
+            unit.append(info)
+            for c in info.children:
+                collect(c)
+
+        collect(root)
+
+        tainted: Set[str] = set()
+        for info in unit:
+            statics = info.static_params if info is root else set()
+            for name in _param_names(info.node):
+                if name not in statics and name != "self":
+                    tainted.add(name)
+
+        # fixed-point assignment propagation over the unit's statements
+        stmts: List[ast.stmt] = []
+        for info in unit:
+            stmts.extend(info.node.body)
+        for _ in range(10):
+            before = len(tainted)
+            for stmt in stmts:
+                self._propagate(stmt, tainted)
+            if len(tainted) == before:
+                break
+
+        for info in unit:
+            self._scan_body(info, tainted)
+
+    def _propagate(self, node, tainted: Set[str]):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign):
+                hot = self._taints(n.value, tainted)
+                for t in n.targets:
+                    self._mark(t, tainted, hot)
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                self._mark(n.target, tainted,
+                           self._taints(n.value, tainted))
+            elif isinstance(n, ast.AugAssign):
+                if self._taints(n.value, tainted):
+                    self._mark(n.target, tainted, True)
+            elif isinstance(n, ast.NamedExpr):
+                self._mark(n.target, tainted,
+                           self._taints(n.value, tainted))
+            elif isinstance(n, ast.For):
+                if self._taints(n.iter, tainted):
+                    self._mark(n.target, tainted, True)
+            elif isinstance(n, ast.withitem):
+                if n.optional_vars is not None and \
+                        self._taints(n.context_expr, tainted):
+                    self._mark(n.optional_vars, tainted, True)
+            elif isinstance(n, ast.comprehension):
+                if self._taints(n.iter, tainted):
+                    self._mark(n.target, tainted, True)
+
+    def _mark(self, target, tainted: Set[str], hot: bool):
+        if isinstance(target, ast.Name):
+            if hot:
+                tainted.add(target.id)
+            else:
+                tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mark(e, tainted, hot)
+        elif isinstance(target, ast.Starred):
+            self._mark(target.value, tainted, hot)
+        # attribute/subscript stores do not (un)taint names
+
+    def _taints(self, node, tainted: Set[str]) -> bool:
+        """Is this expression derived from a traced value? Static
+        metadata (shape/dtype/len/isinstance) breaks the chain."""
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._taints(node.value, tainted)
+        if isinstance(node, ast.Subscript):
+            return self._taints(node.value, tainted) \
+                or self._taints(node.slice, tainted)
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in _STATIC_CALLS:
+                return False
+            if any(self._taints(a, tainted) for a in node.args):
+                return True
+            if any(self._taints(k.value, tainted) for k in node.keywords):
+                return True
+            return self._taints(node.func, tainted)
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return False
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # identity checks (`x is None`) compare Python object
+            # identity, decided at trace time — never a tracer read
+            return False
+        return any(self._taints(c, tainted)
+                   for c in ast.iter_child_nodes(node))
+
+    def _scan_body(self, info: _FuncInfo, tainted: Set[str]):
+        """PT-T001 / PT-T002 / PT-T003 / PT-T006 over one def's own
+        statements (nested defs are scanned as their own infos)."""
+        local = info.local_names
+
+        for node in _walk_own(info.node):
+            # ---- PT-T001: control flow on traced values
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                if self._taints(node.test, tainted):
+                    self._emit(
+                        "PT-T001", node,
+                        f"branching on a traced value in jitted scope "
+                        f"'{info.node.name}': Python control flow is "
+                        f"staged at trace time — use jnp.where / "
+                        f"lax.cond / lax.select")
+            elif isinstance(node, ast.Assert):
+                if self._taints(node.test, tainted):
+                    self._emit(
+                        "PT-T001", node,
+                        f"assert on a traced value in jitted scope "
+                        f"'{info.node.name}' forces concretization; use "
+                        f"checkify or move validation out of the jit")
+
+            # ---- PT-T002 / PT-T006: host calls
+            elif isinstance(node, ast.Call):
+                self._check_call(node, info, tainted)
+
+            # ---- PT-T003: mutating method call as a bare statement
+            # (value-discarded — `xs.append(x)`; a USED result like
+            # `lg = jnp.sort(...)` is a pure-function idiom, not a
+            # mutation)
+            elif isinstance(node, ast.Expr) \
+                    and isinstance(node.value, ast.Call):
+                self._check_mutator(node.value, info, local)
+
+            # ---- PT-T003: side effects
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) else \
+                    "nonlocal"
+                self._emit(
+                    "PT-T003", node,
+                    f"'{kind} {', '.join(node.names)}' inside jitted "
+                    f"scope '{info.node.name}': the write happens once "
+                    f"at trace time, not per call — thread state "
+                    f"through the carry/return instead",
+                )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    self._check_store(t, info, local)
+
+    def _check_call(self, node: ast.Call, info: _FuncInfo,
+                    tainted: Set[str]):
+        name = _dotted(node.func)
+        args_hot = any(self._taints(a, tainted) for a in node.args)
+
+        # PT-T006: host RNG — trace-time constant, not per-call noise
+        if name and (name.startswith("np.random.")
+                     or name.startswith("numpy.random.")
+                     or name.startswith("random.")):
+            self._emit(
+                "PT-T006", node,
+                f"host RNG '{name}' inside jitted scope "
+                f"'{info.node.name}': it draws ONCE at trace time and "
+                f"is baked into the program as a constant — use "
+                f"jax.random with an explicitly threaded key")
+            return
+
+        # PT-T002: host materialization
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _HOST_METHODS \
+                and self._taints(node.func.value, tainted):
+            self._emit(
+                "PT-T002", node,
+                f".{node.func.attr}() on a traced value in jitted scope "
+                f"'{info.node.name}' forces a device→host sync inside "
+                f"the compiled program")
+        elif name in _HOST_BUILTINS and args_hot:
+            self._emit(
+                "PT-T002", node,
+                f"{name}() on a traced value in jitted scope "
+                f"'{info.node.name}' concretizes the tracer (host "
+                f"sync); keep it as a jnp scalar")
+        elif name and (name.startswith("np.") or name.startswith("numpy.")
+                       ) and args_hot:
+            self._emit(
+                "PT-T002", node,
+                f"'{name}' on a traced value in jitted scope "
+                f"'{info.node.name}' materializes to host numpy; use "
+                f"the jnp equivalent")
+        elif name in ("jax.device_get", "device_get") and node.args:
+            self._emit(
+                "PT-T002", node,
+                f"jax.device_get inside jitted scope "
+                f"'{info.node.name}' is a host transfer in the hot "
+                f"program")
+
+    def _check_store(self, target, info: _FuncInfo, local: Set[str]):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._check_store(e, info, local)
+            return
+        if isinstance(target, ast.Attribute):
+            base = _dotted(target.value)
+            root_name = (base or "").split(".")[0]
+            if root_name == "self" or (root_name and
+                                       root_name not in local):
+                self._emit(
+                    "PT-T003", target,
+                    f"attribute store '{_dotted(target)} = ...' inside "
+                    f"jitted scope '{info.node.name}' mutates state "
+                    f"that outlives the trace (runs once, at trace "
+                    f"time); return the new value instead")
+        elif isinstance(target, ast.Subscript):
+            base = _dotted(target.value)
+            root_name = (base or "").split(".")[0]
+            if root_name and root_name != "self" \
+                    and root_name not in local:
+                self._emit(
+                    "PT-T003", target,
+                    f"subscript store into closure/global "
+                    f"'{base}' inside jitted scope "
+                    f"'{info.node.name}' is a trace-time side effect")
+
+    def _check_mutator(self, node: ast.Call, info: _FuncInfo,
+                       local: Set[str]):
+        """PT-T003 for mutating method calls on closure/instance names."""
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr not in _MUTATORS:
+            return
+        base = _dotted(node.func.value)
+        root_name = (base or "").split(".")[0]
+        if not root_name:
+            return
+        if root_name == "self" or root_name not in local:
+            self._emit(
+                "PT-T003", node,
+                f"'{base}.{node.func.attr}(...)' inside jitted scope "
+                f"'{info.node.name}' mutates closure/instance state at "
+                f"trace time only; thread it through the return value")
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _walk_own(fn: ast.FunctionDef):
+    """ast.walk limited to fn's own body — nested defs are excluded
+    (they are scanned as their own _FuncInfo units)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                stack.extend(ast.walk(d))
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

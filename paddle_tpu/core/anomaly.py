@@ -29,9 +29,11 @@ from typing import Optional
 
 import jax.numpy as jnp
 import jax.tree_util as jtu
+import numpy as np
 
 __all__ = ["AnomalyGuard", "anomaly_guard", "set_anomaly_guard",
            "current_guard", "tree_not_finite", "rows_not_finite",
+           "any_not_finite_host", "rows_not_finite_host",
            "sanitize_tree", "POLICIES"]
 
 POLICIES = ("raise", "skip_step", "zero_grads")
@@ -70,6 +72,31 @@ def rows_not_finite(a):
     if a.ndim == 1:
         a = a[None]
     return ~jnp.isfinite(a).reshape(a.shape[0], -1).all(axis=1)
+
+
+def any_not_finite_host(a) -> bool:
+    """Host-side twin of tree_not_finite for a value that is ALREADY
+    host numpy (e.g. the serving engine's fetched logits). Pushing an
+    already-materialized array back through jnp costs a device upload +
+    download per step (ptlint PT-T002's defect class, caught on the
+    serving decode loop); plain np.isfinite keeps the check on host."""
+    a = np.asarray(a)
+    if not np.issubdtype(a.dtype, np.inexact):
+        return False
+    return not bool(np.isfinite(a).all())
+
+
+def rows_not_finite_host(a) -> "np.ndarray":
+    """Host-side twin of rows_not_finite ([N, ...] numpy → [N] bool),
+    for attribution over logits the engine has already materialized."""
+    a = np.asarray(a)
+    if a.ndim == 0:
+        a = a[None]
+    if a.ndim == 1:
+        a = a[None]
+    if not np.issubdtype(a.dtype, np.inexact):
+        return np.zeros(a.shape[0], bool)
+    return ~np.isfinite(a).reshape(a.shape[0], -1).all(axis=1)
 
 
 def sanitize_tree(tree):

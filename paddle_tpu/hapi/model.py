@@ -142,7 +142,8 @@ class Model:
             out, _ = inner.pure_call(params, buffers, key, args, {})
             return out
 
-        jitted = jax.jit(f)
+        # built once per Model.prepare(), then cached on the instance
+        jitted = jax.jit(f)  # ptlint: disable=PT-T004
 
         def run(*args):
             params = {k: p._value for k, p in

@@ -42,12 +42,14 @@ forward, paged decode) belongs to the engine.
 from __future__ import annotations
 
 import itertools
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 import numpy as np
 
+from ...analysis import holds_lock
 from .paged_cache import CacheExhausted, PagedKVCache
 
 __all__ = ["EngineOverloaded", "SamplingParams", "Request", "RequestState",
@@ -162,6 +164,22 @@ class ScheduledBatch:
 
 
 class Scheduler:
+    """FCFS scheduler (module docstring). Thread contract (checked by
+    ptlint PT-C001 via _GUARDED_BY): the queue/running structures are
+    shared between the engine's step loop and intake threads and are
+    only touched under self._lock. Public methods take the lock (RLock:
+    safe to call from the engine's own locked frame — lock order is
+    engine → scheduler, never the reverse); _requeue/_preempt are
+    @holds_lock("_lock") helpers called from schedule()/
+    requeue_for_recovery's locked frames."""
+
+    _GUARDED_BY = {
+        "waiting": "_lock",
+        "running": "_lock",
+        "num_preemptions": "_lock",
+        "watermark_holds": "_lock",
+    }
+
     def __init__(self, config: SchedulerConfig, cache: PagedKVCache):
         if config.admission_policy not in ADMISSION_POLICIES:
             raise ValueError(
@@ -173,6 +191,7 @@ class Scheduler:
                 f"{config.cache_high_watermark}")
         self.config = config
         self.cache = cache
+        self._lock = threading.RLock()
         self.waiting: deque = deque()
         self.running: List[Request] = []
         self.num_preemptions = 0
@@ -193,63 +212,76 @@ class Scheduler:
                 f" ({worst} tokens) but the pool only has "
                 f"{self.cache.num_blocks}; grow num_blocks or shrink the"
                 f" request")
-        shed: List[Request] = []
-        limit = self.config.max_waiting
-        if limit is not None:
-            if self.config.admission_policy == "reject":
-                if len(self.waiting) >= limit:
-                    raise EngineOverloaded(req.request_id,
-                                           len(self.waiting), limit)
-            else:                            # shed_oldest
-                while len(self.waiting) >= limit:
-                    victim = self.waiting.popleft()
-                    victim.state = RequestState.FINISHED_SHED
-                    shed.append(victim)
-        req.state = RequestState.WAITING
-        self.waiting.append(req)
-        return shed
+        with self._lock:
+            shed: List[Request] = []
+            limit = self.config.max_waiting
+            if limit is not None:
+                if self.config.admission_policy == "reject":
+                    if len(self.waiting) >= limit:
+                        raise EngineOverloaded(req.request_id,
+                                               len(self.waiting), limit)
+                else:                        # shed_oldest
+                    while len(self.waiting) >= limit:
+                        victim = self.waiting.popleft()
+                        victim.state = RequestState.FINISHED_SHED
+                        shed.append(victim)
+            req.state = RequestState.WAITING
+            self.waiting.append(req)
+            return shed
 
     def cancel(self, request_id: str) -> bool:
-        for req in list(self.waiting):
-            if req.request_id == request_id:
-                self.waiting.remove(req)
-                req.state = RequestState.CANCELLED
-                return True
-        for req in self.running:
-            if req.request_id == request_id:
-                self.running.remove(req)
-                self.cache.free(request_id)
-                req.state = RequestState.CANCELLED
-                return True
-        return False
+        with self._lock:
+            for req in list(self.waiting):
+                if req.request_id == request_id:
+                    self.waiting.remove(req)
+                    req.state = RequestState.CANCELLED
+                    return True
+            for req in self.running:
+                if req.request_id == request_id:
+                    self.running.remove(req)
+                    self.cache.free(request_id)
+                    req.state = RequestState.CANCELLED
+                    return True
+            return False
 
     def has_unfinished(self) -> bool:
-        return bool(self.waiting or self.running)
+        with self._lock:
+            return bool(self.waiting or self.running)
+
+    def num_waiting(self) -> int:
+        """Queue depth snapshot (the engine's step telemetry reads this
+        instead of reaching into self.waiting unlocked)."""
+        with self._lock:
+            return len(self.waiting)
 
     # ----------------------------------------------------- expiry / abort
     def expire_waiting(self, now: float) -> List[Request]:
         """Remove waiting requests whose queue_ttl_s or deadline_s has
         elapsed (both measured from arrival_time). Returns them with
         state FINISHED_TIMEOUT; the engine emits the terminal outputs."""
-        expired = []
-        for req in list(self.waiting):
-            p = req.params
-            age = now - req.arrival_time
-            if (p.queue_ttl_s is not None and age > p.queue_ttl_s) or \
-                    (p.deadline_s is not None and age > p.deadline_s):
-                self.waiting.remove(req)
-                req.state = RequestState.FINISHED_TIMEOUT
-                expired.append(req)
-        return expired
+        with self._lock:
+            expired = []
+            for req in list(self.waiting):
+                p = req.params
+                age = now - req.arrival_time
+                if (p.queue_ttl_s is not None and age > p.queue_ttl_s) \
+                        or (p.deadline_s is not None
+                            and age > p.deadline_s):
+                    self.waiting.remove(req)
+                    req.state = RequestState.FINISHED_TIMEOUT
+                    expired.append(req)
+            return expired
 
     def overdue_running(self, now: float) -> List[Request]:
         """Running requests past their deadline_s; the engine aborts them
         (finish + terminal output) at the step boundary."""
-        return [r for r in self.running
-                if r.params.deadline_s is not None
-                and (now - r.arrival_time) > r.params.deadline_s]
+        with self._lock:
+            return [r for r in self.running
+                    if r.params.deadline_s is not None
+                    and (now - r.arrival_time) > r.params.deadline_s]
 
     # ---------------------------------------------------------- scheduling
+    @holds_lock("_lock")
     def _requeue(self, req: Request):
         """Arrival-ordered insert into the waiting queue. Preemption and
         crash recovery both requeue through here so a bumped request
@@ -264,6 +296,7 @@ class Scheduler:
                 return
         self.waiting.append(req)
 
+    @holds_lock("_lock")
     def _preempt(self, victim: Request, batch: ScheduledBatch):
         """Recompute-style preemption: drop the cache, requeue in arrival
         order with the generated tokens folded into the prompt
@@ -285,11 +318,17 @@ class Scheduler:
         to having never been disturbed. Freed blocks are scrubbed — a
         poisoned step may have scattered NaN into them, and NaN (unlike
         finite garbage) survives the attention length-mask via 0*NaN."""
-        self.running.remove(req)
-        self.cache.free(req.request_id, scrub=True)
-        self._requeue(req)
+        with self._lock:
+            self.running.remove(req)
+            self.cache.free(req.request_id, scrub=True)
+            self._requeue(req)
 
     def schedule(self) -> ScheduledBatch:
+        with self._lock:
+            return self._schedule_locked()
+
+    @holds_lock("_lock")
+    def _schedule_locked(self) -> ScheduledBatch:
         batch = ScheduledBatch()
         # 1. decode slots, earliest arrival first; preempt from the back
         for req in sorted(self.running, key=lambda r: r.arrival):
@@ -341,7 +380,8 @@ class Scheduler:
         zeroes the freed blocks device-side — required when quarantining
         a poisoned request whose blocks may hold NaN (see
         requeue_for_recovery)."""
-        self.running.remove(req)
-        self.cache.free(req.request_id, scrub=scrub)
-        req.slot = None
-        req.state = state
+        with self._lock:
+            self.running.remove(req)
+            self.cache.free(req.request_id, scrub=scrub)
+            req.slot = None
+            req.state = state

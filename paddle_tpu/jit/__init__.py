@@ -292,6 +292,8 @@ def save(layer, path, input_spec=None, **configs):
         state_spec = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
         try:
+            # ptlint: disable=PT-T004  (export path: jit built once per
+            # save() call, traced on specs, never dispatched)
             exported = jax.export.export(jax.jit(pure))(state_spec, *specs)
         except Exception:
             if not any(any(d in (-1, None) for d in s.shape)
@@ -306,6 +308,7 @@ def save(layer, path, input_spec=None, **configs):
             concrete = [jax.ShapeDtypeStruct(
                 tuple(1 if d in (-1, None) else d for d in s.shape),
                 s.dtype) for s in input_spec]
+            # ptlint: disable=PT-T004  (same export-only jit as above)
             exported = jax.export.export(jax.jit(pure))(state_spec,
                                                         *concrete)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

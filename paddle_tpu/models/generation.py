@@ -411,11 +411,14 @@ def export_decoder(model, path_prefix: str):
     Tp = S // 2
     b = jexport.symbolic_shape("b")[0]
     ids_spec = jax.ShapeDtypeStruct((b, Tp), jnp.int32)
+    # ptlint: disable=PT-T004  (export path: jit built once per
+    # export_decoder() call, traced on specs, never dispatched)
     ex_prefill = jexport.export(jax.jit(prefill_fn))(ids_spec)
     leaf = jax.ShapeDtypeStruct((b, H, S, D), jnp.float32)
     cache_spec = tuple((leaf, leaf) for _ in range(L))
     tok_spec = jax.ShapeDtypeStruct((b,), jnp.int32)
     pos_spec = jax.ShapeDtypeStruct((), jnp.int32)
+    # ptlint: disable=PT-T004  (same export-only jit as above)
     ex_decode = jexport.export(jax.jit(decode_fn))(cache_spec, tok_spec,
                                                    pos_spec)
     d = os.path.dirname(path_prefix)

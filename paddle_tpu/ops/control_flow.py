@@ -75,12 +75,15 @@ def _as_bool(x):
 @op("conditional_block")
 def _cond_op(pred, operands, true_fn, false_fn):
     def t(ops_):
+        # ptlint: disable=PT-T001  (`if ops_` tests tuple EMPTINESS —
+        # static pytree structure, not a traced element value)
         out = true_fn(*[Tensor(a) for a in ops_]) if ops_ else true_fn()
         return jax.tree_util.tree_map(
             lambda o: o._value if isinstance(o, Tensor) else o, out,
             is_leaf=lambda o: isinstance(o, Tensor))
 
     def f(ops_):
+        # ptlint: disable=PT-T001  (same static tuple-emptiness test)
         out = false_fn(*[Tensor(a) for a in ops_]) if ops_ else false_fn()
         return jax.tree_util.tree_map(
             lambda o: o._value if isinstance(o, Tensor) else o, out,

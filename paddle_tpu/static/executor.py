@@ -192,6 +192,8 @@ class Executor:
 
         # donate the written persistables: param updates reuse their own
         # device buffers (in-place semantics, zero copy)
+        # ptlint: disable=PT-T004  (_build is called once per program
+        # cache key; Executor.run caches the result in self._cache)
         return jax.jit(f, donate_argnums=(1,))
 
     def run(self, program: Optional[Program] = None, feed=None,

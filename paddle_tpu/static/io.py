@@ -111,8 +111,11 @@ def save_inference_model(path_prefix: str, feed_vars: List[Variable],
         return out
 
     try:  # dynamic batch via symbolic dims; fall back to concrete shapes
+        # ptlint: disable=PT-T004  (export-only jits: built once per
+        # save_inference_model call, traced on specs, never dispatched)
         exported = jexport.export(jax.jit(infer_fn))(*_args(True))
     except Exception:
+        # ptlint: disable=PT-T004  (fallback arm of the same export)
         exported = jexport.export(jax.jit(infer_fn))(*_args(False))
     d = os.path.dirname(path_prefix)
     if d:

@@ -1,0 +1,37 @@
+"""PT-C001 true negatives: every guarded access is either under
+`with self._lock:` or in a method annotated @holds_lock("_lock").
+Zero findings.
+
+Lint fixture — parsed by ptlint, never executed (holds_lock is a
+local stand-in; the rule matches the decorator by name).
+"""
+import threading
+
+
+def holds_lock(*locks):
+    def wrap(fn):
+        return fn
+    return wrap
+
+
+class SafePool:
+    _GUARDED_BY = {"items": "_lock", "hits": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.hits = 0
+
+    def take(self):
+        with self._lock:
+            if self.items:
+                return self.items.pop()
+            return None
+
+    @holds_lock("_lock")
+    def _bump_locked(self):
+        self.hits += 1
+
+    def record(self):
+        with self._lock:
+            self._bump_locked()

@@ -1,0 +1,23 @@
+"""PT-C001 true positives: fields declared in _GUARDED_BY touched
+without holding the mapped lock.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import threading
+
+
+class Pool:
+    _GUARDED_BY = {"items": "_lock", "hits": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.hits = 0
+
+    def take(self):
+        if self.items:  # expect: PT-C001
+            return self.items.pop()  # expect: PT-C001
+        return None
+
+    def bump(self):
+        self.hits += 1  # expect: PT-C001

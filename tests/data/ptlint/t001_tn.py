@@ -1,0 +1,40 @@
+"""PT-T001 true negatives: branching that is STATIC under tracing —
+shape/dtype metadata, identity checks, closure config. Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def rank_dispatch(x):
+    # shape metadata is static under jax tracing: legal specialization
+    if x.ndim == 4:
+        return x.sum(axis=(2, 3))
+    return x
+
+
+@jax.jit
+def maybe_bias(x, bias=None):
+    # identity check: decided at trace time, never reads the tracer
+    if bias is not None:
+        x = x + bias
+    return x
+
+
+@jax.jit
+def dtype_guard(x):
+    if x.dtype == jnp.float32:
+        return x
+    return x.astype(jnp.float32)
+
+
+def make_scaler(scale):
+    @jax.jit
+    def run(x):
+        # `scale` is a closure constant, not a traced argument
+        if scale > 1.0:
+            return x * scale
+        return x
+    return run

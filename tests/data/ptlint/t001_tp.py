@@ -1,0 +1,28 @@
+"""PT-T001 true positives: Python control flow on traced values.
+
+Lint fixture — parsed by ptlint, never executed. Lines tagged
+`# expect: RULE` must each produce exactly that finding.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_or_zero(x):
+    if x > 0:  # expect: PT-T001
+        return x
+    return jnp.zeros_like(x)
+
+
+@jax.jit
+def count_up(x):
+    while x < 10:  # expect: PT-T001
+        x = x + 1
+    return x
+
+
+@jax.jit
+def checked(x):
+    total = jnp.sum(x)
+    assert total > 0, "empty batch"  # expect: PT-T001
+    return total

@@ -1,0 +1,26 @@
+"""PT-T002 true negatives: numpy on trace-time constants, jnp on
+traced values, host reads in eager (unjitted) code. Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def const_table(x):
+    # numpy over a literal: a trace-time constant, no tracer involved
+    table = np.asarray([0.5, 0.25, 0.125])
+    return x * table[0]
+
+
+@jax.jit
+def stays_on_device(x):
+    # jnp keeps the value on device; no host materialization
+    return jnp.asarray(x, jnp.float32).sum()
+
+
+def eager_fetch(x):
+    # not a jitted scope: host reads are the normal thing to do here
+    return float(np.asarray(x).sum())

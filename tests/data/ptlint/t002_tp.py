@@ -1,0 +1,23 @@
+"""PT-T002 true positives: host materialization of traced values
+inside jitted scopes (device→host syncs in the compiled program).
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+import numpy as np
+
+
+@jax.jit
+def mean_to_float(x):
+    return float(x.mean())  # expect: PT-T002
+
+
+@jax.jit
+def to_numpy(x):
+    host = np.asarray(x)  # expect: PT-T002
+    return host
+
+
+@jax.jit
+def scalar_read(x):
+    return x.item()  # expect: PT-T002

@@ -1,0 +1,25 @@
+"""PT-T003 true negatives: LOCAL scratch structures inside the traced
+function are trace-time-only helpers and are fine. Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def stack_rows(xs):
+    # local list build-up: standard unrolled-loop idiom (cf. prefill's
+    # per-layer cache list)
+    rows = []
+    for i in range(4):
+        rows.append(xs[i] * i)
+    return jnp.stack(rows)
+
+
+@jax.jit
+def local_env(x):
+    env = {}
+    env["doubled"] = x * 2
+    env.update(tripled=x * 3)
+    return env["doubled"] + env["tripled"]

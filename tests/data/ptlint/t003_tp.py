@@ -1,0 +1,31 @@
+"""PT-T003 true positives: Python side effects under trace — the
+mutation runs ONCE at trace time, then never again.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+
+_CALLS = []
+_TOTAL = 0
+
+
+@jax.jit
+def log_call(x):
+    _CALLS.append("called")  # expect: PT-T003
+    return x * 2
+
+
+@jax.jit
+def accumulate(x):
+    global _TOTAL  # expect: PT-T003
+    return x
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    @jax.jit
+    def bump(self, x):
+        self.count = self.count + 1  # expect: PT-T003
+        return x + self.count

@@ -1,0 +1,27 @@
+"""PT-T004 true negatives: jit built once — at module scope, behind a
+memoizing decorator, or stored on self at init time. Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_SUM = jax.jit(jnp.sum)
+
+
+@functools.lru_cache(maxsize=None)
+def compiled_scaler(scale):
+    def run(x):
+        return x * scale
+    return jax.jit(run)
+
+
+class Stepper:
+    def __init__(self, f):
+        # constructed once per instance and cached on self
+        self._step = jax.jit(f)
+
+    def __call__(self, x):
+        return self._step(x)

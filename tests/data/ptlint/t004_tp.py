@@ -1,0 +1,19 @@
+"""PT-T004 true positives: jax.jit constructed per call / per loop
+iteration — every construction is a fresh compilation cache.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def sum_all(batches):
+    out = []
+    for b in batches:
+        fn = jax.jit(jnp.sum)  # expect: PT-T004
+        out.append(fn(b))
+    return out
+
+
+def apply_once(f, x):
+    return jax.jit(f)(x)  # expect: PT-T004

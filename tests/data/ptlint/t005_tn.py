@@ -1,0 +1,25 @@
+"""PT-T005 true negatives: hashable statics (tuples, strings, ints).
+Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile_ok(x, reps=(2, 2)):
+    return jnp.tile(x, reps)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def reduce_ok(x, op="sum", axis=0):
+    if op == "sum":
+        return x.sum(axis=axis)
+    return x.max(axis=axis)
+
+
+def run(x):
+    return tile_ok(x, (2, 2)) + reduce_ok(x, "sum", 0)

@@ -1,0 +1,18 @@
+"""PT-T005 true positives: unhashable values in static_argnums
+positions — jit's cache key requires hashable statics.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile(x, reps=[2, 2]):  # expect: PT-T005
+    return jnp.tile(x, reps)
+
+
+def run(x):
+    return tile(x, [2, 2])  # expect: PT-T005

@@ -1,0 +1,20 @@
+"""PT-T006 true negatives: jax.random with an explicit key inside the
+trace, and host RNG in eager setup code. Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import random
+
+import jax
+
+
+@jax.jit
+def add_noise(x, key):
+    # functional RNG: the key is data, the draw is part of the program
+    return x + jax.random.normal(key, x.shape)
+
+
+def eager_seed():
+    # host RNG outside any traced scope is ordinary Python
+    random.seed(0)
+    return random.random()

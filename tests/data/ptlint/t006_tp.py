@@ -1,0 +1,22 @@
+"""PT-T006 true positives: host RNG under trace — the draw happens
+once at trace time and is baked into the program as a constant.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import random
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def add_noise(x):
+    noise = np.random.normal(size=(4,))  # expect: PT-T006
+    return x + noise
+
+
+@jax.jit
+def maybe_flip(x):
+    if random.random() < 0.5:  # expect: PT-T006
+        return -x
+    return x

@@ -1,0 +1,283 @@
+"""Tier-1 tests for the ptlint static-analysis suite.
+
+Three layers, mirroring the suite itself:
+
+  1. fixture corpus   — every rule is proven LIVE on a true-positive
+                        file (finding lines == `# expect:` markers) and
+                        QUIET on a matching true-negative file;
+  2. engine mechanics — suppressions, baseline write/check, CLI exit
+                        codes (subprocess, no jax import on plain runs);
+  3. jaxpr audit      — forbidden primitives / const bloat / downcasts
+                        each trip on a crafted function, and the real
+                        compiled entry points (TrainStep + the four
+                        decode sub-programs) audit clean.
+
+The repo self-check (`test_repo_tree_is_clean`) is the gate: any new
+unsuppressed finding under paddle_tpu/ fails tier-1.
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import LintEngine, load_baseline, write_baseline
+from paddle_tpu.analysis.rules import RULE_CATALOG
+
+pytestmark = pytest.mark.lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXDIR = REPO / "tests" / "data" / "ptlint"
+PTLINT = REPO / "tools" / "ptlint.py"
+FIXTURES = sorted(FIXDIR.glob("*.py"))
+
+
+def _rule_of(stem: str) -> str:
+    return "PT-" + stem.split("_")[0].upper()
+
+
+# --------------------------------------------------------------- fixtures
+def test_every_rule_has_tp_and_tn_fixtures():
+    stems = {p.stem for p in FIXTURES}
+    for rid in RULE_CATALOG:
+        key = rid[3:].lower()
+        assert f"{key}_tp" in stems, f"{rid} has no true-positive fixture"
+        assert f"{key}_tn" in stems, f"{rid} has no true-negative fixture"
+
+
+@pytest.mark.parametrize("path", FIXTURES, ids=lambda p: p.stem)
+def test_fixture(path):
+    rule = _rule_of(path.stem)
+    report = LintEngine(select={rule}).lint_paths([str(path)])
+    assert not report.parse_errors
+    got = sorted(f.line for f in report.findings)
+    want = sorted(
+        i + 1 for i, line in enumerate(path.read_text().splitlines())
+        if f"# expect: {rule}" in line)
+    if path.stem.endswith("_tp"):
+        assert len(want) >= 2, "TP fixture needs >= 2 # expect markers"
+    else:
+        assert not want, "TN fixture must not carry # expect markers"
+    assert got == want, "\n".join(f.format() for f in report.findings)
+    assert all(f.rule == rule for f in report.findings)
+
+
+# ------------------------------------------------------- repo self-check
+def test_repo_tree_is_clean():
+    """The zero-unsuppressed-findings gate over the shipped package."""
+    report = LintEngine().lint_paths(
+        [str(REPO / "paddle_tpu")], root=str(REPO))
+    assert not report.parse_errors, report.parse_errors
+    assert report.files > 100  # the walk actually covered the tree
+    assert not report.findings, \
+        "\n".join(f.format() for f in report.sorted_findings())
+
+
+def test_shipped_baseline_is_empty():
+    assert load_baseline(str(REPO / "ptlint_baseline.json")) == set()
+
+
+# ------------------------------------------------------------ suppression
+_NOISY = (
+    "import jax\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    if x > 0:{}\n"
+    "        return x\n"
+    "    return -x\n"
+)
+
+
+def test_inline_disable_suppresses_and_is_reported():
+    clean = LintEngine().lint_source(
+        _NOISY.format("  # ptlint: disable=PT-T001  fixture"), "mod.py")
+    assert not clean.findings
+    assert [f.rule for f in clean.suppressed] == ["PT-T001"]
+
+    dirty = LintEngine().lint_source(_NOISY.format(""), "mod.py")
+    assert [f.rule for f in dirty.findings] == ["PT-T001"]
+
+
+def test_comment_line_disable_rides_to_next_code_line():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    # ptlint: disable=PT-T001\n"
+        "    # reason spanning a second comment line\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    report = LintEngine().lint_source(src, "mod.py")
+    assert not report.findings
+    assert [f.rule for f in report.suppressed] == ["PT-T001"]
+
+
+def test_disable_file_and_disable_all():
+    src = "# ptlint: disable-file=PT-T001\n" + _NOISY.format("")
+    assert not LintEngine().lint_source(src, "mod.py").findings
+    src = _NOISY.format("  # ptlint: disable=all")
+    assert not LintEngine().lint_source(src, "mod.py").findings
+
+
+def test_wrong_rule_disable_does_not_suppress():
+    src = _NOISY.format("  # ptlint: disable=PT-T002")
+    assert [f.rule
+            for f in LintEngine().lint_source(src, "mod.py").findings] \
+        == ["PT-T001"]
+
+
+# --------------------------------------------------------------- baseline
+def test_baseline_roundtrip(tmp_path):
+    report = LintEngine().lint_source(_NOISY.format(""), "mod.py")
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), report.findings)
+    known = load_baseline(str(bl))
+    assert known == {f.fingerprint() for f in report.findings}
+    payload = json.loads(bl.read_text())
+    assert payload["version"] == 1 and len(payload["findings"]) == 1
+
+
+# -------------------------------------------------------------------- CLI
+def _cli(*args, **kw):
+    env = dict(os.environ)
+    return subprocess.run(
+        [sys.executable, str(PTLINT), *args],
+        capture_output=True, text=True, env=env, cwd=str(REPO), **kw)
+
+
+def test_cli_clean_file_exits_zero():
+    res = _cli(str(FIXDIR / "t001_tn.py"))
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_findings_exit_one_and_name_the_rule():
+    res = _cli("--select", "PT-T001", str(FIXDIR / "t001_tp.py"))
+    assert res.returncode == 1
+    assert "PT-T001" in res.stdout
+
+
+def test_cli_unknown_rule_exits_two():
+    res = _cli("--select", "PT-X999", str(FIXDIR / "t001_tn.py"))
+    assert res.returncode == 2
+    assert "unknown rule" in res.stderr
+
+
+def test_cli_json_format_is_parseable():
+    res = _cli("--format", "json", "--select", "PT-T002",
+               str(FIXDIR / "t002_tp.py"))
+    payload = json.loads(res.stdout)
+    assert len(payload["findings"]) == 3
+    assert {f["rule"] for f in payload["findings"]} == {"PT-T002"}
+
+
+def test_cli_baseline_check_gates_new_findings(tmp_path):
+    """`--baseline check` passes on known findings, fails on new ones."""
+    bl = tmp_path / "bl.json"
+    tp = str(FIXDIR / "t004_tp.py")
+
+    res = _cli("--baseline", "write", "--baseline-file", str(bl), tp)
+    assert res.returncode == 0
+
+    res = _cli("--baseline", "check", "--baseline-file", str(bl), tp)
+    assert res.returncode == 0, res.stdout  # all findings are known
+
+    extra = tmp_path / "new_violation.py"
+    extra.write_text(
+        "import jax\nimport jax.numpy as jnp\n"
+        "def g(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(jnp.sum)(x)\n")
+    res = _cli("--baseline", "check", "--baseline-file", str(bl),
+               tp, str(extra))
+    assert res.returncode == 1
+    assert "new_violation.py" in res.stdout
+
+
+# ------------------------------------------------------------ jaxpr audit
+def test_audit_flags_host_callback():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.analysis import jaxpr_audit
+
+    def f(x):
+        spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return jax.pure_callback(lambda v: np.asarray(v) * 2, spec, x)
+
+    issues = jaxpr_audit.audit_fn(f, jnp.ones((4,)), name="cb",
+                                  checks=("callbacks",))
+    assert issues and all(i.kind == "callback" for i in issues)
+    with pytest.raises(jaxpr_audit.JaxprAuditError):
+        jaxpr_audit.assert_clean(issues)
+
+
+def test_audit_flags_oversized_captured_const():
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import jaxpr_audit
+
+    big = jnp.zeros((600, 600), jnp.float32)          # ~1.4 MiB
+
+    def f(x):
+        return x + big
+
+    issues = jaxpr_audit.audit_fn(f, jnp.ones((600, 600)), name="bloat",
+                                  checks=("consts",))
+    assert issues and all(i.kind == "const" for i in issues)
+
+    # raising the budget clears it: the check is thresholded, not blanket
+    assert not jaxpr_audit.audit_fn(
+        f, jnp.ones((600, 600)), name="bloat", checks=("consts",),
+        max_const_bytes=4 << 20)
+
+
+def test_audit_flags_float_downcast():
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import jaxpr_audit
+
+    def f(x):
+        return (x.astype(jnp.bfloat16) * 2).astype(jnp.float32)
+
+    issues = jaxpr_audit.audit_fn(f, jnp.ones((4,), jnp.float32),
+                                  name="amp", checks=("downcasts",))
+    assert issues and all(i.kind == "downcast" for i in issues)
+    # int casts are not downcasts
+    assert not jaxpr_audit.audit_fn(
+        lambda x: x.astype(jnp.int8), jnp.ones((4,), jnp.int32),
+        name="ints", checks=("downcasts",))
+
+
+def test_compiled_entry_points_audit_clean():
+    """Acceptance: TrainStep + the four decode sub-programs carry no
+    host callbacks / device_get and no oversized captured constants."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.analysis import jaxpr_audit
+    from paddle_tpu.models import generation
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=24)
+    model = GPT(cfg)
+    geom = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
+    params = generation.extract_params(model)
+    issues = jaxpr_audit.audit_decode_programs(params, geom)
+    assert not issues, "\n".join(i.format() for i in issues)
+
+    def loss_fn(m, x, y):
+        logits = m(x)
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), y.reshape([-1]))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    x = paddle.to_tensor([[1, 2, 3, 4]], dtype="int64")
+    y = paddle.to_tensor([[2, 3, 4, 5]], dtype="int64")
+    issues = jaxpr_audit.audit_train_step(step, x, y)
+    assert not issues, "\n".join(i.format() for i in issues)

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""ptlint CLI: framework-aware static analysis for paddle_tpu.
+
+    python tools/ptlint.py [paths...]              lint (default: paddle_tpu/)
+    python tools/ptlint.py --format json           machine output
+    python tools/ptlint.py --baseline write        snapshot current findings
+    python tools/ptlint.py --baseline check        fail only on NEW findings
+    python tools/ptlint.py --select PT-T004        run a subset of rules
+    python tools/ptlint.py --audit                 also trace-audit the
+                                                   compiled entry points
+                                                   (imports jax; slower)
+
+Exit status: 0 clean, 1 findings (or new-vs-baseline findings), 2 usage/
+parse errors. The lint core is stdlib-only — plain runs never import jax.
+
+Rule catalog: docs/static_analysis.md. Suppress a single site with
+`# ptlint: disable=RULE  <reason>`; the shipped tree carries an EMPTY
+baseline (ptlint_baseline.json) so every new finding fails CI.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# import `analysis` as a top-level package so the lint core loads
+# without importing paddle_tpu/__init__ (which pulls in jax) — then
+# drop the path entry again: paddle_tpu/ holds Paddle-parity modules
+# (sysconfig.py, ...) that would shadow the stdlib for later imports
+_PKG_DIR = os.path.join(_REPO, "paddle_tpu")
+sys.path.insert(0, _PKG_DIR)
+try:
+    import analysis  # noqa: E402
+    from analysis import (LintEngine, load_baseline,  # noqa: E402
+                          write_baseline)
+    from analysis.rules import RULE_CATALOG  # noqa: E402
+finally:
+    sys.path.remove(_PKG_DIR)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "ptlint_baseline.json")
+
+
+def _run_audit() -> int:
+    """Trace-audit the compiled entry points on a tiny GPT: TrainStep
+    and the four decode sub-programs. Needs jax (CPU is fine)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, _REPO)
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.analysis import jaxpr_audit
+    from paddle_tpu.models import generation
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=24)
+    model = GPT(cfg)
+    geom = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
+    params = generation.extract_params(model)
+    issues = jaxpr_audit.audit_decode_programs(params, geom)
+
+    def loss_fn(m, x, y):
+        logits = m(x)
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), y.reshape([-1]))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    x = paddle.to_tensor([[1, 2, 3, 4]], dtype="int64")
+    y = paddle.to_tensor([[2, 3, 4, 5]], dtype="int64")
+    issues += jaxpr_audit.audit_train_step(step, x, y)
+
+    for issue in issues:
+        print(issue.format())
+    if issues:
+        print(f"jaxpr audit: {len(issues)} issue(s)")
+        return 1
+    print("jaxpr audit: TrainStep + 4 decode sub-programs clean")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ptlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_REPO, "paddle_tpu")])
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", choices=("write", "check"))
+    ap.add_argument("--baseline-file", default=DEFAULT_BASELINE)
+    ap.add_argument("--select", action="append", default=[],
+                    metavar="RULE", help="only run these rule ids")
+    ap.add_argument("--ignore", action="append", default=[],
+                    metavar="RULE", help="skip these rule ids")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--audit", action="store_true",
+                    help="also run the trace-time jaxpr audit (needs jax)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (sev, desc) in sorted(RULE_CATALOG.items()):
+            print(f"{rid}  [{sev:7s}]  {desc}")
+        return 0
+
+    unknown = [r for r in args.select + args.ignore
+               if r not in RULE_CATALOG]
+    if unknown:
+        print(f"ptlint: unknown rule id(s): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+
+    engine = LintEngine(select=set(args.select) or None,
+                        ignore=set(args.ignore))
+    report = engine.lint_paths(args.paths, root=_REPO)
+
+    if args.baseline == "write":
+        write_baseline(args.baseline_file, report.findings)
+        print(f"ptlint: wrote {len(report.findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline_file, _REPO)}")
+        return 0
+
+    findings = report.sorted_findings()
+    if args.baseline == "check":
+        known = load_baseline(args.baseline_file)
+        findings = [f for f in findings if f.fingerprint() not in known]
+
+    if args.format == "json":
+        payload = report.as_dict()
+        payload["findings"] = [f.as_dict() for f in findings]
+        if args.show_suppressed:
+            payload["suppressed_findings"] = [
+                f.as_dict() for f in report.suppressed]
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.format())
+        if args.show_suppressed:
+            for f in report.suppressed:
+                print(f"{f.format()}  (suppressed)")
+        for err in report.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        label = "new finding(s)" if args.baseline == "check" else \
+            "finding(s)"
+        print(f"ptlint: {report.files} file(s), {len(findings)} {label}, "
+              f"{len(report.suppressed)} suppressed")
+
+    rc = 0
+    if findings:
+        rc = 1
+    if report.parse_errors:
+        rc = 2
+    if args.audit:
+        rc = max(rc, _run_audit())
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
