@@ -23,6 +23,11 @@ _PEAK_FLOPS = {
     "TPU v5e": 197e12, "TPU v5 lite": 197e12, "TPU v4": 275e12,
     "TPU v5p": 459e12, "TPU v6e": 918e12,
 }
+# HBM bandwidth per chip (B/s); the bytes leg of the static roofline
+_PEAK_HBM_BW = {
+    "TPU v5e": 819e9, "TPU v5 lite": 819e9, "TPU v4": 1228e9,
+    "TPU v5p": 2765e9, "TPU v6e": 1640e9,
+}
 
 
 def _peak_flops(dev) -> float:
@@ -31,6 +36,39 @@ def _peak_flops(dev) -> float:
         if k.lower() in str(kind).lower():
             return v
     return 197e12
+
+
+def _hbm_bw(dev) -> float:
+    kind = getattr(dev, "device_kind", "")
+    for k, v in _PEAK_HBM_BW.items():
+        if k.lower() in str(kind).lower():
+            return v
+    return 819e9
+
+
+#: side channel: bench_* fns drop their jaxcost static estimates here so
+#: main() can print them next to the measurements without changing any
+#: bench function's return signature
+_STATIC_EST: dict = {}
+
+
+def _static_entry(cost, tokens_per_call: int, dev=None) -> dict:
+    """One static_model JSON entry from a jaxcost ProgramCost. With a
+    device, adds the MXU roofline tokens/s = tokens / (flops / peak) —
+    the compute ceiling; measured/roofline is the achieved MFU as the
+    static model counts it. The byte totals are jaxpr-level (pre-fusion)
+    traffic: an upper bound on HBM bytes useful for budget gating, NOT a
+    bandwidth bound, so they stay out of the roofline. unfused_hbm_s is
+    that pessimistic bytes/bandwidth time, labeled as such."""
+    entry = {"flops": cost.flops,
+             "bytes": cost.bytes_read + cost.bytes_written,
+             "peak_bytes": cost.peak_bytes,
+             "tokens_per_call": tokens_per_call}
+    if dev is not None and cost.flops > 0:
+        entry["roofline_tokens_per_sec"] = round(
+            tokens_per_call * _peak_flops(dev) / cost.flops, 1)
+        entry["unfused_hbm_s"] = round(entry["bytes"] / _hbm_bw(dev), 4)
+    return entry
 
 
 def _best_of(run_window, windows: int) -> float:
@@ -101,6 +139,13 @@ def bench_gpt(on_tpu: bool, num_heads: int = 6, iters: int = 30):
     from paddle_tpu.analysis.jaxpr_audit import audit_train_step
     _audit_or_die(audit_train_step(step, x, y,
                                    checks=("callbacks", "consts")))
+
+    # static cost model of the exact program about to be timed, reported
+    # next to the measurement (jaxcost; trace-only, costs no device work)
+    from paddle_tpu.analysis.jaxcost import estimate_train_step
+    _STATIC_EST["train_step"] = _static_entry(
+        estimate_train_step(step, x, y), batch * seq,
+        jax.devices()[0] if on_tpu else None)
 
     # warmup/compile
     step(x, y)
@@ -417,6 +462,14 @@ def bench_decode(on_tpu: bool):
             cfg.hidden_size // cfg.num_heads, cfg.max_seq_len)
     _audit_or_die(audit_decode_programs(extract_params(model), geom,
                                         checks=("callbacks", "consts")))
+
+    # static cost of one full dense decode step at the serving batch,
+    # next to the measured decode tokens/s (one token/seq per call)
+    import jax
+    from paddle_tpu.analysis.jaxcost import estimate_decode_step
+    _STATIC_EST["decode_step"] = _static_entry(
+        estimate_decode_step(extract_params(model), geom, bs), bs,
+        jax.devices()[0] if on_tpu else None)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (bs, prompt), dtype=np.int32)
     short = new // 3
@@ -628,9 +681,19 @@ def main():
                 rn_mfu * 23.8e9 / (3 * 4.1e9), 4)
         dc, _ = bench_decode(on_tpu)
         line["gpt_decode_tokens_per_sec"] = round(dc, 1)
+        if "roofline_tokens_per_sec" in _STATIC_EST.get("decode_step", {}):
+            _STATIC_EST["decode_step"]["measured_vs_roofline"] = round(
+                dc / _STATIC_EST["decode_step"]["roofline_tokens_per_sec"],
+                4)
         sd, sd_detail = bench_serve_decode(on_tpu)
         line["serve_decode_tokens_per_sec"] = round(sd, 1)
         line["serve_decode_detail"] = sd_detail
+    ts = _STATIC_EST.get("train_step", {})
+    if "roofline_tokens_per_sec" in ts:
+        ts["measured_vs_roofline"] = round(
+            tokens_per_sec / ts["roofline_tokens_per_sec"], 4)
+    if _STATIC_EST:
+        line["static_model"] = _STATIC_EST
     print(json.dumps(line))
 
 

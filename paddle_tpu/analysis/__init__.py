@@ -13,11 +13,18 @@ see until they break in production (docs/static_analysis.md):
   analogue of the reference's graph-pass validation in
   paddle/fluid/framework/ir);
 - lock discipline: shared serving state annotated in a `_GUARDED_BY`
-  map is only touched while holding its lock (rules/concurrency.py).
+  map is only touched while holding its lock (rules/concurrency.py);
+- static cost: jaxcost.py + liveness.py model FLOPs, bytes, collective
+  volume and peak live-buffer bytes of every registered jitted program
+  from its jaxpr, gate them against jaxcost_budget.json, and audit
+  buffer donation (docs/static_cost.md); hlo_bytes.py is the shared
+  HLO-text byte accounting used by tools/hlo_bytes.py and
+  tools/scaling_analysis.py.
 
-The lint core (ast_core + rules) is stdlib-only so `tools/ptlint.py`
-runs without importing jax; `jaxpr_audit` needs jax and is imported on
-demand.
+The lint core (ast_core + rules + hlo_bytes) is stdlib-only so
+`tools/ptlint.py` and `tools/hlo_bytes.py` run without importing jax;
+`jaxpr_audit`, `liveness` and `jaxcost` need jax and are imported on
+demand (never from this __init__).
 """
 from __future__ import annotations
 

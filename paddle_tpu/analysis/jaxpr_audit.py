@@ -32,7 +32,8 @@ import numpy as np
 
 __all__ = ["AuditIssue", "JaxprAuditError", "FORBIDDEN_PRIMITIVES",
            "audit_jaxpr", "audit_fn", "audit_train_step",
-           "audit_decode_programs", "assert_clean"]
+           "audit_decode_programs", "assert_clean",
+           "train_step_args", "decode_programs"]
 
 #: primitives that smuggle host work into a compiled program
 FORBIDDEN_PRIMITIVES = frozenset({
@@ -184,15 +185,13 @@ def assert_clean(issues: Sequence[AuditIssue]) -> None:
 
 
 # ----------------------------------------------------------- entry points
-def audit_decode_programs(params, geom,
-                          batch: int = 2,
-                          checks: Sequence[str] = DEFAULT_CHECKS,
-                          max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
-                          ) -> List[AuditIssue]:
-    """Audit the four decode sub-programs every decode path (dense
+def decode_programs(params, geom, batch: int = 2):
+    """[(name, fn, example_args, static_argnums), ...] for the five
+    top-level jitted decode sub-programs every decode path (dense
     generate() AND paged serving) compiles: _token_embed, _decode_qkv,
-    _decode_attn, _decode_head. `params`/`geom` as for
-    models.generation (geom = (L, H, D, S))."""
+    _cache_write, _decode_attn, _decode_head. `params`/`geom` as for
+    models.generation (geom = (L, H, D, S)). Shared by the trace-time
+    audit below and jaxcost's cost/donation registry."""
     from ..models import generation as g
 
     L, H, D, S = geom
@@ -205,31 +204,39 @@ def audit_decode_programs(params, geom,
     q = jnp.zeros((B, H, 1, D), dtype)
     kc = jnp.zeros((B, H, S, D), dtype)
     vc = jnp.zeros((B, H, S, D), dtype)
+    k_new = jnp.zeros((B, H, 1, D), dtype)
+    v_new = jnp.zeros((B, H, 1, D), dtype)
+    pos = jnp.zeros((), jnp.int32)
+    return [
+        ("token_embed", g._token_embed,
+         (params, tokens, positions), ()),
+        ("qkv", g._decode_qkv, (params, 0, x, geom), (1, 3)),
+        ("cache_write", g._cache_write,
+         (kc, vc, k_new, v_new, pos), ()),
+        ("attn", g._decode_attn,
+         (params, 0, x, q, kc, vc, positions, geom), (1, 7)),
+        ("head", g._decode_head, (params, x), ()),
+    ]
 
+
+def audit_decode_programs(params, geom,
+                          batch: int = 2,
+                          checks: Sequence[str] = DEFAULT_CHECKS,
+                          max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
+                          ) -> List[AuditIssue]:
+    """Audit the decode sub-programs (see `decode_programs`)."""
     issues: List[AuditIssue] = []
-    issues += audit_fn(g._token_embed, params, tokens, positions,
-                       name="_token_embed", checks=checks,
-                       max_const_bytes=max_const_bytes)
-    issues += audit_fn(g._decode_qkv, params, 0, x, geom,
-                       name="_decode_qkv", static_argnums=(1, 3),
-                       checks=checks, max_const_bytes=max_const_bytes)
-    issues += audit_fn(g._decode_attn, params, 0, x, q, kc, vc,
-                       positions, geom,
-                       name="_decode_attn", static_argnums=(1, 7),
-                       checks=checks, max_const_bytes=max_const_bytes)
-    issues += audit_fn(g._decode_head, params, x,
-                       name="_decode_head", checks=checks,
-                       max_const_bytes=max_const_bytes)
+    for name, fn, args, static in decode_programs(params, geom, batch):
+        issues += audit_fn(fn, *args, name=f"decode.{name}",
+                           static_argnums=static, checks=checks,
+                           max_const_bytes=max_const_bytes)
     return issues
 
 
-def audit_train_step(step, *batch,
-                     checks: Sequence[str] = DEFAULT_CHECKS,
-                     max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
-                     ) -> List[AuditIssue]:
-    """Audit a jit.TrainStep's full compiled program (fwd + bwd +
-    optimizer) against an example batch, mirroring the argument
-    assembly of TrainStep._dispatch without running the step."""
+def train_step_args(step, *batch):
+    """Assemble the example argument tuple for a jit.TrainStep's raw
+    step — the same assembly as TrainStep._dispatch, without running
+    anything. Shared by the trace-time audit and jaxcost."""
     from ..core.tensor import Tensor
 
     params_t, frozen_t, buffers_t = step._collect_state()
@@ -246,7 +253,17 @@ def audit_train_step(step, *batch,
     rng_ctr = jnp.asarray(1, jnp.uint32)
     arr = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
            for a in batch]
-    return audit_fn(step._raw_step, params, frozen, buffers, opt_state,
-                    lr, key_root, rng_ctr, *arr,
+    return (params, frozen, buffers, opt_state, lr, key_root, rng_ctr,
+            *arr)
+
+
+def audit_train_step(step, *batch,
+                     checks: Sequence[str] = DEFAULT_CHECKS,
+                     max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
+                     ) -> List[AuditIssue]:
+    """Audit a jit.TrainStep's full compiled program (fwd + bwd +
+    optimizer) against an example batch, mirroring the argument
+    assembly of TrainStep._dispatch without running the step."""
+    return audit_fn(step._raw_step, *train_step_args(step, *batch),
                     name=type(step).__name__, checks=checks,
                     max_const_bytes=max_const_bytes)

@@ -1,0 +1,135 @@
+"""Linear-scan liveness over jaxprs -> peak live-buffer bytes.
+
+XLA frees a buffer after its last read, so the peak residency of a
+program is NOT the sum of everything it ever allocates — it is the
+maximum, over equations, of
+
+    (bytes live across the eqn) + (bytes the eqn writes)
+    + (transient extra of any sub-program the eqn runs).
+
+This module computes that maximum by a single linear scan:
+
+1. build a last-use map (eqn index of the final read of every var;
+   jaxpr outvars are pinned live to the end),
+2. walk equations in order, charging each eqn's outputs on top of the
+   current live set, releasing inputs after their last use.
+
+Sub-jaxprs (pjit bodies, scan/while carries, cond branches) recurse via
+`jaxpr_audit._sub_jaxprs` — the same traversal the trace-time auditor
+uses. A sub-program's contribution is its TRANSIENT requirement
+`max(0, sub_peak - sub_entry)`: its inputs are already counted live in
+the parent frame. For scan/while bodies the body invars are pinned live
+through the whole body (`pin_invars`) because at every iteration
+boundary the old carry coexists with the freshly produced one.
+
+Accounting conventions (deterministic, documented, testable):
+
+- literals cost 0 (inlined scalars);
+- captured consts are pinned live for the whole program (they are owned
+  by the executable);
+- dropped outputs (DropVar) are charged at their producing eqn and
+  released immediately;
+- inside `shard_map` bodies avals are per-device, so programs built
+  around shard_map report per-device residency for the mapped region.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+from .jaxpr_audit import _sub_jaxprs
+
+__all__ = ["aval_bytes", "var_bytes", "PeakReport", "peak_live_bytes"]
+
+#: primitives whose body invars stay live for the whole body: the loop
+#: carry is read at the top of every iteration while the new carry is
+#: being produced, so old and new coexist.
+_PIN_BODY = frozenset({"scan", "while"})
+
+
+def aval_bytes(aval) -> int:
+    """Size in bytes of one abstract value (0 for shapeless avals)."""
+    dtype = getattr(aval, "dtype", None)
+    itemsize = int(getattr(dtype, "itemsize", 0) or 0)
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return itemsize * n
+
+
+def _is_literal(v) -> bool:
+    return type(v).__name__ == "Literal" or hasattr(v, "val")
+
+
+def var_bytes(v) -> int:
+    """Bytes of a jaxpr var; literals are inlined and cost nothing."""
+    if _is_literal(v):
+        return 0
+    return aval_bytes(getattr(v, "aval", None))
+
+
+@dataclass(frozen=True)
+class PeakReport:
+    peak_bytes: int    # max simultaneously-live bytes
+    where: str         # "<name>:<eqn idx>:<primitive>" or "<name>:entry"
+    entry_bytes: int   # bytes live at program entry (invars + consts)
+
+
+def peak_live_bytes(jaxpr_like, name: str = "<jaxpr>",
+                    pin_invars: bool = False) -> PeakReport:
+    """Peak live-buffer bytes of a (Closed)Jaxpr by linear-scan
+    liveness. `pin_invars` keeps every invar live to the end (used for
+    scan/while bodies — loop-carry double residency)."""
+    closed = jaxpr_like if hasattr(jaxpr_like, "jaxpr") else None
+    raw = closed.jaxpr if closed is not None else jaxpr_like
+    eqns = list(raw.eqns)
+    end = len(eqns)  # sentinel: live to the end of the program
+
+    last_use: Dict[object, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last_use[v] = i
+    for v in raw.outvars:
+        if not _is_literal(v):
+            last_use[v] = end
+    for v in raw.constvars:
+        last_use[v] = end
+    if pin_invars:
+        for v in raw.invars:
+            last_use[v] = end
+
+    live: Dict[object, int] = {}
+    entry = 0
+    for v in list(raw.constvars) + list(raw.invars):
+        b = var_bytes(v)
+        entry += b
+        if v in last_use and v not in live:
+            live[v] = b
+    live_total = sum(live.values())
+
+    peak, where = entry, f"{name}:entry"
+    for i, eqn in enumerate(eqns):
+        out_b = sum(var_bytes(v) for v in eqn.outvars)
+        inner_extra = 0
+        pin = eqn.primitive.name in _PIN_BODY
+        for label, sub in _sub_jaxprs(eqn):
+            rep = peak_live_bytes(
+                sub, name=f"{name}/{eqn.primitive.name}.{label}",
+                pin_invars=pin)
+            inner_extra = max(inner_extra,
+                              max(0, rep.peak_bytes - rep.entry_bytes))
+        cur = live_total + out_b + inner_extra
+        if cur > peak:
+            peak, where = cur, f"{name}:{i}:{eqn.primitive.name}"
+        for v in eqn.outvars:
+            lu = last_use.get(v)
+            if lu is not None and lu > i and v not in live:
+                b = var_bytes(v)
+                live[v] = b
+                live_total += b
+        for v in [u for u, lu in last_use.items()
+                  if lu == i and u in live]:
+            live_total -= live.pop(v)
+
+    return PeakReport(peak_bytes=peak, where=where, entry_bytes=entry)

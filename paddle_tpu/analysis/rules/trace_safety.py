@@ -26,6 +26,11 @@ Rules (catalog in docs/static_analysis.md):
   PT-T006  host RNG under trace (np.random.* / stdlib random.* inside
            a traced scope — trace-time constants, NOT per-call
            randomness; use jax.random with a threaded key)
+  PT-T007  per-iteration host sync in a HOST-side loop
+           (.block_until_ready() / jax.device_get / np.asarray of a
+           device value inside for/while — each iteration stalls the
+           dispatch pipeline; hoist the sync out of the loop or batch
+           the transfers)
 
 Scope marking is lexical and conservative: a function is "traced" when
 it is decorated with jax.jit (directly or via functools.partial), is
@@ -67,6 +72,9 @@ TRACE_RULES = {
     "PT-T006": ("error",
                 "host RNG (np.random/stdlib random) inside a jitted "
                 "scope"),
+    "PT-T007": ("warning",
+                "per-iteration host sync (.block_until_ready/device_get/"
+                "np.asarray of a device value) inside a host-side loop"),
 }
 
 # attribute reads that are static under jax tracing (never taint)
@@ -250,7 +258,7 @@ def _bound_names(fn: ast.FunctionDef) -> Set[str]:
 
 
 class TraceSafetyRule(Rule):
-    """One analysis pass per module emitting PT-T001..PT-T006."""
+    """One analysis pass per module emitting PT-T001..PT-T007."""
 
     ids = tuple(TRACE_RULES)
 
@@ -268,6 +276,7 @@ class TraceSafetyRule(Rule):
             if info.traced and (info.parent is None
                                 or not info.parent.traced):
                 self._check_traced_unit(info)       # PT-T001/2/3/6
+        self._check_host_loop_syncs(ctx.tree)       # PT-T007
         return self.findings
 
     def _emit(self, rule_id: str, node, message: str):
@@ -798,6 +807,95 @@ class TraceSafetyRule(Rule):
                 f"'{info.node.name}' mutates closure/instance state at "
                 f"trace time only; thread it through the return value")
 
+    # --------------------------------------------------------- PT-T007
+    def _check_host_loop_syncs(self, tree: ast.Module):
+        """PT-T007: per-iteration device→host syncs in HOST loops.
+
+        Traced scopes are PT-T002's territory; this pass covers the
+        complement — module-level code and non-traced defs. For each
+        OUTERMOST for/while it flags calls that force a sync every
+        iteration: `.block_until_ready()`, `jax.block_until_ready(...)`,
+        `jax.device_get(...)`, and `np.asarray/np.array` whose argument
+        is device-derived (a direct non-numpy call, or a name the loop
+        itself assigns from one). One sync per loop body is one pipeline
+        stall per iteration — hoist it past the loop or batch the
+        transfers.
+        """
+        rule = self
+
+        def in_traced_scope(info: Optional[_FuncInfo]) -> bool:
+            while info is not None:
+                if info.traced:
+                    return True
+                info = info.parent
+            return False
+
+        loops: List[ast.stmt] = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loop_depth = [0]   # one counter per def scope
+
+            def visit_FunctionDef(self, node):
+                info = rule.funcs.get(node)
+                if in_traced_scope(info):
+                    return              # traced unit: PT-T002 covers it
+                self.loop_depth.append(0)
+                self.generic_visit(node)
+                self.loop_depth.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def _loop(self, node):
+                if self.loop_depth[-1] == 0:
+                    loops.append(node)
+                self.loop_depth[-1] += 1
+                self.generic_visit(node)
+                self.loop_depth[-1] -= 1
+
+            visit_For = _loop
+            visit_While = _loop
+
+        V().visit(tree)
+        for loop in loops:
+            self._check_one_host_loop(loop)
+
+    def _check_one_host_loop(self, loop):
+        computed = _loop_device_names(loop)
+        for node in _walk_loop(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready" \
+                    and not node.args:
+                base = _dotted(node.func.value) or "<expr>"
+                self._emit(
+                    "PT-T007", node,
+                    f"'{base}.block_until_ready()' inside a host loop "
+                    f"syncs every iteration; hoist it after the loop")
+            elif name in ("jax.block_until_ready", "block_until_ready") \
+                    and node.args:
+                self._emit(
+                    "PT-T007", node,
+                    f"'{name}(...)' inside a host loop syncs every "
+                    f"iteration; hoist it after the loop")
+            elif name in ("jax.device_get", "device_get"):
+                self._emit(
+                    "PT-T007", node,
+                    f"'{name}(...)' inside a host loop transfers "
+                    f"device→host every iteration; batch the transfers "
+                    f"or move the computation on-device")
+            elif name in ("np.asarray", "np.array", "numpy.asarray",
+                          "numpy.array") and node.args:
+                if _device_derived(node.args[0], computed):
+                    self._emit(
+                        "PT-T007", node,
+                        f"'{name}(...)' of a device value inside a host "
+                        f"loop forces a device→host sync every "
+                        f"iteration; keep the value on-device or batch "
+                        f"the transfers")
+
 
 def _param_names(fn: ast.FunctionDef) -> List[str]:
     a = fn.args
@@ -823,3 +921,85 @@ def _walk_own(fn: ast.FunctionDef):
         if isinstance(node, ast.Lambda):
             continue
         stack.extend(ast.iter_child_nodes(node))
+
+
+# ------------------------------------------------------ PT-T007 helpers
+# numpy roots: calls under these are host-side producers, never device
+_NUMPY_ROOTS = ("np", "numpy")
+
+
+def _is_numpy_rooted(name: Optional[str]) -> bool:
+    return name is not None and name.split(".")[0] in _NUMPY_ROOTS
+
+
+def _walk_loop(loop):
+    """Walk a loop's body/orelse, skipping nested defs and lambdas
+    (their bodies run when called, not per loop iteration here)."""
+    stack = list(loop.body) + list(getattr(loop, "orelse", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_device_call(call: ast.Call) -> bool:
+    """A call that plausibly returns a device array: anything that is
+    not numpy-rooted and not a static builtin. Method chains like
+    `self._decode.call(...)` count (dotted resolves, root isn't np)."""
+    name = _dotted(call.func)
+    if name is None:
+        # method on a call result (np.asarray(v).ravel()) inherits the
+        # inner call's classification; bare call-of-call (jit(f)(x))
+        # stays device
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Call):
+            return _is_device_call(call.func.value)
+        return True
+    if name in _STATIC_CALLS or name in _HOST_BUILTINS:
+        return False
+    return not _is_numpy_rooted(name)
+
+
+def _loop_device_names(loop) -> Set[str]:
+    """Names the loop body assigns from expressions containing a
+    device-producing call — candidates for np.asarray sync flags."""
+    names: Set[str] = set()
+
+    def targets_of(t, out: Set[str]):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets_of(e, out)
+        elif isinstance(t, ast.Starred):
+            targets_of(t.value, out)
+
+    for node in _walk_loop(loop):
+        value, tgts = None, []
+        if isinstance(node, ast.Assign):
+            value, tgts = node.value, node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            value, tgts = node.value, [node.target]
+        elif isinstance(node, ast.NamedExpr):
+            value, tgts = node.value, [node.target]
+        if value is None:
+            continue
+        if any(isinstance(n, ast.Call) and _is_device_call(n)
+               for n in ast.walk(value)):
+            for t in tgts:
+                targets_of(t, names)
+    return names
+
+
+def _device_derived(expr, loop_device_names: Set[str]) -> bool:
+    """Does `expr` plausibly hold a device value? True when it contains
+    a device-producing call or a name the loop assigned from one."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and _is_device_call(n):
+            return True
+        if isinstance(n, ast.Name) and n.id in loop_device_names:
+            return True
+    return False

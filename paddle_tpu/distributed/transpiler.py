@@ -89,6 +89,8 @@ class _TrainerProgram:
             # (reference: startup program runs on the pserver; the modern
             # tables initialize server-side, so push the real init values)
             for tid, name in enumerate(self.param_names):
+                # ptlint: disable=PT-T007  one-time table seeding at
+                # init; not a steady-state loop
                 self._client.set_dense(tid, np.asarray(scope.find_var(name)))
         if self.trainers > 1:
             self._client.barrier(self.trainers)
@@ -164,6 +166,8 @@ class DistributeTranspiler:
         lr = 0.01
         for key, fn in program._runtime_scalars.items():
             if key.startswith("learning_rate"):
+                # ptlint: disable=PT-T007  single scalar fetch; the
+                # loop breaks on the first match
                 lr = float(np.asarray(fn()))
                 break
         scope_shapes = {}

@@ -186,6 +186,8 @@ class Model:
                   Tensor(jnp.asarray(np.asarray(l))) for l in labels]
         for m in self._metrics:
             inp = m.compute(*( _to_list(outputs) + labels))
+            # ptlint: disable=PT-T007  metric.update is numpy-in by
+            # API contract; one sync per metric per batch is inherent
             r = m.update(*[np.asarray(i.numpy() if isinstance(i, Tensor)
                                       else i) for i in _to_list(inp)])
             results.append(r)

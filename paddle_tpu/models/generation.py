@@ -147,10 +147,17 @@ def _decode_qkv(params, i, x, geom):
     return _qkv_proj(params, i, x, geom)
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0, 1))
 def _cache_write(kc, vc, k_new, v_new, pos):
     """Write the new token's K/V [B, H, 1, D] at position pos (scalar)
-    of the dense [B, H, S, D] cache."""
+    of the dense [B, H, S, D] cache.
+
+    kc/vc are DONATED: every caller rebinds its cache to the returned
+    pair (decode_step's per-layer loop, DecoderPredictor), so XLA can
+    update the [B, H, S, D] buffers in place instead of double-residing
+    old+new cache per layer per token. Under an enclosing jit (the
+    generate()/beam rollout scans) donation of this inner program is
+    ignored and the scan carry aliasing takes over — same effect."""
     z = jnp.asarray(0, pos.dtype)
     return (jax.lax.dynamic_update_slice(kc, k_new, (z, z, pos, z)),
             jax.lax.dynamic_update_slice(vc, v_new, (z, z, pos, z)))
@@ -474,6 +481,9 @@ class DecoderPredictor:
         seq = ids.copy()
         pos = Tp
         for _ in range(max_new_tokens):
+            # ptlint: disable=PT-T007  host greedy-sampling loop over
+            # an exported decode artifact; the token must reach the
+            # host to be fed back, so one sync per step is the design
             tok = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
             seq = np.concatenate([seq, tok[:, None]], axis=1)
             logits, cache = self._decode.call(

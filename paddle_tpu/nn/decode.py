@@ -217,6 +217,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
             finished = L.logical_or(finished, step_finished)
         step_outputs.append(outputs)
         time += 1
+        # ptlint: disable=PT-T007  eager dynamic_decode terminates on
+        # a host-checked finished flag by definition
         done = bool(np.asarray(M.all(finished).numpy()))
         if done or (max_step_num is not None and time >= max_step_num):
             break

@@ -157,6 +157,8 @@ def serialize_persistables(feed_vars, fetch_vars, executor=None,
     for name in _persistable_names(program):
         v = scope.find_var(name)
         if v is not None:
+            # ptlint: disable=PT-T007  checkpoint serialization: the
+            # per-var device->host copy IS the operation
             state[name] = np.asarray(v)
     return pickle.dumps(state, protocol=2)
 
@@ -196,6 +198,8 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         val = scope.find_var(name)
         if val is None:
             raise ValueError(f"save_vars: {name} has no value in scope")
+        # ptlint: disable=PT-T007  checkpoint serialization: the
+        # per-var device->host copy IS the operation
         state[name] = np.asarray(val)
     os.makedirs(dirname, exist_ok=True)
     if filename is not None:

@@ -35,6 +35,8 @@ def save(program: Program, model_path: str, protocol: int = 4):
         val = scope.find_var(v.name)
         if val is None:
             continue
+        # ptlint: disable=PT-T007  checkpoint serialization: the
+        # per-var device->host copy IS the operation
         (params if v.is_parameter else others)[v.name] = np.asarray(val)
     d = os.path.dirname(model_path)
     if d:

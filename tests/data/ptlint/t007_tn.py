@@ -1,0 +1,31 @@
+"""PT-T007 true negatives: syncs hoisted out of loops, pure-host numpy
+loops, and device work batched before a single transfer. Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+import numpy as np
+
+
+def decode_then_sync(model, prompt, steps):
+    logits = model.prefill(prompt)
+    toks = []
+    for _ in range(steps):
+        logits, cache = model.decode(logits)
+        toks.append(logits)
+    # one sync AFTER the loop: the device queue stays full throughout
+    return jax.device_get(toks)
+
+
+def host_only_loop(rows):
+    out = []
+    for r in rows:
+        # numpy-in, numpy-out: nothing here ever touched a device
+        out.append(np.asarray(r, dtype=np.float32) * 2.0)
+    return out
+
+
+def batched_transfer(step, batches):
+    ys = [step(b) for b in batches]
+    jax.block_until_ready(ys)
+    return np.asarray(ys)

@@ -1,0 +1,39 @@
+"""PT-T007 true positives: per-iteration device→host syncs inside
+host-side loops — every iteration stalls the dispatch pipeline.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+import numpy as np
+
+
+def timed_decode(model, prompt, steps):
+    logits = model.prefill(prompt)
+    out = []
+    for _ in range(steps):
+        logits, cache = model.decode(logits)
+        tok = np.asarray(logits)  # expect: PT-T007
+        out.append(tok)
+    return out
+
+
+def poll_until_done(step, batches):
+    for b in batches:
+        y = step(b)
+        y.block_until_ready()  # expect: PT-T007
+    return y
+
+
+def drain(step, batches):
+    results = []
+    while batches:
+        b = batches.pop()
+        results.append(jax.device_get(step(b)))  # expect: PT-T007
+    return results
+
+
+def fetch_all(step, batches):
+    host = []
+    for b in batches:
+        host.append(np.array(step(b)))  # expect: PT-T007
+    return host

@@ -1,101 +1,31 @@
+#!/usr/bin/env python
 """Rank optimized-HLO entry instructions by bytes touched (output+operands).
 
 Usage: python tools/hlo_bytes.py /tmp/rn_hlo.txt [top_n]
 
-Heuristic HBM-traffic attribution for an HLO text dump: each entry-computation
-instruction is charged its output bytes plus the output bytes of its named
-operands (parameters are charged by their declared type). Fusions are
-opaque — internal reuse stays uncounted, which matches HBM behaviour to
-first order (fusion internals live in registers/VMEM).
+Thin CLI wrapper: the parsing and the dtype table live in
+paddle_tpu/analysis/hlo_bytes.py — the one source of truth for HLO byte
+accounting, shared with tools/scaling_analysis.py (all-reduce payload
+gate) and analysis/jaxcost.py (static cost model). Stdlib-only; never
+imports jax.
 """
 from __future__ import annotations
 
-import re
+import os
 import sys
-from collections import defaultdict
 
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
-                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-                "f64": 8, "c64": 8, "c128": 16}
-
-_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
-# "  %name = <type> <opkind>(operands...), attrs"  — type may contain
-# tuple parens and {layout} blocks; opkind is a bare lowercase word with
-# optional dashes directly before the operand paren.
-_INSTR_RE = re.compile(r"^\s+(%[\w.-]+)\s*=\s*(.*?)\s([a-z][a-z0-9-]*)\(")
-_OPERAND_RE = re.compile(r"%[\w.-]+")
-
-
-def shape_bytes(text: str) -> int:
-    total = 0
-    for dt, dims in _SHAPE_RE.findall(text):
-        b = _DTYPE_BYTES.get(dt)
-        if b is None:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * b
-    return total
-
-
-def audit_text(text: str, top_n: int = 30):
-    # find ENTRY block
-    i = text.index("\nENTRY ")
-    entry = text[i + 1:]
-    entry = entry[:entry.index("\n}")]
-    lines = entry.splitlines()
-    # entry params: name: type pairs in the header (may span the one line)
-    out_bytes = {}
-    header = lines[0]
-    for m in re.finditer(r"(%?[\w.-]+):\s*((?:\([^)]*\)|[a-z]+\d*\[[\d,]*\])"
-                         r"(?:\{[^}]*\})?)", header):
-        out_bytes["%" + m.group(1).lstrip("%")] = shape_bytes(m.group(2))
-    rows = []
-    for line in lines[1:]:
-        m = _INSTR_RE.match(line)
-        if not m:
-            continue
-        name, out_type, kind = m.groups()
-        ob = shape_bytes(out_type)
-        out_bytes[name] = ob
-        # operand list: inside the first top-level paren after kind
-        args_start = line.index(kind + "(") + len(kind)
-        depth = 0
-        j = args_start
-        for j in range(args_start, len(line)):
-            if line[j] == "(":
-                depth += 1
-            elif line[j] == ")":
-                depth -= 1
-                if depth == 0:
-                    break
-        args = line[args_start:j + 1]
-        ab = sum(out_bytes.get(op, 0) for op in _OPERAND_RE.findall(args))
-        rows.append((ob + ab, ob, ab, kind, name, line.strip()[:180]))
-    rows.sort(reverse=True)
-    total = sum(r[0] for r in rows)
-    print(f"total touched (first-order): {total/1e9:.2f} GB over "
-          f"{len(rows)} instructions")
-    by_kind = defaultdict(float)
-    for tb, ob, ab, kind, name, _ in rows:
-        by_kind[kind] += tb
-    print("\n== bytes by op kind ==")
-    for kind, b in sorted(by_kind.items(), key=lambda kv: -kv[1])[:15]:
-        print(f"{b/1e9:8.2f} GB  {kind}")
-    print(f"\n== top {top_n} instructions ==")
-    print(f"{'MB':>9} {'outMB':>8} {'kind':<14} name")
-    for tb, ob, ab, kind, name, line in rows[:top_n]:
-        print(f"{tb/1e6:9.1f} {ob/1e6:8.1f} {kind:<14} {name[:60]}")
-    # f32 big-tensor check: any instruction producing a large fp32 output
-    big_f32 = [(ob, name, line) for tb, ob, ab, kind, name, line in rows
-               if ob > 40e6 and re.search(r"\bf32\[", line.split(" = ")[1]
-                                          if " = " in line else line)]
-    print(f"\n== >40MB fp32 outputs: {len(big_f32)} ==")
-    for ob, name, line in big_f32[:15]:
-        print(f"{ob/1e6:9.1f} {name[:60]}")
-    return rows
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# import `analysis` as a top-level package so this loads without
+# paddle_tpu/__init__ (which pulls in jax) — then drop the path entry:
+# paddle_tpu/ holds Paddle-parity modules (sysconfig.py, ...) that would
+# shadow the stdlib for later imports
+_PKG_DIR = os.path.join(_REPO, "paddle_tpu")
+sys.path.insert(0, _PKG_DIR)
+try:
+    from analysis.hlo_bytes import (audit_text,  # noqa: E402,F401
+                                    allreduce_payload, shape_bytes)
+finally:
+    sys.path.remove(_PKG_DIR)
 
 
 def main():

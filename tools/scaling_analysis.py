@@ -27,10 +27,10 @@ tool produces the honest compile-level counterpart, in two layers:
 
 Run: python tools/scaling_analysis.py [N ...]   (default 8 64 256)
 Child: python tools/scaling_analysis.py --child N
+       python tools/scaling_analysis.py --static-roofline
 """
 import json
 import os
-import re
 import subprocess
 import sys
 import time
@@ -38,9 +38,17 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
-          "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
-          "pred": 1}
+# HLO byte accounting lives in ONE place (analysis/hlo_bytes.py, shared
+# with tools/hlo_bytes.py and jaxcost). Import it as a top-level package
+# so the parent process stays jax-free; drop the path entry again —
+# paddle_tpu/ holds Paddle-parity modules (sysconfig.py, ...) that would
+# shadow the stdlib for later imports.
+_PKG_DIR = os.path.join(ROOT, "paddle_tpu")
+sys.path.insert(0, _PKG_DIR)
+try:
+    from analysis.hlo_bytes import allreduce_payload  # noqa: E402
+finally:
+    sys.path.remove(_PKG_DIR)
 
 FLAGSHIP_METRIC = "gpt_small_train_tokens_per_sec"
 
@@ -65,31 +73,6 @@ def read_flagship_anchor(root):
     tok_s = float(d["value"])  # missing/NaN-shaped value also fails loudly
     step_s = round(32 * 1024 / tok_s, 4)  # flagship bs32 seq1024
     return step_s, f"BENCH_DETAIL.json live ({tok_s:.0f} tok/s)"
-
-
-def allreduce_payload(hlo: str):
-    """Sum payload bytes over all-reduce ops in partitioned HLO text.
-
-    Shapes appear as `f32[1576960]{0} all-reduce(` or, for multi-operand
-    ops, `(f32[8], f32[16384]) all-reduce(`. Counts each op once (the
-    defining line, not operand uses).
-    """
-    total, count = 0, 0
-    shape_re = re.compile(r"(\w+)\[([\d,]*)\]")
-    for line in hlo.splitlines():
-        m = re.search(r"=\s+(\([^)]*\)|\S+)\s+all-reduce(?:-start)?\(", line)
-        if not m:
-            continue
-        count += 1
-        for dt, dims in shape_re.findall(m.group(1)):
-            if dt not in _BYTES:
-                continue
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            total += n * _BYTES[dt]
-    return total, count
 
 
 def child(n_devices: int):
@@ -137,6 +120,53 @@ def child(n_devices: int):
         "allreduce_payload_bytes": payload,
         "allreduce_count": n_ar,
         "compile_s": round(compile_s, 1),
+    }))
+
+
+def static_roofline_child():
+    """Print one JSON line with the jaxcost STATIC model of the flagship
+    train step (f32 trace on the CPU backend — a conservative byte count
+    vs the bf16-AMP chip recipe) and its v5e MXU roofline tokens/s:
+    batch_tokens * MXU_peak / flops. Flops-only on purpose: the static
+    byte totals are pre-fusion jaxpr traffic (a budget gate), not an HBM
+    bandwidth bound. Own subprocess for the same reason as child():
+    backend state is fixed at init."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.analysis.jaxcost import estimate_train_step
+    from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+
+    paddle.seed(0)
+    # the flagship bench geometry (bench.py bench_gpt on_tpu)
+    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                    num_heads=6, max_seq_len=1024)
+    batch, seq = 32, 1024
+    model = GPT(cfg)
+    optim = opt.AdamW(1e-4, parameters=model.parameters(),
+                      grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    step = paddle.jit.TrainStep(model, gpt_loss_fn, optim)
+    x = paddle.to_tensor(np.zeros((batch, seq), np.int32))
+    y = paddle.to_tensor(np.zeros((batch, seq), np.int32))
+    cost = estimate_train_step(step, x, y)
+    peak_flops = 197e12  # v5e bf16 MXU peak
+    nbytes = cost.bytes_read + cost.bytes_written
+    print(json.dumps({
+        "static_flops_per_step": cost.flops,
+        "static_bytes_per_step": nbytes,
+        "static_peak_bytes": cost.peak_bytes,
+        "static_roofline_tokens_per_sec": round(
+            batch * seq * peak_flops / cost.flops, 1),
+        "static_note": "f32 CPU trace of the flagship step (jaxcost); "
+                       "MXU roofline at v5e 197 TFLOP/s — measured/"
+                       "roofline is the achieved MFU as the static model "
+                       "counts flops; byte totals are pre-fusion jaxpr "
+                       "traffic (budget gate, not a bandwidth bound)",
     }))
 
 
@@ -194,6 +224,23 @@ def main(counts):
         step_s, anchor_src = read_flagship_anchor(ROOT)
         print(json.dumps({"anchor_source": anchor_src,
                           "anchor_step_s": step_s}), flush=True)
+        # static-model roofline for the SAME flagship step, right next to
+        # the measured anchor: how much headroom the static cost model
+        # says the chip still has (measured/roofline ~= achievable MFU)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--static-roofline"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, cwd=ROOT, timeout=1800)
+        if out.returncode == 0:
+            sr = json.loads(out.stdout.strip().splitlines()[-1])
+            measured_tok_s = 32 * 1024 / step_s
+            sr["measured_vs_roofline"] = round(
+                measured_tok_s / sr["static_roofline_tokens_per_sec"], 4)
+            print(json.dumps(sr), flush=True)
+        else:
+            print(f"static roofline child FAILED:\n{out.stderr[-2000:]}",
+                  file=sys.stderr)
         print(json.dumps({
             "projection_note": "efficiency floor = compute/(compute+"
             "unoverlapped ICI ring all-reduce); anchored to measured "
@@ -205,6 +252,8 @@ def main(counts):
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--static-roofline":
+        static_roofline_child()
     else:
         ns = [int(a) for a in sys.argv[1:]] or [8, 64, 256]
         main(ns)
