@@ -69,6 +69,7 @@ from . import parallel  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
+from . import obs  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import slim  # noqa: F401,E402

@@ -175,6 +175,12 @@ class ElasticSupervisor:
 
     # -------------------------------------------------------------- launch
     def _start(self, h: _Handle, nprocs: int):
+        from .. import obs
+        with obs.span("elastic.start", cat="restart", annotate=False,
+                      args={"rank": h.rank, "incarnation": h.restarts}):
+            self._start_inner(h, nprocs)
+
+    def _start_inner(self, h: _Handle, nprocs: int):
         spec = h.spec
         env = dict(os.environ)
         # spec.env may override the default rank mapping (multi-node
@@ -238,6 +244,13 @@ class ElasticSupervisor:
         h.restarts += 1
         h.proc = None
         h.restart_at = time.monotonic() + delay
+        # obs telemetry: restart decisions, labeled hang vs crash (the
+        # free-form reason string is too high-cardinality for a label)
+        from .. import obs
+        kind = "hang" if reason.startswith("hang") else "crash"
+        obs.counter("elastic_restarts_total",
+                    "worker restarts scheduled by the elastic supervisor",
+                    labels=("kind",)).labels(kind=kind).inc()
 
     # ----------------------------------------------------------------- run
     def run(self, workers: Union[Callable, Sequence], args=(), nprocs=None):

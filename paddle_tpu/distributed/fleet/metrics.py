@@ -9,6 +9,13 @@ evaluation over the union of the data.
 TPU-native: rides paddle.distributed.all_reduce — inside a compiled SPMD
 step that is an XLA psum over the mesh; on the eager multi-process path it
 rides the coordination-service host allreduce. Single process: identity.
+
+.. deprecated:: scope
+   These helpers are for *model evaluation* metrics (accuracy/MAE/AUC
+   aggregated across trainers) ONLY. For *system* metrics — throughput,
+   latency histograms, queue depths, restart/preemption counters — use
+   `paddle_tpu.obs` (the unified telemetry registry, PR 6); do not grow
+   this module in that direction. See docs/observability.md.
 """
 from __future__ import annotations
 

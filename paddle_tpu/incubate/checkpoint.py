@@ -191,8 +191,15 @@ class AutoCheckpointManager:
 
     def _write(self, state: dict, epoch: int, kind: str = "epoch",
                idx: Optional[int] = None):
-        from .. import framework_io
+        from .. import framework_io, obs
         idx = epoch if idx is None else idx
+        t0 = time.perf_counter()
+        # span + histogram cover serialize/hash/rename (may run on the
+        # async save thread — the obs sinks are thread-safe)
+        span = obs.span("checkpoint.save", cat="checkpoint",
+                        annotate=False,
+                        args={"kind": kind, "index": idx})
+        span.begin()
         tmp = tempfile.mkdtemp(dir=self.save_dir, prefix=".tmp_")
         try:
             framework_io.save(state, os.path.join(tmp, "state.pdparams"))
@@ -213,6 +220,13 @@ class AutoCheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        finally:
+            span.end()
+        obs.histogram("checkpoint_save_seconds",
+                      "snapshot write duration (serialize+hash+rename)",
+                      labels=("kind",),
+                      unit="seconds").labels(kind=kind).observe(
+                          time.perf_counter() - t0)
         self._prune()
 
     def _prune(self):
@@ -273,15 +287,23 @@ class AutoCheckpointManager:
         pickle still parses, the data is wrong) is quarantined with a
         warning and the next-newest snapshot is tried, so one bad file
         never bricks the resume path."""
-        from .. import framework_io
+        from .. import framework_io, obs
         self.wait()  # a restore racing an in-flight save would read torn
+        t0 = time.perf_counter()
         for kind, idx in self._snapshots_newest_first():
             path = os.path.join(self._snap_dir(kind, idx), "state.pdparams")
             try:
-                state = framework_io.load(path)
-                self._verify_checksums(kind, idx, path)
+                with obs.span("checkpoint.restore", cat="checkpoint",
+                              annotate=False,
+                              args={"kind": kind, "index": idx}):
+                    state = framework_io.load(path)
+                    self._verify_checksums(kind, idx, path)
             except Exception as e:
                 import warnings
+                obs.counter(
+                    "checkpoint_quarantined_total",
+                    "snapshots quarantined by restore (corrupt/bit-rot)"
+                ).inc()
                 bad = self._snap_dir(kind, idx)
                 warnings.warn(
                     f"auto-checkpoint: snapshot {kind}_{idx} is corrupt "
@@ -294,6 +316,10 @@ class AutoCheckpointManager:
                 continue
             self._restore(state)
             self.restored_kind, self.restored_index = kind, idx
+            obs.histogram(
+                "checkpoint_restore_seconds",
+                "restore_latest duration incl. quarantine fallbacks",
+                unit="seconds").observe(time.perf_counter() - t0)
             return idx
         self.restored_kind = self.restored_index = None
         return None

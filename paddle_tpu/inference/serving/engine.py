@@ -32,12 +32,20 @@ one bad request costs one request, not the fleet. Admission control
 (max_waiting + admission_policy, cache_high_watermark) bounds the queue
 ('shed' / EngineOverloaded) before overload can strand decodes.
 
-Every phase runs under a profiler.RecordEvent span (cat="serving") so a
-serving trace exported with profiler.export_chrome_tracing shows
-schedule/prefill/decode per engine step, with request counts in args.
+Telemetry (PR 6, docs/observability.md): every phase runs under an
+obs.trace span — the step itself is cat="serving", the phases carry
+their own categories (cat="schedule"/"prefill"/"decode") — so a chrome
+trace exported with profiler.export_chrome_tracing (or obs.trace
+.export_chrome) shows schedule/prefill/decode per engine step with
+request counts in args. EngineStats is a thin view over the obs
+metrics registry, and the step loop additionally records TTFT /
+inter-token / request-latency / step-time histograms plus queue and
+cache-occupancy gauges — all host-side on values the step already
+fetched, so instrumentation adds ZERO device syncs (PT-T007 clean).
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -46,14 +54,16 @@ from typing import Dict, List, Optional
 import numpy as np
 import jax.numpy as jnp
 
+from ... import obs
 from ...analysis import holds_lock
 from ...core import anomaly
 from ...models import generation as gen
 from ...profiler import RecordEvent
 from .attention import paged_decode_step
 from .paged_cache import PagedKVCache
-from .scheduler import (Request, RequestState, SamplingParams,
-                        ScheduledBatch, Scheduler, SchedulerConfig)
+from .scheduler import (EngineOverloaded, Request, RequestState,
+                        SamplingParams, ScheduledBatch, Scheduler,
+                        SchedulerConfig)
 
 __all__ = ["EngineConfig", "EngineStats", "LLMEngine", "RequestOutput",
            "ServingPredictor"]
@@ -70,6 +80,10 @@ class EngineConfig:
     admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
     cache_high_watermark: float = 1.0    # pause prefill admission above
     step_timeout_s: Optional[float] = None  # watchdog budget per step
+    # prefix for this engine's `engine` label in the obs registry; the
+    # final label is ALWAYS uniquified per instance (prefix-N) so two
+    # engines can never merge their metric series
+    obs_label: Optional[str] = None
 
 
 @dataclass
@@ -86,30 +100,130 @@ class RequestOutput:
     finish_reason: Optional[str] = None
 
 
-@dataclass
+# int event counters (serving_events_total{engine,event}); field name
+# IS the event label. 'rejected' (EngineOverloaded raises) is new in
+# the obs layer — the pre-obs stats never counted refused admissions.
+_STAT_EVENTS = ("steps", "prefill_tokens", "generated_tokens",
+                "preemptions", "completed", "cancelled", "expired",
+                "timeouts", "shed", "errors", "recoveries", "rebuilt",
+                "watchdog_trips", "rejected")
+# float phase-time accumulators (serving_phase_seconds_total{engine,phase})
+_STAT_PHASES = {"time_schedule": "schedule", "time_prefill": "prefill",
+                "time_decode": "decode"}
+# per-request wall-time sums over COMPLETED requests (the historical
+# avg_ttft_s / avg_request_latency_s denominators)
+_STAT_REQ_SUMS = {"ttft_sum": "ttft", "latency_sum": "latency"}
+
+_ENGINE_IDS = itertools.count()
+
+
 class EngineStats:
-    steps: int = 0
-    prefill_tokens: int = 0
-    generated_tokens: int = 0
-    preemptions: int = 0
-    completed: int = 0
-    cancelled: int = 0
-    # ------------------------------------------- robustness counters
-    expired: int = 0                     # queued requests timed out
-    timeouts: int = 0                    # running requests past deadline
-    shed: int = 0                        # evicted by admission control
-    errors: int = 0                      # quarantined (poisoned/wedged)
-    recoveries: int = 0                  # poisoned/wedged steps recovered
-    rebuilt: int = 0                     # survivors re-prefilled after one
-    watchdog_trips: int = 0              # steps over step_timeout_s
-    time_schedule: float = 0.0
-    time_prefill: float = 0.0
-    time_decode: float = 0.0
-    ttft_sum: float = 0.0                # time-to-first-token accumulator
-    latency_sum: float = 0.0             # request wall time accumulator
+    """Engine statistics as a THIN VIEW over the obs registry (PR 6).
+
+    Field surface and `as_dict()` are unchanged from the old dataclass
+    (tests and tools/chaos_serve.py read `stats.errors`,
+    `stats.as_dict()` exactly as before), but every field is now a
+    generated property over a registry child — `stats.completed += 1`
+    increments `serving_events_total{engine=...,event="completed"}` —
+    so Prometheus/JSON exporters, the load suite and the engine itself
+    all read ONE sink. Each instance gets a unique `engine` label
+    (never shared: chaos_serve's reference and faulted engines must not
+    merge), and the view also carries the engine's latency histograms
+    (TTFT / inter-token gap / request latency / step time) and per-step
+    gauges, recorded via the observe_*/set_* helpers below.
+
+    Registry children are individually thread-safe and the engine
+    mutates stats only under its own lock, so the view itself needs no
+    `_GUARDED_BY` contract.
+    """
+
+    def __init__(self, label: str = None):
+        if label is None:
+            label = "engine"
+        # ALWAYS uniquified — a caller-supplied label is a prefix
+        self.label = f"{label}-{next(_ENGINE_IDS)}"
+        lbl = dict(engine=self.label)
+        ev = obs.counter("serving_events_total",
+                         "engine lifecycle/robustness event counts",
+                         labels=("engine", "event"))
+        self._events = {f: ev.labels(event=f, **lbl) for f in _STAT_EVENTS}
+        ph = obs.counter("serving_phase_seconds_total",
+                         "host wall time accumulated per engine phase",
+                         labels=("engine", "phase"), unit="seconds")
+        self._phases = {f: ph.labels(phase=p, **lbl)
+                        for f, p in _STAT_PHASES.items()}
+        rs = obs.counter("serving_request_seconds_total",
+                         "per-request wall-time sums over completed "
+                         "requests (kind=ttft|latency)",
+                         labels=("engine", "kind"), unit="seconds")
+        self._req_sums = {f: rs.labels(kind=k, **lbl)
+                          for f, k in _STAT_REQ_SUMS.items()}
+        self._ttft = obs.histogram(
+            "serving_ttft_seconds",
+            "time to first token, observed once per request",
+            labels=("engine",), unit="seconds").labels(**lbl)
+        self._token_gap = obs.histogram(
+            "serving_token_gap_seconds",
+            "inter-token latency (gap between consecutive emitted "
+            "tokens of one request)",
+            labels=("engine",), unit="seconds").labels(**lbl)
+        self._latency = obs.histogram(
+            "serving_request_latency_seconds",
+            "request wall time arrival→finish, observed at completion",
+            labels=("engine",), unit="seconds").labels(**lbl)
+        self._step = obs.histogram(
+            "serving_step_seconds", "engine step() wall time",
+            labels=("engine",), unit="seconds").labels(**lbl)
+        g_run = obs.gauge("serving_running", "running sequences",
+                          labels=("engine",))
+        g_wait = obs.gauge("serving_waiting", "waiting-queue depth",
+                           labels=("engine",))
+        g_blk = obs.gauge("serving_cache_blocks",
+                          "paged-cache block pool occupancy",
+                          labels=("engine", "state"), unit="blocks")
+        g_spend = obs.gauge("serving_prefill_spend_tokens",
+                            "prompt tokens admitted to prefill this step "
+                            "(per-step spend against max_prefill_tokens)",
+                            labels=("engine",), unit="tokens")
+        self._g_running = g_run.labels(**lbl)
+        self._g_waiting = g_wait.labels(**lbl)
+        self._g_blocks_used = g_blk.labels(state="used", **lbl)
+        self._g_blocks_free = g_blk.labels(state="free", **lbl)
+        self._g_prefill_spend = g_spend.labels(**lbl)
+
+    # -------------------------------------------------- record helpers
+    def observe_ttft(self, dt: float) -> None:
+        self._ttft.observe(dt)
+
+    def observe_token_gap(self, dt: float) -> None:
+        self._token_gap.observe(dt)
+
+    def observe_latency(self, dt: float) -> None:
+        self._latency.observe(dt)
+
+    def observe_step(self, dt: float) -> None:
+        self._step.observe(dt)
+
+    def set_step_gauges(self, running: int, waiting: int,
+                        blocks_used: int, blocks_free: int) -> None:
+        self._g_running.set(running)
+        self._g_waiting.set(waiting)
+        self._g_blocks_used.set(blocks_used)
+        self._g_blocks_free.set(blocks_free)
+
+    def set_prefill_spend(self, tokens: int) -> None:
+        self._g_prefill_spend.set(tokens)
+
+    def ttft_quantile(self, q: float) -> float:
+        """Exact TTFT quantile (bench / load suite read p50/p99 here)."""
+        return self._ttft.quantile(q)
 
     def as_dict(self) -> dict:
-        d = dict(self.__dict__)
+        d = {f: getattr(self, f) for f in _STAT_EVENTS}
+        for f in _STAT_PHASES:
+            d[f] = getattr(self, f)
+        for f in _STAT_REQ_SUMS:
+            d[f] = getattr(self, f)
         done = max(self.completed, 1)
         d["avg_ttft_s"] = self.ttft_sum / done
         d["avg_request_latency_s"] = self.latency_sum / done
@@ -117,6 +231,34 @@ class EngineStats:
         d["decode_tokens_per_sec"] = (
             self.generated_tokens / busy if busy > 0 else 0.0)
         return d
+
+
+def _stats_property(table: str, f: str, as_int: bool):
+    """Generated accessor pair: reads pull the registry child's value,
+    writes inc() the monotonic delta — so the historical `stats.x += 1`
+    call sites keep working verbatim against counter-backed storage."""
+
+    def _get(self):
+        v = getattr(self, table)[f].value
+        return int(v) if as_int else v
+
+    def _set(self, new):
+        child = getattr(self, table)[f]
+        delta = new - child.value
+        if delta:
+            child.inc(delta)             # counters refuse to go down
+
+    return property(_get, _set)
+
+
+for _f in _STAT_EVENTS:
+    setattr(EngineStats, _f, _stats_property("_events", _f, as_int=True))
+for _f in _STAT_PHASES:
+    setattr(EngineStats, _f, _stats_property("_phases", _f, as_int=False))
+for _f in _STAT_REQ_SUMS:
+    setattr(EngineStats, _f, _stats_property("_req_sums", _f,
+                                             as_int=False))
+del _f
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -174,7 +316,7 @@ class LLMEngine:
         # RLock: step() holds it across the whole iteration and the
         # helpers it calls re-enter (e.g. _emit under _recover)
         self._lock = threading.RLock()
-        self.stats = EngineStats()
+        self.stats = EngineStats(config.obs_label)
         self._requests: Dict[str, Request] = {}
         self._rngs: Dict[str, np.random.RandomState] = {}
         self._next_id = 0
@@ -221,7 +363,11 @@ class LLMEngine:
             req = Request(request_id=request_id, prompt_ids=ids,
                           params=sampling,
                           arrival_time=time.perf_counter())
-            shed = self.scheduler.add(req)   # validates pool fit / bound
+            try:
+                shed = self.scheduler.add(req)  # validates pool fit/bound
+            except EngineOverloaded:
+                self.stats.rejected += 1
+                raise
             for victim in shed:
                 victim.finish_time = time.perf_counter()
                 self.stats.shed += 1
@@ -279,6 +425,14 @@ class LLMEngine:
         now = time.perf_counter()
         if req.first_token_time is None:
             req.first_token_time = now
+            # TTFT is recorded HERE, exactly once per request at its
+            # first token (tests/test_observability.py pins once-ness);
+            # ttft_sum below stays the completed-only accumulator
+            self.stats.observe_ttft(now - req.arrival_time)
+        else:
+            # per-token latency: gap since this request's previous token
+            self.stats.observe_token_gap(now - req.last_token_time)
+        req.last_token_time = now
         req.output_ids.append(tok)
         self.stats.generated_tokens += 1
         finished, reason = False, None
@@ -295,6 +449,7 @@ class LLMEngine:
             self.stats.completed += 1
             self.stats.ttft_sum += req.first_token_time - req.arrival_time
             self.stats.latency_sum += now - req.arrival_time
+            self.stats.observe_latency(now - req.arrival_time)
         outs.append(RequestOutput(req.request_id, tok,
                                   list(req.output_ids), finished, reason))
 
@@ -387,7 +542,7 @@ class LLMEngine:
             self.faults.corrupt_cache(step_no, self.cache)
             self._expire_and_abort(outs)
             t0 = time.perf_counter()
-            with RecordEvent("serving.schedule", cat="serving") as ev:
+            with RecordEvent("serving.schedule", cat="schedule") as ev:
                 batch = self.scheduler.schedule()
                 ev.args = {"prefill": len(batch.prefill),
                            "decode": len(batch.decode),
@@ -397,10 +552,11 @@ class LLMEngine:
             self.stats.preemptions += len(batch.preempted)
             self.stats.time_schedule += time.perf_counter() - t0
 
+            prefill_spend = 0
             for req in batch.prefill:
                 t0 = time.perf_counter()
                 tokens = req.all_token_ids()
-                with RecordEvent("serving.prefill", cat="serving") as ev:
+                with RecordEvent("serving.prefill", cat="prefill") as ev:
                     ev.args = {"request_id": req.request_id,
                                "tokens": int(tokens.size)}
                     try:
@@ -409,6 +565,7 @@ class LLMEngine:
                         self._quarantine(req, outs, f"prefill raised: {e}")
                         continue
                 self.stats.prefill_tokens += int(tokens.size)
+                prefill_spend += int(tokens.size)
                 self.stats.time_prefill += time.perf_counter() - t0
                 logits = self.faults.poison_logits(step_no, logits)
                 # logits are already host numpy (_prefill fetched them);
@@ -431,7 +588,7 @@ class LLMEngine:
             decode = [r for r in batch.decode if not r.finished]
             if decode:
                 t0 = time.perf_counter()
-                with RecordEvent("serving.decode", cat="serving") as ev:
+                with RecordEvent("serving.decode", cat="decode") as ev:
                     ev.args = {"num_seqs": len(decode)}
                     self.faults.stall(step_no)
                     try:
@@ -468,6 +625,15 @@ class LLMEngine:
                             "expired": self.stats.expired,
                             "shed": self.stats.shed,
                             "recoveries": self.stats.recoveries}
+        # per-step telemetry: all host values already in hand (scheduler
+        # counters, cache free lists) — recording adds no device work
+        self.stats.observe_step(time.perf_counter() - self._step_start)
+        self.stats.set_prefill_spend(prefill_spend)
+        self.stats.set_step_gauges(
+            running=self.scheduler.num_running(),
+            waiting=self.scheduler.num_waiting(),
+            blocks_used=self.cache.num_used(),
+            blocks_free=self.cache.num_free())
         return outs
 
     def _prefill(self, req: Request, tokens: np.ndarray) -> np.ndarray:

@@ -124,6 +124,7 @@ class Request:
     slot: Optional[tuple] = None             # (block, offset, pos)
     arrival_time: float = 0.0
     first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None  # previous emit (token gap)
     finish_time: Optional[float] = None
 
     def all_token_ids(self) -> np.ndarray:
@@ -253,6 +254,12 @@ class Scheduler:
         instead of reaching into self.waiting unlocked)."""
         with self._lock:
             return len(self.waiting)
+
+    def num_running(self) -> int:
+        """Running-set size snapshot (same telemetry contract as
+        num_waiting: the engine's step gauges read it locked)."""
+        with self._lock:
+            return len(self.running)
 
     # ----------------------------------------------------- expiry / abort
     def expire_waiting(self, now: float) -> List[Request]:

@@ -1,0 +1,85 @@
+"""paddle_tpu.obs — unified telemetry: metrics registry + tracing spans.
+
+One process-wide, thread-safe sink for every system metric and span in
+the framework (PR 6; docs/observability.md is the catalog):
+
+- `registry` — counters / gauges / fixed-bucket histograms with exact
+  p50/p90/p99, organized as labeled families in the process-wide
+  `REGISTRY`. The serving engine's `EngineStats`, the training loop,
+  the checkpoint manager and the elastic supervisor all record here.
+- `trace` — nestable wall-clock spans with categories
+  (prefill/decode/schedule/checkpoint/restart/...); absorbs the
+  profiler's RecordEvent machinery (old API is a shim over this).
+- `export` — JSON snapshot, Prometheus text format and chrome trace,
+  on demand or periodically from a daemon thread.
+
+Importing this package pulls in stdlib + numpy only (no jax), so
+tools/ptlint.py-style offline tooling can read metrics definitions
+anywhere. Recording is host arithmetic on already-fetched values —
+the telemetry layer adds ZERO device syncs (PT-T007 clean).
+"""
+from __future__ import annotations
+
+from . import export, registry, trace
+from .export import (SnapshotExporter, dump_snapshot, export_chrome_trace,
+                     snapshot, to_prometheus)
+from .registry import (DEFAULT_BUCKETS, Counter, Family, Gauge, Histogram,
+                       MetricRegistry, REGISTRY)
+from .trace import CATEGORIES, Span, SpanEvent, span
+
+__all__ = [
+    # registry
+    "REGISTRY", "MetricRegistry", "Family", "Counter", "Gauge", "Histogram",
+    "DEFAULT_BUCKETS", "counter", "gauge", "histogram",
+    # trace
+    "Span", "SpanEvent", "span", "CATEGORIES", "trace",
+    # export
+    "snapshot", "dump_snapshot", "to_prometheus", "export_chrome_trace",
+    "SnapshotExporter", "export", "registry",
+    # roofline cross-link
+    "set_roofline", "get_roofline",
+]
+
+
+def counter(name: str, help: str = "", labels=(), unit: str = "") -> Family:
+    """Get-or-create a counter family in the default REGISTRY."""
+    return REGISTRY.counter(name, help=help, labels=labels, unit=unit)
+
+
+def gauge(name: str, help: str = "", labels=(), unit: str = "") -> Family:
+    """Get-or-create a gauge family in the default REGISTRY."""
+    return REGISTRY.gauge(name, help=help, labels=labels, unit=unit)
+
+
+def histogram(name: str, help: str = "", labels=(), unit: str = "",
+              buckets=DEFAULT_BUCKETS, sample_cap: int = 8192) -> Family:
+    """Get-or-create a histogram family in the default REGISTRY."""
+    return REGISTRY.histogram(name, help=help, labels=labels, unit=unit,
+                              buckets=buckets, sample_cap=sample_cap)
+
+
+# --------------------------------------------------------------- roofline
+# jaxcost's static model publishes per-program roofline tokens/s here
+# (bench.py / scaling_analysis set it); the training loop divides its
+# measured tokens/s by it into the `train_measured_vs_roofline` gauge so
+# MFU drift is a live metric, not just a benchmark column.
+
+def set_roofline(program: str, tokens_per_sec: float) -> None:
+    """Publish a static-model roofline (tokens/s) for `program`."""
+    gauge("static_roofline_tokens_per_sec",
+          "jaxcost static-model roofline throughput per program",
+          labels=("program",),
+          unit="tokens_per_second").labels(program=program).set(
+              float(tokens_per_sec))
+
+
+def get_roofline(program: str):
+    """Roofline tokens/s previously published for `program`, or None."""
+    fam = REGISTRY.get("static_roofline_tokens_per_sec")
+    if fam is None:
+        return None
+    child = fam.get(program=program)
+    if child is None:
+        return None
+    v = child.value
+    return v if v > 0 else None

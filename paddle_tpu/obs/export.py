@@ -1,0 +1,160 @@
+"""Exporters: JSON snapshot, Prometheus text format, chrome trace.
+
+Three read paths over the one registry/trace pair, dumped on demand
+(`dump_snapshot`, `to_prometheus`, `export_chrome_trace`) or every N
+seconds from a daemon thread (`SnapshotExporter`). All exporters are
+read-only over `MetricRegistry.collect()` / `trace.events()` — they
+never mint series and never touch the device.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Optional
+
+from . import trace as _trace
+from .registry import REGISTRY, MetricRegistry
+
+__all__ = ["snapshot", "dump_snapshot", "to_prometheus",
+           "export_chrome_trace", "SnapshotExporter"]
+
+
+def snapshot(registry: Optional[MetricRegistry] = None) -> dict:
+    """JSON-able snapshot of every metric family: counters/gauges carry
+    `value`, histograms carry count/sum/buckets plus exact p50/p90/p99
+    (the quantiles the SLO checks read). Includes a wall-clock stamp so
+    artifact files are self-describing."""
+    reg = registry if registry is not None else REGISTRY
+    return {"ts": time.time(), "metrics": reg.collect()}
+
+
+def dump_snapshot(path: str,
+                  registry: Optional[MetricRegistry] = None) -> str:
+    """Write `snapshot()` to `path` (chaos_serve's exit artifact)."""
+    snap = snapshot(registry)
+    import os
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+    return path
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape(str(v))}"'
+                     for k, v in merged.items())
+    return "{" + inner + "}"
+
+
+def _prom_num(v: float) -> str:
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if isinstance(v, float) and math.isnan(v):
+        return "NaN"
+    return repr(float(v))
+
+
+def to_prometheus(registry: Optional[MetricRegistry] = None) -> str:
+    """Prometheus text exposition format (v0.0.4): `# HELP`/`# TYPE`
+    headers, one sample line per child, histograms in the cumulative
+    `_bucket{le=...}` / `_sum` / `_count` shape."""
+    reg = registry if registry is not None else REGISTRY
+    lines = []
+    for fam in reg.collect():
+        name = fam["name"]
+        if fam["help"]:
+            lines.append(f"# HELP {name} {_prom_escape(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for s in fam["series"]:
+            if fam["type"] == "histogram":
+                for le, c in s["buckets"].items():
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_prom_labels(s['labels'], {'le': le})} {c}")
+                lines.append(
+                    f"{name}_sum{_prom_labels(s['labels'])} "
+                    f"{_prom_num(s['sum'])}")
+                lines.append(
+                    f"{name}_count{_prom_labels(s['labels'])} "
+                    f"{s['count']}")
+            else:
+                lines.append(
+                    f"{name}{_prom_labels(s['labels'])} "
+                    f"{_prom_num(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def export_chrome_trace(path: str) -> str:
+    """Chrome-trace JSON of the recorded spans (delegates to
+    obs.trace.export_chrome; same file profiler.export_chrome_tracing
+    writes)."""
+    return _trace.export_chrome(path)
+
+
+class SnapshotExporter:
+    """Daemon thread that writes a registry snapshot to `path` every
+    `interval_s` seconds — the "dumped ... every N seconds" half of the
+    exporter story. `stop()` joins the thread and writes one final
+    snapshot so short runs still leave an artifact."""
+
+    _GUARDED_BY = {"_running": "_lock"}
+
+    def __init__(self, path: str, interval_s: float = 10.0,
+                 registry: Optional[MetricRegistry] = None):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self.registry = registry if registry is not None else REGISTRY
+        self._lock = threading.Lock()
+        self._running = False
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait(self.interval_s)
+            with self._lock:
+                if not self._running:
+                    return
+            dump_snapshot(self.path, self.registry)
+
+    def start(self) -> "SnapshotExporter":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+        self._wake.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="obs-snapshot-exporter", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> str:
+        with self._lock:
+            was = self._running
+            self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if was:
+            dump_snapshot(self.path, self.registry)
+        return self.path
+
+    def __enter__(self) -> "SnapshotExporter":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,188 @@
+"""Tracing spans: nestable wall-clock scopes with chrome-trace export.
+
+This is the span half of the telemetry layer and the NEW HOME of the
+profiler's event machinery: `paddle_tpu.profiler` now aliases
+`_ProfState = _TraceState`, `_Event = SpanEvent` and
+`RecordEvent = Span` (same objects, old names kept as a shim), so
+host-side spans recorded through either API land in one table and one
+chrome trace. Span categories (`CATEGORIES`) attribute wall time to
+the phases the load suite and chaos runner care about — prefill /
+decode / schedule on the serving side, checkpoint / restart / train on
+the training side — instead of a flat op list.
+
+Spans are host wall-clock only (time.perf_counter on already-running
+host code); the optional jax.profiler.TraceAnnotation makes the same
+scope visible inside an XLA device trace but is entered lazily and
+only while tracing is enabled, so importing this module never pulls in
+jax and disabled spans cost two attribute reads.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["Span", "SpanEvent", "CATEGORIES", "enable", "disable",
+           "is_enabled", "clear", "events", "export_chrome", "span"]
+
+#: span categories used by instrument sites (docs/observability.md);
+#: free-form strings are allowed, these are the cataloged ones
+CATEGORIES = ("serving", "schedule", "prefill", "decode", "checkpoint",
+              "restart", "train", "op")
+
+
+class SpanEvent:
+    """One completed span (was profiler._Event)."""
+
+    __slots__ = ("name", "start", "end", "tid", "depth", "cat", "args")
+
+    def __init__(self, name, start, end, tid, depth, cat=None, args=None):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.depth = depth
+        self.cat = cat
+        self.args = args
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+class _TraceState:
+    """Process-wide trace table (was profiler._ProfState — the profiler
+    aliases this class, so `profiler._ProfState.enabled = True` and
+    `obs.trace.enable()` flip the same bit). Class-attribute state, one
+    lock; tls.depth gives nesting depth for the exported events."""
+
+    enabled = False
+    events: List[SpanEvent] = []
+    t0 = 0.0
+    lock = threading.Lock()
+    tls = threading.local()
+    trace_dir: Optional[str] = None
+    op_hook_installed = False
+
+
+def is_enabled() -> bool:
+    return _TraceState.enabled
+
+
+def enable() -> None:
+    """Start recording spans (fresh table)."""
+    if _TraceState.enabled:
+        return
+    with _TraceState.lock:
+        _TraceState.events = []
+        _TraceState.t0 = time.perf_counter()
+    _TraceState.enabled = True
+
+
+def disable() -> None:
+    _TraceState.enabled = False
+
+
+def clear() -> None:
+    with _TraceState.lock:
+        _TraceState.events = []
+        _TraceState.t0 = time.perf_counter()
+
+
+def events() -> List[SpanEvent]:
+    with _TraceState.lock:
+        return list(_TraceState.events)
+
+
+class Span:
+    """Scoped wall-clock span (was profiler.RecordEvent — that name is
+    now an alias of this class, so the old serving/training call sites
+    and the new obs ones record identically).
+
+    Context manager or decorator. `cat` tags the chrome-trace category
+    (see CATEGORIES); `args` is an optional dict written into the trace
+    event — set at construction or mutate `span.args` inside the scope
+    (the serving engine records per-step request counts this way), it
+    is read at end(). `annotate=False` skips the
+    jax.profiler.TraceAnnotation for spans that must stay jax-free.
+    """
+
+    def __init__(self, name: str, cat: str = None, args: dict = None,
+                 annotate: bool = True):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.annotate = annotate
+        self._t0 = None
+        self._ann = None
+
+    def begin(self):
+        if _TraceState.enabled:
+            self._t0 = time.perf_counter()
+            if self.annotate:
+                import jax
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            depth = getattr(_TraceState.tls, "depth", 0)
+            _TraceState.tls.depth = depth + 1
+
+    def end(self):
+        if self._t0 is not None:
+            t1 = time.perf_counter()
+            _TraceState.tls.depth -= 1
+            with _TraceState.lock:
+                _TraceState.events.append(SpanEvent(
+                    self.name, self._t0, t1,
+                    threading.get_ident(), _TraceState.tls.depth,
+                    self.cat, self.args))
+            if self._ann is not None:
+                self._ann.__exit__(None, None, None)
+                self._ann = None
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with Span(self.name, cat=self.cat, annotate=self.annotate):
+                return fn(*a, **k)
+        return wrapper
+
+
+def span(name: str, cat: str = None, args: dict = None,
+         annotate: bool = True) -> Span:
+    """Convenience constructor: `with obs.span("x", cat="decode"): ...`"""
+    return Span(name, cat=cat, args=args, annotate=annotate)
+
+
+def export_chrome(path: str) -> str:
+    """Write recorded spans as chrome://tracing JSON (the substance of
+    profiler.export_chrome_tracing, which now delegates here). ts/dur
+    in microseconds relative to enable() time; category defaults to
+    "op" for unlabeled spans."""
+    evs = events()
+    trace = {"traceEvents": [
+        dict({"name": e.name, "ph": "X", "cat": e.cat or "op",
+              "ts": (e.start - _TraceState.t0) * 1e6,
+              "dur": (e.end - e.start) * 1e6,
+              "pid": os.getpid(), "tid": e.tid},
+             **({"args": e.args} if e.args else {}))
+        for e in evs
+    ]}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path

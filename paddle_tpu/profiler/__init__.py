@@ -12,15 +12,24 @@ TPU-native analogue of the reference profiler stack:
   jax.profiler.start_trace/stop_trace producing a TensorBoard/perfetto
   trace directory.
 - tools/timeline.py chrome-trace generation → export_chrome_tracing().
+
+As of PR 6 the event machinery LIVES in `paddle_tpu.obs.trace` (the
+unified telemetry layer): `RecordEvent` is `obs.trace.Span`,
+`_ProfState` is `obs.trace._TraceState` and `_Event` is
+`obs.trace.SpanEvent` — the same objects under their historical names,
+so existing call sites and tests keep working while profiler spans and
+obs spans land in one table and one chrome trace. New code should
+instrument via `paddle_tpu.obs`; this module remains the
+paddle-compatible facade.
 """
 from __future__ import annotations
 
-import json
-import os
-import threading
-import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
+
+from ..obs import trace as _trace
+from ..obs.trace import (Span as RecordEvent, SpanEvent as _Event,
+                         _TraceState as _ProfState)
 
 __all__ = [
     "RecordEvent", "record_event", "start_profiler", "stop_profiler",
@@ -30,93 +39,8 @@ __all__ = [
 ]
 
 
-class _Event:
-    __slots__ = ("name", "start", "end", "tid", "depth", "cat", "args")
-
-    def __init__(self, name, start, end, tid, depth, cat=None, args=None):
-        self.name = name
-        self.start = start
-        self.end = end
-        self.tid = tid
-        self.depth = depth
-        self.cat = cat
-        self.args = args
-
-
-class _ProfState:
-    enabled = False
-    events: List[_Event] = []
-    t0 = 0.0
-    lock = threading.Lock()
-    tls = threading.local()
-    trace_dir: Optional[str] = None
-    op_hook_installed = False
-
-
 def is_profiler_enabled() -> bool:
     return _ProfState.enabled
-
-
-class RecordEvent:
-    """Scoped event marker (reference: platform/profiler.h:127 RecordEvent).
-
-    Usable as context manager or decorator. Host side: wall-time event in
-    the global table. Device side: a jax.profiler.TraceAnnotation so the
-    scope appears in XLA traces viewed in TensorBoard/perfetto.
-
-    cat tags the chrome-trace category (default "op"); args is an
-    optional dict written into the trace event's args — set it at
-    construction or mutate `ev.args` inside the scope (the serving
-    engine records per-step request counts this way), it is read at
-    end().
-    """
-
-    def __init__(self, name: str, cat: str = None, args: dict = None):
-        self.name = name
-        self.cat = cat
-        self.args = args
-        self._t0 = None
-        self._ann = None
-
-    def begin(self):
-        if _ProfState.enabled:
-            self._t0 = time.perf_counter()
-            import jax
-            self._ann = jax.profiler.TraceAnnotation(self.name)
-            self._ann.__enter__()
-            depth = getattr(_ProfState.tls, "depth", 0)
-            _ProfState.tls.depth = depth + 1
-
-    def end(self):
-        if self._t0 is not None:
-            t1 = time.perf_counter()
-            _ProfState.tls.depth -= 1
-            with _ProfState.lock:
-                _ProfState.events.append(_Event(
-                    self.name, self._t0, t1,
-                    threading.get_ident(), _ProfState.tls.depth,
-                    self.cat, self.args))
-            if self._ann is not None:
-                self._ann.__exit__(None, None, None)
-                self._ann = None
-            self._t0 = None
-
-    def __enter__(self):
-        self.begin()
-        return self
-
-    def __exit__(self, *exc):
-        self.end()
-        return False
-
-    def __call__(self, fn):
-        import functools
-
-        @functools.wraps(fn)
-        def wrapper(*a, **k):
-            with RecordEvent(self.name):
-                return fn(*a, **k)
-        return wrapper
 
 
 @contextmanager
@@ -150,16 +74,12 @@ def start_profiler(state: str = "All", tracer_option: str = "Default"):
     if _ProfState.enabled:
         return
     _install_op_hook()
-    _ProfState.events = []
-    _ProfState.t0 = time.perf_counter()
-    _ProfState.enabled = True
+    _trace.enable()
 
 
 def reset_profiler():
     """reference: fluid/profiler.py reset_profiler."""
-    with _ProfState.lock:
-        _ProfState.events = []
-        _ProfState.t0 = time.perf_counter()
+    _trace.clear()
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
@@ -170,7 +90,7 @@ def stop_profiler(sorted_key: Optional[str] = None,
     chrome://tracing — the tools/timeline.py role)."""
     if not _ProfState.enabled:
         return
-    _ProfState.enabled = False
+    _trace.disable()
     if profile_path:
         export_chrome_tracing(profile_path)
     print(summary(sorted_key=sorted_key or "total"))
@@ -179,9 +99,7 @@ def stop_profiler(sorted_key: Optional[str] = None,
 def summary(sorted_key: str = "total") -> str:
     """Aggregate event table: calls/total/avg/min/max ms per event name."""
     agg: Dict[str, List[float]] = {}
-    with _ProfState.lock:
-        events = list(_ProfState.events)
-    for e in events:
+    for e in _trace.events():
         d = (e.end - e.start) * 1e3
         s = agg.setdefault(e.name, [0, 0.0, float("inf"), 0.0])
         s[0] += 1
@@ -209,27 +127,12 @@ def summary(sorted_key: str = "total") -> str:
 
 def export_chrome_tracing(path: str):
     """Write recorded host events as a chrome://tracing JSON file
-    (reference: tools/timeline.py Timeline generation). Events carry
-    their category (e.g. the serving engine's prefill/decode/schedule
-    spans are cat="serving" with request counts in args), so an
-    LLMEngine trace is inspectable end to end in chrome://tracing or
-    perfetto."""
-    with _ProfState.lock:
-        events = list(_ProfState.events)
-    trace = {"traceEvents": [
-        dict({"name": e.name, "ph": "X", "cat": e.cat or "op",
-              "ts": (e.start - _ProfState.t0) * 1e6,
-              "dur": (e.end - e.start) * 1e6,
-              "pid": os.getpid(), "tid": e.tid},
-             **({"args": e.args} if e.args else {}))
-        for e in events
-    ]}
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(trace, f)
-    return path
+    (reference: tools/timeline.py Timeline generation). Delegates to
+    obs.trace.export_chrome — events carry their category (e.g. the
+    serving engine's prefill/decode/schedule spans with request counts
+    in args), so an LLMEngine trace is inspectable end to end in
+    chrome://tracing or perfetto."""
+    return _trace.export_chrome(path)
 
 
 @contextmanager

@@ -378,13 +378,18 @@ def test_engine_steps_appear_in_chrome_trace(tmp_path):
         profiler._ProfState.enabled = False
     with open(path) as f:
         events = json.load(f)["traceEvents"]
-    serving = [e for e in events if e.get("cat") == "serving"]
-    names = {e["name"] for e in serving}
-    assert {"serving.engine_step", "serving.schedule",
-            "serving.prefill", "serving.decode"} <= names
-    sched = next(e for e in serving if e["name"] == "serving.schedule")
+    # PR 6: phases carry their own span categories (obs.trace.CATEGORIES)
+    # — the step span stays cat="serving", schedule/prefill/decode are
+    # attributable per phase in chrome://tracing
+    by_cat = {e["name"]: e.get("cat") for e in events
+              if e["name"].startswith("serving.")}
+    assert by_cat == {"serving.engine_step": "serving",
+                      "serving.schedule": "schedule",
+                      "serving.prefill": "prefill",
+                      "serving.decode": "decode"}
+    sched = next(e for e in events if e["name"] == "serving.schedule")
     assert {"prefill", "decode", "free_blocks"} <= set(sched["args"])
-    pre = next(e for e in serving if e["name"] == "serving.prefill")
+    pre = next(e for e in events if e["name"] == "serving.prefill")
     assert pre["args"]["tokens"] == 5
 
 

@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
+import tempfile
 
 import numpy as np
 
@@ -110,12 +112,20 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
     injector = ServingFaultInjector(faults)
     eng, rids, cancelled = drive(injector, do_cancel=True)
 
+    d = eng.stats.as_dict()
+    unserved = d["shed"] + d["errors"] + d["timeouts"] + d["expired"]
+    p99 = eng.stats.ttft_quantile(0.99)
     report = {
         "seed": seed, "requests": n_requests, "faults": faults,
         "fired": list(injector.fired_log),
-        "stats": {k: v for k, v in eng.stats.as_dict().items()
+        "stats": {k: v for k, v in d.items()
                   if isinstance(v, int) and v},
         "cache": eng.cache.stats(),
+        # serving SLO view (same definitions as tools/load_suite.py):
+        # reject_rate counts every submitted request the engine did not
+        # serve to completion for an engine-side reason
+        "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
+                "reject_rate": round(unserved / max(n_requests, 1), 4)},
     }
     # 1. no lost requests: every id terminal
     lost = [i for i, r in rids.items() if not eng.get_request(r).finished]
@@ -149,6 +159,17 @@ def main(argv=None) -> int:
     ap.add_argument("--cancel-every", type=int, default=0,
                     help="cancel a random live request every N steps")
     ap.add_argument("--max-steps", type=int, default=400)
+    ap.add_argument("--snapshot", metavar="PATH",
+                    default=os.path.join(tempfile.gettempdir(),
+                                         "chaos_serve_obs.json"),
+                    help="obs registry snapshot dumped on exit "
+                         "(pass or fail); '' disables")
+    ap.add_argument("--slo", action="store_true",
+                    help="exit nonzero on TTFT-p99 / reject-rate breach")
+    ap.add_argument("--max-ttft-p99", type=float, default=10.0,
+                    help="--slo threshold, seconds")
+    ap.add_argument("--max-reject-rate", type=float, default=0.5,
+                    help="--slo threshold, fraction of submitted")
     args = ap.parse_args(argv)
     try:
         report = run_chaos(seed=args.seed, n_requests=args.requests,
@@ -157,8 +178,27 @@ def main(argv=None) -> int:
     except AssertionError as e:
         print(f"CHAOS FAIL: {e}", file=sys.stderr)
         return 1
+    finally:
+        # post-mortem telemetry: full obs snapshot (both engines' metric
+        # series — the labels differ, so ref vs faulted stay separate)
+        if args.snapshot:
+            from paddle_tpu import obs
+            obs.dump_snapshot(args.snapshot)
+            print(f"obs snapshot: {args.snapshot}", file=sys.stderr)
+    rc = 0
+    if args.slo:
+        viol = []
+        p99 = report["slo"]["ttft_p99_s"]
+        if p99 is None or p99 > args.max_ttft_p99:
+            viol.append(f"ttft_p99 {p99} > {args.max_ttft_p99}s")
+        if report["slo"]["reject_rate"] > args.max_reject_rate:
+            viol.append(f"reject_rate {report['slo']['reject_rate']} > "
+                        f"{args.max_reject_rate}")
+        if viol:
+            print(f"SLO FAIL: {'; '.join(viol)}", file=sys.stderr)
+            rc = 1
     print(json.dumps(report, indent=2, default=str))
-    return 0
+    return rc
 
 
 if __name__ == "__main__":
