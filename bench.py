@@ -516,8 +516,15 @@ def bench_serve_decode(on_tpu: bool):
     the serving counterpart of bench_decode's single-batch scan. Reports
     engine decode tokens/s (device decode time only, from EngineStats;
     schedule/sample host time is reported separately so host overhead is
-    visible, not hidden in the headline). Returns
-    (decode_tokens_per_sec, stats_dict)."""
+    visible, not hidden in the headline).
+
+    The headline run uses the fused k-token device-resident decode
+    (EngineConfig.decode_chunk_size default); a second pass with
+    decode_chunk_size=1 measures the classic one-sync-per-token step on
+    the SAME workload, and the detail dict reports host-syncs-per-token
+    plus the host/device time split for both, so the chunking gain is
+    attributed, not asserted. Returns (decode_tokens_per_sec,
+    stats_dict)."""
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig
     from paddle_tpu.inference.serving import (EngineConfig, LLMEngine,
@@ -550,8 +557,8 @@ def bench_serve_decode(on_tpu: bool):
                           dtype=np.int32),
               int(rng.randint(t_lo, t_hi))) for _ in range(n_req)]
 
-    def run_once():
-        eng = LLMEngine.from_model(model, ecfg)
+    def run_once(cfg_run=None):
+        eng = LLMEngine.from_model(model, cfg_run or ecfg)
         pending = list(specs)
         for _ in range(min(ecfg.max_num_seqs, len(pending))):
             p, mt = pending.pop(0)
@@ -572,7 +579,14 @@ def bench_serve_decode(on_tpu: bool):
         eng = run_once()
         if best is None or eng.stats.time_decode < best.stats.time_decode:
             best = eng
+    # the pre-chunking baseline on the same workload: one host sync per
+    # token (decode_chunk_size=1) — attributes the fused-chunk gain
+    from dataclasses import replace as _dc_replace
+    ecfg1 = _dc_replace(ecfg, decode_chunk_size=1)
+    run_once(ecfg1)                             # compile the k=1 variant
+    before = run_once(ecfg1)
     d = best.stats.as_dict()
+    d1 = before.stats.as_dict()
     # host/device split and TTFT come from the obs registry: the
     # time_* fields are thin views over serving_phase_seconds_total and
     # the quantiles read the serving_ttft_seconds histogram's samples
@@ -587,6 +601,12 @@ def bench_serve_decode(on_tpu: bool):
         "device_prefill_s": round(d["time_prefill"], 4),
         "device_decode_s": round(d["time_decode"], 4),
         "cache_high_water": best.cache.high_water,
+        "decode_chunk_size": ecfg.decode_chunk_size,
+        "host_syncs_per_token": round(d["host_syncs_per_token"], 4),
+        "host_syncs_per_token_k1": round(d1["host_syncs_per_token"], 4),
+        "tokens_per_sec_k1": round(d1["decode_tokens_per_sec"], 2),
+        "host_schedule_s_k1": round(d1["time_schedule"], 4),
+        "device_decode_s_k1": round(d1["time_decode"], 4),
     }
 
 

@@ -513,7 +513,9 @@ def _decode_sub_programs() -> List[_Program]:
 
 
 def _serving_programs() -> List[_Program]:
-    from ..inference.serving.attention import paged_decode_step
+    from ..inference.serving.attention import (PACK_COLS,
+                                               fused_decode_chunk,
+                                               paged_decode_step)
     from ..models import generation as g
     _, cfg, geom, params, _ = _tiny_gpt()
     L, H, D, S = geom
@@ -540,7 +542,20 @@ def _serving_programs() -> List[_Program]:
                      "to rebuild survivors after a poisoned step "
                      "(LLMEngine watchdog); donating them would delete "
                      "the rollback copy"})
-    return [prefill, paged]
+    # the fused k-token chunk (the engine's steady-state decode path):
+    # cost scales ~k x the single paged step — the scan body is
+    # multiplied by its static trip count — and the pools ARE donated
+    # here (the scan carries them; the engine rebinds cache.pools from
+    # the return value, and chunk-granular recovery re-prefills from
+    # host token logs instead of re-reading pre-step pools)
+    K = 8
+    packed = jnp.zeros((N, PACK_COLS + MB), jnp.int32)
+    chunk = _Program(
+        "serving.decode_chunk",
+        getattr(fused_decode_chunk, "__wrapped__", fused_decode_chunk),
+        (params, pools, packed, geom, K),
+        static_argnums=(3, 4), donate_argnums=(1,))
+    return [prefill, paged, chunk]
 
 
 def _collective_programs() -> List[_Program]:
@@ -600,7 +615,7 @@ _REGISTRY_NAMES = (
     "train_step",
     "decode.token_embed", "decode.qkv", "decode.cache_write",
     "decode.attn", "decode.head",
-    "serving.prefill", "serving.paged_decode",
+    "serving.prefill", "serving.paged_decode", "serving.decode_chunk",
     "collective.ring_attention", "collective.ulysses_attention",
     "collective.psum_tree",
 )

@@ -18,7 +18,8 @@ PAPERS.md arxiv 2604.15464). Four cooperating modules:
 See docs/serving.md for architecture and tuning.
 """
 from .paged_cache import CacheExhausted, PagedKVCache  # noqa: F401
-from .attention import gather_block_kv, paged_decode_step  # noqa: F401
+from .attention import (gather_block_kv, paged_decode_step,  # noqa: F401
+                        fused_decode_chunk)
 from .scheduler import (EngineOverloaded, Request,  # noqa: F401
                         RequestState, SamplingParams, ScheduledBatch,
                         Scheduler, SchedulerConfig)
@@ -28,7 +29,8 @@ from .engine import (EngineConfig, EngineStats, LLMEngine,  # noqa: F401
 __all__ = [
     "PagedKVCache", "CacheExhausted", "EngineOverloaded",
     "gather_block_kv",
-    "paged_decode_step", "SamplingParams", "Request", "RequestState",
+    "paged_decode_step", "fused_decode_chunk",
+    "SamplingParams", "Request", "RequestState",
     "Scheduler", "SchedulerConfig", "ScheduledBatch", "EngineConfig",
     "EngineStats", "LLMEngine", "RequestOutput", "ServingPredictor",
 ]

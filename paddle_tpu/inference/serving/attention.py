@@ -31,13 +31,18 @@ compiles once per bucket and NEVER recompiles per request mix.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
+from ...core.anomaly import rows_not_finite
 from ...models.generation import (_decode_attn, _decode_head, _decode_qkv,
                                   _token_embed)
 
-__all__ = ["gather_block_kv", "paged_decode_step"]
+__all__ = ["gather_block_kv", "paged_decode_step", "fused_decode_chunk",
+           "PACK_COLS", "pack_f32"]
 
 
 def gather_block_kv(pool, block_tables):
@@ -96,3 +101,142 @@ def paged_decode_step(params, pools, tokens, positions, block_tables,
         new_pools.append((kp, vp))
         x = _decode_attn(params, i, x, qkv[0], kc, vc, positions, geom)
     return _decode_head(params, x), tuple(new_pools)
+
+
+# ------------------------------------------------- fused k-token decode
+# Packed per-sequence control state, one int32 [N, PACK_COLS + MB] upload
+# per chunk (column layout below; float fields travel as raw f32 bits so
+# the whole transfer stays a single dtype-homogeneous array):
+#   0 tok        last sampled token (the next step's input)
+#   1 pos        next KV write position (== cached length)
+#   2 active     1 for live rows, 0 for bucket padding
+#   3 out_cnt    tokens generated so far (threads the PRNG fold_in)
+#   4 max_out    SamplingParams.max_tokens
+#   5 eos        eos_token_id, -1 when unset
+#   6 temp       temperature as float32 bits
+#   7 top_k      0 = disabled
+#   8 top_p      top_p as float32 bits (>=1.0 = disabled)
+#   9 seed       per-request PRNG seed (masked to 31 bits)
+#   10.. tables  the block table row [MB]
+PACK_COLS = 10
+
+
+def pack_f32(x) -> int:
+    """Host-side helper: float -> raw float32 bits as a python int, for
+    the packed control columns above."""
+    import numpy as np
+    return int(np.float32(x).view(np.int32))
+
+
+def _sample_rows(logits, keys, temps, top_ks, top_ps):
+    """Branchless per-row sampling over [N, V] logits — the device twin
+    of LLMEngine._sample / generation._sampling_rollout: greedy when
+    temp<=0, else temperature softmax restricted by top-k (kth-largest
+    threshold, ties kept) and nucleus top-p (smallest prefix of the
+    descending distribution with cumulative mass >= top_p; the kept set
+    is computed with an EXCLUSIVE cumsum so the crossing token stays).
+    All rows run every path; jnp.where selects, so the program is a
+    fixed dataflow suitable as a lax.scan body."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / jnp.where(temps > 0, temps, 1.0)[:, None]
+    # top-k: threshold at the k-th largest value (ties kept, like the
+    # host sampler's kth = sort(lg)[-top_k]).
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(top_ks - 1, 0, vocab - 1)[:, None], axis=1)
+    lg = jnp.where((top_ks[:, None] > 0) & (lg < kth), -1e30, lg)
+    # top-p: exclusive cumulative mass < top_p keeps the crossing token.
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    excl = jnp.cumsum(probs, axis=-1) - probs
+    n_keep = jnp.sum(excl < top_ps[:, None], axis=-1)
+    pth = jnp.take_along_axis(
+        srt, jnp.clip(n_keep - 1, 0, vocab - 1)[:, None], axis=1)
+    use_p = (top_ps > 0.0) & (top_ps < 1.0)
+    lg = jnp.where(use_p[:, None] & (lg < pth), -1e30, lg)
+    sampled = jax.vmap(jax.random.categorical)(keys, lg).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(1,))
+def fused_decode_chunk(params, pools, packed, geom, k):
+    """k decode steps for N sequences entirely on device: one lax.scan
+    whose body is the paged decode step above plus on-device sampling
+    and termination tracking. The host uploads ONE packed int32 array
+    (layout at PACK_COLS) and fetches ONE int32 [k+2, N] result:
+
+        rows 0..k-1   sampled token per scan step, -1 where the row was
+                      frozen (inactive / already finished / flagged bad)
+        row  k        finished mask after the chunk (EOS or max_tokens)
+        row  k+1      per-row not-finite flag, latched at the FIRST bad
+                      step — the engine's anomaly attribution, computed
+                      in-scan so quarantine needs no extra fetch
+
+    Frozen rows still flow through the fixed-shape body but scatter to
+    slot_block=num_blocks (dropped) and keep their carry unchanged, so
+    a chunk is bitwise-equivalent to running its live prefix as smaller
+    chunks: sampling keys derive from fold_in(seed_key, out_cnt) — a
+    function of per-request progress, NOT of chunk geometry — which
+    makes token streams invariant under chunk size and under
+    preemption/recovery replay (tests pin k-step vs k x 1-step).
+
+    pools (arg 1) is DONATED: the KV carry is updated in place across
+    the scan and the input buffers alias the output on TPU, so the k
+    cache writes cost no extra copies of the pool.
+
+    Returns (out [k+2, N] int32, updated pools).
+    """
+    num_layers, num_heads, head_dim, max_seq = geom
+    tables = packed[:, PACK_COLS:]
+    num_blocks = pools[0][0].shape[0]
+    block_size = pools[0][0].shape[1]
+    n = packed.shape[0]
+    active = packed[:, 2] > 0
+    max_out = packed[:, 4]
+    eos = packed[:, 5]
+    temps = lax.bitcast_convert_type(packed[:, 6], jnp.float32)
+    top_ks = packed[:, 7]
+    top_ps = lax.bitcast_convert_type(packed[:, 8], jnp.float32)
+    base_keys = jax.vmap(jax.random.PRNGKey)(packed[:, 9])
+
+    def body(carry, _):
+        pools, tok, pos, out_cnt, finished, bad = carry
+        run = active & ~finished & ~bad
+        blk_idx = jnp.where(run, pos // block_size, 0)
+        slot_blocks = jnp.where(
+            run,
+            jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0],
+            num_blocks)                      # frozen rows: scatter drops
+        slot_offsets = pos % block_size
+        x = _token_embed(params, tok, pos)
+        new_pools = []
+        for i, (kp, vp) in enumerate(pools):
+            qkv = _decode_qkv(params, i, x, geom)
+            kp, vp, kc, vc = _pool_write_gather(
+                kp, vp, qkv[1], qkv[2], slot_blocks, slot_offsets, tables)
+            new_pools.append((kp, vp))
+            x = _decode_attn(params, i, x, qkv[0], kc, vc, pos, geom)
+        logits = _decode_head(params, x)
+        row_bad = rows_not_finite(logits) & run
+        bad = bad | row_bad
+        keys = jax.vmap(jax.random.fold_in)(base_keys, out_cnt)
+        tok_new = _sample_rows(logits, keys, temps, top_ks, top_ps)
+        ok = run & ~row_bad
+        emit = jnp.where(ok, tok_new, -1)
+        finished = finished | (ok & ((tok_new == eos)
+                                     | (out_cnt + 1 >= max_out)))
+        tok = jnp.where(ok, tok_new, tok)
+        pos = jnp.where(ok, pos + 1, pos)
+        out_cnt = jnp.where(ok, out_cnt + 1, out_cnt)
+        return (tuple(new_pools), tok, pos, out_cnt, finished, bad), emit
+
+    carry0 = (pools, packed[:, 0], packed[:, 1], packed[:, 3],
+              jnp.zeros((n,), bool), jnp.zeros((n,), bool))
+    (pools, _, _, _, finished, bad), toks = lax.scan(
+        body, carry0, None, length=k)
+    out = jnp.concatenate(
+        [toks.astype(jnp.int32),
+         finished[None].astype(jnp.int32),
+         bad[None].astype(jnp.int32)], axis=0)
+    return out, pools

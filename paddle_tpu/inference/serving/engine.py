@@ -10,15 +10,25 @@ Device work per step:
 - prefill: models.generation.prefill (the SAME jitted program the dense
   generate() path uses — one compilation per prompt-length bucket),
   scattered into the sequence's blocks (PagedKVCache.write_prefill);
-- decode: serving.attention.paged_decode_step over ALL running
-  sequences at once, padded to a power-of-two bucket capped at
-  max_num_seqs, so XLA compiles once per bucket and never recompiles
-  per request mix.
+- decode: serving.attention.fused_decode_chunk — a jitted lax.scan that
+  decodes, SAMPLES and tracks termination for up to decode_chunk_size
+  tokens per running sequence entirely on device, padded to a
+  power-of-two bucket capped at max_num_seqs, so XLA compiles once per
+  (bucket, k) and never recompiles per request mix.
 
-Sampling is host-side numpy (greedy argmax / temperature + top-k/top-p)
-with a per-request RNG: continuous batching must not change results, so
-greedy engine output token-matches models.generation.generate
-(tests/test_serving.py pins this end to end, preemptions included).
+Host/device contract (docs/serving.md "Device-resident decode"): the
+host uploads ONE packed control array per chunk and fetches ONE
+(tokens[k], finished, not-finite flags) result — host syncs in
+steady-state decode are 1 per k tokens, not 1 per token (the obs
+host-sync counter pins this). The first token of a request is sampled
+on host from the prefill logits (host numpy, per-request RNG); every
+subsequent token is sampled in-scan with a fold_in(seed,
+tokens-generated) PRNG key, a function of request progress only — so
+token streams are invariant under chunk size, preemption and crash
+replay, and greedy engine output token-matches
+models.generation.generate (tests/test_serving.py pins this end to
+end, preemptions included; tests/test_serving_chunked.py pins k-chunk
+vs k x 1-chunk bitwise, temperature paths included).
 
 Hardened step (docs/serving.md "Failure semantics"): every step first
 expires overdue requests (deadline_s / queue_ttl_s → 'timeout'), then
@@ -59,7 +69,7 @@ from ...analysis import holds_lock
 from ...core import anomaly
 from ...models import generation as gen
 from ...profiler import RecordEvent
-from .attention import paged_decode_step
+from .attention import PACK_COLS, fused_decode_chunk, pack_f32
 from .paged_cache import PagedKVCache
 from .scheduler import (EngineOverloaded, Request, RequestState,
                         SamplingParams, ScheduledBatch, Scheduler,
@@ -75,6 +85,14 @@ class EngineConfig:
     num_blocks: int = 256
     max_num_seqs: int = 8
     max_prefill_tokens: int = 2048
+    # tokens decoded per fused device chunk (the k of
+    # attention.fused_decode_chunk): the host syncs with the device
+    # once per k tokens instead of once per token. 1 reproduces the
+    # classic single-token step (useful for A/B and debugging); larger
+    # k amortizes dispatch further but coarsens the granularity at
+    # which deadlines/watchdog/fault quarantine act (they all run at
+    # chunk boundaries).
+    decode_chunk_size: int = 8
     # ----------------------------- robustness layer (docs/serving.md)
     max_waiting: Optional[int] = None    # bounded waiting queue (None=∞)
     admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
@@ -174,6 +192,24 @@ class EngineStats:
         self._step = obs.histogram(
             "serving_step_seconds", "engine step() wall time",
             labels=("engine",), unit="seconds").labels(**lbl)
+        self._decode_chunk = obs.histogram(
+            "serving_decode_chunk_seconds",
+            "fused k-token decode chunk wall time (the device scan plus "
+            "its single host fetch)",
+            labels=("engine",), unit="seconds").labels(**lbl)
+        sy = obs.counter(
+            "serving_host_syncs_total",
+            "device->host synchronizations: one per prefill logits "
+            "fetch, one per fused decode chunk fetch",
+            labels=("engine", "phase"))
+        self._syncs = {p: sy.labels(phase=p, **lbl)
+                       for p in ("prefill", "decode")}
+        self._g_syncs_per_token = obs.gauge(
+            "serving_host_syncs_per_token",
+            "decode host syncs / generated tokens — the steady-state "
+            "per-token host round-trip cost the fused chunk amortizes "
+            "to ~1/k",
+            labels=("engine",)).labels(**lbl)
         g_run = obs.gauge("serving_running", "running sequences",
                           labels=("engine",))
         g_wait = obs.gauge("serving_waiting", "waiting-queue depth",
@@ -214,9 +250,31 @@ class EngineStats:
     def set_prefill_spend(self, tokens: int) -> None:
         self._g_prefill_spend.set(tokens)
 
+    def observe_decode_chunk(self, dt: float) -> None:
+        self._decode_chunk.observe(dt)
+
+    def inc_host_sync(self, phase: str) -> None:
+        self._syncs[phase].inc()
+
+    def host_syncs(self, phase: str) -> int:
+        """Exact sync count (the chunked-decode acceptance test pins
+        decode syncs == number of chunks, not tokens)."""
+        return int(self._syncs[phase].value)
+
+    def set_syncs_per_token(self, v: float) -> None:
+        self._g_syncs_per_token.set(v)
+
+    def host_syncs_per_token(self) -> float:
+        return self._g_syncs_per_token.value
+
     def ttft_quantile(self, q: float) -> float:
         """Exact TTFT quantile (bench / load suite read p50/p99 here)."""
         return self._ttft.quantile(q)
+
+    def token_gap_quantile(self, q: float) -> float:
+        """Exact inter-token-gap quantile (load suite decode_heavy
+        reports p99 here)."""
+        return self._token_gap.quantile(q)
 
     def as_dict(self) -> dict:
         d = {f: getattr(self, f) for f in _STAT_EVENTS}
@@ -230,6 +288,9 @@ class EngineStats:
         busy = self.time_prefill + self.time_decode
         d["decode_tokens_per_sec"] = (
             self.generated_tokens / busy if busy > 0 else 0.0)
+        d["host_syncs_per_token"] = (
+            self.host_syncs("decode") / self.generated_tokens
+            if self.generated_tokens else 0.0)
         return d
 
 
@@ -299,6 +360,10 @@ class LLMEngine:
             raise ValueError(
                 f"block_size {config.block_size} must divide "
                 f"max_seq_len {S}")
+        if config.decode_chunk_size < 1:
+            raise ValueError(
+                f"decode_chunk_size must be >= 1, got "
+                f"{config.decode_chunk_size}")
         self.params = params
         self.geom = geom
         self.config = config
@@ -309,6 +374,7 @@ class LLMEngine:
             SchedulerConfig(
                 max_num_seqs=config.max_num_seqs,
                 max_prefill_tokens=config.max_prefill_tokens,
+                decode_chunk_size=config.decode_chunk_size,
                 max_waiting=config.max_waiting,
                 admission_policy=config.admission_policy,
                 cache_high_watermark=config.cache_high_watermark),
@@ -588,38 +654,52 @@ class LLMEngine:
             decode = [r for r in batch.decode if not r.finished]
             if decode:
                 t0 = time.perf_counter()
+                k = self.config.decode_chunk_size
                 with RecordEvent("serving.decode", cat="decode") as ev:
-                    ev.args = {"num_seqs": len(decode)}
+                    ev.args = {"num_seqs": len(decode), "chunk": k}
                     self.faults.stall(step_no)
                     try:
-                        logits = self._decode(decode)
+                        toks, bad = self._decode_chunk(decode, k)
                     except Exception as e:
-                        logits = None
+                        toks = None
                         self._recover(decode, [decode[0]], outs,
                                       f"decode raised: {e}")
-                self.stats.time_decode += time.perf_counter() - t0
-                if logits is not None:
-                    logits = self.faults.poison_logits(step_no, logits)
-                    # host-side twin of rows_not_finite: _decode already
-                    # materialized the logits, keep attribution on host
-                    bad = anomaly.rows_not_finite_host(logits)
+                dt = time.perf_counter() - t0
+                self.stats.time_decode += dt
+                self.stats.observe_decode_chunk(dt)
+                if toks is not None:
+                    # the not-finite flags were computed IN-SCAN and
+                    # arrived with the chunk fetch — anomaly attribution
+                    # costs no extra sync (and no host re-reduction)
+                    bad = self.faults.poison_chunk(step_no, bad)
                     if bad.any():
+                        # a bad row poisons the whole chunk: every
+                        # emission is discarded, offenders quarantined,
+                        # survivors requeued — replay is bitwise because
+                        # sampling keys depend only on request progress
                         self._recover(
                             decode,
                             [r for i, r in enumerate(decode) if bad[i]],
-                            outs, "non-finite decode logits")
+                            outs, "non-finite decode logits in chunk")
                     elif self._wedged():
-                        # a wedged batched decode cannot be attributed;
+                        # a wedged batched chunk cannot be attributed;
                         # quarantine its head (deterministic) and rebuild
-                        # the rest — the whole step's tokens are dropped
+                        # the rest — the whole chunk's tokens are dropped
                         # so survivors stay bitwise on the replay
                         self.stats.watchdog_trips += 1
                         self._recover(decode, [decode[0]], outs,
-                                      "wedged decode step (watchdog)")
+                                      "wedged decode chunk (watchdog)")
                     else:
-                        for i, req in enumerate(decode):
-                            self._emit(req, self._sample(req, logits[i]),
-                                       outs)
+                        # step-major drain of the fetched chunk: row j of
+                        # toks is scan step j; -1 marks a frozen row.
+                        # _emit re-derives eos/max_tokens terminals on
+                        # host — the same conditions the device froze on
+                        # — so telemetry and finish_reason stay exact.
+                        for j in range(toks.shape[0]):
+                            for i, req in enumerate(decode):
+                                t = int(toks[j, i])
+                                if t >= 0 and not req.finished:
+                                    self._emit(req, t, outs)
             step_ev.args = {"step": step_no, "outputs": len(outs),
                             "errors": self.stats.errors,
                             "expired": self.stats.expired,
@@ -629,6 +709,10 @@ class LLMEngine:
         # counters, cache free lists) — recording adds no device work
         self.stats.observe_step(time.perf_counter() - self._step_start)
         self.stats.set_prefill_spend(prefill_spend)
+        if self.stats.generated_tokens:
+            self.stats.set_syncs_per_token(
+                self.stats.host_syncs("decode")
+                / self.stats.generated_tokens)
         self.stats.set_step_gauges(
             running=self.scheduler.num_running(),
             waiting=self.scheduler.num_waiting(),
@@ -636,41 +720,54 @@ class LLMEngine:
             blocks_free=self.cache.num_free())
         return outs
 
+    @holds_lock("_lock")
     def _prefill(self, req: Request, tokens: np.ndarray) -> np.ndarray:
         """Dense prefill (shared jitted program with generate()),
-        scattered into the sequence's blocks. Returns last-position
-        logits [V]."""
+        scattered into the sequence's blocks. One upload (the prompt),
+        one fetch (the last-position logits [V]) — already the minimal
+        host/device traffic for a prompt forward."""
         logits, dense_cache = gen.prefill(
             self.params, jnp.asarray(tokens[None], jnp.int32), self.geom)
         self.cache.write_prefill(req.request_id, dense_cache, tokens.size)
-        return np.asarray(logits[0])
+        out = np.asarray(logits[0])
+        self.stats.inc_host_sync("prefill")
+        return out
 
-    def _decode(self, reqs: List[Request]) -> np.ndarray:
-        """Ragged paged decode for all running sequences, padded to the
-        power-of-two bucket. Returns logits [len(reqs), V]."""
+    @holds_lock("_lock")
+    def _decode_chunk(self, reqs: List[Request], k: int):
+        """Fused k-token device-resident decode for all running
+        sequences, padded to the power-of-two bucket. The per-sequence
+        control state (last token, position, sampling knobs, block
+        table) travels as ONE packed int32 upload; the result — k
+        sampled tokens per row plus the finished and not-finite masks —
+        comes back in ONE fetch. Returns (tokens [k, len(reqs)] int32
+        with -1 on frozen rows, bad [len(reqs)] bool)."""
         n = _bucket(len(reqs), self.config.max_num_seqs)
-        mb, nb = self.max_blocks_per_seq, self.config.num_blocks
-        tokens = np.zeros(n, np.int32)
-        positions = np.zeros(n, np.int32)
-        tables = np.zeros((n, mb), np.int32)
-        # padded rows scatter out of bounds -> dropped by the kernel
-        slot_blocks = np.full(n, nb, np.int32)
-        slot_offsets = np.zeros(n, np.int32)
+        mb = self.max_blocks_per_seq
+        packed = np.zeros((n, PACK_COLS + mb), np.int32)
         for i, req in enumerate(reqs):
-            block, offset, pos = req.slot
-            tokens[i] = req.last_token
-            positions[i] = pos
-            slot_blocks[i] = block
-            slot_offsets[i] = offset
+            p = req.params
+            packed[i, 0] = req.last_token
+            packed[i, 1] = req.slot[2]       # first reserved position
+            packed[i, 2] = 1                 # active (padding rows: 0)
+            packed[i, 3] = len(req.output_ids)
+            packed[i, 4] = p.max_tokens
+            packed[i, 5] = -1 if p.eos_token_id is None \
+                else int(p.eos_token_id)
+            packed[i, 6] = pack_f32(p.temperature)
+            packed[i, 7] = int(p.top_k)
+            packed[i, 8] = pack_f32(p.top_p)
+            packed[i, 9] = p.seed & 0x7FFFFFFF
             table = self.cache.block_table(req.request_id)
-            tables[i, :len(table)] = table
-        logits, pools = paged_decode_step(
-            self.params, self.cache.pools, jnp.asarray(tokens),
-            jnp.asarray(positions), jnp.asarray(tables),
-            jnp.asarray(slot_blocks), jnp.asarray(slot_offsets),
-            self.geom)
+            packed[i, PACK_COLS:PACK_COLS + len(table)] = table
+        out, pools = fused_decode_chunk(
+            self.params, self.cache.pools, jnp.asarray(packed),
+            self.geom, k)
         self.cache.pools = pools
-        return np.asarray(logits)[:len(reqs)]
+        fetched = np.asarray(out)            # the chunk's ONE host sync
+        self.stats.inc_host_sync("decode")
+        live = len(reqs)
+        return fetched[:k, :live], fetched[k + 1, :live].astype(bool)
 
     # ------------------------------------------------------- convenience
     def run(self, max_steps: int = None) -> Dict[str, np.ndarray]:

@@ -133,6 +133,28 @@ class PagedKVCache:
         block = table[pos // self.block_size]
         return block, pos % self.block_size, pos
 
+    def reserve_slots(self, seq_id, n: int) -> Tuple[int, int, int]:
+        """Reserve the slots for the sequence's next n tokens at once —
+        the chunk-granular twin of append_slot for the fused k-token
+        decode (serving/attention.py fused_decode_chunk). Grows the
+        block table by however many blocks the n tokens need in ONE
+        atomic _take_blocks claim (CacheExhausted leaves the sequence
+        untouched), and advances the length by n. Returns the FIRST
+        reserved slot (block_id, offset, position); the device scan
+        derives slot j's location as position+j through the identity
+        layout. A sequence that finishes mid-chunk simply leaves its
+        tail reservation unwritten — the whole table is freed with the
+        request, so over-reservation can never leak blocks."""
+        if n <= 0:
+            raise ValueError(f"reserve_slots needs n >= 1, got {n}")
+        pos = self._lens[seq_id]
+        table = self._tables[seq_id]
+        need = self.blocks_needed(pos + n) - len(table)
+        if need > 0:
+            table.extend(self._take_blocks(seq_id, need))
+        self._lens[seq_id] = pos + n
+        return table[pos // self.block_size], pos % self.block_size, pos
+
     def free(self, seq_id, scrub: bool = False) -> int:
         """Return every block of seq_id to the pool (completion,
         preemption or cancellation). `scrub=True` also zeroes the blocks'

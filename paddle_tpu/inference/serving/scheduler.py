@@ -5,8 +5,9 @@ Reference shape: vLLM's scheduler (and the fluid inference executor's
 batch dispatch, reference paddle/fluid/inference/), specialised to the
 paged cache in serving/paged_cache.py. Per engine step:
 
-1. DECODE — every RUNNING sequence reserves the slot for its next token
-   (cache.append_slot), earliest arrival first. If the pool is
+1. DECODE — every RUNNING sequence reserves the slots for its next
+   decode chunk (cache.reserve_slots, up to decode_chunk_size tokens),
+   earliest arrival first. If the pool is
    exhausted, the LATEST-arrived running sequence is preempted: its
    blocks are freed and it re-queues at the FRONT of the waiting line
    with prompt := prompt + generated-so-far (recompute-style preemption
@@ -148,6 +149,11 @@ class Request:
 class SchedulerConfig:
     max_num_seqs: int = 8                    # decode bucket ceiling
     max_prefill_tokens: int = 2048           # per-step admission budget
+    # tokens decoded per fused device chunk: each scheduled decode
+    # reserves min(decode_chunk_size, tokens-remaining) cache slots so
+    # the fused scan (serving/attention.py) can write k tokens without
+    # a host round-trip. 1 reproduces the classic one-token step.
+    decode_chunk_size: int = 1
     # ------------------------------ admission control / backpressure
     max_waiting: Optional[int] = None        # waiting-queue bound (None=∞)
     admission_policy: str = "reject"         # 'reject' | 'shed_oldest'
@@ -337,13 +343,21 @@ class Scheduler:
     @holds_lock("_lock")
     def _schedule_locked(self) -> ScheduledBatch:
         batch = ScheduledBatch()
-        # 1. decode slots, earliest arrival first; preempt from the back
+        # 1. decode slots, earliest arrival first; preempt from the back.
+        # Each sequence reserves its whole next CHUNK (up to
+        # decode_chunk_size tokens, capped by its remaining budget) so
+        # the fused device scan never needs a mid-chunk allocation; a
+        # sequence that stops early (EOS) frees the unwritten tail with
+        # the rest of its table.
+        chunk = max(1, self.config.decode_chunk_size)
         for req in sorted(self.running, key=lambda r: r.arrival):
             if req not in self.running:      # preempted below, this step
                 continue
+            n = min(chunk, req.params.max_tokens - len(req.output_ids))
+            n = max(1, n)
             while True:
                 try:
-                    req.slot = self.cache.append_slot(req.request_id)
+                    req.slot = self.cache.reserve_slots(req.request_id, n)
                     batch.decode.append(req)
                     break
                 except CacheExhausted:

@@ -253,6 +253,25 @@ class ServingFaultInjector:
             logits[min(row, logits.shape[0] - 1), 0] = np.nan
         return logits
 
+    def poison_chunk(self, step: int, bad):
+        """Engine hook on the fetched per-row not-finite flags of a
+        fused decode chunk (the device-resident twin of poison_logits:
+        with sampling on device there are no host logits to poison, so
+        the fault flips the armed row's anomaly flag instead — the
+        engine's quarantine path downstream of the flags is identical).
+        Claims a 'nan_logits' fault so chaos specs stay
+        decode-path-agnostic."""
+        if not self.enabled:
+            return bad
+        arg = self._claim("nan_logits", step)
+        if arg is None:
+            return bad
+        import numpy as np
+        bad = np.array(bad)                           # private copy
+        row = 0 if arg != arg else int(arg)           # NaN -> default
+        bad[min(row, len(bad) - 1)] = True
+        return bad
+
     def corrupt_cache(self, step: int, cache):
         """Engine hook, top of step: overwrite the first block of the
         earliest live sequence with NaN in layer 0's K pool (enough to

@@ -252,9 +252,13 @@ def test_engine_mixed_workload_with_preemption_acceptance():
     least one preemption — all must complete, greedy outputs must
     token-match generate(), and the pool must not leak a single block."""
     m, _ = _model()
-    # 10 blocks x 4 slots for up to 4 concurrent sequences of worst case
-    # 16 tokens each -> guaranteed pressure, but every request fits alone
-    eng = _engine(m, num_blocks=10, max_num_seqs=4)
+    # 6 blocks x 4 slots for up to 4 concurrent sequences of worst case
+    # 16 tokens each -> guaranteed pressure, but every request fits
+    # alone (worst single request is 4 blocks). The pool is tighter
+    # than the pre-chunk version of this test because chunked decode
+    # drains requests in ~1/k the steps — with 10 blocks the mix
+    # completes before pressure ever builds.
+    eng = _engine(m, num_blocks=6, max_num_seqs=4)
     rng = np.random.RandomState(3)
     lens = [3, 6, 2, 8, 5, 4, 7, 3]
     max_toks = [8, 5, 10, 6, 8, 12, 4, 9]

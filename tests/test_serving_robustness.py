@@ -163,10 +163,13 @@ def test_prefill_nan_quarantines_only_offender(model):
 
 
 def test_decode_nan_recovery_keeps_survivors_bitwise(model):
-    # all four prefill at step 1; step 3 is pure decode, so the poison
-    # lands on decode row 1 → that request quarantined, the other three
-    # rebuilt by re-prefill and BITWISE-equal to the unfaulted reference
-    fi = ServingFaultInjector("nan_logits@3:1")
+    # all four prefill at step 1; step 2 is pure decode (one fused
+    # chunk drains the remaining tokens), so the poison lands on decode
+    # row 1 of that chunk → the WHOLE chunk is discarded, that request
+    # quarantined, the other three rebuilt by re-prefill and
+    # BITWISE-equal to the unfaulted reference (chunk-invariant
+    # sampling keys make the replay exact)
+    fi = ServingFaultInjector("nan_logits@2:1")
     eng = _engine(model, faults=fi)
     p = _prompts(4)
     rids = [eng.add_request(q, SamplingParams(max_tokens=6)) for q in p]
@@ -176,7 +179,7 @@ def test_decode_nan_recovery_keeps_survivors_bitwise(model):
     assert len(errored) == 1
     assert eng.stats.errors == 1 and eng.stats.recoveries == 1
     assert eng.stats.rebuilt == 3
-    assert ("nan_logits", 3) in fi.fired_log
+    assert ("nan_logits", 2) in fi.fired_log
     for q, rid in zip(p, rids):
         if rid in errored:
             continue

@@ -25,6 +25,12 @@ Scenarios (docs/observability.md "Load suite"):
                  crash recovery quarantines offenders and rebuilds
                  survivors while traffic keeps flowing. Bounded error
                  rate, everything terminal, zero leaked blocks.
+- decode_heavy — many short prompts, long generations: the
+                 steady-state decode regime the fused k-token
+                 device-resident chunk (PR 7) targets. Reports
+                 tokens/s and inter-token-gap p99 (the
+                 serving_token_gap_seconds histogram) into BENCH_FULL
+                 and gates both.
 
 Each scenario runs its full workload once unmeasured (compiles every
 prefill/decode bucket — TTFT must not include XLA compile time), then
@@ -54,7 +60,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill")
+SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
+             "decode_heavy")
 
 #: per-scenario SLOs. Latency bounds are generous (CPU-smoke friendly)
 #: — the point is catching regressions in KIND (rejects where none are
@@ -69,6 +76,12 @@ SLOS = {
                     "max_reject_rate": 0.1},
     "chaos_kill":  {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 10.0,
                     "max_reject_rate": 0.5},
+    # decode-bound: nothing may be rejected, and the inter-token gap
+    # must stay bounded — chunked emission makes in-chunk gaps ~0, so
+    # the p99 essentially measures the chunk boundary (schedule +
+    # device scan), the regression this scenario exists to catch
+    "decode_heavy": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 8.0,
+                     "max_reject_rate": 0.0, "max_token_gap_p99_s": 4.0},
 }
 
 CHAOS_FAULTS = "nan_logits@6,stall@9:0.05,cache_corrupt@12"
@@ -121,6 +134,12 @@ def _arrivals(name: str, n: int, vocab: int, seed: int):
     elif name == "chaos_kill":
         for i in range(n):
             arr.append((2 * i, prompt(4, 12), int(rng.randint(6, 12))))
+    elif name == "decode_heavy":
+        # short prompts, long generations, arrivals paced slower than
+        # the other mixes: the workload spends its life in steady-state
+        # decode, where the fused chunk owns the token cadence
+        for i in range(n):
+            arr.append((3 * i, prompt(3, 7), int(rng.randint(24, 40))))
     else:
         raise ValueError(f"unknown scenario {name!r}; "
                          f"choose from {SCENARIOS}")
@@ -166,6 +185,11 @@ def _quantile(eng, q):
     return None if math.isnan(v) else round(v, 4)
 
 
+def _gap_quantile(eng, q):
+    v = eng.stats.token_gap_quantile(q)
+    return None if math.isnan(v) else round(v, 4)
+
+
 def _metrics(eng, submitted, rejected, wall) -> dict:
     d = eng.stats.as_dict()
     unserved = (rejected + d["shed"] + d["errors"] + d["timeouts"]
@@ -175,6 +199,9 @@ def _metrics(eng, submitted, rejected, wall) -> dict:
         if wall > 0 else 0.0,
         "ttft_p50": _quantile(eng, 0.5),
         "ttft_p99": _quantile(eng, 0.99),
+        "token_gap_p99": _gap_quantile(eng, 0.99),
+        "host_syncs_per_token": round(
+            eng.stats.host_syncs_per_token(), 4),
         "reject_rate": round(unserved / max(submitted, 1), 4),
         "submitted": submitted,
         "completed": d["completed"],
@@ -196,6 +223,11 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
     if metrics["reject_rate"] > slo["max_reject_rate"]:
         viol.append(f"reject_rate {metrics['reject_rate']} > "
                     f"{slo['max_reject_rate']}")
+    gap_max = slo.get("max_token_gap_p99_s")
+    if gap_max is not None:
+        gap = metrics["token_gap_p99"]
+        if gap is None or gap > gap_max:
+            viol.append(f"token_gap_p99 {gap} > {gap_max}s")
     return {"pass": not viol, "violations": viol, "thresholds": dict(slo)}
 
 
