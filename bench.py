@@ -190,6 +190,36 @@ def bench_gpt(on_tpu: bool, num_heads: int = 6, iters: int = 30):
     if on_tpu:
         peak = _peak_flops(jax.devices()[0])
         mfu = tokens_per_sec * _gpt_flops_per_token(cfg) / peak
+
+    # the committed jaxplan decision rides next to static_model: which
+    # remat policy the run was planned under, its predicted peak, and —
+    # where the backend reports memory — predicted/measured peak as a
+    # live gauge so plan drift against reality is a metric, not a guess
+    from paddle_tpu.analysis import jaxplan
+    plan = jaxplan.load_plan()
+    if plan:
+        remat = plan.get("remat", {}).get("train_step", {})
+        entry = {"remat_policy": remat.get("policy"),
+                 "predicted_peak_bytes": remat.get("predicted_peak_bytes"),
+                 "recompute_flops": remat.get("recompute_flops"),
+                 "envelope_bytes": plan.get("envelope_bytes")}
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        measured = (stats or {}).get("peak_bytes_in_use")
+        predicted = remat.get("predicted_peak_bytes")
+        if measured and predicted:
+            # note the bases differ: predicted is the registry geometry's
+            # jaxpr liveness peak, measured is whole-process device peak —
+            # the ratio's TREND is the signal, not its absolute value
+            ratio = round(predicted / measured, 4)
+            entry["measured_peak_bytes"] = int(measured)
+            entry["predicted_vs_measured_peak"] = ratio
+            from paddle_tpu import obs
+            obs.gauge("plan_predicted_vs_measured_peak",
+                      "jaxplan predicted peak bytes over device-reported "
+                      "peak bytes in use",
+                      labels=("program",)).labels(
+                          program="train_step").set(ratio)
+        _STATIC_EST["plan"] = entry
     return tokens_per_sec, mfu
 
 

@@ -1,0 +1,532 @@
+"""jaxplan: the static planner — analysis turned into applied policy.
+
+jaxcost (PR 5) *gates*: it models FLOPs/bytes/peak per program and
+fails CI on drift. This module makes the same analysis *steer*. Three
+planners share one committed plan file (`jaxplan.json`, shaped like
+`jaxcost_budget.json`):
+
+- **remat planner** — enumerate per-block `jax.checkpoint` policies
+  over the training step (`none` / `group:<k>` contiguous k-block
+  groups / `full` per-block), score every candidate with the existing
+  analyzers (`liveness.peak_live_bytes` for predicted peak,
+  jaxcost FLOPs for recompute overhead — jax's `remat2` sub-jaxprs
+  recurse through both transparently), and pick the CHEAPEST candidate
+  whose predicted peak fits a configurable HBM envelope (default
+  15.75 GiB, one v5e chip). `GPTConfig.use_recompute="auto"` resolves
+  through the committed plan instead of a hand-set boolean — the bench
+  note "bs=64 fails to compile: 17.18G of 15.75G hbm; remat to fit
+  would add ~25-30% FLOPs" becomes a computed decision.
+- **donation planner** — promote the PR-5 donation *audit* into
+  applied policy: the plan pins per-program `donate_argnums` (with
+  reasoned suppressions for intentional non-donation), the audit
+  proves no further argument could be safely donated, and
+  `jit.TrainStep` consumes the planned tuple instead of hard-coding
+  one.
+- **admission pricing** — the serving scheduler's flat
+  `max_prefill_tokens` budget becomes a FLOPs budget: a
+  `PrefillCostModel` (quadratic in prompt length, fit exactly from the
+  jaxcost static model of the serving prefill program) prices each
+  request, so one 8k-token prompt no longer costs the same per-token
+  as thirty-two 256-token prompts.
+
+Plan drift is caught exactly like budget drift: `tools/jaxplan.py
+--plan check` recomputes the plan under the committed envelope and
+fails on any structural change (policy, donation sets) or numeric
+drift beyond the file's tolerance.
+
+Import discipline: this module is stdlib-only at import time — the
+plan *readers* (`committed_remat_policy`, `planned_donation`,
+`default_admission_model`, `PrefillCostModel`) must load from
+models/gpt.py and the serving scheduler without pulling jax. The
+*planners* import jax + jaxcost lazily.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_HBM_ENVELOPE", "DEFAULT_PLAN_PATH", "DEFAULT_TOLERANCE",
+    "PLAN_VERSION", "InfeasibleEnvelope", "PrefillCostModel",
+    "RematCandidate", "RematPlan", "candidate_policies", "check_plan",
+    "committed_remat_policy", "compute_plan", "default_admission_model",
+    "diff_plans", "fit_prefill_cost_model", "load_plan", "plan_donation",
+    "plan_remat", "planned_donation", "remat_group_size", "write_plan",
+]
+
+#: one v5e chip's HBM — the default envelope the remat planner fits
+DEFAULT_HBM_ENVELOPE = int(15.75 * 2 ** 30)
+PLAN_VERSION = 1
+DEFAULT_TOLERANCE = 0.05
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_PLAN_PATH = os.path.join(_REPO, "jaxplan.json")
+
+#: prompt lengths the admission quadratic is fit through (three points
+#: determine the exact polynomial; all must fit the registry GPT's
+#: max_seq_len)
+ADMISSION_FIT_LENGTHS = (4, 8, 16)
+
+
+class InfeasibleEnvelope(ValueError):
+    """No remat candidate's predicted peak fits the HBM envelope.
+    Carries the shortfall in bytes (best candidate peak - envelope)."""
+
+    def __init__(self, envelope_bytes: int, best_policy: str,
+                 best_peak_bytes: int):
+        self.envelope_bytes = int(envelope_bytes)
+        self.best_policy = best_policy
+        self.best_peak_bytes = int(best_peak_bytes)
+        self.shortfall_bytes = self.best_peak_bytes - self.envelope_bytes
+        super().__init__(
+            f"no remat policy fits the {self.envelope_bytes:,}-byte HBM "
+            f"envelope: the best candidate ({best_policy!r}) still peaks "
+            f"at {self.best_peak_bytes:,} bytes — "
+            f"{self.shortfall_bytes:,} bytes short; shrink the model or "
+            f"raise the envelope")
+
+
+# ------------------------------------------------------- policy vocabulary
+def remat_group_size(policy: str, num_layers: int) -> int:
+    """Checkpoint group size for a policy string: 0 = no remat, 1 =
+    per-block, k = contiguous k-block groups. Group sizes larger than
+    the model clamp to one whole-model group (a plan computed on a
+    deeper model stays applicable to a shallower one)."""
+    if policy in ("none", ""):
+        return 0
+    if policy == "full":
+        return 1
+    if isinstance(policy, str) and policy.startswith("group:"):
+        k = int(policy.split(":", 1)[1])
+        if k < 1:
+            raise ValueError(f"group size must be >= 1, got {policy!r}")
+        return min(k, max(int(num_layers), 1))
+    raise ValueError(
+        f"unknown remat policy {policy!r}; expected 'none', 'full' or "
+        f"'group:<k>'")
+
+
+def candidate_policies(num_layers: int) -> List[str]:
+    """Escalation-ordered candidates: none, then grouped checkpoints
+    with shrinking groups (divisors of num_layers, largest first — one
+    checkpoint around everything down to pairs), then per-block."""
+    out = ["none"]
+    out.extend(f"group:{k}" for k in range(int(num_layers), 1, -1)
+               if num_layers % k == 0)
+    out.append("full")
+    return out
+
+
+# ------------------------------------------------------------- plan reading
+@functools.lru_cache(maxsize=16)
+def _load_plan_cached(path: str, mtime_ns: int) -> Optional[dict]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_plan(path: str = DEFAULT_PLAN_PATH) -> Optional[dict]:
+    """The committed plan payload, or None when no plan file exists.
+    Cached per (path, mtime) so hot readers (model construction, the
+    scheduler) cost one stat, not one parse."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return _load_plan_cached(os.path.abspath(path), st.st_mtime_ns)
+
+
+def committed_remat_policy(path: str = DEFAULT_PLAN_PATH,
+                           program: str = "train_step") -> str:
+    """The remat policy `use_recompute="auto"` resolves to. No plan
+    file (or no entry) means no remat — the planner's output is an
+    explicit artifact, never an implicit guess."""
+    plan = load_plan(path) or {}
+    entry = plan.get("remat", {}).get(program) or {}
+    return str(entry.get("policy", "none"))
+
+
+def planned_donation(program: str, default: Sequence[int] = (),
+                     path: str = DEFAULT_PLAN_PATH) -> Tuple[int, ...]:
+    """The planned donate_argnums for one program, falling back to
+    `default` when no plan is committed."""
+    plan = load_plan(path) or {}
+    entry = plan.get("donation", {}).get(program)
+    if not entry:
+        return tuple(int(i) for i in default)
+    return tuple(int(i) for i in entry.get("donate_argnums", default))
+
+
+def default_admission_model(path: str = DEFAULT_PLAN_PATH
+                            ) -> Optional["PrefillCostModel"]:
+    """The committed prefill cost model, or None (flat token budget)."""
+    plan = load_plan(path) or {}
+    entry = plan.get("admission", {}).get("prefill_cost_model")
+    return PrefillCostModel.from_dict(entry) if entry else None
+
+
+# -------------------------------------------------------- admission pricing
+@dataclass(frozen=True)
+class PrefillCostModel:
+    """Static price of one prefill as a function of prompt length:
+    cost(n) = base + a*n + b*n^2 FLOPs (matmuls are the linear term,
+    causal attention the quadratic one). The scheduler charges
+    `cost(len)` per admission against `budget(max_prefill_tokens) =
+    cost(max_prefill_tokens)` — so one maximal prompt still exactly
+    fills a step (flat-budget compatible at the limit) while short
+    prompts, whose quadratic term is negligible, admit in larger
+    batches and a long prompt pays super-linearly for its attention."""
+    base_flops: float
+    flops_per_token: float
+    flops_per_token_sq: float
+
+    def cost(self, num_tokens: int) -> float:
+        n = float(num_tokens)
+        return (self.base_flops + self.flops_per_token * n
+                + self.flops_per_token_sq * n * n)
+
+    def budget(self, max_prefill_tokens: int) -> float:
+        return self.cost(max_prefill_tokens)
+
+    def as_dict(self) -> dict:
+        return {"base_flops": self.base_flops,
+                "flops_per_token": self.flops_per_token,
+                "flops_per_token_sq": self.flops_per_token_sq}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PrefillCostModel":
+        return cls(base_flops=float(d["base_flops"]),
+                   flops_per_token=float(d["flops_per_token"]),
+                   flops_per_token_sq=float(d["flops_per_token_sq"]))
+
+
+def fit_prefill_cost_model(lengths: Sequence[int] = ADMISSION_FIT_LENGTHS
+                           ) -> PrefillCostModel:
+    """Fit the quadratic through the jaxcost static FLOPs of the
+    serving prefill program (batch 1, the admission unit) at the given
+    prompt lengths — an exact solve at three points, least-squares
+    beyond. Each length is priced with its cache geometry sized to the
+    prompt, the way paged attention allocates per request — a fixed
+    max-length cache would hide attention's quadratic term behind a
+    constant key count. Needs jax."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from . import jaxcost
+    from ..models import generation as g
+
+    _, _, geom, params, _ = jaxcost._tiny_gpt()
+    layers, heads, head_dim, _ = geom
+    fn = getattr(g.prefill, "__wrapped__", g.prefill)
+    pts = []
+    for n in lengths:
+        ids = jnp.zeros((1, int(n)), jnp.int32)
+        cost = jaxcost.estimate_fn(fn, params, ids,
+                                   (layers, heads, head_dim, int(n)),
+                                   static_argnums=(2,),
+                                   name=f"serving.prefill[n={n}]")
+        pts.append((int(n), int(cost.flops)))
+    a = np.array([[1.0, n, float(n) * n] for n, _ in pts])
+    f = np.array([fl for _, fl in pts], dtype=float)
+    coef, *_ = np.linalg.lstsq(a, f, rcond=None)
+    return PrefillCostModel(base_flops=round(float(coef[0]), 3),
+                            flops_per_token=round(float(coef[1]), 3),
+                            flops_per_token_sq=round(float(coef[2]), 3))
+
+
+# ----------------------------------------------------------- remat planning
+@dataclass(frozen=True)
+class RematCandidate:
+    policy: str
+    group_size: int
+    flops: int
+    peak_bytes: int
+
+    def as_dict(self) -> dict:
+        return {"group_size": self.group_size, "flops": self.flops,
+                "peak_bytes": self.peak_bytes}
+
+
+@dataclass(frozen=True)
+class RematPlan:
+    policy: str
+    group_size: int
+    predicted_peak_bytes: int
+    recompute_flops: int          # chosen flops - no-remat flops
+    envelope_bytes: int
+    candidates: Tuple[RematCandidate, ...] = ()
+
+    def candidate(self, policy: str) -> Optional[RematCandidate]:
+        for c in self.candidates:
+            if c.policy == policy:
+                return c
+        return None
+
+    def as_dict(self) -> dict:
+        return {"policy": self.policy, "group_size": self.group_size,
+                "predicted_peak_bytes": self.predicted_peak_bytes,
+                "recompute_flops": self.recompute_flops,
+                "envelope_bytes": self.envelope_bytes,
+                "candidates": {c.policy: c.as_dict()
+                               for c in self.candidates}}
+
+
+def _registry_remat_builder(policy: str):
+    """Build the registry tiny-GPT TrainStep under one remat policy —
+    the same deterministic recipe jaxcost._tiny_gpt pins, with the
+    policy applied through GPTConfig so the planner scores exactly what
+    `use_recompute` would run."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from ..models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=24, use_recompute=policy)
+    model = GPT(cfg)
+
+    def loss_fn(m, x, y):
+        logits = m(x)
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), y.reshape([-1]))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    x = paddle.to_tensor([[1, 2, 3, 4]], dtype="int64")
+    y = paddle.to_tensor([[2, 3, 4, 5]], dtype="int64")
+    return step, (x, y), cfg.num_layers
+
+
+def _select(cands: Sequence[RematCandidate], envelope_bytes: int,
+            tolerance: float) -> RematCandidate:
+    """Cheapest feasible candidate, with FLOP counts compared at the
+    model's own resolution: differences inside `tolerance` (the same
+    5% the budget gate uses) are noise, and noise-level ties resolve
+    toward the EARLIER (less aggressive) candidate — so the plan
+    escalates none -> grouped -> full exactly as far as the envelope
+    forces it, never further on a sub-tolerance FLOP delta."""
+    feasible = [c for c in cands if c.peak_bytes <= envelope_bytes]
+    if not feasible:
+        best = min(cands, key=lambda c: c.peak_bytes)
+        raise InfeasibleEnvelope(envelope_bytes, best.policy,
+                                 best.peak_bytes)
+    floor = min(c.flops for c in feasible)
+    return next(c for c in feasible
+                if c.flops <= floor * (1.0 + tolerance))
+
+
+def plan_remat(envelope_bytes: int = DEFAULT_HBM_ENVELOPE, *,
+               policies: Optional[Sequence[str]] = None,
+               build: Optional[Callable] = None,
+               candidates: Optional[Sequence[RematCandidate]] = None,
+               tolerance: float = DEFAULT_TOLERANCE,
+               name: str = "train_step") -> RematPlan:
+    """Score every candidate policy and pick the cheapest feasible one
+    (see `_select` for the exact rule).
+
+    `build(policy) -> (step, batch, num_layers)` constructs the train
+    step under one policy (default: the registry tiny GPT). Pass
+    `candidates` (a previously scored table, e.g. from another
+    RematPlan) to re-plan under a different envelope without
+    re-tracing. Raises InfeasibleEnvelope (with the byte shortfall)
+    when even the best candidate does not fit."""
+    if candidates is None:
+        from . import jaxcost
+
+        build = build or _registry_remat_builder
+        first_step, first_batch, num_layers = build("none")
+        pols = list(policies) if policies is not None \
+            else candidate_policies(num_layers)
+        cands: List[RematCandidate] = []
+        for pol in pols:
+            step, batch, nl = (first_step, first_batch, num_layers) \
+                if pol == "none" else build(pol)
+            cost = jaxcost.estimate_train_step(step, *batch,
+                                               name=f"{name}[{pol}]")
+            cands.append(RematCandidate(
+                policy=pol, group_size=remat_group_size(pol, nl),
+                flops=int(cost.flops), peak_bytes=int(cost.peak_bytes)))
+    else:
+        cands = list(candidates)
+    chosen = _select(cands, envelope_bytes, tolerance)
+    base_flops = next((c.flops for c in cands if c.policy == "none"),
+                      cands[0].flops)
+    return RematPlan(policy=chosen.policy, group_size=chosen.group_size,
+                     predicted_peak_bytes=chosen.peak_bytes,
+                     recompute_flops=max(0, chosen.flops - base_flops),
+                     envelope_bytes=int(envelope_bytes),
+                     candidates=tuple(cands))
+
+
+# -------------------------------------------------------- donation planning
+def plan_donation() -> Tuple[Dict[str, dict], List[str]]:
+    """Per-program applied donation policy, verified by the audit.
+
+    Returns (entries, violations): entries pin each registry program's
+    `donate_argnums` plus its reasoned suppressions; violations list
+    every UNSUPPRESSED audit finding — an argument the static analysis
+    proves donatable that no policy or reason covers. A clean plan has
+    zero violations (the same invariant the jaxcost test suite pins),
+    so committing the plan is committing proof-backed policy."""
+    from . import jaxcost
+
+    entries: Dict[str, dict] = {}
+    for p in jaxcost._build_programs():
+        entries[p.name] = {
+            "donate_argnums": sorted(int(i) for i in p.donate_argnums),
+            "suppressed": {str(k): v for k, v in sorted(p.suppress.items())},
+            "applies": bool(p.donation_applies),
+        }
+    violations = [
+        f"{f.program}: unsuppressed donation finding — {f.message}"
+        for f in jaxcost.collect_donation_findings()
+        if f.suppressed is None]
+    return entries, violations
+
+
+# ----------------------------------------------------------- the full plan
+def compute_plan(envelope_bytes: int = DEFAULT_HBM_ENVELOPE
+                 ) -> Tuple[dict, List[str]]:
+    """Run all three planners; returns (plan payload, violations).
+    Violations (unsuppressed donation findings) make the payload
+    unsuitable for committing."""
+    remat = plan_remat(envelope_bytes)
+    donation, violations = plan_donation()
+    admission_model = fit_prefill_cost_model()
+    payload = {
+        "version": PLAN_VERSION,
+        "tolerance": DEFAULT_TOLERANCE,
+        "envelope_bytes": int(envelope_bytes),
+        "remat": {"train_step": remat.as_dict()},
+        "donation": donation,
+        "admission": {
+            "prefill_cost_model": admission_model.as_dict(),
+            "fit_lengths": list(ADMISSION_FIT_LENGTHS),
+        },
+    }
+    return payload, violations
+
+
+def write_plan(path: str, payload: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _num_drifted(cur, ref, tol: float) -> bool:
+    try:
+        cur, ref = float(cur), float(ref)
+    except (TypeError, ValueError):
+        return True
+    if ref == 0.0:
+        return cur != 0.0
+    return abs(cur - ref) > tol * abs(ref)
+
+
+def check_plan(path: str = DEFAULT_PLAN_PATH) -> List[str]:
+    """Recompute the plan under the committed envelope and diff
+    (diff_plans). Returns violation strings (empty = plan holds)."""
+    committed = load_plan(path)
+    if committed is None:
+        return [f"plan file {path} missing; generate it with "
+                f"tools/jaxplan.py --plan write"]
+    if int(committed.get("version", 0)) != PLAN_VERSION:
+        # an old-format plan cannot be meaningfully diffed — fail
+        # before spending a recompute on it
+        return [f"plan version {committed.get('version')} != "
+                f"{PLAN_VERSION}; re-plan with --plan write"]
+    envelope = int(committed.get("envelope_bytes", DEFAULT_HBM_ENVELOPE))
+    try:
+        current, violations = compute_plan(envelope_bytes=envelope)
+    except InfeasibleEnvelope as e:
+        return [f"committed envelope is no longer feasible: {e}"]
+    return violations + diff_plans(committed, current)
+
+
+def diff_plans(committed: dict, current: dict) -> List[str]:
+    """Pure diff of two plan payloads. Structural fields (chosen
+    policy, group size, donation sets, suppression keys) must match
+    exactly; numeric predictions (peak bytes, FLOPs, admission
+    coefficients) may drift within the committed file's tolerance."""
+    tol = float(committed.get("tolerance", DEFAULT_TOLERANCE))
+    out: List[str] = []
+
+    # ---- remat: chosen policy exact, predictions within tolerance
+    com_r = committed.get("remat", {})
+    cur_r = current["remat"]
+    for prog in sorted(set(com_r) | set(cur_r)):
+        a, b = com_r.get(prog), cur_r.get(prog)
+        if a is None or b is None:
+            out.append(f"{prog}: remat plan "
+                       f"{'missing from committed plan' if a is None else 'no longer produced'}")
+            continue
+        if a.get("policy") != b["policy"] \
+                or int(a.get("group_size", -1)) != b["group_size"]:
+            out.append(
+                f"{prog}: planned remat policy drifted — committed "
+                f"{a.get('policy')!r} (group {a.get('group_size')}), "
+                f"current {b['policy']!r} (group {b['group_size']})")
+        for metric in ("predicted_peak_bytes", "recompute_flops"):
+            if _num_drifted(b[metric], a.get(metric, 0), tol):
+                out.append(
+                    f"{prog}: remat {metric} {b[metric]:,} drifted from "
+                    f"committed {a.get(metric, 0):,} beyond tolerance "
+                    f"{tol:.0%}")
+        com_c = a.get("candidates", {})
+        cur_c = b.get("candidates", {})
+        for pol in sorted(set(com_c) | set(cur_c)):
+            ca, cb = com_c.get(pol), cur_c.get(pol)
+            if ca is None or cb is None:
+                out.append(f"{prog}: remat candidate {pol!r} "
+                           f"{'appeared' if ca is None else 'vanished'}")
+                continue
+            for metric in ("flops", "peak_bytes"):
+                if _num_drifted(cb[metric], ca.get(metric, 0), tol):
+                    out.append(
+                        f"{prog}: candidate {pol!r} {metric} "
+                        f"{cb[metric]:,} drifted from committed "
+                        f"{ca.get(metric, 0):,} beyond tolerance "
+                        f"{tol:.0%}")
+
+    # ---- donation: applied sets and suppression coverage are exact
+    com_d = committed.get("donation", {})
+    cur_d = current["donation"]
+    for prog in sorted(set(com_d) | set(cur_d)):
+        a, b = com_d.get(prog), cur_d.get(prog)
+        if a is None:
+            out.append(f"{prog}: donation policy not in committed plan "
+                       f"(new program? re-plan with --plan write)")
+            continue
+        if b is None:
+            out.append(f"{prog}: in committed plan but no longer in the "
+                       f"registry (program removed? re-plan)")
+            continue
+        if list(a.get("donate_argnums", [])) != b["donate_argnums"]:
+            out.append(
+                f"{prog}: donate_argnums {b['donate_argnums']} != "
+                f"committed {a.get('donate_argnums', [])}")
+        if sorted(a.get("suppressed", {})) != sorted(b["suppressed"]):
+            out.append(
+                f"{prog}: suppressed argnums "
+                f"{sorted(b['suppressed'])} != committed "
+                f"{sorted(a.get('suppressed', {}))}")
+        if bool(a.get("applies", True)) != b["applies"]:
+            out.append(f"{prog}: donation 'applies' flag drifted")
+
+    # ---- admission: coefficients within tolerance, fit grid exact
+    com_a = committed.get("admission", {})
+    cur_a = current["admission"]
+    if list(com_a.get("fit_lengths", [])) != cur_a["fit_lengths"]:
+        out.append(f"admission: fit_lengths {cur_a['fit_lengths']} != "
+                   f"committed {com_a.get('fit_lengths', [])}")
+    com_m = com_a.get("prefill_cost_model", {})
+    for key, cur_v in cur_a["prefill_cost_model"].items():
+        if _num_drifted(cur_v, com_m.get(key, 0.0), tol):
+            out.append(
+                f"admission: {key} {cur_v} drifted from committed "
+                f"{com_m.get(key, 0.0)} beyond tolerance {tol:.0%}")
+    return out

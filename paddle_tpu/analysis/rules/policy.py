@@ -1,0 +1,99 @@
+"""Policy-centralization rule: remat and donation decisions belong to
+the planner, not to call sites.
+
+PR 8 introduced jaxplan (analysis/jaxplan.py): remat policy and
+donate_argnums are *planned* from the static cost model and committed
+to jaxplan.json, then consumed via `use_recompute="auto"` and
+`jaxplan.planned_donation(...)`. A hand-set `use_recompute=True`, a
+manual `jax.checkpoint(...)`, or a literal `donate_argnums=(...)` on a
+jit construction silently forks that policy — the plan gate keeps
+passing while the program runs something else. Such sites are legal
+only with a reasoned suppression, so every divergence from the planner
+is visible and justified in place:
+
+  PT-T009  hand-set remat/donation policy at a call site (use the
+           planner, or suppress with a reason)
+
+The suppression IS the workflow: the sanctioned implementation layer
+(fleet.utils.recompute — the primitive the planner itself lowers to)
+and structural remat (pipeline microbatching) carry
+`# ptlint: disable=PT-T009` comments explaining why they are the
+mechanism rather than a policy fork.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..ast_core import Finding, ModuleContext, Rule
+from .trace_safety import _dotted, _is_jit_callee, _jit_partial
+
+__all__ = ["PolicyCentralizationRule", "POLICY_RULES"]
+
+POLICY_RULES = {
+    "PT-T009": ("error",
+                "hand-set remat/donation policy at a call site (bypass "
+                "of the jaxplan planner)"),
+}
+
+# remat entry points whose direct use hard-codes a remat decision
+_REMAT_CALLEES = {"jax.checkpoint", "jax.remat"}
+
+
+class PolicyCentralizationRule(Rule):
+    """Module-wide scan for hand-set remat/donation policy (PT-T009)."""
+
+    ids = tuple(POLICY_RULES)
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        sev = POLICY_RULES["PT-T009"][0]
+
+        def emit(node, message):
+            findings.append(
+                ctx.finding("PT-T009", node, message, severity=sev))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                # manual jax.checkpoint/jax.remat
+                if name in _REMAT_CALLEES:
+                    emit(node,
+                         f"manual '{name}(...)': remat policy is chosen "
+                         f"by the planner (analysis/jaxplan.py, "
+                         f"use_recompute='auto'); route through the "
+                         f"planned policy or suppress with a reason")
+                # hand-set use_recompute=True at a construction site
+                for kw in node.keywords:
+                    if kw.arg == "use_recompute" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and kw.value.value is True:
+                        emit(kw.value,
+                             "use_recompute=True hard-codes remat on; "
+                             "use 'auto' (committed plan) or an explicit "
+                             "planner policy string, or suppress with a "
+                             "reason")
+                # literal donate_argnums on a jit construction
+                if _is_jit_callee(name) or _jit_partial(node) is not None:
+                    for kw in node.keywords:
+                        if kw.arg == "donate_argnums" and isinstance(
+                                kw.value,
+                                (ast.Tuple, ast.List, ast.Constant)):
+                            emit(kw.value,
+                                 "literal donate_argnums on a jit "
+                                 "construction: donation sets are "
+                                 "planned (jaxplan.planned_donation) "
+                                 "and audited; consume the plan or "
+                                 "suppress with a reason")
+            # hand-set cfg.use_recompute = True after construction
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is True:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "use_recompute":
+                        emit(node,
+                             "use_recompute=True hard-codes remat on; "
+                             "use 'auto' (committed plan) or suppress "
+                             "with a reason")
+        return findings

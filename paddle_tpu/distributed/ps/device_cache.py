@@ -27,11 +27,14 @@ import jax.numpy as jnp
 __all__ = ["DeviceEmbeddingCache"]
 
 
+# ptlint: disable=PT-T009  PS embedding shards live outside the jaxplan
+# registry; table/state (0/1) are the cache's own double-buffered pair
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _sgd_update(table, state, rows, g, lr):
     return table.at[rows].add(-lr * g), state
 
 
+# ptlint: disable=PT-T009  same contract as _sgd_update above
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _adagrad_update(table, state, rows, g, lr, eps=1e-6):
     # identical rule to table.py _AdagradRule: state += g^2;

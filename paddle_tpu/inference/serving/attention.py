@@ -159,6 +159,8 @@ def _sample_rows(logits, keys, temps, top_ks, top_ps):
     return jnp.where(temps <= 0.0, greedy, sampled)
 
 
+# ptlint: disable=PT-T009  agrees with the committed plan entry
+# serving.decode_chunk (donate=[1]); the jaxplan donation gate pins it
 @functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(1,))
 def fused_decode_chunk(params, pools, packed, geom, k):
     """k decode steps for N sequences entirely on device: one lax.scan

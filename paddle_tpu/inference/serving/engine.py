@@ -85,6 +85,12 @@ class EngineConfig:
     num_blocks: int = 256
     max_num_seqs: int = 8
     max_prefill_tokens: int = 2048
+    # static-cost admission (docs/serving.md): a PrefillCostModel
+    # (analysis/jaxplan) pricing each admission by its modelled prefill
+    # FLOPs instead of a flat token count. "auto" loads the committed
+    # plan's model (jaxplan.json; falls back to flat if no plan is
+    # committed); None keeps the flat budget.
+    prefill_cost_model: Optional[object] = None
     # tokens decoded per fused device chunk (the k of
     # attention.fused_decode_chunk): the host syncs with the device
     # once per k tokens instead of once per token. 1 reproduces the
@@ -370,6 +376,12 @@ class LLMEngine:
         self.max_blocks_per_seq = S // config.block_size
         self.cache = PagedKVCache(L, H, D, config.num_blocks,
                                   config.block_size)
+        cost_model = config.prefill_cost_model
+        if cost_model == "auto":
+            # committed-plan admission pricing; a repo without a plan
+            # file degrades to the flat token budget
+            from ...analysis import jaxplan
+            cost_model = jaxplan.default_admission_model()
         self.scheduler = Scheduler(
             SchedulerConfig(
                 max_num_seqs=config.max_num_seqs,
@@ -377,7 +389,8 @@ class LLMEngine:
                 decode_chunk_size=config.decode_chunk_size,
                 max_waiting=config.max_waiting,
                 admission_policy=config.admission_policy,
-                cache_high_watermark=config.cache_high_watermark),
+                cache_high_watermark=config.cache_high_watermark,
+                prefill_cost_model=cost_model),
             self.cache)
         # RLock: step() holds it across the whole iteration and the
         # helpers it calls re-enter (e.g. _emit under _recover)

@@ -149,6 +149,14 @@ class Request:
 class SchedulerConfig:
     max_num_seqs: int = 8                    # decode bucket ceiling
     max_prefill_tokens: int = 2048           # per-step admission budget
+    # static-cost admission: an object with .cost(num_tokens) and
+    # .budget(max_prefill_tokens) (analysis/jaxplan.PrefillCostModel).
+    # When set, each admission is charged its modelled prefill FLOPs
+    # (quadratic in prompt length — attention) against
+    # budget(max_prefill_tokens), so one long prompt pays super-linearly
+    # instead of the same per-token rate as many short ones. None keeps
+    # the flat token count.
+    prefill_cost_model: Optional[object] = None
     # tokens decoded per fused device chunk: each scheduled decode
     # reserves min(decode_chunk_size, tokens-remaining) cache slots so
     # the fused scan (serving/attention.py) can write k tokens without
@@ -365,15 +373,23 @@ class Scheduler:
                     self._preempt(victim, batch)
                     if victim is req:
                         break                # preempted itself; move on
-        # 2. FCFS admission under seq count + prefill token budget +
-        #    the cache occupancy high-watermark (decode headroom)
-        budget = self.config.max_prefill_tokens
+        # 2. FCFS admission under seq count + prefill cost budget +
+        #    the cache occupancy high-watermark (decode headroom).
+        #    With a cost model the budget is FLOPs (each request priced
+        #    by the static model); without, the flat token count. Either
+        #    way the head of line may overflow an untouched budget so a
+        #    maximal request cannot starve.
+        cost_model = self.config.prefill_cost_model
+        budget = cost_model.budget(self.config.max_prefill_tokens) \
+            if cost_model else self.config.max_prefill_tokens
         mark = self.config.cache_high_watermark
         while self.waiting and len(self.running) \
                 < self.config.max_num_seqs:
             req = self.waiting[0]
             tokens = req.all_token_ids()
-            if len(tokens) > budget and batch.prefill:
+            price = cost_model.cost(len(tokens)) if cost_model \
+                else len(tokens)
+            if price > budget and batch.prefill:
                 break                        # budget spent; next step
             needed = self.cache.blocks_needed(len(tokens))
             if (self.cache.num_used() + needed) > mark * self.cache.num_blocks \
@@ -392,7 +408,7 @@ class Scheduler:
             req.state = RequestState.RUNNING
             self.running.append(req)
             batch.prefill.append(req)
-            budget -= len(tokens)
+            budget -= price
         return batch
 
     # ------------------------------------------------------------ results

@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import jaxplan
 from ..core.tensor import Tensor
 from ..core import random as _random
 from ..core.autograd import no_grad
@@ -459,9 +460,12 @@ class TrainStep:
         # _dispatch rebinds immediately), so XLA reuses their buffers for
         # the outputs instead of double-residing old+new. frozen (1) is
         # read-only across steps and lr/key_root (4/5) are reused, so
-        # they stay undonated. The jaxcost donation audit gates this:
-        # an undonated dead argnum here is a tier-1 finding.
-        donate_argnums = (0, 2, 3, 6) if donate else ()
+        # they stay undonated. The tuple comes from the committed plan
+        # (jaxplan.json, donation planner) with these argnums as the
+        # fallback; the jaxcost donation audit gates it either way — an
+        # undonated dead argnum here is a tier-1 finding.
+        donate_argnums = jaxplan.planned_donation(
+            "train_step", default=(0, 2, 3, 6)) if donate else ()
         self._donate_argnums = donate_argnums
         self._raw_step = step  # unjitted; MultiStepTrainStep scans over it
         self._step = jax.jit(step, donate_argnums=donate_argnums)
@@ -669,7 +673,8 @@ class MultiStepTrainStep(TrainStep):
 
         # same donation set as the 1-step program (see TrainStep): the
         # scan carry consumes params/buffers/opt_state/rng_ctr in place
-        donate_argnums = (0, 2, 3, 6) if donate else ()
+        donate_argnums = jaxplan.planned_donation(
+            "train_step", default=(0, 2, 3, 6)) if donate else ()
         self._donate_argnums = donate_argnums
         self._multi = jax.jit(multi, donate_argnums=donate_argnums)
 

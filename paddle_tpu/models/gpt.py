@@ -42,7 +42,14 @@ class GPTConfig:
     max_seq_len: int = 1024
     ffn_mult: int = 4
     dropout: float = 0.0
-    use_recompute: bool = False
+    # False/"none": no remat. True: legacy per-block recompute inside
+    # GPTBlock.forward. "full": per-block remat applied by the GPT-level
+    # loop. "group:<k>": contiguous groups of k blocks, each wrapped in
+    # ONE jax.checkpoint (k trades recompute FLOPs against live bytes).
+    # "auto": the policy committed by the static planner
+    # (analysis/jaxplan.py, jaxplan.json) — pick the cheapest policy
+    # whose predicted peak fits the HBM envelope instead of hand-tuning.
+    use_recompute: object = False
     # NOTE: block outputs are unconditionally constrained to the canonical
     # [batch=(dp,sharding), seq=sp] layout regardless of this flag; on
     # build_mesh meshes sp defaults to size 1 so this is a no-op, but a
@@ -168,9 +175,9 @@ class GPTAttention(nn.Layer):
         K/V rotating over ICI (parallel/ring_attention.py). Manual over
         'sp' only — dp/tp/sharding stay in GSPMD auto mode so context
         parallelism composes with the other degrees."""
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
         from ..core.dispatch import dispatch
+        from ..parallel.compat import shard_map
         from ..parallel.mesh import ensure_global_mesh
         from ..parallel.ring_attention import ring_attention
         if self.cfg.dropout > 0.0 and self.training:
@@ -227,7 +234,11 @@ class GPTBlock(nn.Layer):
         return x
 
     def forward(self, x):
-        if self.cfg.use_recompute:
+        # `is True` on purpose: planned policies ("auto"/"full"/
+        # "group:k" — truthy strings) are applied by the GPT-level
+        # block loop, which may wrap SEVERAL blocks in one checkpoint;
+        # only the legacy boolean keeps the per-block path here.
+        if self.cfg.use_recompute is True:
             from ..distributed.fleet.utils import recompute
             from ..distributed.moe import MoEMLP
             if isinstance(self.mlp, MoEMLP):
@@ -244,6 +255,26 @@ class GPTBlock(nn.Layer):
         return self._body(x)
 
 
+def _resolve_remat_group(cfg: GPTConfig) -> int:
+    """Map cfg.use_recompute to the GPT-level checkpoint group size
+    (0 = no GPT-level remat). Booleans resolve to 0 — False is off and
+    True keeps the legacy per-block path inside GPTBlock.forward.
+    "auto" resolves through the committed plan (jaxplan.json); explicit
+    "none"/"full"/"group:<k>" policies are what the planner itself uses
+    to build scoring candidates."""
+    pol = cfg.use_recompute
+    if pol is True or pol is False or pol is None:
+        return 0
+    if isinstance(pol, str):
+        from ..analysis import jaxplan
+        if pol == "auto":
+            pol = jaxplan.committed_remat_policy()
+        return jaxplan.remat_group_size(pol, cfg.num_layers)
+    raise ValueError(
+        f"use_recompute must be a bool, 'auto', 'none', 'full' or "
+        f"'group:<k>', got {pol!r}")
+
+
 class GPT(nn.Layer):
     def __init__(self, cfg: GPTConfig = None, **kwargs):
         super().__init__()
@@ -254,6 +285,9 @@ class GPT(nn.Layer):
         self.drop = nn.Dropout(cfg.dropout)
         self.blocks = nn.LayerList([GPTBlock(cfg, layer_idx=i)
                                     for i in range(cfg.num_layers)])
+        # planned remat: group size applied by forward()'s block loop
+        # (0 = off; legacy use_recompute=True stays inside GPTBlock)
+        self._remat_group = _resolve_remat_group(cfg)
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
         # column-parallel LM head over vocab (untied: its own V x H
         # matrix; the bench FLOPs formula counts the unembed matmul once
@@ -269,10 +303,38 @@ class GPT(nn.Layer):
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         x = shard_batch_activation(x)
-        for blk in self.blocks:
-            x = blk(x)
+        g = self._remat_group
+        if g:
+            blocks = list(self.blocks)
+            for s in range(0, len(blocks), g):
+                x = self._run_group_rematted(blocks[s:s + g], x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         x = self.ln_f(x)
         return self.lm_head(x)
+
+    def _run_group_rematted(self, group, x):
+        """One checkpointed segment of `group` consecutive blocks. MoE
+        aux losses must ride the checkpointed return — a Tensor stashed
+        on a layer inside jax.checkpoint would leak its tracer into the
+        outer trace — so they come back as extra outputs and are
+        restored onto their layers afterwards."""
+        from ..distributed.fleet.utils import recompute
+        from ..distributed.moe import MoEMLP
+        moe_blocks = [b for b in group if isinstance(b.mlp, MoEMLP)]
+
+        def segment(x_):
+            for b in group:
+                x_ = b(x_)
+            return (x_, *[b.mlp.aux_loss for b in moe_blocks])
+
+        if not moe_blocks:
+            return recompute(lambda x_: segment(x_)[0], x)
+        out, *auxes = recompute(segment, x)
+        for b, aux in zip(moe_blocks, auxes):
+            b.mlp.aux_loss = aux
+        return out
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)

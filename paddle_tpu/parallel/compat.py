@@ -1,0 +1,52 @@
+"""Version-tolerant `shard_map` shim.
+
+jax moved shard_map twice: it lived in `jax.experimental.shard_map`
+(positional `mesh`, `check_rep=`, manual-axes-by-default with an
+`auto=` escape hatch), and newer releases promote it to `jax.shard_map`
+(kw-only, `check_vma=`, `axis_names=` naming the MANUAL axes). The
+repo is written against the new surface; this module makes that
+surface work on both:
+
+- `jax.shard_map` present → pass straight through.
+- experimental fallback → translate `check_vma` → `check_rep`, and
+  `axis_names={manual}` → `auto = mesh.axis_names - manual` (the old
+  API names the AUTO axes instead of the manual ones).
+
+Everything in the tree (parallel/, models/gpt.py, fleet comm_opt,
+tests) imports shard_map from here — one place to retire when the
+minimum jax version catches up.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                  axis_names=None):
+        kw = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                  axis_names=None):
+        kw = {}
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        # `axis_names={manual}` would translate to `auto = mesh axes -
+        # manual`, but the old partial-manual path lowers axis_index to
+        # a PartitionId instruction XLA's SPMD partitioner rejects once
+        # a real (size>1) auto axis exists. Full-manual is semantically
+        # equivalent here — specs not mentioning an axis replicate over
+        # it — so the legacy branch always runs fully manual.
+        return _legacy_shard_map(f, mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)

@@ -27,7 +27,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ring_attention", "ulysses_attention"]
+from .compat import shard_map  # noqa: F401  (re-export: the version-
+# tolerant shim callers pair with ring/ulysses attention)
+
+__all__ = ["ring_attention", "shard_map", "ulysses_attention"]
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,

@@ -192,8 +192,10 @@ class Executor:
 
         # donate the written persistables: param updates reuse their own
         # device buffers (in-place semantics, zero copy)
-        # ptlint: disable=PT-T004  (_build is called once per program
-        # cache key; Executor.run caches the result in self._cache)
+        # ptlint: disable=PT-T004,PT-T009  (_build is called once per
+        # program cache key; Executor.run caches the result in
+        # self._cache. The donated state dict (1) is the interpreter's
+        # own persistable snapshot — not a jaxplan registry program)
         return jax.jit(f, donate_argnums=(1,))
 
     def run(self, program: Optional[Program] = None, feed=None,

@@ -1,0 +1,27 @@
+"""PT-T009 true negatives: remat policy flows through the planner
+(string policies resolve through analysis/jaxplan, "auto" reads the
+committed plan), donation tuples come from jaxplan.planned_donation,
+and suppressed hand-set sites carry a reason. Zero findings.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+
+from paddle_tpu.analysis import jaxplan
+
+
+def build_model(GPTConfig):
+    auto = GPTConfig(hidden_size=8, use_recompute="auto")
+    explicit = GPTConfig(hidden_size=8, use_recompute="group:2")
+    off = GPTConfig(hidden_size=8, use_recompute=False)
+    return auto, explicit, off
+
+
+def make_step(step):
+    donate = jaxplan.planned_donation("train_step", default=(0, 2, 3, 6))
+    return jax.jit(step, donate_argnums=donate)
+
+
+def sanctioned(pure, x):
+    # ptlint: disable=PT-T009  fixture: the suppression workflow itself
+    return jax.checkpoint(pure)(x)

@@ -1,0 +1,30 @@
+"""PT-T009 true positives: hand-set remat/donation policy at call
+sites — manual jax.checkpoint/jax.remat, use_recompute=True literals,
+and literal donate_argnums on jit constructions, all bypassing the
+jaxplan planner.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import functools
+
+import jax
+
+
+def hand_rematted(f, x):
+    return jax.checkpoint(f)(x)  # expect: PT-T009
+
+
+backward_cheap = jax.remat(abs)  # expect: PT-T009
+
+_step = jax.jit(sum, donate_argnums=(0, 2))  # expect: PT-T009
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))  # expect: PT-T009
+def update(state, grads):
+    return state
+
+
+def build_model(GPTConfig):
+    cfg = GPTConfig(hidden_size=8, use_recompute=True)  # expect: PT-T009
+    cfg.use_recompute = True  # expect: PT-T009
+    return cfg

@@ -155,8 +155,9 @@ def test_gradient_merge_matches_big_batch():
 
 
 def test_collectives_inside_shard_map():
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import shard_map
     import paddle_tpu.distributed as dist
     mesh = build_mesh(dp=8, pp=1, tp=1, sp=1, sharding=1)
     set_global_mesh(mesh)

@@ -7,11 +7,10 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as paddle
-from paddle_tpu.parallel import build_mesh, set_global_mesh
+from paddle_tpu.parallel import build_mesh, set_global_mesh, shard_map
 from paddle_tpu.parallel.ring_attention import ring_attention
 
 

@@ -13,10 +13,10 @@ import paddle_tpu as paddle
 def test_allreduce_prod_handles_negatives_and_zeros():
     """reference c_allreduce_prod (c_allreduce_op.h:123): NCCL prod is
     sign-correct and zero-correct; exp(psum(log)) is not."""
-    from jax import shard_map
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     import paddle_tpu.distributed as dist
+    from paddle_tpu.parallel import shard_map
     from paddle_tpu.distributed import ReduceOp
     from paddle_tpu.parallel.mesh import build_mesh, set_global_mesh
 
