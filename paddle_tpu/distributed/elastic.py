@@ -32,8 +32,8 @@ import sys
 import time
 from typing import Callable, List, Optional, Sequence, Union
 
-__all__ = ["ElasticSupervisor", "ElasticJobError", "WorkerSpec",
-           "elastic_spawn", "heartbeat"]
+__all__ = ["BackoffPolicy", "ElasticSupervisor", "ElasticJobError",
+           "WorkerSpec", "elastic_spawn", "heartbeat"]
 
 # env contract (in addition to the PADDLE_TRAINER_* launch contract)
 HEARTBEAT_FILE_ENV = "PADDLE_ELASTIC_HEARTBEAT_FILE"
@@ -55,6 +55,31 @@ def heartbeat():
             os.utime(path, None)
     except OSError:
         pass  # a beat lost to fs flakiness must never kill the step
+
+
+class BackoffPolicy:
+    """Capped exponential restart backoff with seeded multiplicative
+    jitter: delay(n) = min(max_delay, base * factor**n) * (1 + U[0,
+    jitter)). The SAME policy object serves both restart supervisors in
+    the system — the trainer-level ElasticSupervisor below and the
+    serving replica supervisor (inference/serving/replica.py) — so a
+    correlated failure of many workers/replicas never produces a
+    synchronized restart storm in either runtime."""
+
+    def __init__(self, base: float = 0.25, factor: float = 2.0,
+                 max_delay: float = 30.0, jitter: float = 0.25,
+                 seed: Optional[int] = None):
+        self.base = float(base)
+        self.factor = float(factor)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def delay(self, n_prev_restarts: int) -> float:
+        """Delay before restart #(n_prev_restarts+1) of one worker."""
+        d = self.base * (self.factor ** n_prev_restarts)
+        d = min(d, self.max_delay)
+        return d * (1.0 + self.jitter * self._rng.random())
 
 
 class ElasticJobError(RuntimeError):
@@ -164,14 +189,15 @@ class ElasticSupervisor:
         self.heartbeat_timeout = heartbeat_timeout
         self.monitor_interval = float(monitor_interval)
         self.heartbeat_dir = heartbeat_dir
-        self._rng = random.Random(seed)
+        self._backoff = BackoffPolicy(base=backoff_base,
+                                      factor=backoff_factor,
+                                      max_delay=backoff_max,
+                                      jitter=jitter, seed=seed)
 
     # ------------------------------------------------------------- backoff
     def backoff_delay(self, n_prev_restarts: int) -> float:
         """Delay before restart #(n_prev_restarts+1) of one rank."""
-        d = self.backoff_base * (self.backoff_factor ** n_prev_restarts)
-        d = min(d, self.backoff_max)
-        return d * (1.0 + self.jitter * self._rng.random())
+        return self._backoff.delay(n_prev_restarts)
 
     # -------------------------------------------------------------- launch
     def _start(self, h: _Handle, nprocs: int):

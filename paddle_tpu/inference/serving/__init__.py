@@ -14,6 +14,12 @@ PAPERS.md arxiv 2604.15464). Four cooperating modules:
 - engine:       LLMEngine (add_request/step/streamed outputs, profiler
                 spans, throughput/latency stats) + ServingPredictor
                 (the inference.create_predictor dispatch target).
+- replica:      EngineReplica — one supervised engine slot (heartbeat,
+                quarantine, capped-backoff restart + warmup probe).
+- router:       ReplicaSet — N replicas behind one front-end with
+                free-block load balancing, replica-level failover
+                (zero-lost-request requeue to survivors), draining,
+                and router-level backpressure.
 
 See docs/serving.md for architecture and tuning.
 """
@@ -25,6 +31,9 @@ from .scheduler import (EngineOverloaded, Request,  # noqa: F401
                         Scheduler, SchedulerConfig)
 from .engine import (EngineConfig, EngineStats, LLMEngine,  # noqa: F401
                      RequestOutput, ServingPredictor)
+from .replica import (EngineReplica, ReplicaCrashed,  # noqa: F401
+                      ReplicaState)
+from .router import ReplicaSet, RouterConfig, RouterRequest  # noqa: F401
 
 __all__ = [
     "PagedKVCache", "CacheExhausted", "EngineOverloaded",
@@ -33,4 +42,6 @@ __all__ = [
     "SamplingParams", "Request", "RequestState",
     "Scheduler", "SchedulerConfig", "ScheduledBatch", "EngineConfig",
     "EngineStats", "LLMEngine", "RequestOutput", "ServingPredictor",
+    "EngineReplica", "ReplicaCrashed", "ReplicaState",
+    "ReplicaSet", "RouterConfig", "RouterRequest",
 ]

@@ -418,12 +418,28 @@ class LLMEngine:
 
     # ------------------------------------------------------------ intake
     def add_request(self, prompt_ids, sampling: SamplingParams = None,
-                    request_id: str = None) -> str:
+                    request_id: str = None, arrival_time: float = None,
+                    arrival: int = None, resume_tokens=None,
+                    readmit: bool = False) -> str:
         """Queue one request. Raises EngineOverloaded when the bounded
         waiting queue is full under admission_policy='reject'; under
         'shed_oldest' the oldest waiting request is evicted instead
         (terminal RequestOutput with finish_reason='shed', streamed from
-        the next step())."""
+        the next step()).
+
+        The keyword extensions are the replica-failover re-admission
+        surface (router.py; docs/serving.md "Multi-replica serving"):
+        `arrival_time`/`arrival` carry the request's ORIGINAL wall-clock
+        arrival and FCFS ticket across engines — deadline_s/queue_ttl_s
+        stay measured from the original arrival (a re-admitted request
+        that already blew its deadline finishes as 'timeout', never as a
+        silent retry), and the requeue keeps its original place in line.
+        `resume_tokens` seeds the output log with the tokens the failed
+        replica already streamed, so re-prefill continues the SAME token
+        stream (sampling keys depend only on request progress) and
+        max_tokens accounting never restarts. `readmit=True` inserts
+        arrival-ordered and bypasses the max_waiting bound (backpressure
+        applies to new arrivals, not to recovered in-flight work)."""
         sampling = sampling or SamplingParams()
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
@@ -439,11 +455,26 @@ class LLMEngine:
                 self._next_id += 1
             if request_id in self._requests:
                 raise ValueError(f"duplicate request_id {request_id!r}")
+            now = time.perf_counter()
             req = Request(request_id=request_id, prompt_ids=ids,
                           params=sampling,
-                          arrival_time=time.perf_counter())
+                          arrival_time=now if arrival_time is None
+                          else arrival_time)
+            if arrival is not None:
+                req.arrival = arrival
+            if resume_tokens is not None and len(resume_tokens):
+                req.output_ids = [int(t) for t in resume_tokens]
+                # TTFT was already observed on the replica that emitted
+                # the first token; the re-admitting engine records only
+                # token gaps (from now) for the resumed stream
+                req.first_token_time = req.arrival_time
+                req.last_token_time = now
             try:
-                shed = self.scheduler.add(req)  # validates pool fit/bound
+                if readmit:
+                    self.scheduler.readmit(req)
+                    shed = []
+                else:
+                    shed = self.scheduler.add(req)  # validates pool fit
             except EngineOverloaded:
                 self.stats.rejected += 1
                 raise
@@ -476,6 +507,39 @@ class LLMEngine:
     def get_request(self, request_id: str) -> Request:
         with self._lock:
             return self._requests[request_id]
+
+    # ----------------------------------------------- router-facing surface
+    def shed_oldest_waiting(self) -> Optional[str]:
+        """Evict this engine's oldest waiting request (the router's
+        cross-replica 'shed_oldest' acts on whichever replica holds the
+        globally-oldest waiting request). Streams the terminal 'shed'
+        output from the next step(); returns the shed request_id or
+        None when nothing waits."""
+        with self._lock:
+            victim = self.scheduler.shed_oldest()
+            if victim is None:
+                return None
+            victim.finish_time = time.perf_counter()
+            self.stats.shed += 1
+            self._pending_outputs.append(RequestOutput(
+                victim.request_id, None, list(victim.output_ids),
+                True, "shed"))
+            return victim.request_id
+
+    def oldest_waiting_arrival(self) -> Optional[int]:
+        return self.scheduler.oldest_waiting_arrival()
+
+    def load_info(self) -> dict:
+        """Host-side load snapshot the ReplicaSet balances on:
+        free_blocks MINUS the engine's outstanding block demand is the
+        effective headroom, prefill_cost prices the queued re-prefills
+        with the committed cost model (docs/serving.md "Multi-replica
+        serving")."""
+        with self._lock:
+            info = self.scheduler.backlog()
+            info["free_blocks"] = self.cache.num_free()
+            info["running"] = self.scheduler.num_running()
+            return info
 
     # ---------------------------------------------------------- sampling
     @holds_lock("_lock")

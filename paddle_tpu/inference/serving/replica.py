@@ -1,0 +1,311 @@
+"""EngineReplica: one supervised LLMEngine slot inside a ReplicaSet.
+
+The replica supervisor is the serving twin of the trainer supervision in
+distributed/elastic.py — the same three signals, one per failure mode:
+
+- CRASH: the engine's step raises (a kill_replica fault, a device error
+  the engine-level recovery could not contain). The exception IS the
+  signal, like a nonzero exit code to ElasticSupervisor.
+- WEDGE: the engine stops making progress without raising — a hung
+  device call. Detected the way elastic detects trainer hangs: each
+  successful step beats a heartbeat timestamp, and a replica holding
+  unfinished work whose beat goes stale past `heartbeat_timeout` counts
+  as wedged (`ReplicaSet` runs the check; a wedged step here returns
+  without beating, which is exactly what a hung engine looks like from
+  the router's thread).
+- DRAIN: operator-initiated; the replica finishes its admitted work but
+  receives nothing new, then parks DRAINED until undrained.
+
+A failed replica's engine object is DISCARDED untouched — the router
+scrub-frees nothing it can't reach, because a dead engine's device state
+is gone and a wedged one's is untrustworthy; the blocks die with the
+pool. Restarts follow elastic's capped-backoff policy
+(distributed.elastic.BackoffPolicy — literally the same class), and a
+restarted replica rejoins rotation only after a WARMUP PROBE: a 1-token
+greedy request must complete on the fresh engine before any real traffic
+routes there (a replica that crashes on its probe goes straight back to
+backoff).
+
+Thread contract (ptlint PT-C001 via _GUARDED_BY): replica state is
+shared between the router's step loop and intake threads; public methods
+take self._lock, helpers are @holds_lock. Lock order is
+router → replica → engine → scheduler, never the reverse.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ...analysis import holds_lock
+from ...distributed.elastic import BackoffPolicy
+from .scheduler import SamplingParams
+
+__all__ = ["EngineReplica", "ReplicaCrashed", "ReplicaState"]
+
+
+class ReplicaCrashed(RuntimeError):
+    """A replica's engine died mid-step (the serving analogue of a
+    nonzero worker exit code); the router quarantines the replica and
+    fails its requests over to survivors."""
+
+
+class ReplicaState:
+    STARTING = "starting"    # fresh engine up, warmup probe pending
+    UP = "up"                # serving; eligible for admissions
+    DRAINING = "draining"    # finishing admitted work; no new admissions
+    DRAINED = "drained"      # drained empty; parked until undrain()
+    DOWN = "down"            # quarantined; backing off before restart
+    FAILED = "failed"        # restart budget exhausted; never rejoins
+
+    SERVING = (UP, DRAINING)  # states whose engine steps
+
+
+class EngineReplica:
+    """One supervised engine slot (module docstring). The ReplicaSet is
+    the only caller; every public method is safe from the router's
+    locked frame (lock order router → replica)."""
+
+    _GUARDED_BY = {
+        "engine": "_lock",
+        "state": "_lock",
+        "restarts": "_lock",
+        "restart_at": "_lock",
+        "last_beat": "_lock",
+        "last_step_end": "_lock",
+        "_wedged": "_lock",
+        "history": "_lock",
+        "failed_at": "_lock",
+        "probe_tokens": "_lock",
+    }
+
+    def __init__(self, index: int, engine_factory: Callable,
+                 backoff: BackoffPolicy, max_restarts: int = 3,
+                 heartbeat_timeout: Optional[float] = None,
+                 probe_prompt=(1,), probe_timeout_steps: int = 64):
+        self.index = index
+        self._factory = engine_factory
+        self._backoff = backoff
+        self.max_restarts = int(max_restarts)
+        self.heartbeat_timeout = heartbeat_timeout
+        self.probe_prompt = list(probe_prompt)
+        self.probe_timeout_steps = int(probe_timeout_steps)
+        self._lock = threading.RLock()
+        self.engine = engine_factory(index, 0)
+        # first incarnation starts UP unprobed — the same trust a
+        # single-engine deployment extends to a freshly built LLMEngine;
+        # the warmup probe gates REJOIN after a failure, where the
+        # previous incarnation just proved the slot can go bad
+        self.state = ReplicaState.UP
+        self.restarts = 0                 # incarnations spent (0 = first)
+        self.restart_at: Optional[float] = None
+        self.last_beat = time.monotonic()
+        self.last_step_end = self.last_beat
+        self._wedged = False
+        self.failed_at: Optional[float] = None  # quarantine timestamp
+        self.history: List[tuple] = []    # [(incarnation, reason)]
+        self.probe_tokens = 0             # warmup tokens spent (telemetry)
+
+    # ------------------------------------------------------------ queries
+    def is_serving(self) -> bool:
+        with self._lock:
+            return self.state in ReplicaState.SERVING
+
+    def accepts_admissions(self) -> bool:
+        with self._lock:
+            return self.state == ReplicaState.UP
+
+    def has_unfinished(self) -> bool:
+        with self._lock:
+            return self.state in ReplicaState.SERVING \
+                and self.engine.has_unfinished()
+
+    def load_info(self) -> dict:
+        with self._lock:
+            return self.engine.load_info()
+
+    def check_integrity(self):
+        """Zero-leak audit of THIS replica's live pool (None while the
+        slot holds no engine — a quarantined incarnation's pool is
+        unreachable by definition)."""
+        with self._lock:
+            if self.engine is None:
+                return None
+            return self.engine.cache.check_integrity()
+
+    # ------------------------------------------------------------ intake
+    def dispatch(self, prompt_ids, sampling, request_id,
+                 arrival_time=None, arrival=None, resume_tokens=None,
+                 readmit: bool = False):
+        """Admit one request to this replica's engine (router-only
+        entry; the dispatch beats the heartbeat so an idle replica's
+        clock starts when work lands). Returns the engine-stamped
+        (arrival ticket, arrival_time)."""
+        with self._lock:
+            self.engine.add_request(prompt_ids, sampling,
+                                    request_id=request_id,
+                                    arrival_time=arrival_time,
+                                    arrival=arrival,
+                                    resume_tokens=resume_tokens,
+                                    readmit=readmit)
+            self.last_beat = time.monotonic()
+            req = self.engine.get_request(request_id)
+            return req.arrival, req.arrival_time
+
+    def oldest_waiting_arrival(self) -> Optional[int]:
+        with self._lock:
+            return self.engine.oldest_waiting_arrival()
+
+    def shed_oldest_waiting(self) -> Optional[str]:
+        with self._lock:
+            return self.engine.shed_oldest_waiting()
+
+    def cancel(self, request_id: str) -> bool:
+        with self._lock:
+            if self.engine is None:
+                return False
+            return self.engine.cancel(request_id)
+
+    # --------------------------------------------------------------- step
+    def step(self, router_step: int, faults=None) -> list:
+        """One engine step under supervision. Raises ReplicaCrashed when
+        a kill fault (or any engine-level exception) fires; a wedged
+        replica returns [] WITHOUT beating the heartbeat — from the
+        router's perspective indistinguishable from a hung device call,
+        which is the point."""
+        with self._lock:
+            if faults is not None \
+                    and faults.kill_replica(router_step, self.index):
+                raise ReplicaCrashed(
+                    f"replica {self.index} killed by fault injection at "
+                    f"router step {router_step}")
+            if faults is not None \
+                    and faults.wedge_replica(router_step, self.index):
+                self._wedged = True
+            if self._wedged:
+                self.last_step_end = time.monotonic()
+                return []
+            try:
+                outs = self.engine.step()
+            except Exception as e:
+                raise ReplicaCrashed(
+                    f"replica {self.index} engine step raised: {e}") from e
+            now = time.monotonic()
+            self.last_beat = now
+            self.last_step_end = now
+            return outs
+
+    def beat(self) -> None:
+        """Reset the heartbeat baseline (the router beats on dispatch so
+        a request added to a momentarily-idle replica can't trip the
+        stale-beat check before its first step)."""
+        with self._lock:
+            self.last_beat = time.monotonic()
+
+    def wedged(self) -> bool:
+        """Heartbeat-based wedge verdict: serving, holding unfinished
+        work, and silent past heartbeat_timeout. The staleness baseline
+        is the replica's OWN last step-return time, not wall clock — a
+        healthy step always beats at its end, so last_step_end and
+        last_beat advance together and a slow-but-progressing step
+        (fresh-engine compile, a long stall that completes) can never
+        false-trip the check; only steps that return WITHOUT beating —
+        the wedge signature — let last_step_end drift ahead. An IDLE
+        wedged replica is caught on its first admission: the dispatch
+        beat starts the clock and no step beat ever follows."""
+        with self._lock:
+            if self.heartbeat_timeout is None \
+                    or self.state not in ReplicaState.SERVING:
+                return False
+            if not self.engine.has_unfinished():
+                return False
+            return (self.last_step_end - self.last_beat) \
+                > self.heartbeat_timeout
+
+    # ----------------------------------------------------------- failover
+    def quarantine(self, reason: str) -> None:
+        """Take the replica out of rotation after a crash/wedge verdict.
+        The engine object is dropped UNREAD — nothing it owns can be
+        trusted (and for a real dead process nothing is reachable), so
+        there is no scrub, no free: the pool dies with the engine. A
+        fresh incarnation gets a fresh pool."""
+        with self._lock:
+            self.history.append((self.restarts, reason))
+            self.engine = None
+            self._wedged = False
+            self.failed_at = time.monotonic()
+            if self.restarts >= self.max_restarts:
+                self.state = ReplicaState.FAILED
+                self.restart_at = None
+            else:
+                self.state = ReplicaState.DOWN
+                self.restart_at = time.monotonic() \
+                    + self._backoff.delay(self.restarts)
+                self.restarts += 1
+
+    def restart_due(self, now: float = None) -> bool:
+        with self._lock:
+            now = time.monotonic() if now is None else now
+            return self.state == ReplicaState.DOWN \
+                and self.restart_at is not None and now >= self.restart_at
+
+    def restart(self) -> bool:
+        """Build a fresh engine incarnation and run the warmup probe.
+        Returns True when the replica is back UP; a probe failure sends
+        it straight back to quarantine (counting against the restart
+        budget, with escalated backoff)."""
+        with self._lock:
+            self.state = ReplicaState.STARTING
+            try:
+                self.engine = self._factory(self.index, self.restarts)
+                self._probe()
+            except Exception as e:          # noqa: BLE001 — any probe
+                # failure is a failed incarnation, not a router crash
+                self.quarantine(f"warmup probe failed: {e}")
+                return False
+            self.state = ReplicaState.UP
+            self.last_beat = time.monotonic()
+            return True
+
+    @holds_lock("_lock")
+    def _probe(self) -> None:
+        """Warmup probe: one greedy token end-to-end on the fresh engine
+        (prefill → paged decode → terminal). Any raise or a non-'length'
+        terminal fails the probe; the probe request never reaches the
+        router's tables."""
+        eng = self.engine
+        rid = eng.add_request(
+            self.probe_prompt,
+            SamplingParams(max_tokens=1, temperature=0.0),
+            request_id=f"warmup-probe-r{self.index}-i{self.restarts}")
+        for _ in range(self.probe_timeout_steps):
+            eng.step()
+            req = eng.get_request(rid)
+            if req.finished:
+                break
+        req = eng.get_request(rid)
+        if req.state != "finished_length":
+            raise RuntimeError(
+                f"warmup probe ended {req.state!r} instead of serving "
+                f"its token")
+        self.probe_tokens += len(req.output_ids)
+
+    # ------------------------------------------------------------ draining
+    def drain(self) -> None:
+        with self._lock:
+            if self.state == ReplicaState.UP:
+                self.state = ReplicaState.DRAINING
+
+    def maybe_drained(self) -> bool:
+        """DRAINING → DRAINED once the engine has nothing unfinished
+        (router polls this each step). True when parked."""
+        with self._lock:
+            if self.state == ReplicaState.DRAINING \
+                    and not self.engine.has_unfinished():
+                self.state = ReplicaState.DRAINED
+            return self.state == ReplicaState.DRAINED
+
+    def undrain(self) -> None:
+        with self._lock:
+            if self.state in (ReplicaState.DRAINING, ReplicaState.DRAINED):
+                self.state = ReplicaState.UP

@@ -1,0 +1,569 @@
+"""ReplicaSet: N supervised LLMEngine replicas behind one front-end.
+
+ROADMAP item 3: one dense replica cannot serve heavy traffic. The
+ReplicaSet runs N data-parallel engine replicas (replica.py supervises
+each one the way distributed/elastic.py supervises trainers) behind a
+single `add_request` / `step` / streaming surface, and promotes the
+engine-level crash recovery of PR 3 to REPLICA-LEVEL failover:
+
+- ADMISSION routes each request to the replica with the most effective
+  headroom: free blocks MINUS the replica's outstanding block demand
+  (worst-case growth of everything admitted + queued), tie-broken by
+  the smallest queued re-prefill cost as priced by the PR-8 jaxplan
+  prefill cost model. Under skewed prompt lengths this beats
+  round-robin (kept as `balance="round_robin"` for A/B) because a long
+  prompt's demand lands on one replica's score immediately.
+- FAILOVER: a replica that crashes (step raises — kill_replica fault,
+  unrecoverable engine error) or wedges (heartbeat stale past
+  `heartbeat_timeout_s` while holding work) is quarantined: its engine
+  object is dropped UNREAD (the router scrub-frees nothing it can't
+  reach — a dead engine's pool died with it), and every one of its
+  in-flight and queued requests is re-admitted to survivors in
+  ORIGINAL arrival order with its original arrival_time/FCFS ticket
+  and the tokens already streamed (re-prefill — exactly the PR-3
+  requeue discipline, crossing engines). A seeded kill therefore loses
+  ZERO requests, and requests on untouched replicas stay
+  bitwise-identical to an unfaulted run. Deadlines keep counting from
+  the ORIGINAL arrival: a re-admitted request that already blew
+  deadline_s finishes as 'timeout', never as a silent retry.
+- RECOVERY: failed replicas restart with capped backoff
+  (distributed.elastic.BackoffPolicy) and rejoin only after a warmup
+  probe serves a token end-to-end on the fresh engine; a replica that
+  exhausts max_restarts parks FAILED. If NO survivor is up at failover
+  time, recovered requests wait in the router's orphan queue (arrival
+  order) and re-admit the moment a replica rejoins — only when every
+  replica is permanently FAILED do they terminalize as 'error'.
+- BACKPRESSURE spans replicas: `max_waiting` bounds the TOTAL waiting
+  depth across up replicas; policy 'reject' raises EngineOverloaded
+  carrying a `retry_after_s` hint (drain-rate estimate from the
+  router's step-time EWMA, or the earliest pending restart), policy
+  'shed_oldest' sheds the GLOBALLY-oldest waiting request from
+  whichever replica holds it.
+
+Observability (docs/observability.md): `serving_replica_up{router,
+replica}` gauge, `serving_failovers_total{router,replica,reason}`,
+`serving_requeued_total{router}`, `serving_router_ttft_seconds{router}`
+(first token as the CLIENT sees it, across failovers) and
+`serving_failover_recovery_seconds{router}` (quarantine → back UP);
+per-replica token/TTFT/latency families come for free through each
+engine's existing `engine` label (one per replica incarnation).
+
+The router is host-side orchestration only — it owns no device
+programs and adds no host syncs; all device work stays inside the
+engines it supervises.
+
+Thread contract (ptlint PT-C001 via _GUARDED_BY): router tables are
+shared between the serving loop (step/run) and intake threads
+(add_request/cancel); public entry points take self._lock, helpers are
+@holds_lock. Lock order: router → replica → engine → scheduler, never
+the reverse.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ... import obs
+from ...analysis import holds_lock
+from ...distributed.elastic import BackoffPolicy
+from .replica import EngineReplica, ReplicaCrashed, ReplicaState
+from .scheduler import EngineOverloaded, SamplingParams
+from .engine import RequestOutput
+
+__all__ = ["BALANCE_POLICIES", "ReplicaSet", "RouterConfig",
+           "RouterRequest"]
+
+BALANCE_POLICIES = ("free_blocks", "round_robin")
+
+_ROUTER_IDS = itertools.count()
+
+
+@dataclass
+class RouterConfig:
+    num_replicas: int = 2
+    balance: str = "free_blocks"         # BALANCE_POLICIES
+    # heartbeat-based wedge detection (None disables — crash failover
+    # still works; wedges then surface only through engine watchdogs)
+    heartbeat_timeout_s: Optional[float] = None
+    # replica restart policy (distributed.elastic.BackoffPolicy)
+    max_restarts: int = 3
+    backoff_base: float = 0.25
+    backoff_factor: float = 2.0
+    backoff_max: float = 30.0
+    backoff_jitter: float = 0.25
+    backoff_seed: Optional[int] = None
+    # router-level backpressure spanning replicas: TOTAL waiting bound
+    max_waiting: Optional[int] = None
+    admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
+    # warmup probe for rejoining replicas (token ids; must be < vocab)
+    probe_prompt: tuple = (1,)
+    obs_label: Optional[str] = None
+
+
+@dataclass
+class RouterRequest:
+    """Router-side record of one request: the authoritative copy of
+    everything failover needs — prompt, params, ORIGINAL arrival
+    stamps, and the token log as streamed to the client (the router
+    never reads recovery state out of a dead engine)."""
+    request_id: str
+    prompt_ids: np.ndarray
+    params: SamplingParams
+    arrival_time: float
+    arrival: int                         # global FCFS ticket
+    replica: Optional[int]               # current home (None = orphaned)
+    tokens: List[int] = field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    requeues: int = 0                    # failover re-admissions
+    first_token_time: Optional[float] = None
+
+
+class ReplicaSet:
+    """N supervised engine replicas behind one serving surface (module
+    docstring)."""
+
+    _GUARDED_BY = {
+        "_requests": "_lock",
+        "_next_id": "_lock",
+        "_rr_next": "_lock",
+        "_orphans": "_lock",
+        "_pending": "_lock",
+        "_steps": "_lock",
+        "_step_ewma": "_lock",
+        "recovery_times": "_lock",
+    }
+
+    def __init__(self, engine_factory, config: RouterConfig = None,
+                 faults=None):
+        """`engine_factory(replica_index, incarnation) -> LLMEngine`
+        builds each replica incarnation; `from_model` wires the common
+        case. `faults` is a ServingFaultInjector shared by the router
+        (kill_replica/wedge_replica hooks) and — when the factory passes
+        it through, as from_model does — by every engine (the
+        engine-level nan/stall/corrupt hooks keep working unchanged in
+        multi-replica runs)."""
+        config = config or RouterConfig()
+        if config.num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {config.num_replicas}")
+        if config.balance not in BALANCE_POLICIES:
+            raise ValueError(
+                f"balance must be one of {BALANCE_POLICIES}, got "
+                f"{config.balance!r}")
+        if config.admission_policy not in ("reject", "shed_oldest"):
+            raise ValueError(
+                f"admission_policy must be 'reject' or 'shed_oldest', "
+                f"got {config.admission_policy!r}")
+        self.config = config
+        self.label = f"{config.obs_label or 'router'}-{next(_ROUTER_IDS)}"
+        if faults is None:
+            from ...testing.faults import ServingFaultInjector
+            faults = ServingFaultInjector()
+        self.faults = faults
+        backoff = BackoffPolicy(base=config.backoff_base,
+                                factor=config.backoff_factor,
+                                max_delay=config.backoff_max,
+                                jitter=config.backoff_jitter,
+                                seed=config.backoff_seed)
+        # the replica list itself is immutable after construction (each
+        # EngineReplica carries its own lock); router tables below are
+        # the shared-mutable state under self._lock
+        self.replicas = [
+            EngineReplica(i, engine_factory, backoff,
+                          max_restarts=config.max_restarts,
+                          heartbeat_timeout=config.heartbeat_timeout_s,
+                          probe_prompt=config.probe_prompt)
+            for i in range(config.num_replicas)]
+        self._lock = threading.RLock()
+        self._requests: Dict[str, RouterRequest] = {}
+        self._next_id = 0
+        self._rr_next = 0                 # round_robin cursor
+        self._orphans: List[RouterRequest] = []
+        self._pending: List[RequestOutput] = []
+        self._steps = 0
+        self._step_ewma = 0.05            # drain-rate estimate seed (s)
+        self.recovery_times: List[float] = []
+        lbl = dict(router=self.label)
+        self._g_up = obs.gauge(
+            "serving_replica_up",
+            "1 while the replica accepts admissions, 0 while draining/"
+            "down/failed", labels=("router", "replica"))
+        self._c_failovers = obs.counter(
+            "serving_failovers_total",
+            "replica-level failovers by reason (crash|wedge)",
+            labels=("router", "replica", "reason"))
+        self._c_requeued = obs.counter(
+            "serving_requeued_total",
+            "requests re-admitted to a survivor (or orphaned) after "
+            "their replica failed", labels=("router",)).labels(**lbl)
+        self._h_ttft = obs.histogram(
+            "serving_router_ttft_seconds",
+            "client-visible time to first token across replicas and "
+            "failovers", labels=("router",), unit="seconds").labels(**lbl)
+        self._h_recovery = obs.histogram(
+            "serving_failover_recovery_seconds",
+            "quarantine -> rejoined-UP wall time per replica restart",
+            labels=("router",), unit="seconds").labels(**lbl)
+        for r in self.replicas:
+            self._set_up_gauge(r)
+
+    @classmethod
+    def from_model(cls, model, config: RouterConfig = None,
+                   engine_config=None, faults=None):
+        """Build a ReplicaSet of identical engines over one model's
+        live parameters (each replica gets its own paged pool and a
+        per-replica obs label `<router>-r<i>`)."""
+        import dataclasses
+        from .engine import EngineConfig, LLMEngine
+        config = config or RouterConfig()
+        ecfg = engine_config or EngineConfig()
+        if faults is None:
+            from ...testing.faults import ServingFaultInjector
+            faults = ServingFaultInjector()
+        base_label = config.obs_label or "router"
+
+        def factory(index, incarnation):
+            cfg_i = dataclasses.replace(
+                ecfg, obs_label=f"{base_label}-r{index}")
+            return LLMEngine.from_model(model, cfg_i, faults=faults)
+
+        return cls(factory, config, faults=faults)
+
+    # ------------------------------------------------------------ intake
+    def add_request(self, prompt_ids, sampling: SamplingParams = None,
+                    request_id: str = None) -> str:
+        """Route one request to the best replica. Raises
+        EngineOverloaded (with a retry_after_s hint) when no replica is
+        up, or when the router-level waiting bound is hit under policy
+        'reject'; under 'shed_oldest' the globally-oldest waiting
+        request is shed instead."""
+        sampling = sampling or SamplingParams()
+        with self._lock:
+            if request_id is None:
+                request_id = f"rr-{self._next_id}"
+                self._next_id += 1
+            if request_id in self._requests:
+                raise ValueError(f"duplicate request_id {request_id!r}")
+            ups = [r for r in self.replicas if r.accepts_admissions()]
+            if not ups:
+                raise EngineOverloaded(
+                    request_id, 0, 0,
+                    retry_after_s=self._retry_after())
+            limit = self.config.max_waiting
+            if limit is not None:
+                total = sum(r.load_info()["waiting"] for r in ups)
+                if total >= limit:
+                    if self.config.admission_policy == "reject":
+                        raise EngineOverloaded(
+                            request_id, total, limit,
+                            retry_after_s=self._retry_after())
+                    self._shed_globally_oldest(ups)
+            last_exc = None
+            for rep in self._rank(ups):
+                try:
+                    arrival, arrival_time = rep.dispatch(
+                        prompt_ids, sampling, request_id)
+                except EngineOverloaded as e:
+                    last_exc = e          # per-replica bound; try next
+                    continue
+                self._rr_next = (rep.index + 1) % len(self.replicas)
+                self._requests[request_id] = RouterRequest(
+                    request_id=request_id,
+                    prompt_ids=np.asarray(prompt_ids,
+                                          np.int32).reshape(-1),
+                    params=sampling, arrival_time=arrival_time,
+                    arrival=arrival, replica=rep.index)
+                return request_id
+            # every up replica refused at ITS bound: surface overload
+            # with the strongest hint we have
+            raise EngineOverloaded(
+                request_id, last_exc.depth if last_exc else 0,
+                last_exc.limit if last_exc else 0,
+                retry_after_s=self._retry_after())
+
+    def cancel(self, request_id: str) -> bool:
+        with self._lock:
+            rec = self._requests.get(request_id)
+            if rec is None or rec.finished:
+                return False
+            if rec.replica is None:       # orphaned: cancel router-side
+                self._orphans = [o for o in self._orphans
+                                 if o.request_id != request_id]
+                self._terminal(rec, "cancelled")
+                return True
+            ok = self.replicas[rec.replica].cancel(request_id)
+            if ok:
+                self._terminal(rec, "cancelled")
+            return ok
+
+    def get_request(self, request_id: str) -> RouterRequest:
+        with self._lock:
+            return self._requests[request_id]
+
+    def has_unfinished(self) -> bool:
+        with self._lock:
+            return any(not rec.finished
+                       for rec in self._requests.values())
+
+    # ------------------------------------------------------------ routing
+    @holds_lock("_lock")
+    def _rank(self, candidates: List[EngineReplica]):
+        """Dispatch preference order. free_blocks: descending effective
+        headroom (free - outstanding demand), then cheapest queued
+        re-prefill backlog (jaxplan-priced when the engines carry a
+        cost model), then lowest index. round_robin: rotate."""
+        if self.config.balance == "round_robin":
+            n = len(self.replicas)
+            return sorted(candidates,
+                          key=lambda r: (r.index - self._rr_next) % n)
+
+        def score(rep):
+            info = rep.load_info()
+            return (info["free_blocks"] - info["block_demand"],
+                    -info["prefill_cost"], -rep.index)
+
+        return sorted(candidates, key=score, reverse=True)
+
+    @holds_lock("_lock")
+    def _shed_globally_oldest(self, ups: List[EngineReplica]) -> None:
+        oldest, victim_rep = None, None
+        for rep in ups:
+            a = rep.oldest_waiting_arrival()
+            if a is not None and (oldest is None or a < oldest):
+                oldest, victim_rep = a, rep
+        if victim_rep is not None:
+            victim_rep.shed_oldest_waiting()
+            # terminal 'shed' output streams from that replica's next
+            # step and lands in the router record via _absorb
+
+    @holds_lock("_lock")
+    def _retry_after(self) -> float:
+        """Client backoff hint: the earliest pending replica restart if
+        the fleet is (partially) down, else one drain step's EWMA."""
+        now = time.monotonic()
+        waits = [max(r.restart_at - now, 0.0) for r in self.replicas
+                 if r.restart_at is not None
+                 and r.state == ReplicaState.DOWN]
+        base = max(self._step_ewma, 0.01)
+        return round(max(min(waits), base), 3) if waits \
+            else round(base, 3)
+
+    # -------------------------------------------------------------- step
+    def step(self) -> List[RequestOutput]:
+        """One router iteration: restart due replicas (warmup-probed),
+        re-admit orphans, step every serving replica under crash
+        supervision, then run the heartbeat wedge check. Returns the
+        merged streamed outputs."""
+        with self._lock:
+            return self._step_locked()
+
+    @holds_lock("_lock")
+    def _step_locked(self) -> List[RequestOutput]:
+        outs: List[RequestOutput] = list(self._pending)
+        self._pending.clear()
+        self._steps += 1
+        step_no = self._steps
+        t0 = time.perf_counter()
+        with obs.span("serving.router_step", cat="serving",
+                      annotate=False,
+                      args={"router": self.label, "step": step_no}):
+            for rep in self.replicas:
+                if rep.restart_due():
+                    before = rep.failed_at
+                    if rep.restart():
+                        self._set_up_gauge(rep)
+                        dt = time.monotonic() - before
+                        self.recovery_times.append(dt)
+                        self._h_recovery.observe(dt)
+            self._readmit_orphans(outs)
+            for rep in self.replicas:
+                if not rep.is_serving():
+                    continue
+                try:
+                    r_outs = rep.step(step_no, self.faults)
+                except ReplicaCrashed as e:
+                    self._failover(rep, "crash", str(e), outs)
+                    continue
+                self._absorb(r_outs, outs)
+                rep.maybe_drained()
+            for rep in self.replicas:
+                if rep.wedged():
+                    self._failover(rep, "wedge",
+                                   "heartbeat stale past "
+                                   f"{self.config.heartbeat_timeout_s}s",
+                                   outs)
+        dt = time.perf_counter() - t0
+        self._step_ewma = 0.8 * self._step_ewma + 0.2 * dt
+        return outs
+
+    @holds_lock("_lock")
+    def _absorb(self, replica_outputs, outs) -> None:
+        """Fold one replica's streamed outputs into the router tables.
+        token_ids is authoritative (it includes resumed tokens, so the
+        router log can only move forward)."""
+        now = time.perf_counter()
+        for o in replica_outputs:
+            rec = self._requests.get(o.request_id)
+            if rec is None:
+                continue                  # warmup probe etc.
+            rec.tokens = list(o.token_ids)
+            if rec.first_token_time is None and o.new_token is not None:
+                rec.first_token_time = now
+                self._h_ttft.observe(now - rec.arrival_time)
+            if o.finished:
+                rec.finished = True
+                rec.finish_reason = o.finish_reason
+            outs.append(o)
+
+    @holds_lock("_lock")
+    def _terminal(self, rec: RouterRequest, reason: str) -> None:
+        """Router-side terminal (cancel of an orphan, orphans with no
+        fleet left): synthesize the terminal output the engines would
+        have streamed."""
+        rec.finished = True
+        rec.finish_reason = reason
+        self._pending.append(RequestOutput(
+            rec.request_id, None, list(rec.tokens), True, reason))
+
+    # ----------------------------------------------------------- failover
+    @holds_lock("_lock")
+    def _failover(self, rep: EngineReplica, reason: str, detail: str,
+                  outs) -> None:
+        """Quarantine a crashed/wedged replica and re-admit its
+        non-terminal requests to survivors in original arrival order
+        (module docstring). The router's own record is the recovery
+        source — nothing is read from the failed engine."""
+        self._c_failovers.labels(router=self.label,
+                                 replica=str(rep.index),
+                                 reason=reason).inc()
+        rep.quarantine(f"{reason}: {detail}")
+        self._set_up_gauge(rep)
+        victims = sorted(
+            (rec for rec in self._requests.values()
+             if not rec.finished and rec.replica == rep.index),
+            key=lambda rec: rec.arrival)
+        for rec in victims:
+            rec.replica = None
+            rec.requeues += 1
+            self._c_requeued.inc()
+        self._orphans.extend(victims)
+        self._orphans.sort(key=lambda rec: rec.arrival)
+        self._readmit_orphans(outs)
+
+    @holds_lock("_lock")
+    def _readmit_orphans(self, outs) -> None:
+        """Re-admit orphaned requests (original arrival order) to up
+        replicas; with the whole fleet permanently FAILED they
+        terminalize as 'error' — loudly, never silently dropped."""
+        if not self._orphans:
+            return
+        if all(r.state == ReplicaState.FAILED for r in self.replicas):
+            for rec in self._orphans:
+                self._terminal(rec, "error")
+                outs.append(self._pending.pop())
+            self._orphans.clear()
+            return
+        remaining: List[RouterRequest] = []
+        for rec in self._orphans:
+            ups = [r for r in self.replicas if r.accepts_admissions()]
+            if not ups:
+                remaining.append(rec)
+                continue
+            target = self._rank(ups)[0]
+            try:
+                target.dispatch(rec.prompt_ids, rec.params,
+                                rec.request_id,
+                                arrival_time=rec.arrival_time,
+                                arrival=rec.arrival,
+                                resume_tokens=rec.tokens, readmit=True)
+            except ValueError:
+                # can never fit the survivor's pool — terminal, loud
+                self._terminal(rec, "error")
+                outs.append(self._pending.pop())
+                continue
+            rec.replica = target.index
+        self._orphans[:] = remaining
+
+    @holds_lock("_lock")
+    def _set_up_gauge(self, rep: EngineReplica) -> None:
+        self._g_up.labels(router=self.label,
+                          replica=str(rep.index)).set(
+            1 if rep.accepts_admissions() else 0)
+
+    # ------------------------------------------------------------ control
+    def drain(self, index: int) -> None:
+        """Stop routing new work to replica `index`; it finishes what
+        it holds and parks DRAINED (undrain() to rejoin)."""
+        with self._lock:
+            self.replicas[index].drain()
+            self._set_up_gauge(self.replicas[index])
+
+    def undrain(self, index: int) -> None:
+        with self._lock:
+            self.replicas[index].undrain()
+            self._set_up_gauge(self.replicas[index])
+
+    # ------------------------------------------------------------- audits
+    def check_integrity(self) -> dict:
+        """Per-replica zero-leak audit (chaos gate): every live pool's
+        free list + tables must exactly partition it. Replicas whose
+        slot holds no engine (DOWN/FAILED) audit as None — their pools
+        are unreachable."""
+        return {r.index: r.check_integrity() for r in self.replicas}
+
+    def states(self) -> dict:
+        return {r.index: r.state for r in self.replicas}
+
+    def num_up(self) -> int:
+        return sum(1 for r in self.replicas if r.accepts_admissions())
+
+    def ttft_quantile(self, q: float) -> float:
+        return self._h_ttft.quantile(q)
+
+    def router_stats(self) -> dict:
+        with self._lock:
+            recs = list(self._requests.values())
+            by_reason: Dict[str, int] = {}
+            for rec in recs:
+                if rec.finished:
+                    key = rec.finish_reason or "unknown"
+                    by_reason[key] = by_reason.get(key, 0) + 1
+            return {
+                "steps": self._steps,
+                "requests": len(recs),
+                "unfinished": sum(1 for r in recs if not r.finished),
+                "generated_tokens": sum(len(r.tokens) for r in recs),
+                "requeues": sum(r.requeues for r in recs),
+                "finish_reasons": by_reason,
+                "replica_states": {r.index: r.state
+                                   for r in self.replicas},
+                "recovery_times_s": [round(t, 4)
+                                     for t in self.recovery_times],
+            }
+
+    # ------------------------------------------------------- convenience
+    def run(self, max_steps: int = None) -> Dict[str, np.ndarray]:
+        """Drive every queued request to a terminal state; returns
+        {request_id: generated token ids} for normally-completed
+        requests. Idles briefly while the only pending work is a
+        replica restart backoff, so the drain loop doesn't spin."""
+        steps = 0
+        while self.has_unfinished():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"router did not drain within {max_steps} steps")
+            if not any(r.has_unfinished() for r in self.replicas) \
+                    and self.has_unfinished():
+                time.sleep(0.002)         # waiting on a restart backoff
+        with self._lock:
+            return {rid: np.asarray(rec.tokens, np.int64)
+                    for rid, rec in self._requests.items()
+                    if rec.finish_reason in ("stop", "length")}

@@ -61,17 +61,23 @@ ADMISSION_POLICIES = ("reject", "shed_oldest")
 
 class EngineOverloaded(RuntimeError):
     """Admission refused: the bounded waiting queue is full (policy
-    'reject'). Carries the queue depth so callers can surface
-    retry-after semantics."""
+    'reject'). Carries the queue depth and, when the raiser can estimate
+    one, a `retry_after_s` hint — the ReplicaSet router fills it from
+    its observed drain rate so clients can back off instead of hammering
+    a saturated fleet."""
 
-    def __init__(self, request_id, depth: int, limit: int):
+    def __init__(self, request_id, depth: int, limit: int,
+                 retry_after_s: Optional[float] = None):
         self.request_id = request_id
         self.depth = depth
         self.limit = limit
+        self.retry_after_s = retry_after_s
+        hint = "" if retry_after_s is None \
+            else f"; retry after ~{retry_after_s:.2f}s"
         super().__init__(
             f"engine overloaded: request {request_id!r} rejected, waiting "
             f"queue at {depth}/{limit} (admission_policy='reject'; use "
-            f"'shed_oldest' to evict instead)")
+            f"'shed_oldest' to evict instead){hint}")
 
 
 @dataclass(frozen=True)
@@ -243,6 +249,74 @@ class Scheduler:
             req.state = RequestState.WAITING
             self.waiting.append(req)
             return shed
+
+    def readmit(self, req: Request):
+        """Failover re-admission (docs/serving.md "Multi-replica
+        serving"): insert a request recovered from a failed replica into
+        THIS scheduler's waiting queue at its ORIGINAL arrival position —
+        the same arrival-ordered requeue discipline `_requeue` applies to
+        preemption and crash recovery, but crossing engines. Bypasses
+        `max_waiting` deliberately: the bound is backpressure against NEW
+        arrivals, and bouncing a recovered in-flight request would break
+        the zero-lost-request guarantee (the transient overshoot drains
+        at FCFS priority)."""
+        worst = len(req.prompt_ids) + req.params.max_tokens
+        if self.cache.blocks_needed(worst) > self.cache.num_blocks:
+            raise ValueError(
+                f"request {req.request_id!r} needs "
+                f"{self.cache.blocks_needed(worst)} blocks at its longest"
+                f" ({worst} tokens) but the pool only has "
+                f"{self.cache.num_blocks}")
+        with self._lock:
+            self._requeue(req)
+
+    def shed_oldest(self) -> Optional[Request]:
+        """Evict the oldest waiting request (router-level 'shed_oldest'
+        spanning replicas: the ReplicaSet finds the globally-oldest
+        waiting request and sheds it from whichever replica holds it).
+        Returns it with state FINISHED_SHED, or None when nothing
+        waits."""
+        with self._lock:
+            if not self.waiting:
+                return None
+            victim = self.waiting.popleft()
+            victim.state = RequestState.FINISHED_SHED
+            return victim
+
+    def oldest_waiting_arrival(self) -> Optional[int]:
+        """Arrival ticket of the head of the waiting line (None when
+        empty) — the router's cross-replica shed_oldest scans these."""
+        with self._lock:
+            return self.waiting[0].arrival if self.waiting else None
+
+    def backlog(self) -> dict:
+        """Load snapshot for the router's free-block balancer:
+        `waiting` (queue depth), `block_demand` (worst-case ADDITIONAL
+        blocks needed to finish every admitted and queued request — the
+        growth headroom this engine still owes), and `prefill_cost`
+        (modelled cost of the re-prefills waiting in line, priced by the
+        jaxplan cost model when configured, flat tokens otherwise)."""
+        with self._lock:
+            cost_model = self.config.prefill_cost_model
+            demand = 0
+            cost = 0.0
+            for req in self.waiting:
+                tokens = len(req.prompt_ids) + len(req.output_ids)
+                remaining = max(0, req.params.max_tokens
+                                - len(req.output_ids))
+                demand += self.cache.blocks_needed(tokens + remaining)
+                cost += cost_model.cost(tokens) if cost_model else tokens
+            for req in self.running:
+                tokens = len(req.prompt_ids) + len(req.output_ids)
+                remaining = max(0, req.params.max_tokens
+                                - len(req.output_ids))
+                held = len(self.cache.block_table(req.request_id)) \
+                    if self.cache.has_seq(req.request_id) else 0
+                demand += max(
+                    0, self.cache.blocks_needed(tokens + remaining) - held)
+            return {"waiting": len(self.waiting),
+                    "block_demand": demand,
+                    "prefill_cost": cost}
 
     def cancel(self, request_id: str) -> bool:
         with self._lock:

@@ -35,7 +35,8 @@ STATE_DIR_ENV = "PADDLE_TPU_FAULT_STATE_DIR"
 SERVE_SPEC_ENV = "PADDLE_TPU_SERVE_FAULTS"
 
 KINDS = ("kill", "nan", "stall", "corrupt")
-SERVE_KINDS = ("nan_logits", "stall", "cache_corrupt", "burst")
+SERVE_KINDS = ("nan_logits", "stall", "cache_corrupt", "burst",
+               "kill_replica", "wedge_replica")
 KILL_EXIT_CODE = 37  # distinctive, so supervisors/tests can assert on it
 
 
@@ -195,6 +196,17 @@ class ServingFaultInjector:
         burst@3:8             report 8 extra arrivals due at step 3 —
                               consumed by chaos harnesses (burst())
                               to drive admission control
+        kill_replica@6[:r]    replica-level crash: replica `r` (default
+                              0) of a ReplicaSet raises ReplicaCrashed
+                              at the top of its step at/after ROUTER
+                              step 6 — models a dead engine process;
+                              the router quarantines it and fails its
+                              requests over to survivors
+        wedge_replica@8[:r]   replica-level hang: replica `r` stops
+                              making progress AND stops beating its
+                              heartbeat — models a hung device call;
+                              detected by the router's heartbeat-based
+                              wedge check (heartbeat_timeout_s)
 
     Each fault fires ONCE per injector instance, at the first
     opportunity AT OR AFTER its step (a fault armed for a step where its
@@ -223,6 +235,19 @@ class ServingFaultInjector:
                 self.fired_log.append((kind, step))
                 return arg if arg is not None else float("nan")
         return None
+
+    def _claim_targeted(self, kind: str, step: int, target: int) -> bool:
+        """Replica-targeted twin of _claim: only a fault whose arg names
+        `target` (default replica 0) fires, and only the ROUTER calls
+        these hooks — the same at-or-after slide applies per target."""
+        for i, (k, s, arg) in enumerate(self.faults):
+            if k == kind and s <= step and i not in self._fired:
+                t = 0 if arg is None or arg != arg else int(arg)
+                if t == target:
+                    self._fired.add(i)
+                    self.fired_log.append((kind, step))
+                    return True
+        return False
 
     # ------------------------------------------------------------- hooks
     def stall(self, step: int):
@@ -286,6 +311,24 @@ class ServingFaultInjector:
         block = cache._tables[seq_id][0]
         (kp, vp), rest = cache.pools[0], cache.pools[1:]
         cache.pools = ((kp.at[block].set(jnp.nan), vp),) + tuple(rest)
+
+    def kill_replica(self, step: int, replica: int) -> bool:
+        """Router hook, top of replica `replica`'s step: True exactly
+        once when a kill_replica fault targeting this replica is due at
+        or after router step `step` — the replica raises ReplicaCrashed,
+        modelling SIGKILL-grade engine death (host state unreachable)."""
+        if not self.enabled:
+            return False
+        return self._claim_targeted("kill_replica", step, replica)
+
+    def wedge_replica(self, step: int, replica: int) -> bool:
+        """Router hook, top of replica `replica`'s step: True exactly
+        once when a wedge_replica fault targeting this replica is due —
+        the replica latches wedged (no progress, no heartbeat) until the
+        router's heartbeat check quarantines and restarts it."""
+        if not self.enabled:
+            return False
+        return self._claim_targeted("wedge_replica", step, replica)
 
     def burst(self, step: int) -> int:
         """Harness hook: number of extra arrivals due now (0 if none) —

@@ -21,6 +21,23 @@ Exit status is nonzero on any violation, so CI can run this directly:
 `run_chaos` is importable — tests/test_bench_smoke.py smoke-invokes it
 and the chaos-marked acceptance test in tests/test_serving_robustness.py
 asserts the same invariants in-process.
+
+`--replicas N` switches to the multi-replica harness (`run_chaos_replicas`):
+the same seeded workload flows through a ReplicaSet while replica-targeted
+faults (kill_replica@step:r, wedge_replica@step:r) crash/wedge whole
+engines mid-traffic, and the audit gates widen to the router's promises
+(docs/serving.md "Multi-replica serving and failover"):
+
+- every submitted request reaches a terminal state (failover loses none);
+- every live replica's pool audits zero leaked blocks;
+- requests on UNTOUCHED replicas produce tokens bitwise-identical to an
+  unfaulted router run (greedy decode — failover must not perturb
+  survivors);
+- every killed/wedged replica rejoins after its warmup probe AND serves
+  a canary request within the same run.
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --replicas 3 \
+        --faults "kill_replica@6:1,nan_logits@10,stall@12:0.05"
 """
 from __future__ import annotations
 
@@ -150,11 +167,166 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
     return report
 
 
+DEFAULT_REPLICA_FAULTS = "kill_replica@6:1,nan_logits@10,stall@12:0.05"
+
+
+def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
+                       replicas: int = 3,
+                       faults: str = DEFAULT_REPLICA_FAULTS,
+                       max_steps: int = 4000) -> dict:
+    """One seeded multi-replica chaos run (module docstring). Raises
+    AssertionError on a lost request, a leaked block on any live
+    replica, an untouched-replica token divergence, or a faulted
+    replica that fails to rejoin and serve again."""
+    import time
+
+    from paddle_tpu.inference.serving import (EngineConfig, ReplicaSet,
+                                              RouterConfig,
+                                              SamplingParams)
+    from paddle_tpu.testing.faults import ServingFaultInjector
+
+    model, cfg = _build_model()
+    rng = np.random.RandomState(seed)
+    specs = [(rng.randint(0, cfg.vocab_size, (int(rng.randint(3, 9)),),
+                          dtype=np.int32),
+              int(rng.randint(6, 12))) for _ in range(n_requests)]
+    # decode_chunk_size=2 keeps requests in flight across many router
+    # steps so mid-traffic faults land on live work
+    ecfg = EngineConfig(block_size=4, num_blocks=32, max_num_seqs=4,
+                        decode_chunk_size=2)
+
+    def router_config():
+        # tight backoff so a killed replica's restart lands inside the
+        # run; heartbeat small enough that a wedged replica is caught
+        # while survivors still hold its failed-over work
+        return RouterConfig(num_replicas=replicas,
+                            heartbeat_timeout_s=0.02,
+                            backoff_base=0.01, backoff_max=0.05,
+                            backoff_jitter=0.0)
+
+    def drive(injector):
+        rs = ReplicaSet.from_model(model, router_config(),
+                                   engine_config=ecfg, faults=injector)
+        pending = list(enumerate(specs))
+        rids, homes = {}, {}
+        for i, (p, mt) in pending[:2 * replicas]:
+            rids[i] = rs.add_request(p, SamplingParams(max_tokens=mt))
+            homes[i] = rs.get_request(rids[i]).replica
+        pending = pending[2 * replicas:]
+        steps = 0
+        while rs.has_unfinished() or pending:
+            rs.step()
+            steps += 1
+            assert steps <= max_steps, \
+                f"router failed to drain within {max_steps} steps"
+            if steps % 2 == 0 and pending:      # staggered arrivals
+                i, (p, mt) = pending.pop(0)
+                rids[i] = rs.add_request(p, SamplingParams(max_tokens=mt))
+                homes[i] = rs.get_request(rids[i]).replica
+            if not any(r.has_unfinished() for r in rs.replicas) \
+                    and rs.has_unfinished():
+                time.sleep(0.002)               # restart backoff pending
+        return rs, rids, homes
+
+    # reference pass: same workload through an unfaulted router (defines
+    # expected tokens; greedy tokens depend only on the prompt, so the
+    # comparison is routing-independent)
+    ref_rs, ref_rids, _ = drive(ServingFaultInjector(""))
+    for idx, audit in ref_rs.check_integrity().items():
+        assert audit is not None, f"reference replica {idx} lost engine"
+    ref_tokens = {i: list(ref_rs.get_request(r).tokens)
+                  for i, r in ref_rids.items()}
+
+    injector = ServingFaultInjector(faults)
+    targeted = sorted({(0 if arg is None or arg != arg else int(arg))
+                       for k, s, arg in injector.faults
+                       if k in ("kill_replica", "wedge_replica")})
+    rs, rids, homes = drive(injector)
+
+    st = rs.router_stats()
+    p99 = rs.ttft_quantile(0.99)
+    unserved = sum(v for k, v in st["finish_reasons"].items()
+                   if k not in ("stop", "length"))
+    report = {
+        "seed": seed, "requests": n_requests, "replicas": replicas,
+        "faults": faults, "fired": list(injector.fired_log),
+        "targeted_replicas": targeted,
+        "requeues": st["requeues"],
+        "finish_reasons": st["finish_reasons"],
+        "replica_states": {k: str(v)
+                           for k, v in st["replica_states"].items()},
+        "recovery_times_s": st["recovery_times_s"],
+        # router-level SLO view, same definitions as the single-engine
+        # report: TTFT is client-visible (across failovers)
+        "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
+                "reject_rate": round(unserved / max(n_requests, 1), 4)},
+    }
+    # 1. no lost requests: every id terminal
+    lost = [i for i, r in rids.items()
+            if not rs.get_request(r).finished]
+    assert not lost, f"non-terminal requests after drain: {lost}"
+    # 2. zero leaked blocks on every live replica (a faulted replica
+    #    must be live again by now — gate 4 — so None is a failure)
+    report["integrity"] = rs.check_integrity()
+    for idx, audit in report["integrity"].items():
+        assert audit is not None, \
+            f"replica {idx} ended the run without a live engine"
+    # 3. untouched-replica requests match the unfaulted run bitwise
+    #    (never requeued AND homed on a never-faulted replica)
+    mismatched, untouched = [], 0
+    for i, r in rids.items():
+        rec = rs.get_request(r)
+        if rec.requeues or homes[i] in targeted \
+                or rec.finish_reason not in ("stop", "length"):
+            continue
+        untouched += 1
+        if list(rec.tokens) != ref_tokens[i]:
+            mismatched.append(i)
+    report["untouched_survivors"] = untouched
+    assert not mismatched, \
+        f"untouched-replica token divergence vs unfaulted run: {mismatched}"
+    # 4. every faulted replica rejoined (warmup probe passed) and serves
+    #    a canary request end-to-end in this same run
+    for idx in targeted:
+        assert str(rs.states()[idx]) == "up", \
+            f"faulted replica {idx} did not rejoin (state " \
+            f"{rs.states()[idx]})"
+    for other in range(replicas):
+        if other not in targeted:
+            rs.drain(other)
+    canaries = {}
+    for idx in targeted:
+        rid = rs.add_request(specs[0][0], SamplingParams(max_tokens=2))
+        canaries[idx] = rid
+        assert rs.get_request(rid).replica == idx, \
+            f"canary for rejoined replica {idx} routed to " \
+            f"{rs.get_request(rid).replica}"
+    steps = 0
+    while rs.has_unfinished():
+        rs.step()
+        steps += 1
+        assert steps <= max_steps, "canary requests failed to drain"
+    for idx, rid in canaries.items():
+        reason = rs.get_request(rid).finish_reason
+        assert reason in ("stop", "length"), \
+            f"rejoined replica {idx} canary ended {reason!r}"
+    for other in range(replicas):
+        if other not in targeted:
+            rs.undrain(other)
+    report["canaries_served"] = len(canaries)
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--requests", type=int, default=16)
-    ap.add_argument("--faults", default=DEFAULT_FAULTS,
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="run the multi-replica harness with N engine "
+                         "replicas behind a ReplicaSet (0 = single-"
+                         "engine mode); default faults become "
+                         f"{DEFAULT_REPLICA_FAULTS!r}")
+    ap.add_argument("--faults", default=None,
                     help="ServingFaultInjector spec (see testing/faults.py)")
     ap.add_argument("--cancel-every", type=int, default=0,
                     help="cancel a random live request every N steps")
@@ -172,9 +344,20 @@ def main(argv=None) -> int:
                     help="--slo threshold, fraction of submitted")
     args = ap.parse_args(argv)
     try:
-        report = run_chaos(seed=args.seed, n_requests=args.requests,
-                           faults=args.faults, max_steps=args.max_steps,
-                           cancel_every=args.cancel_every)
+        if args.replicas > 0:
+            report = run_chaos_replicas(
+                seed=args.seed, n_requests=args.requests,
+                replicas=args.replicas,
+                faults=(args.faults if args.faults is not None
+                        else DEFAULT_REPLICA_FAULTS),
+                max_steps=args.max_steps)
+        else:
+            report = run_chaos(
+                seed=args.seed, n_requests=args.requests,
+                faults=(args.faults if args.faults is not None
+                        else DEFAULT_FAULTS),
+                max_steps=args.max_steps,
+                cancel_every=args.cancel_every)
     except AssertionError as e:
         print(f"CHAOS FAIL: {e}", file=sys.stderr)
         return 1

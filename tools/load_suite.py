@@ -35,6 +35,15 @@ Scenarios (docs/observability.md "Load suite"):
                  tokens/s and inter-token-gap p99 (the
                  serving_token_gap_seconds histogram) into BENCH_FULL
                  and gates both.
+- replica_kill — kill 1 of N engine replicas mid-traffic behind the
+                 ReplicaSet router (docs/serving.md "Multi-replica
+                 serving and failover"): the dead replica's requests
+                 fail over to survivors in arrival order and the
+                 replica rejoins after its warmup probe. Reports
+                 tokens/s, TTFT p50/p99 (client-visible, across the
+                 failover), reject rate and failover-recovery time
+                 into BENCH_FULL; the SLO additionally pins ZERO lost
+                 requests and a bounded p99.
 
 Each scenario runs its full workload once unmeasured (compiles every
 prefill/decode bucket — TTFT must not include XLA compile time), then
@@ -65,7 +74,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
-             "decode_heavy")
+             "decode_heavy", "replica_kill")
 
 #: per-scenario SLOs. Latency bounds are generous (CPU-smoke friendly)
 #: — the point is catching regressions in KIND (rejects where none are
@@ -90,9 +99,16 @@ SLOS = {
     # device scan), the regression this scenario exists to catch
     "decode_heavy": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 8.0,
                      "max_reject_rate": 0.0, "max_token_gap_p99_s": 4.0},
+    # replica-level failover: losing 1 of 3 replicas may slow things
+    # down and bump TTFT for the failed-over cohort, but NOTHING may be
+    # lost — every submitted request must reach a terminal state
+    "replica_kill": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 10.0,
+                     "max_reject_rate": 0.3, "max_lost": 0},
 }
 
 CHAOS_FAULTS = "nan_logits@6,stall@9:0.05,cache_corrupt@12"
+REPLICA_FAULTS = "kill_replica@6:1"
+REPLICA_COUNT = 3
 
 
 def _build_model(seq=96):
@@ -154,6 +170,15 @@ def _arrivals(name: str, n: int, vocab: int, seed: int):
         # decode, where the fused chunk owns the token cadence
         for i in range(n):
             arr.append((3 * i, prompt(3, 7), int(rng.randint(24, 40))))
+    elif name == "replica_kill":
+        # steady-shaped mix, but small decode chunks so requests stay
+        # in flight across enough router steps that the kill at router
+        # step 6 lands on live work (each replica gets its own pool,
+        # so the per-replica block budget shrinks)
+        ecfg.decode_chunk_size = 2
+        ecfg.num_blocks = 48
+        for i in range(n):
+            arr.append((2 * i, prompt(4, 12), int(rng.randint(6, 12))))
     else:
         raise ValueError(f"unknown scenario {name!r}; "
                          f"choose from {SCENARIOS}")
@@ -192,6 +217,86 @@ def _drive(model, ecfg, arrivals, faults: str = "", max_steps=4000):
     wall = time.perf_counter() - t0
     eng.cache.check_integrity()          # zero-leak audit post-drain
     return eng, submitted, rejected, wall
+
+
+def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
+                  faults: str = "", max_steps=6000):
+    """replica_kill driver: the same arrival clock as _drive, but the
+    workload flows through a ReplicaSet and the fault schedule targets
+    whole replicas. Returns (router, request_ids, submitted, rejected,
+    wall_seconds)."""
+    from paddle_tpu.inference.serving import (ReplicaSet, RouterConfig,
+                                              SamplingParams)
+    from paddle_tpu.inference.serving.scheduler import EngineOverloaded
+    from paddle_tpu.testing.faults import ServingFaultInjector
+
+    rc = RouterConfig(num_replicas=replicas, heartbeat_timeout_s=0.02,
+                      backoff_base=0.01, backoff_max=0.05,
+                      backoff_jitter=0.0,
+                      obs_label="load-replica-kill")
+    rs = ReplicaSet.from_model(model, rc, engine_config=ecfg,
+                               faults=ServingFaultInjector(faults))
+    queue = sorted(arrivals, key=lambda a: a[0])
+    i = submitted = rejected = 0
+    step = 0
+    rids = []
+    t0 = time.perf_counter()
+    while i < len(queue) or rs.has_unfinished():
+        while i < len(queue) and queue[i][0] <= step:
+            _, p, mt = queue[i]
+            i += 1
+            submitted += 1
+            try:
+                rids.append(rs.add_request(p, SamplingParams(max_tokens=mt)))
+            except EngineOverloaded:
+                rejected += 1
+        if rs.has_unfinished():
+            rs.step()
+            if not any(r.has_unfinished() for r in rs.replicas) \
+                    and rs.has_unfinished():
+                time.sleep(0.002)        # orphans parked on a restart
+        step += 1
+        if step > max_steps:
+            raise RuntimeError(
+                f"scenario failed to drain within {max_steps} steps")
+    wall = time.perf_counter() - t0
+    # zero-leak audit on every replica that ended the run with a live
+    # engine (a FAILED slot's pool is unreachable by design)
+    for audit in rs.check_integrity().values():
+        assert audit is None or audit["leaked"] == 0
+    return rs, rids, submitted, rejected, wall
+
+
+def _metrics_router(rs, rids, submitted, rejected, wall) -> dict:
+    """The same four headline numbers as _metrics, measured at the
+    ROUTER (TTFT is client-visible, spanning failovers), plus the
+    failover accounting the replica_kill SLO gates on."""
+    st = rs.router_stats()
+    reasons = st["finish_reasons"]
+    unserved = rejected + sum(v for k, v in reasons.items()
+                              if k not in ("stop", "length"))
+    lost = sum(1 for r in rids if not rs.get_request(r).finished)
+    p50 = rs.ttft_quantile(0.5)
+    p99 = rs.ttft_quantile(0.99)
+    rec = st["recovery_times_s"]
+    return {
+        "tokens_per_sec": round(st["generated_tokens"] / wall, 2)
+        if wall > 0 else 0.0,
+        "ttft_p50": None if math.isnan(p50) else round(p50, 4),
+        "ttft_p99": None if math.isnan(p99) else round(p99, 4),
+        "reject_rate": round(unserved / max(submitted, 1), 4),
+        "submitted": submitted,
+        "completed": sum(v for k, v in reasons.items()
+                         if k in ("stop", "length")),
+        "generated_tokens": st["generated_tokens"],
+        "lost": lost,
+        "requeues": st["requeues"],
+        "failovers": sum(len(r.history) for r in rs.replicas),
+        "failover_recovery_s": round(max(rec), 4) if rec else None,
+        "replica_states": {k: str(v)
+                           for k, v in st["replica_states"].items()},
+        "rejected": rejected,
+    }
 
 
 def _quantile(eng, q):
@@ -242,6 +347,10 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
         gap = metrics["token_gap_p99"]
         if gap is None or gap > gap_max:
             viol.append(f"token_gap_p99 {gap} > {gap_max}s")
+    lost_max = slo.get("max_lost")
+    if lost_max is not None and metrics["lost"] > lost_max:
+        viol.append(f"lost {metrics['lost']} > {lost_max} "
+                    "(failover dropped requests)")
     return {"pass": not viol, "violations": viol, "thresholds": dict(slo)}
 
 
@@ -255,6 +364,16 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
         n = 8 if fast else 24
     faults = CHAOS_FAULTS if name == "chaos_kill" else ""
     ecfg, arr = _arrivals(name, n, cfg.vocab_size, seed)
+    if name == "replica_kill":
+        # warmup WITH the kill so the restart + warmup-probe path (its
+        # probe-length prefill bucket included) compiles unmeasured;
+        # each pass gets a fresh fire-once injector
+        _drive_router(model, ecfg, arr, faults=REPLICA_FAULTS)
+        rs, rids, submitted, rejected, wall = _drive_router(
+            model, ecfg, arr, faults=REPLICA_FAULTS)
+        m = _metrics_router(rs, rids, submitted, rejected, wall)
+        m["slo"] = _check_slo(m, SLOS[name])
+        return m
     # warmup: same workload, unmeasured — every prompt-length and decode
     # bucket compiles here so measured TTFT is serving time, not XLA.
     # The chaos pass warms UNfaulted (compile time under a stall fault
