@@ -549,12 +549,16 @@ def bench_serve_decode(on_tpu: bool):
     visible, not hidden in the headline).
 
     The headline run uses the fused k-token device-resident decode
-    (EngineConfig.decode_chunk_size default); a second pass with
-    decode_chunk_size=1 measures the classic one-sync-per-token step on
-    the SAME workload, and the detail dict reports host-syncs-per-token
-    plus the host/device time split for both, so the chunking gain is
-    attributed, not asserted. Returns (decode_tokens_per_sec,
-    stats_dict)."""
+    (EngineConfig.decode_chunk_size default) with the ragged
+    paged-attention kernel (EngineConfig.kernel default); a second pass
+    with decode_chunk_size=1 measures the classic one-sync-per-token
+    step, and a third with kernel="bucketed" measures the power-of-two
+    bucketed fallback, all on the SAME workload. The detail dict
+    reports host-syncs-per-token, the host/device time split,
+    ragged-vs-bucketed tokens/s AND fused_decode_chunk compile counts
+    (via jit _cache_size deltas), so both the chunking gain and the
+    one-compilation ragged win are attributed, not asserted. Returns
+    (decode_tokens_per_sec, stats_dict)."""
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig
     from paddle_tpu.inference.serving import (EngineConfig, LLMEngine,
@@ -603,15 +607,31 @@ def bench_serve_decode(on_tpu: bool):
         eng.cache.check_integrity()             # zero-leak audit post-drain
         return eng
 
-    run_once()                                  # compile every bucket
+    # compile-count receipts: the delta of fused_decode_chunk's jit
+    # cache across each kernel's warmup run IS the number of programs
+    # that kernel needed for this workload's batch mixes
+    from paddle_tpu.inference.serving.attention import fused_decode_chunk
+    c0 = fused_decode_chunk._cache_size()
+    run_once()                                  # compile (one program)
+    compiles_ragged = fused_decode_chunk._cache_size() - c0
     best = None
     for _ in range(3 if on_tpu else 1):
         eng = run_once()
         if best is None or eng.stats.time_decode < best.stats.time_decode:
             best = eng
+    # the bucketed fallback on the SAME workload: the batch re-pads to
+    # power-of-two buckets, so the staggered arrivals walk several
+    # bucket shapes and each costs a compilation the ragged kernel's
+    # fixed-width batch never pays
+    from dataclasses import replace as _dc_replace
+    ecfgb = _dc_replace(ecfg, kernel="bucketed")
+    cb0 = fused_decode_chunk._cache_size()
+    run_once(ecfgb)                             # compile every bucket
+    compiles_bucketed = fused_decode_chunk._cache_size() - cb0
+    bucketed = run_once(ecfgb)
+    db = bucketed.stats.as_dict()
     # the pre-chunking baseline on the same workload: one host sync per
     # token (decode_chunk_size=1) — attributes the fused-chunk gain
-    from dataclasses import replace as _dc_replace
     ecfg1 = _dc_replace(ecfg, decode_chunk_size=1)
     run_once(ecfg1)                             # compile the k=1 variant
     before = run_once(ecfg1)
@@ -637,6 +657,20 @@ def bench_serve_decode(on_tpu: bool):
         "tokens_per_sec_k1": round(d1["decode_tokens_per_sec"], 2),
         "host_schedule_s_k1": round(d1["time_schedule"], 4),
         "device_decode_s_k1": round(d1["time_decode"], 4),
+        "kernel": ecfg.kernel,
+        "tokens_per_sec_bucketed": round(db["decode_tokens_per_sec"], 2),
+        "compiles_ragged": compiles_ragged,
+        "compiles_bucketed": compiles_bucketed,
+        "padding_waste_bucketed": round(bucketed.stats.padding_waste(),
+                                        4),
+        "ragged_note": (
+            "ragged pads once to the fixed max_num_seqs width so this "
+            f"workload's batch mixes compiled {compiles_ragged} "
+            f"fused-chunk program(s) vs {compiles_bucketed} for the "
+            "power-of-two-bucketed fallback; the tokens/s delta is the "
+            "recompile + padding overhead the ragged kernel deletes "
+            "(docs/serving.md, 'Ragged paged attention and chunked "
+            "prefill')"),
     }
 
 

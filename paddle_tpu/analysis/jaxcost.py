@@ -517,6 +517,8 @@ def _serving_programs() -> List[_Program]:
                                                fused_decode_chunk,
                                                paged_decode_step)
     from ..models import generation as g
+    from ..ops.pallas.ragged_paged_attention import \
+        ragged_attention_reference
     _, cfg, geom, params, _ = _tiny_gpt()
     L, H, D, S = geom
     dtype = params["wte.weight"].dtype
@@ -548,14 +550,39 @@ def _serving_programs() -> List[_Program]:
     # here (the scan carries them; the engine rebinds cache.pools from
     # the return value, and chunk-granular recovery re-prefills from
     # host token logs instead of re-reading pre-step pools)
+    # NOTE: there is no per-bucket compile-count axis here anymore — the
+    # ragged default pads every batch to the ONE fixed max_num_seqs
+    # width, so these budgets each cover every batch mix (pinned by the
+    # compile-count test in tests/test_serving_ragged.py).
     K = 8
-    packed = jnp.zeros((N, PACK_COLS + MB), jnp.int32)
+    packed = jnp.zeros((N, PACK_COLS + K + MB), jnp.int32)
     chunk = _Program(
         "serving.decode_chunk",
         getattr(fused_decode_chunk, "__wrapped__", fused_decode_chunk),
-        (params, pools, packed, geom, K),
-        static_argnums=(3, 4), donate_argnums=(1,))
-    return [prefill, paged, chunk]
+        (params, pools, packed, geom, K, "ragged"),
+        static_argnums=(3, 4, 5), donate_argnums=(1,))
+    # the ragged paged-attention program: the lax.scan reference is the
+    # kernel's cost-faithful twin (same block-streamed flash update the
+    # pallas kernel executes per row), so the committed budget bounds
+    # the kernel's FLOP/bytes envelope without tracing pallas_call
+    q1 = jnp.zeros((N, H, D), dtype)
+    lens = jnp.zeros((N,), jnp.int32)
+    ragged = _Program(
+        "serving.ragged_attention",
+        getattr(ragged_attention_reference, "__wrapped__",
+                ragged_attention_reference),
+        (q1, pool, pool, tables, lens))
+    # chunked prefill rides the SAME fused scan (prompt tokens feed the
+    # body; no extra dispatch): registering it separately pins that the
+    # prompt-feed path adds no cost axis over plain decode — the two
+    # budgets must stay identical
+    pf_packed = jnp.zeros((N, PACK_COLS + K + MB), jnp.int32)
+    chunked_prefill = _Program(
+        "serving.chunked_prefill",
+        getattr(fused_decode_chunk, "__wrapped__", fused_decode_chunk),
+        (params, pools, pf_packed, geom, K, "ragged"),
+        static_argnums=(3, 4, 5), donate_argnums=(1,))
+    return [prefill, paged, chunk, ragged, chunked_prefill]
 
 
 def _collective_programs() -> List[_Program]:
@@ -616,6 +643,7 @@ _REGISTRY_NAMES = (
     "decode.token_embed", "decode.qkv", "decode.cache_write",
     "decode.attn", "decode.head",
     "serving.prefill", "serving.paged_decode", "serving.decode_chunk",
+    "serving.ragged_attention", "serving.chunked_prefill",
     "collective.ring_attention", "collective.ulysses_attention",
     "collective.psum_tree",
 )

@@ -24,10 +24,20 @@ pins this). Padded bucket rows write out of bounds (dropped) and
 attend only to block-table padding that their mask erases; their
 logits are garbage and the engine ignores them.
 
-Shape bucketing: everything here is shape-polymorphic only in
-(N, max_blocks_per_seq, num_blocks); the engine pads N to a power-of-two
-bucket capped at max_num_seqs and keeps the other two fixed, so XLA
-compiles once per bucket and NEVER recompiles per request mix.
+Batch shape: everything here is shape-polymorphic only in
+(N, max_blocks_per_seq, num_blocks). Under the default ragged kernel
+the engine pads N to the FIXED max_num_seqs — dead rows cost zero
+kernel work (per-row lengths gate every block), so ONE compilation
+covers every batch mix and there is no bucket axis at all. The
+`kernel="bucketed"` fallback keeps the old power-of-two bucketing
+(one compile per bucket) as the parity oracle.
+
+Chunked prefill: prompt tokens ride the same fused scan as decode —
+each scan trip feeds a prefilling row one prompt token (KV write, no
+sample), and the trip that consumes the last prompt token samples the
+request's first output in-scan. Long prompts therefore never
+monopolise a step: they are split into k-token chunks admitted
+alongside decode slots (scheduler.prefill_chunk_threshold).
 """
 from __future__ import annotations
 
@@ -38,8 +48,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ...core.anomaly import rows_not_finite
-from ...models.generation import (_decode_attn, _decode_head, _decode_qkv,
-                                  _token_embed)
+from ...models.generation import (_attn_merge, _decode_attn, _decode_head,
+                                  _decode_qkv, _token_embed)
+from ...ops.pallas import ragged_paged_attention as _ragged
 
 __all__ = ["gather_block_kv", "paged_decode_step", "fused_decode_chunk",
            "PACK_COLS", "pack_f32"]
@@ -103,13 +114,13 @@ def paged_decode_step(params, pools, tokens, positions, block_tables,
     return _decode_head(params, x), tuple(new_pools)
 
 
-# ------------------------------------------------- fused k-token decode
-# Packed per-sequence control state, one int32 [N, PACK_COLS + MB] upload
-# per chunk (column layout below; float fields travel as raw f32 bits so
-# the whole transfer stays a single dtype-homogeneous array):
+# ----------------------------------- fused k-token decode + prefill chunks
+# Packed per-sequence control state, one int32 [N, PACK_COLS + k + MB]
+# upload per chunk (column layout below; float fields travel as raw f32
+# bits so the whole transfer stays a single dtype-homogeneous array):
 #   0 tok        last sampled token (the next step's input)
 #   1 pos        next KV write position (== cached length)
-#   2 active     1 for live rows, 0 for bucket padding
+#   2 active     1 for live rows, 0 for padding
 #   3 out_cnt    tokens generated so far (threads the PRNG fold_in)
 #   4 max_out    SamplingParams.max_tokens
 #   5 eos        eos_token_id, -1 when unset
@@ -117,8 +128,13 @@ def paged_decode_step(params, pools, tokens, positions, block_tables,
 #   7 top_k      0 = disabled
 #   8 top_p      top_p as float32 bits (>=1.0 = disabled)
 #   9 seed       per-request PRNG seed (masked to 31 bits)
-#   10.. tables  the block table row [MB]
-PACK_COLS = 10
+#   10 pf_feed   prompt tokens to consume this chunk (0 = pure decode row)
+#   11 pf_more   1 if prompt remains after this chunk (pf_more=1 implies
+#                pf_feed == k: the engine never leaves a mid-chunk gap
+#                between the last fed prompt token and the first sample)
+#   12..12+k-1   the pf_feed prompt tokens for this chunk (0-padded)
+#   12+k..       the block table row [MB]
+PACK_COLS = 12
 
 
 def pack_f32(x) -> int:
@@ -159,10 +175,20 @@ def _sample_rows(logits, keys, temps, top_ks, top_ps):
     return jnp.where(temps <= 0.0, greedy, sampled)
 
 
+@jax.jit
+def _pool_write(kp, vp, k_new, v_new, slot_blocks, slot_offsets):
+    """Scatter-only variant of _pool_write_gather for the ragged kernel
+    path: the kernel reads the pools through the block table itself, so
+    no gathered context is materialised."""
+    kp = kp.at[slot_blocks, slot_offsets].set(k_new[:, :, 0], mode="drop")
+    vp = vp.at[slot_blocks, slot_offsets].set(v_new[:, :, 0], mode="drop")
+    return kp, vp
+
+
 # ptlint: disable=PT-T009  agrees with the committed plan entry
 # serving.decode_chunk (donate=[1]); the jaxplan donation gate pins it
-@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(1,))
-def fused_decode_chunk(params, pools, packed, geom, k):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5), donate_argnums=(1,))
+def fused_decode_chunk(params, pools, packed, geom, k, kernel="ragged"):
     """k decode steps for N sequences entirely on device: one lax.scan
     whose body is the paged decode step above plus on-device sampling
     and termination tracking. The host uploads ONE packed int32 array
@@ -170,10 +196,20 @@ def fused_decode_chunk(params, pools, packed, geom, k):
 
         rows 0..k-1   sampled token per scan step, -1 where the row was
                       frozen (inactive / already finished / flagged bad)
+                      or silently consuming a prompt token (prefill trip)
         row  k        finished mask after the chunk (EOS or max_tokens)
         row  k+1      per-row not-finite flag, latched at the FIRST bad
                       step — the engine's anomaly attribution, computed
                       in-scan so quarantine needs no extra fetch
+
+    Chunked prefill: rows with pf_feed > 0 spend their first pf_feed
+    trips consuming prompt tokens from the feed columns — KV is written
+    at the row's position exactly like a decode trip, but no token is
+    sampled or emitted. The trip that consumes the LAST prompt token
+    (pf_left==1 and pf_more==0) samples the request's first output from
+    its logits with fold_in(seed, 0), then the row decodes normally for
+    the rest of the chunk. Prefill and decode rows therefore share one
+    program and one dispatch — a long prompt never stalls the batch.
 
     Frozen rows still flow through the fixed-shape body but scatter to
     slot_block=num_blocks (dropped) and keep their carry unchanged, so
@@ -183,6 +219,16 @@ def fused_decode_chunk(params, pools, packed, geom, k):
     makes token streams invariant under chunk size and under
     preemption/recovery replay (tests pin k-step vs k x 1-step).
 
+    kernel (static): "ragged" (default) routes per-layer attention to
+    the pallas ragged paged-attention kernel when the backend supports
+    it (ops/pallas/ragged_paged_attention.route_gate) — the pools are
+    read through the block table inside the kernel, dead rows cost zero
+    work, and the batch is padded to ONE fixed width so a single
+    compilation covers every mix. Off-TPU (CPU tier-1) both modes lower
+    to the same gather + composed attention built from the shared
+    jitted sub-programs, preserving the bitwise-parity contract;
+    "bucketed" keeps the power-of-two padded path as the oracle.
+
     pools (arg 1) is DONATED: the KV carry is updated in place across
     the scan and the input buffers alias the output on TPU, so the k
     cache writes cost no extra copies of the pool.
@@ -190,7 +236,8 @@ def fused_decode_chunk(params, pools, packed, geom, k):
     Returns (out [k+2, N] int32, updated pools).
     """
     num_layers, num_heads, head_dim, max_seq = geom
-    tables = packed[:, PACK_COLS:]
+    tables = packed[:, PACK_COLS + k:]
+    feed = packed[:, PACK_COLS:PACK_COLS + k].T      # [k, N] prompt feed
     num_blocks = pools[0][0].shape[0]
     block_size = pools[0][0].shape[1]
     n = packed.shape[0]
@@ -201,42 +248,62 @@ def fused_decode_chunk(params, pools, packed, geom, k):
     top_ks = packed[:, 7]
     top_ps = lax.bitcast_convert_type(packed[:, 8], jnp.float32)
     base_keys = jax.vmap(jax.random.PRNGKey)(packed[:, 9])
+    pf_more = packed[:, 11] > 0
+    use_ragged = (kernel == "ragged"
+                  and _ragged.route_gate(head_dim, num_heads, block_size))
 
-    def body(carry, _):
-        pools, tok, pos, out_cnt, finished, bad = carry
+    def body(carry, feed_j):
+        pools, tok, pos, out_cnt, finished, bad, pf_left = carry
         run = active & ~finished & ~bad
+        prefilling = run & (pf_left > 0)
+        last_pf = prefilling & (pf_left == 1) & ~pf_more
+        sampling = (run & ~prefilling) | last_pf
+        tok_in = jnp.where(prefilling, feed_j, tok)
         blk_idx = jnp.where(run, pos // block_size, 0)
         slot_blocks = jnp.where(
             run,
             jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0],
             num_blocks)                      # frozen rows: scatter drops
         slot_offsets = pos % block_size
-        x = _token_embed(params, tok, pos)
+        x = _token_embed(params, tok_in, pos)
+        att_lens = jnp.where(run, pos + 1, 0).astype(jnp.int32)
         new_pools = []
         for i, (kp, vp) in enumerate(pools):
             qkv = _decode_qkv(params, i, x, geom)
-            kp, vp, kc, vc = _pool_write_gather(
-                kp, vp, qkv[1], qkv[2], slot_blocks, slot_offsets, tables)
+            if use_ragged:
+                kp, vp = _pool_write(
+                    kp, vp, qkv[1], qkv[2], slot_blocks, slot_offsets)
+                att = _ragged.ragged_decode_attention(
+                    qkv[0][:, :, 0, :], kp, vp, tables, att_lens)
+                x = _attn_merge(params, i, x, att[:, :, None, :], geom)
+            else:
+                kp, vp, kc, vc = _pool_write_gather(
+                    kp, vp, qkv[1], qkv[2], slot_blocks, slot_offsets,
+                    tables)
+                x = _decode_attn(params, i, x, qkv[0], kc, vc, pos, geom)
             new_pools.append((kp, vp))
-            x = _decode_attn(params, i, x, qkv[0], kc, vc, pos, geom)
         logits = _decode_head(params, x)
         row_bad = rows_not_finite(logits) & run
         bad = bad | row_bad
         keys = jax.vmap(jax.random.fold_in)(base_keys, out_cnt)
         tok_new = _sample_rows(logits, keys, temps, top_ks, top_ps)
         ok = run & ~row_bad
-        emit = jnp.where(ok, tok_new, -1)
-        finished = finished | (ok & ((tok_new == eos)
-                                     | (out_cnt + 1 >= max_out)))
-        tok = jnp.where(ok, tok_new, tok)
+        step_ok = ok & sampling
+        emit = jnp.where(step_ok, tok_new, -1)
+        finished = finished | (step_ok & ((tok_new == eos)
+                                          | (out_cnt + 1 >= max_out)))
+        tok = jnp.where(step_ok, tok_new, tok)
         pos = jnp.where(ok, pos + 1, pos)
-        out_cnt = jnp.where(ok, out_cnt + 1, out_cnt)
-        return (tuple(new_pools), tok, pos, out_cnt, finished, bad), emit
+        out_cnt = jnp.where(step_ok, out_cnt + 1, out_cnt)
+        pf_left = jnp.where(ok & prefilling, pf_left - 1, pf_left)
+        return (tuple(new_pools), tok, pos, out_cnt, finished, bad,
+                pf_left), emit
 
     carry0 = (pools, packed[:, 0], packed[:, 1], packed[:, 3],
-              jnp.zeros((n,), bool), jnp.zeros((n,), bool))
-    (pools, _, _, _, finished, bad), toks = lax.scan(
-        body, carry0, None, length=k)
+              jnp.zeros((n,), bool), jnp.zeros((n,), bool),
+              packed[:, 10])
+    (pools, _, _, _, finished, bad, _), toks = lax.scan(
+        body, carry0, feed, length=k)
     out = jnp.concatenate(
         [toks.astype(jnp.int32),
          finished[None].astype(jnp.int32),

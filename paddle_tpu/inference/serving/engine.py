@@ -99,6 +99,20 @@ class EngineConfig:
     # which deadlines/watchdog/fault quarantine act (they all run at
     # chunk boundaries).
     decode_chunk_size: int = 8
+    # serving attention kernel (docs/serving.md "Ragged paged attention
+    # and chunked prefill"): "ragged" (default) pads every decode batch
+    # to the ONE fixed max_num_seqs width — dead rows cost zero kernel
+    # work under the pallas ragged paged-attention kernel, and a single
+    # compilation covers every batch mix. "bucketed" keeps the legacy
+    # power-of-two bucket padding (one compile per bucket) as the
+    # fallback and parity oracle. Off-TPU both lower to the same
+    # gather + composed attention, so they are bitwise-identical there.
+    kernel: str = "ragged"
+    # prompts STRICTLY longer than this are admitted CHUNKED: their
+    # prefill rides the fused decode scan decode_chunk_size tokens per
+    # step instead of a dedicated dense prefill dispatch, so long
+    # prompts never stall a step. None disables chunking.
+    prefill_chunk_threshold: Optional[int] = None
     # ----------------------------- robustness layer (docs/serving.md)
     max_waiting: Optional[int] = None    # bounded waiting queue (None=∞)
     admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
@@ -227,6 +241,18 @@ class EngineStats:
                             "prompt tokens admitted to prefill this step "
                             "(per-step spend against max_prefill_tokens)",
                             labels=("engine",), unit="tokens")
+        self._c_prefill_chunks = obs.counter(
+            "serving_prefill_chunks_total",
+            "prompt chunks consumed inside the fused decode scan — one "
+            "per mid-prefill row per chunk dispatch (chunked prefill)",
+            labels=("engine",)).labels(**lbl)
+        self._g_padding_waste = obs.gauge(
+            "serving_padding_waste_ratio",
+            "dead (padded) rows / batch width of the last decode "
+            "dispatch: (bucket - live)/bucket under the bucketed "
+            "fallback; 0 under the ragged kernel, whose per-row length "
+            "gating makes dead rows cost zero kernel work",
+            labels=("engine",)).labels(**lbl)
         self._g_running = g_run.labels(**lbl)
         self._g_waiting = g_wait.labels(**lbl)
         self._g_blocks_used = g_blk.labels(state="used", **lbl)
@@ -258,6 +284,18 @@ class EngineStats:
 
     def observe_decode_chunk(self, dt: float) -> None:
         self._decode_chunk.observe(dt)
+
+    def inc_prefill_chunks(self, n: int = 1) -> None:
+        self._c_prefill_chunks.inc(n)
+
+    def prefill_chunks(self) -> int:
+        return int(self._c_prefill_chunks.value)
+
+    def set_padding_waste(self, v: float) -> None:
+        self._g_padding_waste.set(v)
+
+    def padding_waste(self) -> float:
+        return self._g_padding_waste.value
 
     def inc_host_sync(self, phase: str) -> None:
         self._syncs[phase].inc()
@@ -390,7 +428,8 @@ class LLMEngine:
                 max_waiting=config.max_waiting,
                 admission_policy=config.admission_policy,
                 cache_high_watermark=config.cache_high_watermark,
-                prefill_cost_model=cost_model),
+                prefill_cost_model=cost_model,
+                prefill_chunk_threshold=config.prefill_chunk_threshold),
             self.cache)
         # RLock: step() holds it across the whole iteration and the
         # helpers it calls re-enter (e.g. _emit under _recover)
@@ -813,15 +852,24 @@ class LLMEngine:
     @holds_lock("_lock")
     def _decode_chunk(self, reqs: List[Request], k: int):
         """Fused k-token device-resident decode for all running
-        sequences, padded to the power-of-two bucket. The per-sequence
-        control state (last token, position, sampling knobs, block
+        sequences — padded to the ONE fixed max_num_seqs width under the
+        default ragged kernel (dead rows cost zero kernel work, so a
+        single compilation covers every batch mix), or to the power-of-
+        two bucket under kernel="bucketed". The per-sequence control
+        state (last token, position, sampling knobs, prefill feed, block
         table) travels as ONE packed int32 upload; the result — k
         sampled tokens per row plus the finished and not-finite masks —
-        comes back in ONE fetch. Returns (tokens [k, len(reqs)] int32
-        with -1 on frozen rows, bad [len(reqs)] bool)."""
-        n = _bucket(len(reqs), self.config.max_num_seqs)
+        comes back in ONE fetch. Mid-prefill rows (chunked prefill) get
+        their next min(k, remaining-prompt) tokens packed into the feed
+        columns and advance prefill_pos iff the chunk came back clean.
+        Returns (tokens [k, len(reqs)] int32 with -1 on frozen rows,
+        bad [len(reqs)] bool)."""
+        ragged = self.config.kernel == "ragged"
+        n = self.config.max_num_seqs if ragged \
+            else _bucket(len(reqs), self.config.max_num_seqs)
         mb = self.max_blocks_per_seq
-        packed = np.zeros((n, PACK_COLS + mb), np.int32)
+        packed = np.zeros((n, PACK_COLS + k + mb), np.int32)
+        fed = []                             # (req, tokens consumed)
         for i, req in enumerate(reqs):
             p = req.params
             packed[i, 0] = req.last_token
@@ -835,16 +883,37 @@ class LLMEngine:
             packed[i, 7] = int(p.top_k)
             packed[i, 8] = pack_f32(p.top_p)
             packed[i, 9] = p.seed & 0x7FFFFFFF
+            if req.prefill_pos < req.pf_target:
+                pf_rem = req.pf_target - req.prefill_pos
+                f = min(k, pf_rem)
+                packed[i, 10] = f
+                packed[i, 11] = 1 if pf_rem > k else 0
+                prompt = req.all_token_ids()
+                packed[i, PACK_COLS:PACK_COLS + f] = \
+                    prompt[req.prefill_pos:req.prefill_pos + f]
+                fed.append((req, f))
             table = self.cache.block_table(req.request_id)
-            packed[i, PACK_COLS:PACK_COLS + len(table)] = table
+            packed[i, PACK_COLS + k:PACK_COLS + k + len(table)] = table
         out, pools = fused_decode_chunk(
             self.params, self.cache.pools, jnp.asarray(packed),
-            self.geom, k)
+            self.geom, k, self.config.kernel)
         self.cache.pools = pools
         fetched = np.asarray(out)            # the chunk's ONE host sync
         self.stats.inc_host_sync("decode")
         live = len(reqs)
-        return fetched[:k, :live], fetched[k + 1, :live].astype(bool)
+        # padded-vs-live telemetry: the bucketed fallback burns compute
+        # on its dead rows; the ragged kernel's length gating skips them
+        self.stats.set_padding_waste(0.0 if ragged else (n - live) / n)
+        if fed:
+            self.stats.inc_prefill_chunks(len(fed))
+        bad = fetched[k + 1, :live].astype(bool)
+        if not bad.any():
+            # a bad chunk is discarded wholesale (offenders quarantined,
+            # survivors requeued with pf state reset), so prefill
+            # progress only commits on a clean fetch
+            for req, f in fed:
+                req.prefill_pos += f
+        return fetched[:k, :live], bad
 
     # ------------------------------------------------------- convenience
     def run(self, max_steps: int = None) -> Dict[str, np.ndarray]:

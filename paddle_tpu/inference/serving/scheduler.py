@@ -133,6 +133,15 @@ class Request:
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None  # previous emit (token gap)
     finish_time: Optional[float] = None
+    # chunked prefill (docs/serving.md "Ragged paged attention and
+    # chunked prefill"): a long prompt admitted chunked feeds the fused
+    # decode scan k prompt tokens per step instead of running a dense
+    # prefill dispatch. pf_target is len(all_token_ids()) at admission;
+    # prefill_pos advances per good chunk; the row is mid-prefill while
+    # prefill_pos < pf_target. Both reset on every requeue (recompute
+    # discipline: re-admission re-prefills from the token log).
+    pf_target: int = 0
+    prefill_pos: int = 0
 
     def all_token_ids(self) -> np.ndarray:
         """prompt + generated — the effective prompt after preemption."""
@@ -175,6 +184,14 @@ class SchedulerConfig:
     # exceed this fraction — reserves decode headroom so CacheExhausted
     # cannot strand running sequences. 1.0 disables the watermark.
     cache_high_watermark: float = 1.0
+    # chunked prefill: prompts STRICTLY longer than this are admitted
+    # chunked — they join the running set with an empty block table and
+    # consume decode_chunk_size prompt tokens per step inside the fused
+    # decode scan, so a long prompt never monopolises a step. Admission
+    # charges only the first chunk against the prefill budget (later
+    # chunks are inherently rate-limited at k tokens/step). None
+    # disables chunking (every prompt takes the dense prefill path).
+    prefill_chunk_threshold: Optional[int] = None
 
 
 @dataclass
@@ -385,6 +402,10 @@ class Scheduler:
         starve a repeatedly-preempted earlier one."""
         req.slot = None
         req.state = RequestState.WAITING
+        # chunked-prefill progress is cache state; a requeue drops the
+        # cache, so re-admission must re-prefill from the token log
+        req.pf_target = 0
+        req.prefill_pos = 0
         for i, w in enumerate(self.waiting):
             if w.arrival > req.arrival:
                 self.waiting.insert(i, req)
@@ -435,7 +456,15 @@ class Scheduler:
         for req in sorted(self.running, key=lambda r: r.arrival):
             if req not in self.running:      # preempted below, this step
                 continue
-            n = min(chunk, req.params.max_tokens - len(req.output_ids))
+            remaining = req.params.max_tokens - len(req.output_ids)
+            if req.prefill_pos < req.pf_target:
+                # mid-prefill row: the chunk consumes up to pf_rem fed
+                # prompt tokens, then may sample/decode for the rest of
+                # its k trips — every consumed trip writes one KV slot
+                pf_rem = req.pf_target - req.prefill_pos
+                n = min(chunk, pf_rem + max(0, remaining))
+            else:
+                n = min(chunk, remaining)
             n = max(1, n)
             while True:
                 try:
@@ -457,15 +486,21 @@ class Scheduler:
         budget = cost_model.budget(self.config.max_prefill_tokens) \
             if cost_model else self.config.max_prefill_tokens
         mark = self.config.cache_high_watermark
+        thr = self.config.prefill_chunk_threshold
+        admitted = 0
         while self.waiting and len(self.running) \
                 < self.config.max_num_seqs:
             req = self.waiting[0]
             tokens = req.all_token_ids()
-            price = cost_model.cost(len(tokens)) if cost_model \
-                else len(tokens)
-            if price > budget and batch.prefill:
+            # chunked prefill: a long prompt is admitted with an empty
+            # table and fed to the fused decode scan k tokens per step —
+            # it is priced (and block-checked) per chunk, not per prompt
+            chunked = thr is not None and len(tokens) > thr
+            eff = min(chunk, len(tokens)) if chunked else len(tokens)
+            price = cost_model.cost(eff) if cost_model else eff
+            if price > budget and admitted:
                 break                        # budget spent; next step
-            needed = self.cache.blocks_needed(len(tokens))
+            needed = self.cache.blocks_needed(eff)
             if (self.cache.num_used() + needed) > mark * self.cache.num_blocks \
                     and self.running:
                 # above the watermark with live decodes: hold admission
@@ -474,14 +509,36 @@ class Scheduler:
                 # alone may legitimately exceed the watermark).
                 self.watermark_holds += 1
                 break
-            try:
-                self.cache.allocate(req.request_id, len(tokens))
-            except CacheExhausted:
-                break                        # never preempt to admit
-            self.waiting.popleft()
-            req.state = RequestState.RUNNING
-            self.running.append(req)
-            batch.prefill.append(req)
+            if chunked:
+                remaining = max(0, req.params.max_tokens
+                                - len(req.output_ids))
+                try:
+                    self.cache.allocate(req.request_id, 0)
+                    req.slot = self.cache.reserve_slots(
+                        req.request_id,
+                        min(chunk, len(tokens) + remaining))
+                except CacheExhausted:
+                    if self.cache.has_seq(req.request_id):
+                        self.cache.free(req.request_id)
+                    break                    # never preempt to admit
+                req.pf_target = len(tokens)
+                req.prefill_pos = 0
+                self.waiting.popleft()
+                req.state = RequestState.RUNNING
+                self.running.append(req)
+                # rides THIS step's fused decode dispatch: first chunk
+                # of prompt feed goes out alongside the decode slots
+                batch.decode.append(req)
+            else:
+                try:
+                    self.cache.allocate(req.request_id, len(tokens))
+                except CacheExhausted:
+                    break                    # never preempt to admit
+                self.waiting.popleft()
+                req.state = RequestState.RUNNING
+                self.running.append(req)
+                batch.prefill.append(req)
+            admitted += 1
             budget -= price
         return batch
 

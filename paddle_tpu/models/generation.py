@@ -76,6 +76,19 @@ def _block(p, i, x, q, k_cache, v_cache, pos_mask, geom):
                        jnp.asarray(-1e30, scores.dtype))
     probs = jax.nn.softmax(scores, axis=-1)
     att = jnp.einsum("bhts,bhsd->bhtd", probs, v_cache)
+    return _attn_merge(p, i, x, att, geom)
+
+
+def _attn_merge(p, i, x, att, geom):
+    """Post-attention half of _block: heads-major att [B, H, t, D] →
+    out-projection residual, then the MLP. Split out so attention-kernel
+    substitutes (the TPU ragged paged-attention kernel,
+    ops/pallas/ragged_paged_attention.py) can replace only the
+    score/softmax math and reuse this half verbatim; _block calling
+    through it traces to the identical jaxpr as the inline form."""
+    _, H, D, _ = geom
+    pre = f"blocks.{i}."
+    B, t = x.shape[0], x.shape[1]
     att = att.transpose(0, 2, 1, 3).reshape(B, t, H * D)
     x = x + att @ p[pre + "attn.out.weight"] + p[pre + "attn.out.bias"]
     h = _ln(x, p[pre + "ln2.weight"], p[pre + "ln2.bias"])

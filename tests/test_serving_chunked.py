@@ -71,16 +71,18 @@ def _run_engine(model, prompts, samplings, **kw):
 
 
 # ------------------------------------------------------- kernel parity
-def _packed_state(cache, seqs, mb):
+def _packed_state(cache, seqs, mb, k):
     """Build the fused-chunk control array for live sequences
-    [(seq_id, tok, pos, out_cnt, max_out, temp, top_k, top_p, seed)]."""
-    packed = np.zeros((len(seqs), PACK_COLS + mb), np.int32)
+    [(seq_id, tok, pos, out_cnt, max_out, temp, top_k, top_p, seed)] —
+    pure-decode rows (pf_feed=0, empty feed columns)."""
+    packed = np.zeros((len(seqs), PACK_COLS + k + mb), np.int32)
     for i, (sid, tok, pos, out_cnt, max_out, t, tk, tp, seed) in \
             enumerate(seqs):
         table = cache.block_table(sid)
-        packed[i, :10] = [tok, pos, 1, out_cnt, max_out, -1,
-                          pack_f32(t), tk, pack_f32(tp), seed]
-        packed[i, PACK_COLS:PACK_COLS + len(table)] = table
+        packed[i, :PACK_COLS] = [tok, pos, 1, out_cnt, max_out, -1,
+                                 pack_f32(t), tk, pack_f32(tp), seed,
+                                 0, 0]
+        packed[i, PACK_COLS + k:PACK_COLS + k + len(table)] = table
     return packed
 
 
@@ -117,7 +119,7 @@ def test_fused_k_step_bitwise_matches_k_single_steps(model, sampling):
         for step_k in chunks:
             for s in state:
                 cache.reserve_slots(s[0], step_k)
-            packed = _packed_state(cache, state, mb)
+            packed = _packed_state(cache, state, mb, step_k)
             out, pools = fused_decode_chunk(
                 params, cache.pools, jnp.asarray(packed), geom, step_k)
             cache.pools = pools
