@@ -6,7 +6,11 @@ vLLM continuous batching + the TPU Ragged Paged Attention kernel,
 PAPERS.md arxiv 2604.15464). Four cooperating modules:
 
 - paged_cache:  PagedKVCache — block-pooled KV storage, block tables,
-                alloc/free with CacheExhausted reporting, counters.
+                alloc/free with CacheExhausted reporting, counters;
+                refcounted block sharing when prefix caching is on.
+- prefix_cache: PrefixCacheIndex — radix-trie prefix index (token ids
+                -> cached blocks) behind copy-on-write block sharing
+                (docs/serving.md "Prefix caching").
 - attention:    ragged paged-attention decode step (pure-JAX reference,
                 bitwise-pinned to models.generation.decode_step).
 - scheduler:    FCFS continuous batching — admission, prefill/decode
@@ -24,6 +28,7 @@ PAPERS.md arxiv 2604.15464). Four cooperating modules:
 See docs/serving.md for architecture and tuning.
 """
 from .paged_cache import CacheExhausted, PagedKVCache  # noqa: F401
+from .prefix_cache import PrefixCacheIndex, PrefixNode  # noqa: F401
 from .attention import (gather_block_kv, paged_decode_step,  # noqa: F401
                         fused_decode_chunk)
 from .scheduler import (EngineOverloaded, Request,  # noqa: F401
@@ -37,6 +42,7 @@ from .router import ReplicaSet, RouterConfig, RouterRequest  # noqa: F401
 
 __all__ = [
     "PagedKVCache", "CacheExhausted", "EngineOverloaded",
+    "PrefixCacheIndex", "PrefixNode",
     "gather_block_kv",
     "paged_decode_step", "fused_decode_chunk",
     "SamplingParams", "Request", "RequestState",

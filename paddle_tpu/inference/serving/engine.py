@@ -113,6 +113,12 @@ class EngineConfig:
     # step instead of a dedicated dense prefill dispatch, so long
     # prompts never stall a step. None disables chunking.
     prefill_chunk_threshold: Optional[int] = None
+    # prefix caching (docs/serving.md "Prefix caching"): share KV
+    # blocks across requests through a radix-trie index with
+    # refcounts, copy-on-write forking and LRU eviction. Prompts with
+    # a cached prefix are admitted chunked and prefill only their
+    # uncached suffix; greedy output is bitwise-identical either way.
+    enable_prefix_cache: bool = False
     # ----------------------------- robustness layer (docs/serving.md)
     max_waiting: Optional[int] = None    # bounded waiting queue (None=∞)
     admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
@@ -258,6 +264,37 @@ class EngineStats:
         self._g_blocks_used = g_blk.labels(state="used", **lbl)
         self._g_blocks_free = g_blk.labels(state="free", **lbl)
         self._g_prefill_spend = g_spend.labels(**lbl)
+        # prefix cache (docs/observability.md): hit/miss/eviction
+        # counters mirrored from the cache's lifetime counters via the
+        # delta-inc pattern, plus cached/shared block gauges and the
+        # cached-prompt-token ratio
+        self._prefix_counters = {
+            "hits": obs.counter(
+                "serving_prefix_cache_hits_total",
+                "admissions that attached at least one cached prefix "
+                "token", labels=("engine",)).labels(**lbl),
+            "misses": obs.counter(
+                "serving_prefix_cache_misses_total",
+                "admissions that matched nothing in the prefix trie",
+                labels=("engine",)).labels(**lbl),
+            "evictions": obs.counter(
+                "serving_prefix_cache_evictions_total",
+                "unreferenced cached blocks reclaimed under pool "
+                "pressure (LRU leaf first)",
+                labels=("engine",)).labels(**lbl),
+        }
+        self._g_prefix_ratio = obs.gauge(
+            "serving_prefix_cached_tokens_ratio",
+            "prompt tokens served from cache / prompt tokens admitted "
+            "(lifetime, per engine)",
+            labels=("engine",)).labels(**lbl)
+        g_pfx = obs.gauge(
+            "serving_prefix_cache_blocks",
+            "prefix-cache block census: kind=cached (trie-indexed) | "
+            "shared (refcount >= 2)",
+            labels=("engine", "kind"), unit="blocks")
+        self._g_prefix_cached = g_pfx.labels(kind="cached", **lbl)
+        self._g_prefix_shared = g_pfx.labels(kind="shared", **lbl)
 
     # -------------------------------------------------- record helpers
     def observe_ttft(self, dt: float) -> None:
@@ -310,6 +347,24 @@ class EngineStats:
 
     def host_syncs_per_token(self) -> float:
         return self._g_syncs_per_token.value
+
+    def record_prefix(self, ps: dict) -> None:
+        """Publish one prefix-cache snapshot (PagedKVCache.prefix_stats)
+        — counters advance by delta (they are lifetime-monotone on the
+        cache side), gauges overwrite."""
+        for k, child in self._prefix_counters.items():
+            delta = ps[k] - child.value
+            if delta > 0:
+                child.inc(delta)
+        self._g_prefix_ratio.set(ps["cached_tokens_ratio"])
+        self._g_prefix_cached.set(ps["cached_blocks"])
+        self._g_prefix_shared.set(ps["shared_blocks"])
+
+    def prefix_counter(self, kind: str) -> int:
+        """Exact published counter value (kind='hits'|'misses'|
+        'evictions') — tests pin these against the cache's own
+        counters."""
+        return int(self._prefix_counters[kind].value)
 
     def ttft_quantile(self, q: float) -> float:
         """Exact TTFT quantile (bench / load suite read p50/p99 here)."""
@@ -412,8 +467,9 @@ class LLMEngine:
         self.geom = geom
         self.config = config
         self.max_blocks_per_seq = S // config.block_size
-        self.cache = PagedKVCache(L, H, D, config.num_blocks,
-                                  config.block_size)
+        self.cache = PagedKVCache(
+            L, H, D, config.num_blocks, config.block_size,
+            enable_prefix_cache=config.enable_prefix_cache)
         cost_model = config.prefill_cost_model
         if cost_model == "auto":
             # committed-plan admission pricing; a repo without a plan
@@ -834,6 +890,8 @@ class LLMEngine:
             waiting=self.scheduler.num_waiting(),
             blocks_used=self.cache.num_used(),
             blocks_free=self.cache.num_free())
+        if self.cache.prefix_index is not None:
+            self.stats.record_prefix(self.cache.prefix_stats())
         return outs
 
     @holds_lock("_lock")
@@ -845,6 +903,11 @@ class LLMEngine:
         logits, dense_cache = gen.prefill(
             self.params, jnp.asarray(tokens[None], jnp.int32), self.geom)
         self.cache.write_prefill(req.request_id, dense_cache, tokens.size)
+        if self.cache.prefix_index is not None:
+            # every prompt position's KV is now written — index the
+            # full blocks immediately so template siblings queued
+            # behind this request already hit
+            self.cache.register_prefix(req.request_id, tokens)
         out = np.asarray(logits[0])
         self.stats.inc_host_sync("prefill")
         return out
@@ -913,6 +976,13 @@ class LLMEngine:
             # progress only commits on a clean fetch
             for req, f in fed:
                 req.prefill_pos += f
+                if self.cache.prefix_index is not None:
+                    # committed prefill progress is valid KV: index the
+                    # newly completed full blocks so concurrent template
+                    # siblings share them while this row still prefills
+                    self.cache.register_prefix(
+                        req.request_id,
+                        req.all_token_ids()[:req.prefill_pos])
         return fetched[:k, :live], bad
 
     # ------------------------------------------------------- convenience

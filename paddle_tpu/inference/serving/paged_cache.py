@@ -11,17 +11,34 @@ vLLM's PagedAttention, with the pool as one jnp array per layer so the
 ragged decode step (serving/attention.py) gathers it with one
 block-table index per layer.
 
+Prefix caching (docs/serving.md "Prefix caching"): with
+`enable_prefix_cache=True` blocks become REFCOUNTED and content-
+addressed through a radix-trie index (serving/prefix_cache.py) at
+full-block granularity. Admission attaches the longest cached prefix
+of a prompt to the new sequence's table (the same physical blocks,
+refcount += 1), forks a private copy-on-write block when the prompt
+diverges mid-block, and only the uncached suffix is ever prefilled.
+A freed block returns to the free list only at refcount 0; blocks the
+trie still indexes are RETAINED at refcount 0 (evictable LRU-leaf-
+first under pool pressure) instead of freed. Scrub is refcount-aware:
+a quarantined sequence scrubs only blocks it was the LAST holder of,
+and distrusts (trie-evicts + taints) anything it shared — a tainted
+block is scrubbed the moment its final reference drops.
+
 Host/device split: block accounting (free list, tables, lengths,
-counters) is plain Python — it feeds the scheduler and never traces.
-The pools themselves are jax arrays; `write_prefill` scatters a dense
-prefill cache into a sequence's blocks, and the decode step returns
-updated pools that the engine assigns back.
+refcounts, trie, counters) is plain Python — it feeds the scheduler
+and never traces. The pools themselves are jax arrays; `write_prefill`
+scatters a dense prefill cache into a sequence's blocks, and the
+decode step returns updated pools that the engine assigns back.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
+
+from .prefix_cache import PrefixCacheIndex
 
 __all__ = ["PagedKVCache", "CacheExhausted"]
 
@@ -49,10 +66,20 @@ class PagedKVCache:
     D]. Token position p of a sequence lives in its block table entry
     p // block_size at slot offset p % block_size — the identity layout
     that makes the gathered context bitwise-match the dense cache.
+
+    Block lifecycle: free list -> owned (refcount = number of tables
+    holding the block) -> either back to the free list at refcount 0,
+    or — when the prefix trie indexes it — retained at refcount 0 as
+    an evictable cached block. `blocks_allocated`/`blocks_freed` count
+    free-list crossings only, so attaching a shared block is not an
+    allocation and retaining a cached block is not (yet) a free; with
+    the prefix cache disabled this reduces exactly to the historical
+    allocated == freed zero-leak reconciliation.
     """
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
-                 num_blocks: int, block_size: int, dtype=jnp.float32):
+                 num_blocks: int, block_size: int, dtype=jnp.float32,
+                 enable_prefix_cache: bool = False):
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
         self.num_layers = num_layers
@@ -68,10 +95,21 @@ class PagedKVCache:
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._tables: Dict[object, List[int]] = {}
         self._lens: Dict[object, int] = {}
+        # refcount[b] = number of block tables containing b; an entry
+        # exists exactly while b is OFF the free list (0 only for
+        # trie-cached, currently-unreferenced blocks)
+        self._refcount: Dict[int, int] = {}
+        # blocks whose content is distrusted (shared at scrub time):
+        # never re-indexed, scrubbed when their last reference drops
+        self._tainted: set = set()
+        self.prefix_index: Optional[PrefixCacheIndex] = \
+            PrefixCacheIndex(block_size) if enable_prefix_cache else None
         # lifetime counters (the zero-leak invariant is
-        # blocks_allocated == blocks_freed once every sequence is freed)
+        # blocks_allocated == blocks_freed once every sequence is freed
+        # and, with prefix caching, the trie is cleared)
         self.blocks_allocated = 0
         self.blocks_freed = 0
+        self.blocks_attached = 0             # shared-prefix attaches
         self.alloc_failures = 0
         self.high_water = 0
 
@@ -81,6 +119,14 @@ class PagedKVCache:
 
     def num_used(self) -> int:
         return self.num_blocks - len(self._free)
+
+    def num_evictable(self) -> int:
+        """Trie-cached blocks no table references — reclaimable on
+        demand, so admission watermarks treat them as headroom."""
+        if self.prefix_index is None:
+            return 0
+        return sum(1 for b in self.prefix_index.blocks()
+                   if self._refcount.get(b, 0) == 0)
 
     def utilization(self) -> float:
         return self.num_used() / self.num_blocks
@@ -99,14 +145,38 @@ class PagedKVCache:
 
     # ------------------------------------------------------- alloc / free
     def _take_blocks(self, seq_id, n: int) -> List[int]:
+        if n > len(self._free) and self.prefix_index is not None:
+            self._evict_cached(n - len(self._free))
         if n > len(self._free):
             self.alloc_failures += 1
             raise CacheExhausted(seq_id, n, len(self._free),
                                  self.num_blocks)
         got = [self._free.pop() for _ in range(n)]
+        for b in got:
+            self._refcount[b] = 1
         self.blocks_allocated += n
         self.high_water = max(self.high_water, self.num_used())
         return got
+
+    def _evict_cached(self, n: int) -> int:
+        """Reclaim up to n unreferenced cached blocks, LRU leaf first
+        (leaf-only removal keeps the trie rooted; clocks are monotone
+        root-ward so the coldest extremity goes first). Evicted blocks
+        are NOT scrubbed — finite stale KV is erased exactly by the
+        attention length mask, the same contract as a non-scrub free."""
+        idx = self.prefix_index
+        evicted = 0
+        while evicted < n:
+            node = idx.pop_lru_leaf(
+                lambda b: self._refcount.get(b, 0) == 0)
+            if node is None:
+                break
+            del self._refcount[node.block]
+            self._free.append(node.block)
+            self.blocks_freed += 1
+            idx.evictions += 1
+            evicted += 1
+        return evicted
 
     def allocate(self, seq_id, num_tokens: int) -> List[int]:
         """Claim blocks for a new sequence of num_tokens cached tokens
@@ -155,20 +225,195 @@ class PagedKVCache:
         self._lens[seq_id] = pos + n
         return table[pos // self.block_size], pos % self.block_size, pos
 
-    def free(self, seq_id, scrub: bool = False) -> int:
-        """Return every block of seq_id to the pool (completion,
-        preemption or cancellation). `scrub=True` also zeroes the blocks'
-        device contents — mandatory on the quarantine/recovery paths:
-        finite stale garbage is erased exactly by the attention length
-        mask (masked probs are exact zeros), but NaN survives it
-        (0 * NaN = NaN), so a poisoned block must not re-enter the free
-        list carrying NaN."""
+    # -------------------------------------------------- prefix caching
+    def match_len(self, tokens) -> int:
+        """Pricing probe (no LRU side effects): how many leading tokens
+        of `tokens` the cache could serve at admission. Capped at
+        len(tokens) - 1 — at least one prompt token must run through
+        the model so the first output has logits to sample from (and so
+        the last prompt token's KV is written at its own position,
+        never double-written)."""
+        if self.prefix_index is None or len(tokens) < 2:
+            return 0
+        toks = [int(t) for t in tokens[:len(tokens) - 1]]
+        path, partial = self.prefix_index.match(toks, touch=False)
+        return len(path) * self.block_size + \
+            (partial[1] if partial is not None else 0)
+
+    def allocate_with_prefix(self, seq_id, tokens) -> int:
+        """Admission with prefix reuse: start seq_id's table with the
+        longest cached prefix of `tokens` — full-block trie hits attach
+        the SHARED physical blocks (refcount += 1), a mid-block
+        divergence forks a private copy-on-write duplicate of the
+        partially-agreeing cached block (the sequence overwrites slots
+        past the matched m as it prefills). Returns the number of
+        prompt tokens served from cache (the sequence's initial length;
+        prefill resumes there). With the prefix cache disabled this is
+        exactly `allocate(seq_id, 0)` returning 0 — the chunked-prefill
+        empty-table admission."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id!r} already allocated")
+        idx = self.prefix_index
+        if idx is None:
+            self._tables[seq_id] = []
+            self._lens[seq_id] = 0
+            return 0
+        toks = [int(t) for t in tokens]
+        path, partial = idx.match(toks[:len(toks) - 1], touch=True)
+        table = [node.block for node in path]
+        for b in table:
+            self._refcount[b] += 1
+        self.blocks_attached += len(table)
+        cached = len(table) * self.block_size
+        if partial is not None:
+            donor, m = partial
+            try:
+                fork = self._take_blocks(seq_id, 1)[0]
+            except CacheExhausted:
+                # the fork is an optimisation; under pressure fall back
+                # to recomputing the partial block from tokens. The
+                # attached full blocks stay attached — roll nothing back
+                self.alloc_failures -= 1     # not an admission failure
+            else:
+                self._copy_block(donor.block, fork)
+                table.append(fork)
+                cached += m
+                idx.cow_forks += 1
+        self._tables[seq_id] = table
+        self._lens[seq_id] = cached
+        if cached > 0:
+            idx.hits += 1
+        else:
+            idx.misses += 1
+        idx.cached_tokens_total += cached
+        idx.prompt_tokens_total += len(toks)
+        return cached
+
+    def note_prefix_miss(self, num_tokens: int) -> None:
+        """Hit-rate accounting for admissions that bypass
+        allocate_with_prefix (the dense prefill path — taken exactly
+        when nothing matched): without this, dense misses would never
+        enter the cached-token ratio's denominator."""
+        if self.prefix_index is not None:
+            self.prefix_index.misses += 1
+            self.prefix_index.prompt_tokens_total += num_tokens
+
+    def register_prefix(self, seq_id, tokens) -> int:
+        """Index seq_id's full blocks under `tokens` — the tokens whose
+        KV the sequence has actually WRITTEN (prefill progress, or the
+        full log minus the never-fed-back last sampled token). Only
+        whole blocks are indexed (partial blocks are still being
+        written); first-wins dedupe keeps an existing node's physical
+        block; tainted blocks are never indexed. Idempotent. Returns
+        the number of newly indexed blocks."""
+        idx = self.prefix_index
+        if idx is None:
+            return 0
+        table = self._tables[seq_id]
+        toks = [int(t) for t in tokens]
+        full = min(len(toks) // self.block_size, len(table))
+        if full <= 0:
+            return 0
+        return idx.insert(toks, table[:full],
+                          skip=lambda b: b in self._tainted)
+
+    def clear_prefix_cache(self) -> int:
+        """Drop the entire trie, returning unreferenced cached blocks
+        to the free list (tainted ones scrubbed). Blocks still held by
+        live tables just lose their index entry. The reconciliation
+        hook: after clearing, a drained cache is back to the
+        allocated == freed zero-leak identity. Returns the number of
+        blocks released."""
+        idx = self.prefix_index
+        if idx is None:
+            return 0
+        released: List[int] = []
+        for b in idx.clear():
+            if self._refcount.get(b, 0) == 0:
+                del self._refcount[b]
+                self._free.append(b)
+                released.append(b)
+        self.blocks_freed += len(released)
+        dirty = [b for b in released if b in self._tainted]
+        if dirty:
+            self._tainted.difference_update(dirty)
+            self.scrub_blocks(dirty)
+        return len(released)
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Device-side block duplication for copy-on-write forks: one
+        gather + scatter per layer pool, no host sync."""
+        self.pools = tuple(
+            (kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
+            for kp, vp in self.pools)
+
+    def _distrust(self, b: int, to_scrub: List[int]) -> None:
+        """Scrub-path hygiene for block b's trie entry: remove its
+        whole subtree from the index (a removed parent orphans its
+        children, and content downstream of a distrusted block must
+        not be re-matched). Subtree blocks nobody references are
+        released scrubbed; still-referenced ones are tainted — their
+        final free scrubs them. b itself is left to the caller."""
+        idx = self.prefix_index
+        if idx is None:
+            return
+        node = idx.node_of(b)
+        if node is None:
+            return
+        for blk in idx.remove_subtree(node):
+            if blk == b:
+                continue
+            if self._refcount.get(blk, 0) == 0:
+                del self._refcount[blk]
+                self._free.append(blk)
+                self.blocks_freed += 1
+                self._tainted.discard(blk)
+                to_scrub.append(blk)
+            else:
+                self._tainted.add(blk)
+
+    def free(self, seq_id, scrub: bool = False, cache_tokens=None) -> int:
+        """Drop seq_id's table (completion, preemption, cancellation),
+        decrementing refcounts; blocks return to the pool only at
+        refcount 0, and blocks the prefix trie indexes are RETAINED at
+        refcount 0 (evictable) instead of freed. `cache_tokens` — the
+        sequence's tokens with valid written KV — indexes its full
+        blocks first, so finished/preempted work stays matchable.
+
+        `scrub=True` (quarantine/recovery) zeroes the device contents
+        of every block this call actually releases — finite stale
+        garbage is erased exactly by the attention length mask (masked
+        probs are exact zeros), but NaN survives it (0 * NaN = NaN), so
+        a poisoned block must not re-enter the free list carrying NaN.
+        Scrub is REFCOUNT-AWARE: a block other sequences still hold is
+        never zeroed under them; it is evicted from the trie, tainted,
+        and scrubbed when its final reference drops."""
+        idx = self.prefix_index
+        if idx is not None and cache_tokens is not None and not scrub \
+                and len(cache_tokens):
+            self.register_prefix(seq_id, cache_tokens)
         ids = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
-        self._free.extend(reversed(ids))
-        self.blocks_freed += len(ids)
-        if scrub:
-            self.scrub_blocks(ids)
+        to_scrub: List[int] = []
+        for b in reversed(ids):
+            self._refcount[b] -= 1
+            if scrub:
+                self._distrust(b, to_scrub)
+            if self._refcount[b] > 0:
+                if scrub:
+                    self._tainted.add(b)
+                continue
+            if not scrub and idx is not None \
+                    and idx.node_of(b) is not None:
+                continue                     # retained: cached, evictable
+            del self._refcount[b]
+            self._free.append(b)
+            self.blocks_freed += 1
+            if scrub or b in self._tainted:
+                self._tainted.discard(b)
+                to_scrub.append(b)
+        if to_scrub:
+            self.scrub_blocks(to_scrub)
         return len(ids)
 
     def scrub_blocks(self, block_ids) -> None:
@@ -183,22 +428,39 @@ class PagedKVCache:
 
     def check_integrity(self) -> dict:
         """Invariant audit for the chaos harness: the free list and the
-        live block tables must exactly partition the pool, with lifetime
-        counters consistent. Returns the audit dict; raises RuntimeError
-        on any violation (a leaked or double-owned block)."""
+        LIVE blocks (table-owned plus trie-cached) must exactly
+        partition the pool, refcounts must equal table multiplicity,
+        unreferenced live blocks must be trie-cached, taints must point
+        at owned blocks, the trie must be structurally sound, and the
+        lifetime counters must account for every off-free-list block.
+        Returns the audit dict; raises RuntimeError on any violation.
+        With the prefix cache disabled this reduces to the historical
+        free-list/table partition check."""
         in_tables = [b for ids in self._tables.values() for b in ids]
         owned = set(in_tables)
         free = set(self._free)
+        idx = self.prefix_index
+        cached = set(idx.blocks()) if idx is not None else set()
+        live = owned | cached
+        mult = Counter(in_tables)
         report = {
-            "leaked": self.num_blocks - len(owned) - len(free),
-            "double_owned": len(in_tables) - len(owned),
-            "free_and_owned": len(owned & free),
+            "leaked": self.num_blocks - len(live | free),
+            "double_owned": sum(
+                1 for b in set(self._refcount) | owned
+                if self._refcount.get(b, 0) != mult.get(b, 0)),
+            "free_and_owned": len(live & free),
             "counter_drift": (self.blocks_allocated - self.blocks_freed)
-            - len(in_tables),
+            - (self.num_blocks - len(self._free)),
+            "unreachable_zero_ref": sum(
+                1 for b, rc in self._refcount.items()
+                if rc == 0 and b not in cached),
+            "stale_tainted": len(self._tainted - owned),
+            "trie_defects": idx.audit() if idx is not None else 0,
         }
         if any(report.values()):
             raise RuntimeError(f"paged cache integrity violated: {report} "
                                f"(tables={len(self._tables)}, "
+                               f"cached={len(cached)}, "
                                f"free={len(free)}/{self.num_blocks})")
         return report
 
@@ -209,7 +471,10 @@ class PagedKVCache:
         (k [B, H, S, D], v) from models.generation.prefill) into its
         allocated blocks. Positions past num_tokens inside the last
         block stay zero (prefill zero-fills past the prompt), matching
-        a fresh pool block bit-for-bit."""
+        a fresh pool block bit-for-bit. Must only run on PRIVATE tables
+        (dense admission never attaches shared blocks — any prefix hit
+        is admitted through the chunked path, which writes only the
+        uncached suffix positions)."""
         ids = self._tables[seq_id]
         n_blocks, bs = len(ids), self.block_size
         t_pad = n_blocks * bs
@@ -225,6 +490,25 @@ class PagedKVCache:
             (scatter(kp, kc), scatter(vp, vc))
             for (kp, vp), (kc, vc) in zip(self.pools, dense_cache))
 
+    def prefix_stats(self) -> dict:
+        """Prefix-cache telemetry snapshot (engine gauges + load suite
+        hit-rate reporting read this)."""
+        idx = self.prefix_index
+        if idx is None:
+            return {"enabled": False, "cached_blocks": 0,
+                    "shared_blocks": 0, "evictable_blocks": 0,
+                    "hits": 0, "misses": 0, "evictions": 0,
+                    "cow_forks": 0, "inserted_blocks": 0,
+                    "cached_tokens_total": 0, "prompt_tokens_total": 0,
+                    "cached_tokens_ratio": 0.0, "attached_blocks": 0}
+        out = {"enabled": True}
+        out.update(idx.stats())
+        out["shared_blocks"] = sum(
+            1 for rc in self._refcount.values() if rc >= 2)
+        out["evictable_blocks"] = self.num_evictable()
+        out["attached_blocks"] = self.blocks_attached
+        return out
+
     def stats(self) -> dict:
         return {
             "num_blocks": self.num_blocks,
@@ -234,6 +518,7 @@ class PagedKVCache:
             "utilization": self.utilization(),
             "blocks_allocated": self.blocks_allocated,
             "blocks_freed": self.blocks_freed,
+            "blocks_attached": self.blocks_attached,
             "alloc_failures": self.alloc_failures,
             "high_water": self.high_water,
         }

@@ -1,0 +1,257 @@
+"""Radix-trie prefix index over paged KV blocks (prefix caching).
+
+Serving traffic from millions of users is template-shaped: system
+prompts, few-shot preambles and multi-turn history repeat across
+requests, yet without sharing every request re-prefills from token
+zero. The paged block tables (serving/paged_cache.py) already give the
+indirection that makes sharing pure bookkeeping: if two prompts agree
+on their first k*block_size tokens, the KV content of those k blocks
+is identical bit for bit (causal attention: position p's KV depends
+only on tokens <= p), so the SAME physical blocks can appear in both
+sequences' tables.
+
+This module owns the content index; PagedKVCache owns the physical
+side (refcounts, free list, copy-on-write forks, eviction). The index
+is a radix trie at FULL-BLOCK granularity: one node per cached block,
+keyed by the tuple of block_size token ids that block holds, child
+edges extending the prefix by one block. Matching a prompt walks the
+trie greedily; divergence INSIDE a block surfaces as a partial match
+(node, m) that the cache materialises as a copy-on-write fork.
+
+Invariants (audited by PagedKVCache.check_integrity):
+- a physical block appears at most once in the trie;
+- a node's depth equals its block's position range: node at depth d
+  (root = 0) holds token positions [(d-1)*bs, d*bs);
+- every trie block is OFF the free list (cached blocks with refcount 0
+  are retained-but-evictable, not free);
+- last_touch clocks are monotone root-ward (children are only touched
+  through their parents), so LRU leaf eviction never strands a
+  recently-used descendant.
+
+Host-side only: the index never touches device arrays. See
+docs/serving.md "Prefix caching".
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["PrefixCacheIndex", "PrefixNode"]
+
+
+class PrefixNode:
+    """One cached block: `key` is the tuple of block_size token ids the
+    block holds, `block` the physical block id, `last_touch` the
+    index's logical clock at the last match through this node."""
+
+    __slots__ = ("key", "block", "parent", "children", "last_touch")
+
+    def __init__(self, key: Optional[tuple], block: int,
+                 parent: Optional["PrefixNode"], touch: int = 0):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: Dict[tuple, "PrefixNode"] = {}
+        self.last_touch = touch
+
+    def __repr__(self):                      # debugging aid only
+        return (f"PrefixNode(block={self.block}, "
+                f"children={len(self.children)})")
+
+
+class PrefixCacheIndex:
+    """Token-id radix trie mapping full-block prefixes to block ids.
+
+    Thread contract: owned by a PagedKVCache and mutated only under its
+    owning engine's lock (the cache itself has no lock — same contract
+    as the block tables).
+    """
+
+    def __init__(self, block_size: int):
+        if block_size <= 0:
+            raise ValueError("block_size must be positive")
+        self.block_size = block_size
+        self.root = PrefixNode(None, -1, None)
+        self._by_block: Dict[int, PrefixNode] = {}
+        self._clock = 0
+        # ----------------------------------------- lifetime counters
+        self.hits = 0                 # admissions with cached_len > 0
+        self.misses = 0               # admissions matching nothing
+        self.evictions = 0            # blocks reclaimed under pressure
+        self.cow_forks = 0            # mid-block divergence forks
+        self.inserted_blocks = 0      # trie insertions (first-wins)
+        self.cached_tokens_total = 0  # prompt tokens served from cache
+        self.prompt_tokens_total = 0  # prompt tokens seen at admission
+
+    # -------------------------------------------------------- queries
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def blocks(self):
+        """View of every cached physical block id."""
+        return self._by_block.keys()
+
+    def node_of(self, block: int) -> Optional[PrefixNode]:
+        return self._by_block.get(block)
+
+    # ------------------------------------------------------- matching
+    def match(self, tokens: List[int], touch: bool = True
+              ) -> Tuple[List[PrefixNode],
+                         Optional[Tuple[PrefixNode, int]]]:
+        """Longest cached prefix of `tokens`: the list of full-block
+        nodes matched in order, plus an optional partial match
+        (child_node, m) when 1 <= m < block_size leading tokens of the
+        NEXT block agree with a cached child — the copy-on-write
+        candidate. `touch=False` is the scheduler's pricing probe (no
+        LRU side effects); the real attach touches the matched path so
+        eviction age reflects use."""
+        bs = self.block_size
+        if touch:
+            self._clock += 1
+        node, path = self.root, []
+        i = 0
+        while i + bs <= len(tokens):
+            child = node.children.get(tuple(tokens[i:i + bs]))
+            if child is None:
+                break
+            if touch:
+                child.last_touch = self._clock
+            path.append(child)
+            node = child
+            i += bs
+        # mid-block divergence: the best partially-agreeing child
+        rest = tokens[i:]
+        best: Optional[Tuple[PrefixNode, int]] = None
+        if rest:
+            for key, child in node.children.items():
+                m = 0
+                for a, b in zip(rest, key):
+                    if a != b:
+                        break
+                    m += 1
+                if m >= 1 and (best is None or m > best[1]):
+                    best = (child, m)
+            if best is not None and touch:
+                best[0].last_touch = self._clock
+        return path, best
+
+    # ------------------------------------------------------ insertion
+    def insert(self, tokens: List[int], blocks: List[int],
+               skip: Optional[Callable[[int], bool]] = None) -> int:
+        """Register `blocks` (block i holding tokens[i*bs:(i+1)*bs]) as
+        cached prefixes. First-wins dedupe: where a node already exists
+        the existing physical block is kept and `blocks[i]` stays a
+        private duplicate (freed normally with its table). `skip(b)`
+        vetoes individual blocks (tainted content must never be
+        re-matched); a vetoed or already-indexed block STOPS the walk —
+        a deeper insertion would orphan its children. Returns the
+        number of newly indexed blocks."""
+        bs = self.block_size
+        self._clock += 1
+        node, added = self.root, 0
+        for i, b in enumerate(blocks):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is not None:
+                child.last_touch = self._clock
+                node = child
+                continue
+            if (skip is not None and skip(b)) or b in self._by_block:
+                break
+            child = PrefixNode(key, b, node, self._clock)
+            node.children[key] = child
+            self._by_block[b] = child
+            added += 1
+            node = child
+        self.inserted_blocks += added
+        return added
+
+    # ------------------------------------------------------- eviction
+    def remove(self, node: PrefixNode) -> None:
+        """Unlink one LEAF node (raises on internal nodes — removing
+        them would orphan the subtree; use remove_subtree)."""
+        if node.children:
+            raise ValueError(
+                f"cannot remove internal prefix node for block "
+                f"{node.block} ({len(node.children)} children)")
+        del node.parent.children[node.key]
+        del self._by_block[node.block]
+        node.parent = None
+
+    def remove_subtree(self, node: PrefixNode) -> List[int]:
+        """Unlink `node` and its whole subtree (distrust on scrub:
+        tainted content must not be re-matched, and a removed parent
+        would orphan its children anyway). Returns the removed block
+        ids, node first."""
+        del node.parent.children[node.key]
+        node.parent = None
+        removed: List[int] = []
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            removed.append(n.block)
+            del self._by_block[n.block]
+            stack.extend(n.children.values())
+            n.children.clear()
+        return removed
+
+    def pop_lru_leaf(self, evictable: Callable[[int], bool]
+                     ) -> Optional[PrefixNode]:
+        """Remove and return the least-recently-touched leaf whose
+        block satisfies `evictable` (the cache passes refcount == 0),
+        or None. Clocks are monotone root-ward, so evicting the oldest
+        leaf frees the coldest extremity of the trie first."""
+        best: Optional[PrefixNode] = None
+        for node in self._by_block.values():
+            if node.children or not evictable(node.block):
+                continue
+            if best is None or node.last_touch < best.last_touch:
+                best = node
+        if best is not None:
+            self.remove(best)
+        return best
+
+    def clear(self) -> List[int]:
+        """Drop the entire index; returns every block id it held (the
+        cache reconciles them back to the free list / tables)."""
+        blocks = list(self._by_block)
+        self._by_block.clear()
+        self.root.children.clear()
+        return blocks
+
+    # --------------------------------------------------------- audits
+    def audit(self) -> int:
+        """Structural self-check, returns the number of violations:
+        key widths, parent/child links, by-block map coverage and
+        block uniqueness (one trie slot per physical block)."""
+        bad = 0
+        seen: Dict[int, int] = {}
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for key, child in node.children.items():
+                if child.key != key or len(key) != self.block_size:
+                    bad += 1
+                if child.parent is not node:
+                    bad += 1
+                if self._by_block.get(child.block) is not child:
+                    bad += 1
+                seen[child.block] = seen.get(child.block, 0) + 1
+                stack.append(child)
+        bad += sum(c - 1 for c in seen.values() if c > 1)
+        bad += len(set(self._by_block) - set(seen))
+        return bad
+
+    def stats(self) -> dict:
+        total = self.prompt_tokens_total
+        return {
+            "cached_blocks": len(self._by_block),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "cow_forks": self.cow_forks,
+            "inserted_blocks": self.inserted_blocks,
+            "cached_tokens_total": self.cached_tokens_total,
+            "prompt_tokens_total": total,
+            "cached_tokens_ratio":
+                self.cached_tokens_total / total if total else 0.0,
+        }

@@ -13,6 +13,17 @@ engine-level crash recovery of PR 3 to REPLICA-LEVEL failover:
   prefill cost model. Under skewed prompt lengths this beats
   round-robin (kept as `balance="round_robin"` for A/B) because a long
   prompt's demand lands on one replica's score immediately.
+  `balance="prefix_affinity"` (docs/serving.md "Prefix caching") adds
+  a prefix-affinity tier on top: the leading blocks of the prompt are
+  rendezvous-hashed (highest-random-weight over replica indices) to a
+  deterministic preferred replica, so every request sharing a template
+  prefix lands where that prefix's KV blocks already live and the
+  cache hit rate survives scale-out instead of dying by 1/N.
+  Rendezvous keys, not cache probes, make the policy stateless and
+  failover-stable (a key re-hashes to the same survivor set minus the
+  dead replica); a preferred replica without block headroom for the
+  request falls back to the free-block ranking, so affinity can skew
+  load but never wedge admission.
 - FAILOVER: a replica that crashes (step raises — kill_replica fault,
   unrecoverable engine error) or wedges (heartbeat stale past
   `heartbeat_timeout_s` while holding work) is quarantined: its engine
@@ -60,6 +71,7 @@ the reverse.
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import threading
 import time
@@ -78,7 +90,7 @@ from .engine import RequestOutput
 __all__ = ["BALANCE_POLICIES", "ReplicaSet", "RouterConfig",
            "RouterRequest"]
 
-BALANCE_POLICIES = ("free_blocks", "round_robin")
+BALANCE_POLICIES = ("free_blocks", "round_robin", "prefix_affinity")
 
 _ROUTER_IDS = itertools.count()
 
@@ -100,6 +112,11 @@ class RouterConfig:
     # router-level backpressure spanning replicas: TOTAL waiting bound
     max_waiting: Optional[int] = None
     admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
+    # prefix-affinity key width (balance="prefix_affinity"): how many
+    # leading FULL blocks of the prompt feed the rendezvous hash. Wide
+    # enough to separate templates, narrow enough that one template's
+    # requests share a key whatever their unique suffixes
+    affinity_prefix_blocks: int = 4
     # warmup probe for rejoining replicas (token ids; must be < vocab)
     probe_prompt: tuple = (1,)
     obs_label: Optional[str] = None
@@ -264,8 +281,12 @@ class ReplicaSet:
                             request_id, total, limit,
                             retry_after_s=self._retry_after())
                     self._shed_globally_oldest(ups)
+            ids = np.asarray(prompt_ids, np.int32).reshape(-1)
             last_exc = None
-            for rep in self._rank(ups):
+            for rep in self._rank(ups, prompt_ids=ids,
+                                  demand=self._worst_demand(
+                                      ids.size + sampling.max_tokens,
+                                      ups)):
                 try:
                     arrival, arrival_time = rep.dispatch(
                         prompt_ids, sampling, request_id)
@@ -274,9 +295,7 @@ class ReplicaSet:
                     continue
                 self._rr_next = (rep.index + 1) % len(self.replicas)
                 self._requests[request_id] = RouterRequest(
-                    request_id=request_id,
-                    prompt_ids=np.asarray(prompt_ids,
-                                          np.int32).reshape(-1),
+                    request_id=request_id, prompt_ids=ids,
                     params=sampling, arrival_time=arrival_time,
                     arrival=arrival, replica=rep.index)
                 return request_id
@@ -313,11 +332,16 @@ class ReplicaSet:
 
     # ------------------------------------------------------------ routing
     @holds_lock("_lock")
-    def _rank(self, candidates: List[EngineReplica]):
+    def _rank(self, candidates: List[EngineReplica],
+              prompt_ids=None, demand: int = 0):
         """Dispatch preference order. free_blocks: descending effective
         headroom (free - outstanding demand), then cheapest queued
         re-prefill backlog (jaxplan-priced when the engines carry a
-        cost model), then lowest index. round_robin: rotate."""
+        cost model), then lowest index. round_robin: rotate.
+        prefix_affinity: the prompt's rendezvous-preferred replica
+        first IF its effective headroom covers the request's worst-case
+        `demand` blocks, then the free_blocks order — affinity steers,
+        headroom decides."""
         if self.config.balance == "round_robin":
             n = len(self.replicas)
             return sorted(candidates,
@@ -328,7 +352,58 @@ class ReplicaSet:
             return (info["free_blocks"] - info["block_demand"],
                     -info["prefill_cost"], -rep.index)
 
-        return sorted(candidates, key=score, reverse=True)
+        by_headroom = sorted(candidates, key=score, reverse=True)
+        if self.config.balance == "prefix_affinity" \
+                and prompt_ids is not None:
+            key = self._affinity_key(prompt_ids)
+            if key is not None:
+                pref = max(candidates,
+                           key=lambda r: self._affinity_weight(key,
+                                                               r.index))
+                info = pref.load_info()
+                if info["free_blocks"] - info["block_demand"] >= demand:
+                    return [pref] + [r for r in by_headroom
+                                     if r is not pref]
+        return by_headroom
+
+    @holds_lock("_lock")
+    def _affinity_key(self, prompt_ids) -> Optional[tuple]:
+        """Routing key: the prompt's leading full blocks, capped at
+        affinity_prefix_blocks, mirroring what the engine-side prefix
+        trie can actually share (full-block granularity over the first
+        len-1 tokens). None when the prompt spans no full block — such
+        prompts carry nothing shareable and route purely on headroom."""
+        ups = [r for r in self.replicas if r.engine is not None]
+        if not ups:
+            return None
+        bs = ups[0].engine.cache.block_size
+        toks = [int(t) for t in
+                np.asarray(prompt_ids, np.int32).reshape(-1)]
+        nb = min(max(len(toks) - 1, 0) // bs,
+                 self.config.affinity_prefix_blocks)
+        if nb <= 0:
+            return None
+        return tuple(toks[:nb * bs])
+
+    @staticmethod
+    def _affinity_weight(key: tuple, index: int) -> int:
+        """Highest-random-weight (rendezvous) hash: every router ranks
+        (key, replica) identically, keys spread uniformly, and removing
+        a replica only remaps the keys it owned — failover moves a
+        template's traffic to ONE deterministic survivor instead of
+        scattering it."""
+        h = hashlib.sha256(repr((key, index)).encode()).digest()
+        return int.from_bytes(h[:8], "big")
+
+    @holds_lock("_lock")
+    def _worst_demand(self, n_tokens: int, ups: List[EngineReplica]
+                      ) -> int:
+        """Worst-case block footprint of a request (prompt + full
+        max_tokens budget), in the fleet's common block geometry — the
+        headroom bar a prefix-affinity preferred replica must clear."""
+        eng = next((r.engine for r in ups if r.engine is not None), None)
+        return eng.cache.blocks_needed(n_tokens) if eng is not None \
+            else 0
 
     @holds_lock("_lock")
     def _shed_globally_oldest(self, ups: List[EngineReplica]) -> None:
@@ -475,7 +550,15 @@ class ReplicaSet:
             if not ups:
                 remaining.append(rec)
                 continue
-            target = self._rank(ups)[0]
+            # affinity-aware re-admission: the rendezvous key re-ranks
+            # over the SURVIVOR set, so a dead replica's template
+            # traffic converges on one deterministic survivor and
+            # rebuilds its prefix working set there once
+            target = self._rank(
+                ups, prompt_ids=rec.prompt_ids,
+                demand=self._worst_demand(
+                    rec.prompt_ids.size + rec.params.max_tokens,
+                    ups))[0]
             try:
                 target.dispatch(rec.prompt_ids, rec.params,
                                 rec.request_id,
@@ -516,6 +599,31 @@ class ReplicaSet:
         slot holds no engine (DOWN/FAILED) audit as None — their pools
         are unreachable."""
         return {r.index: r.check_integrity() for r in self.replicas}
+
+    def prefix_stats(self) -> dict:
+        """Fleet-level prefix-cache telemetry: per-replica snapshots
+        plus the aggregate hit rate the 3-replica affinity SLO gates on
+        (cached tokens / prompt tokens summed across LIVE replicas —
+        dead replicas' counters died with their engines)."""
+        with self._lock:
+            per = {}
+            agg = {"hits": 0, "misses": 0, "evictions": 0,
+                   "cow_forks": 0, "cached_tokens_total": 0,
+                   "prompt_tokens_total": 0}
+            for r in self.replicas:
+                eng = r.engine
+                if eng is None:
+                    per[r.index] = None
+                    continue
+                ps = eng.cache.prefix_stats()
+                per[r.index] = ps
+                for k in agg:
+                    agg[k] += ps[k]
+            total = agg["prompt_tokens_total"]
+            agg["cached_tokens_ratio"] = \
+                agg["cached_tokens_total"] / total if total else 0.0
+            agg["replicas"] = per
+            return agg
 
     def states(self) -> dict:
         return {r.index: r.state for r in self.replicas}

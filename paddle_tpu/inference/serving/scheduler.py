@@ -345,7 +345,8 @@ class Scheduler:
             for req in self.running:
                 if req.request_id == request_id:
                     self.running.remove(req)
-                    self.cache.free(request_id)
+                    self.cache.free(request_id,
+                                    cache_tokens=self._cache_tokens(req))
                     req.state = RequestState.CANCELLED
                     return True
             return False
@@ -393,6 +394,23 @@ class Scheduler:
                     and (now - r.arrival_time) > r.params.deadline_s]
 
     # ---------------------------------------------------------- scheduling
+    def _cache_tokens(self, req: Request):
+        """Tokens whose KV the sequence has actually WRITTEN — what a
+        release may index into the prefix trie (docs/serving.md "Prefix
+        caching"). Mid-prefill that is the committed prefill_pos; after
+        prefill it is everything except the last sampled token, which
+        is emitted but never fed back (its KV slot is only written by
+        the step that would have sampled its successor). None when the
+        prefix cache is off."""
+        if self.cache.prefix_index is None:
+            return None
+        toks = req.all_token_ids()
+        if req.pf_target and req.prefill_pos < req.pf_target:
+            valid = req.prefill_pos
+        else:
+            valid = len(req.prompt_ids) + max(0, len(req.output_ids) - 1)
+        return toks[:valid]
+
     @holds_lock("_lock")
     def _requeue(self, req: Request):
         """Arrival-ordered insert into the waiting queue. Preemption and
@@ -420,7 +438,11 @@ class Scheduler:
         self.running.remove(victim)
         if victim in batch.decode:
             batch.decode.remove(victim)
-        self.cache.free(victim.request_id)
+        # the victim's written KV stays matchable: its re-admission (or
+        # any template sibling) re-attaches the cached blocks instead
+        # of re-prefilling from token zero
+        self.cache.free(victim.request_id,
+                        cache_tokens=self._cache_tokens(victim))
         victim.num_preemptions += 1
         self.num_preemptions += 1
         self._requeue(victim)
@@ -492,37 +514,51 @@ class Scheduler:
                 < self.config.max_num_seqs:
             req = self.waiting[0]
             tokens = req.all_token_ids()
+            # prefix caching: probe the longest cached prefix first —
+            # a hit is admitted CHUNKED regardless of length (the
+            # chunked path writes only uncached suffix positions, so
+            # shared blocks are never touched; dense write_prefill
+            # would scatter the WHOLE table), and admission is priced
+            # on the uncached tokens only: a fully-templated prompt
+            # admits at near-zero cost
+            cached_probe = self.cache.match_len(tokens)
+            uncached = len(tokens) - cached_probe
             # chunked prefill: a long prompt is admitted with an empty
             # table and fed to the fused decode scan k tokens per step —
             # it is priced (and block-checked) per chunk, not per prompt
-            chunked = thr is not None and len(tokens) > thr
-            eff = min(chunk, len(tokens)) if chunked else len(tokens)
+            chunked = (thr is not None and len(tokens) > thr) \
+                or cached_probe > 0
+            eff = min(chunk, uncached) if chunked else len(tokens)
             price = cost_model.cost(eff) if cost_model else eff
             if price > budget and admitted:
                 break                        # budget spent; next step
             needed = self.cache.blocks_needed(eff)
-            if (self.cache.num_used() + needed) > mark * self.cache.num_blocks \
+            used = self.cache.num_used() - self.cache.num_evictable()
+            if (used + needed) > mark * self.cache.num_blocks \
                     and self.running:
                 # above the watermark with live decodes: hold admission
-                # so their growth can't hit CacheExhausted. With nothing
-                # running there is nothing to strand — admit (the head
-                # alone may legitimately exceed the watermark).
+                # so their growth can't hit CacheExhausted (evictable
+                # cached blocks count as headroom — they reclaim on
+                # demand). With nothing running there is nothing to
+                # strand — admit (the head alone may legitimately
+                # exceed the watermark).
                 self.watermark_holds += 1
                 break
             if chunked:
                 remaining = max(0, req.params.max_tokens
                                 - len(req.output_ids))
                 try:
-                    self.cache.allocate(req.request_id, 0)
+                    got = self.cache.allocate_with_prefix(
+                        req.request_id, tokens)
                     req.slot = self.cache.reserve_slots(
                         req.request_id,
-                        min(chunk, len(tokens) + remaining))
+                        min(chunk, (len(tokens) - got) + remaining))
                 except CacheExhausted:
                     if self.cache.has_seq(req.request_id):
                         self.cache.free(req.request_id)
                     break                    # never preempt to admit
                 req.pf_target = len(tokens)
-                req.prefill_pos = 0
+                req.prefill_pos = got
                 self.waiting.popleft()
                 req.state = RequestState.RUNNING
                 self.running.append(req)
@@ -534,6 +570,7 @@ class Scheduler:
                     self.cache.allocate(req.request_id, len(tokens))
                 except CacheExhausted:
                     break                    # never preempt to admit
+                self.cache.note_prefix_miss(len(tokens))
                 self.waiting.popleft()
                 req.state = RequestState.RUNNING
                 self.running.append(req)
@@ -550,6 +587,8 @@ class Scheduler:
         requeue_for_recovery)."""
         with self._lock:
             self.running.remove(req)
-            self.cache.free(req.request_id, scrub=scrub)
+            self.cache.free(
+                req.request_id, scrub=scrub,
+                cache_tokens=None if scrub else self._cache_tokens(req))
             req.slot = None
             req.state = state

@@ -38,6 +38,15 @@ engines mid-traffic, and the audit gates widen to the router's promises
 
     JAX_PLATFORMS=cpu python tools/chaos_serve.py --replicas 3 \
         --faults "kill_replica@6:1,nan_logits@10,stall@12:0.05"
+
+`--prefix-cache` reruns either harness on TEMPLATED prompts with
+radix-trie block sharing enabled (docs/serving.md "Prefix caching") —
+multi-replica mode additionally routes by prefix affinity so the
+scheduled kill lands on the replica holding the shared blocks
+mid-decode. All of the gates above must hold with refcounted sharing
+active (scrub-frees taint instead of scrubbing blocks siblings still
+hold; failover re-admission neither double-frees nor double-counts),
+and the run asserts it was non-vacuous: zero trie hits is a failure.
 """
 from __future__ import annotations
 
@@ -69,23 +78,43 @@ def _build_model(vocab=97, hidden=32, layers=2, heads=4, seq=48):
 
 def run_chaos(seed: int = 0, n_requests: int = 16,
               faults: str = DEFAULT_FAULTS, max_steps: int = 400,
-              cancel_every: int = 0) -> dict:
+              cancel_every: int = 0, prefix_cache: bool = False) -> dict:
     """One seeded chaos run; returns the audit report dict. Raises
     AssertionError on a lost request, a leaked block, or a survivor
-    whose tokens diverge from the unfaulted reference run."""
+    whose tokens diverge from the unfaulted reference run.
+    `prefix_cache=True` switches the workload to templated prompts and
+    enables radix-trie block sharing, so the same gates now also cover
+    refcounted shared blocks under faults: scrub-frees (cache_corrupt
+    recovery) must taint, not scrub, blocks other requests still hold,
+    and the audit's refcount/trie invariants must survive the churn.
+    The run asserts the sharing was non-vacuous (hits > 0)."""
     from paddle_tpu.inference.serving import (EngineConfig, LLMEngine,
                                               SamplingParams)
     from paddle_tpu.testing.faults import ServingFaultInjector
 
     model, cfg = _build_model()
     rng = np.random.RandomState(seed)
-    specs = [(rng.randint(0, cfg.vocab_size, (int(rng.randint(3, 9)),),
-                          dtype=np.int32),
-              int(rng.randint(4, 10))) for _ in range(n_requests)]
+    if prefix_cache:
+        # templated mix: 2 fixed 16-token templates (4 full blocks),
+        # unique 2..6-token suffixes — every other request shares a
+        # prefix with a live or recently-freed sibling
+        tpls = [rng.randint(0, cfg.vocab_size, (16,), dtype=np.int32)
+                for _ in range(2)]
+        specs = [(np.concatenate(
+                    [tpls[i % 2],
+                     rng.randint(0, cfg.vocab_size,
+                                 (int(rng.randint(2, 6)),),
+                                 dtype=np.int32)]),
+                  int(rng.randint(4, 10))) for i in range(n_requests)]
+    else:
+        specs = [(rng.randint(0, cfg.vocab_size,
+                              (int(rng.randint(3, 9)),), dtype=np.int32),
+                  int(rng.randint(4, 10))) for _ in range(n_requests)]
     ecfg = EngineConfig(block_size=4, num_blocks=64, max_num_seqs=4,
                         max_waiting=n_requests,
                         admission_policy="shed_oldest",
-                        cache_high_watermark=0.9)
+                        cache_high_watermark=0.9,
+                        enable_prefix_cache=prefix_cache)
 
     def drive(injector, do_cancel):
         eng = LLMEngine.from_model(model, ecfg, faults=injector)
@@ -144,10 +173,19 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
         "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
                 "reject_rate": round(unserved / max(n_requests, 1), 4)},
     }
+    if prefix_cache:
+        ps = eng.cache.prefix_stats()
+        report["prefix"] = {k: ps[k] for k in
+                           ("hits", "misses", "evictions", "cow_forks",
+                            "cached_tokens_total", "prompt_tokens_total",
+                            "shared_blocks", "evictable_blocks")}
+        assert ps["hits"] > 0, \
+            "prefix-cache chaos run was vacuous: zero trie hits"
     # 1. no lost requests: every id terminal
     lost = [i for i, r in rids.items() if not eng.get_request(r).finished]
     assert not lost, f"non-terminal requests after drain: {lost}"
-    # 2. zero leaked blocks
+    # 2. zero leaked blocks (with prefix_cache this also audits
+    #    refcount-vs-table drift, taint hygiene and trie structure)
     report["integrity"] = eng.cache.check_integrity()
     # 3. survivors (normal completions, not cancelled here or there)
     #    match the unfaulted run bitwise
@@ -173,11 +211,17 @@ DEFAULT_REPLICA_FAULTS = "kill_replica@6:1,nan_logits@10,stall@12:0.05"
 def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
                        replicas: int = 3,
                        faults: str = DEFAULT_REPLICA_FAULTS,
-                       max_steps: int = 4000) -> dict:
+                       max_steps: int = 4000,
+                       prefix_cache: bool = False) -> dict:
     """One seeded multi-replica chaos run (module docstring). Raises
     AssertionError on a lost request, a leaked block on any live
     replica, an untouched-replica token divergence, or a faulted
-    replica that fails to rejoin and serve again."""
+    replica that fails to rejoin and serve again. `prefix_cache=True`
+    runs templated traffic with trie sharing on and routes by prefix
+    affinity, so the kill lands on a replica holding SHARED blocks
+    mid-decode: failover re-admission must neither double-free nor
+    double-count them (the zero-lost + zero-leak gates now cover
+    refcounted sharing), and the run must record trie hits."""
     import time
 
     from paddle_tpu.inference.serving import (EngineConfig, ReplicaSet,
@@ -187,13 +231,27 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
 
     model, cfg = _build_model()
     rng = np.random.RandomState(seed)
-    specs = [(rng.randint(0, cfg.vocab_size, (int(rng.randint(3, 9)),),
-                          dtype=np.int32),
-              int(rng.randint(6, 12))) for _ in range(n_requests)]
+    if prefix_cache:
+        # templated mix (see run_chaos): with prefix-affinity routing
+        # each template's requests pile onto ONE replica, so the
+        # scheduled kill hits live shared-prefix decodes, not strays
+        tpls = [rng.randint(0, cfg.vocab_size, (16,), dtype=np.int32)
+                for _ in range(2)]
+        specs = [(np.concatenate(
+                    [tpls[i % 2],
+                     rng.randint(0, cfg.vocab_size,
+                                 (int(rng.randint(2, 6)),),
+                                 dtype=np.int32)]),
+                  int(rng.randint(6, 12))) for i in range(n_requests)]
+    else:
+        specs = [(rng.randint(0, cfg.vocab_size,
+                              (int(rng.randint(3, 9)),), dtype=np.int32),
+                  int(rng.randint(6, 12))) for _ in range(n_requests)]
     # decode_chunk_size=2 keeps requests in flight across many router
     # steps so mid-traffic faults land on live work
     ecfg = EngineConfig(block_size=4, num_blocks=32, max_num_seqs=4,
-                        decode_chunk_size=2)
+                        decode_chunk_size=2,
+                        enable_prefix_cache=prefix_cache)
 
     def router_config():
         # tight backoff so a killed replica's restart lands inside the
@@ -202,7 +260,9 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
         return RouterConfig(num_replicas=replicas,
                             heartbeat_timeout_s=0.02,
                             backoff_base=0.01, backoff_max=0.05,
-                            backoff_jitter=0.0)
+                            backoff_jitter=0.0,
+                            balance=("prefix_affinity" if prefix_cache
+                                     else "free_blocks"))
 
     def drive(injector):
         rs = ReplicaSet.from_model(model, router_config(),
@@ -261,6 +321,14 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
         "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
                 "reject_rate": round(unserved / max(n_requests, 1), 4)},
     }
+    if prefix_cache:
+        fps = rs.prefix_stats()
+        report["prefix"] = {k: fps[k] for k in
+                            ("hits", "misses", "evictions", "cow_forks",
+                             "cached_tokens_total",
+                             "prompt_tokens_total")}
+        assert fps["hits"] > 0, \
+            "prefix-cache replica chaos run was vacuous: zero trie hits"
     # 1. no lost requests: every id terminal
     lost = [i for i, r in rids.items()
             if not rs.get_request(r).finished]
@@ -330,6 +398,12 @@ def main(argv=None) -> int:
                     help="ServingFaultInjector spec (see testing/faults.py)")
     ap.add_argument("--cancel-every", type=int, default=0,
                     help="cancel a random live request every N steps")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="templated workload with radix-trie prefix "
+                         "caching on (multi-replica mode also routes "
+                         "by prefix affinity): the zero-lost/zero-leak "
+                         "gates must hold with refcounted shared "
+                         "blocks, and the run must record trie hits")
     ap.add_argument("--max-steps", type=int, default=400)
     ap.add_argument("--snapshot", metavar="PATH",
                     default=os.path.join(tempfile.gettempdir(),
@@ -350,14 +424,16 @@ def main(argv=None) -> int:
                 replicas=args.replicas,
                 faults=(args.faults if args.faults is not None
                         else DEFAULT_REPLICA_FAULTS),
-                max_steps=args.max_steps)
+                max_steps=args.max_steps,
+                prefix_cache=args.prefix_cache)
         else:
             report = run_chaos(
                 seed=args.seed, n_requests=args.requests,
                 faults=(args.faults if args.faults is not None
                         else DEFAULT_FAULTS),
                 max_steps=args.max_steps,
-                cancel_every=args.cancel_every)
+                cancel_every=args.cancel_every,
+                prefix_cache=args.prefix_cache)
     except AssertionError as e:
         print(f"CHAOS FAIL: {e}", file=sys.stderr)
         return 1

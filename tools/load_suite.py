@@ -60,6 +60,16 @@ Scenarios (docs/observability.md "Load suite"):
                  to MISS the gap SLO) — so the report attributes the
                  win every run.
 
+- prefix_heavy — templated traffic against the radix-trie prefix
+                 cache (docs/serving.md "Prefix caching"): leaders
+                 register 40-token templates, follower bursts re-use
+                 them and prefill only their unique suffixes. Runs the
+                 SAME workload reuse-on and reuse-off (reported as
+                 `no_cache_baseline`) and gates the TTFT-p50 speedup
+                 (>= 2x) plus the hit rate; a 3-replica pass behind
+                 `balance="prefix_affinity"` must retain >= 80% of the
+                 single-replica hit rate.
+
 Each scenario runs its full workload once unmeasured (compiles every
 prefill/decode bucket — TTFT must not include XLA compile time), then
 once measured on a fresh engine. `reject_rate` counts every submitted
@@ -89,7 +99,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
-             "decode_heavy", "replica_kill", "mixed_prefill_decode")
+             "decode_heavy", "replica_kill", "mixed_prefill_decode",
+             "prefix_heavy")
 
 #: per-scenario SLOs. Latency bounds are generous (CPU-smoke friendly)
 #: — the point is catching regressions in KIND (rejects where none are
@@ -134,6 +145,21 @@ SLOS = {
                              "max_ttft_p99_s": 10.0,
                              "max_reject_rate": 0.0,
                              "max_token_gap_p99_s": 0.25},
+    # prefix caching's contract (docs/serving.md "Prefix caching"):
+    # templated traffic re-prefills only its unique suffix. The
+    # scenario runs the SAME workload reuse-on (SLO-gated) and
+    # reuse-off (`no_cache_baseline`): with reuse off every follower
+    # re-pays the full template against the per-step prefill budget
+    # and queues behind its siblings, so the on/off TTFT-p50 ratio
+    # (`ttft_speedup`) measures the admission+prefill work the trie
+    # deletes — pinned at >= 2x. The 3-replica run behind
+    # balance="prefix_affinity" must retain >= 80% of the
+    # single-replica hit rate (rendezvous hashing keeps each
+    # template's followers on the replica that cached it).
+    "prefix_heavy": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 8.0,
+                     "max_reject_rate": 0.0, "min_hit_rate": 0.5,
+                     "min_ttft_speedup": 2.0,
+                     "min_affinity_retention": 0.8},
 }
 
 CHAOS_FAULTS = "nan_logits@6,stall@9:0.05,cache_corrupt@12"
@@ -228,6 +254,32 @@ def _arrivals(name: str, n: int, vocab: int, seed: int):
             arr.append((3 + 2 * j,
                         rng.randint(1, vocab, (plen,), dtype=np.int32),
                         int(rng.randint(4, 8))))
+    elif name == "prefix_heavy":
+        # templated traffic: 3 fixed 40-token templates (10 full
+        # blocks), each request = template + unique 2..6-token suffix.
+        # Leaders arrive first and register their blocks as they
+        # prefill; followers then land in bursts and match the trie.
+        # The prefill budget is deliberately TIGHT (64 tokens/step vs
+        # ~44-token prompts): with reuse off, one follower admits per
+        # step and the bursts queue; with reuse on, a follower is
+        # priced at its uncached suffix, so whole bursts admit at
+        # once — the mechanism behind the min_ttft_speedup SLO.
+        ecfg.enable_prefix_cache = True
+        ecfg.max_num_seqs = 8
+        ecfg.max_prefill_tokens = 64
+        ecfg.num_blocks = 160
+        ecfg.decode_chunk_size = 4
+        n = max(n, 15)                   # >= 12 followers, 2 bursts
+        templates = [rng.randint(1, vocab, (40,), dtype=np.int32)
+                     for _ in range(3)]
+        for t in range(3):               # leaders: one per template
+            arr.append((2 * t,
+                        np.concatenate([templates[t], prompt(2, 6)]),
+                        int(rng.randint(4, 8))))
+        for i in range(n - 3):           # follower bursts of 6
+            arr.append((8 + 2 * (i // 6),
+                        np.concatenate([templates[i % 3], prompt(2, 6)]),
+                        int(rng.randint(4, 8))))
     else:
         raise ValueError(f"unknown scenario {name!r}; "
                          f"choose from {SCENARIOS}")
@@ -269,11 +321,13 @@ def _drive(model, ecfg, arrivals, faults: str = "", max_steps=4000):
 
 
 def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
-                  faults: str = "", max_steps=6000):
-    """replica_kill driver: the same arrival clock as _drive, but the
-    workload flows through a ReplicaSet and the fault schedule targets
-    whole replicas. Returns (router, request_ids, submitted, rejected,
-    wall_seconds)."""
+                  faults: str = "", max_steps=6000,
+                  balance: str = "free_blocks",
+                  obs_label: str = "load-replica-kill"):
+    """replica_kill / prefix_heavy fleet driver: the same arrival clock
+    as _drive, but the workload flows through a ReplicaSet (and for
+    replica_kill the fault schedule targets whole replicas). Returns
+    (router, request_ids, submitted, rejected, wall_seconds)."""
     from paddle_tpu.inference.serving import (ReplicaSet, RouterConfig,
                                               SamplingParams)
     from paddle_tpu.inference.serving.scheduler import EngineOverloaded
@@ -281,8 +335,8 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
 
     rc = RouterConfig(num_replicas=replicas, heartbeat_timeout_s=0.02,
                       backoff_base=0.01, backoff_max=0.05,
-                      backoff_jitter=0.0,
-                      obs_label="load-replica-kill")
+                      backoff_jitter=0.0, balance=balance,
+                      obs_label=obs_label)
     rs = ReplicaSet.from_model(model, rc, engine_config=ecfg,
                                faults=ServingFaultInjector(faults))
     queue = sorted(arrivals, key=lambda a: a[0])
@@ -400,6 +454,23 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
     if lost_max is not None and metrics["lost"] > lost_max:
         viol.append(f"lost {metrics['lost']} > {lost_max} "
                     "(failover dropped requests)")
+    hit_min = slo.get("min_hit_rate")
+    if hit_min is not None:
+        hr = metrics["prefix"]["hit_rate"]
+        if hr < hit_min:
+            viol.append(f"prefix hit_rate {hr} < {hit_min}")
+    sp_min = slo.get("min_ttft_speedup")
+    if sp_min is not None:
+        sp = metrics["ttft_speedup"]
+        if sp is None or sp < sp_min:
+            viol.append(f"ttft_speedup {sp} < {sp_min}x "
+                        "(reuse-on vs reuse-off)")
+    ret_min = slo.get("min_affinity_retention")
+    if ret_min is not None:
+        ret = metrics["affinity"]["retention"]
+        if ret is None or ret < ret_min:
+            viol.append(f"affinity retention {ret} < {ret_min} "
+                        "(3-replica vs single-replica hit rate)")
     return {"pass": not viol, "violations": viol, "thresholds": dict(slo)}
 
 
@@ -447,6 +518,60 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
             "ttft_p99": bm["ttft_p99"],
             "token_gap_p99": bm["token_gap_p99"],
             "slo_pass": _check_slo(bm, SLOS[name])["pass"],
+        }
+        m["slo"] = _check_slo(m, SLOS[name])
+        return m
+    if name == "prefix_heavy":
+        import dataclasses
+        # reuse ON (the SLO-gated default)
+        _drive(model, ecfg, arr)
+        eng, submitted, rejected, wall = _drive(model, ecfg, arr)
+        m = _metrics(eng, submitted, rejected, wall)
+        ps = eng.cache.prefix_stats()
+        lookups = ps["hits"] + ps["misses"]
+        hit_rate = ps["hits"] / lookups if lookups else 0.0
+        m["prefix"] = {
+            "hits": ps["hits"], "misses": ps["misses"],
+            "hit_rate": round(hit_rate, 4),
+            "cached_tokens_ratio": round(ps["cached_tokens_ratio"], 4),
+            "cow_forks": ps["cow_forks"],
+            "evictions": ps["evictions"],
+            "shared_blocks": ps["shared_blocks"],
+        }
+        # reuse OFF: same workload, sharing disabled — every follower
+        # re-prefills its full template against the same tight budget
+        ocfg = dataclasses.replace(ecfg, enable_prefix_cache=False,
+                                   obs_label=f"load-{name}-nocache")
+        _drive(model, ocfg, arr)
+        oeng, osub, orej, owall = _drive(model, ocfg, arr)
+        om = _metrics(oeng, osub, orej, owall)
+        m["no_cache_baseline"] = {
+            "tokens_per_sec": om["tokens_per_sec"],
+            "ttft_p50": om["ttft_p50"],
+            "ttft_p99": om["ttft_p99"],
+        }
+        on50, off50 = m["ttft_p50"], om["ttft_p50"]
+        m["ttft_speedup"] = round(off50 / on50, 2) \
+            if on50 and off50 else None
+        # 3-replica fleet behind prefix-affinity routing: each
+        # template's followers must land on the replica that cached it
+        _drive_router(model, ecfg, arr, balance="prefix_affinity",
+                      obs_label=f"load-{name}-fleet")
+        rs, rids, rsub, rrej, rwall = _drive_router(
+            model, ecfg, arr, balance="prefix_affinity",
+            obs_label=f"load-{name}-fleet")
+        fps = rs.prefix_stats()
+        flook = fps["hits"] + fps["misses"]
+        fleet_rate = fps["hits"] / flook if flook else 0.0
+        m["affinity"] = {
+            "replicas": REPLICA_COUNT,
+            "hit_rate": round(fleet_rate, 4),
+            "cached_tokens_ratio":
+                round(fps["cached_tokens_ratio"], 4),
+            "retention": round(fleet_rate / hit_rate, 4)
+            if hit_rate else None,
+            "lost": sum(1 for r in rids
+                        if not rs.get_request(r).finished),
         }
         m["slo"] = _check_slo(m, SLOS[name])
         return m
