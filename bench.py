@@ -793,7 +793,9 @@ def main():
         line["serve_decode_detail"] = sd_detail
         # standing multi-scenario load suite (tools/load_suite.py):
         # per-scenario {tokens_per_sec, ttft_p50, ttft_p99, reject_rate}
-        # + SLO verdicts, merged into the same BENCH_FULL line
+        # + SLO verdicts + the trace-derived TTFT decomposition (and on
+        # steady the pinned recorder-overhead A/B), merged into the
+        # same BENCH_FULL line
         import sys
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tools"))
@@ -806,6 +808,10 @@ def main():
                                          "ttft_p99", "reject_rate")}
                 | {"slo_pass": m["slo"]["pass"],
                    "slo_violations": m["slo"]["violations"]}
+                | {k: m[k] for k in ("ttft_decomposition",
+                                     "recorder_overhead_pct",
+                                     "recorder_overhead_noisy")
+                   if k in m}
                 for name, m in ls["scenarios"].items()},
         }
     ts = _STATIC_EST.get("train_step", {})

@@ -444,6 +444,7 @@ class LLMEngine:
         "_requests": "_lock",
         "_rngs": "_lock",
         "_next_id": "_lock",
+        "_next_trace": "_lock",
         "_pending_outputs": "_lock",
         "stats": "_lock",
         "_step_start": "_lock",
@@ -494,6 +495,7 @@ class LLMEngine:
         self._requests: Dict[str, Request] = {}
         self._rngs: Dict[str, np.random.RandomState] = {}
         self._next_id = 0
+        self._next_trace = 0
         self._pending_outputs: List[RequestOutput] = []
         self._step_start = 0.0
         if faults is None:
@@ -515,7 +517,8 @@ class LLMEngine:
     def add_request(self, prompt_ids, sampling: SamplingParams = None,
                     request_id: str = None, arrival_time: float = None,
                     arrival: int = None, resume_tokens=None,
-                    readmit: bool = False) -> str:
+                    readmit: bool = False,
+                    trace_id: str = None) -> str:
         """Queue one request. Raises EngineOverloaded when the bounded
         waiting queue is full under admission_policy='reject'; under
         'shed_oldest' the oldest waiting request is evicted instead
@@ -534,7 +537,11 @@ class LLMEngine:
         stream (sampling keys depend only on request progress) and
         max_tokens accounting never restarts. `readmit=True` inserts
         arrival-ordered and bypasses the max_waiting bound (backpressure
-        applies to new arrivals, not to recovered in-flight work)."""
+        applies to new arrivals, not to recovered in-flight work).
+        `trace_id` is the per-request causal-trace id (obs/reqtrace.py);
+        the router mints one and passes it through dispatch so a
+        failover hop stays ONE timeline — a standalone engine mints its
+        own (`tr-<engine-label>-N`)."""
         sampling = sampling or SamplingParams()
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
@@ -557,6 +564,10 @@ class LLMEngine:
                           else arrival_time)
             if arrival is not None:
                 req.arrival = arrival
+            if trace_id is None:
+                trace_id = f"tr-{self.stats.label}-{self._next_trace}"
+                self._next_trace += 1
+            req.trace_id = trace_id
             if resume_tokens is not None and len(resume_tokens):
                 req.output_ids = [int(t) for t in resume_tokens]
                 # TTFT was already observed on the replica that emitted
@@ -579,9 +590,16 @@ class LLMEngine:
                 self._pending_outputs.append(RequestOutput(
                     victim.request_id, None, list(victim.output_ids),
                     True, "shed"))
+                obs.reqtrace.record("finish", victim.tid,
+                                    victim.request_id, reason="shed")
             self._requests[request_id] = req
             self._rngs[request_id] = np.random.RandomState(
                 sampling.seed & 0x7FFFFFFF)
+            obs.reqtrace.record(
+                "engine_admit", req.tid, request_id,
+                engine=self.stats.label, arrival=req.arrival,
+                readmit=bool(readmit), resume=len(req.output_ids),
+                waiting=self.scheduler.num_waiting())
             return request_id
 
     def cancel(self, request_id: str) -> bool:
@@ -594,6 +612,8 @@ class LLMEngine:
                 self._pending_outputs.append(RequestOutput(
                     request_id, None, list(req.output_ids), True,
                     "cancelled"))
+                obs.reqtrace.record("finish", req.tid, request_id,
+                                    reason="cancelled")
             return ok
 
     def has_unfinished(self) -> bool:
@@ -619,6 +639,8 @@ class LLMEngine:
             self._pending_outputs.append(RequestOutput(
                 victim.request_id, None, list(victim.output_ids),
                 True, "shed"))
+            obs.reqtrace.record("finish", victim.tid, victim.request_id,
+                                reason="shed")
             return victim.request_id
 
     def oldest_waiting_arrival(self) -> Optional[int]:
@@ -667,6 +689,8 @@ class LLMEngine:
             # first token (tests/test_observability.py pins once-ness);
             # ttft_sum below stays the completed-only accumulator
             self.stats.observe_ttft(now - req.arrival_time)
+            obs.reqtrace.record("first_token", req.tid, req.request_id,
+                                ttft_s=now - req.arrival_time)
         else:
             # per-token latency: gap since this request's previous token
             self.stats.observe_token_gap(now - req.last_token_time)
@@ -688,6 +712,9 @@ class LLMEngine:
             self.stats.ttft_sum += req.first_token_time - req.arrival_time
             self.stats.latency_sum += now - req.arrival_time
             self.stats.observe_latency(now - req.arrival_time)
+            obs.reqtrace.record("finish", req.tid, req.request_id,
+                                reason=reason,
+                                tokens=len(req.output_ids))
         outs.append(RequestOutput(req.request_id, tok,
                                   list(req.output_ids), finished, reason))
 
@@ -703,6 +730,8 @@ class LLMEngine:
         req.finish_time = time.perf_counter()
         outs.append(RequestOutput(req.request_id, None,
                                   list(req.output_ids), True, reason))
+        obs.reqtrace.record("finish", req.tid, req.request_id,
+                            reason=reason, tokens=len(req.output_ids))
 
     @holds_lock("_lock")
     def _expire_and_abort(self, outs: List[RequestOutput]):
@@ -716,6 +745,8 @@ class LLMEngine:
             outs.append(RequestOutput(req.request_id, None,
                                       list(req.output_ids), True,
                                       "timeout"))
+            obs.reqtrace.record("finish", req.tid, req.request_id,
+                                reason="timeout")
         for req in self.scheduler.overdue_running(now):
             self.stats.timeouts += 1
             self._finish_abnormal(req, RequestState.FINISHED_TIMEOUT,
@@ -738,8 +769,16 @@ class LLMEngine:
         """One poisoned/wedged request costs one request: error-terminal,
         blocks scrubbed (NaN survives the attention mask) and freed."""
         self.stats.errors += 1
+        obs.reqtrace.record("quarantine", req.tid, req.request_id,
+                            why=why, engine=self.stats.label)
         self._finish_abnormal(req, RequestState.FINISHED_ERROR, "error",
                               outs, scrub=True)
+        # flight recorder: a quarantine is a postmortem trigger — when
+        # armed, ship the victim's full timeline + registry snapshot
+        obs.reqtrace.maybe_flight(
+            "quarantine", [req.tid],
+            extra={"why": why, "engine": self.stats.label,
+                   "request_id": req.request_id})
 
     @holds_lock("_lock")
     def _recover(self, decode: List[Request], offenders: List[Request],
@@ -814,6 +853,8 @@ class LLMEngine:
                     self._quarantine(req, outs,
                                      "non-finite prefill logits")
                     continue
+                obs.reqtrace.record("prefill", req.tid, req.request_id,
+                                    tokens=int(tokens.size))
                 self._emit(req, self._sample(req, logits), outs)
                 if not req.finished and self._wedged():
                     # prefill attribution is exact: the request whose
@@ -867,11 +908,24 @@ class LLMEngine:
                         # _emit re-derives eos/max_tokens terminals on
                         # host — the same conditions the device froze on
                         # — so telemetry and finish_reason stay exact.
+                        emitted: Dict[str, int] = {}
                         for j in range(toks.shape[0]):
                             for i, req in enumerate(decode):
                                 t = int(toks[j, i])
                                 if t >= 0 and not req.finished:
                                     self._emit(req, t, outs)
+                                    emitted[req.request_id] = \
+                                        emitted.get(req.request_id, 0) + 1
+                        # chunk-boundary trace events: tokens emitted
+                        # per row + the finish latch (host values only)
+                        for req in decode:
+                            n_emit = emitted.get(req.request_id, 0)
+                            if n_emit:
+                                obs.reqtrace.record(
+                                    "decode_chunk", req.tid,
+                                    req.request_id, n=n_emit,
+                                    total=len(req.output_ids),
+                                    finished=req.finished)
             step_ev.args = {"step": step_no, "outputs": len(outs),
                             "errors": self.stats.errors,
                             "expired": self.stats.expired,
@@ -976,6 +1030,9 @@ class LLMEngine:
             # progress only commits on a clean fetch
             for req, f in fed:
                 req.prefill_pos += f
+                obs.reqtrace.record(
+                    "prefill_chunk", req.tid, req.request_id, fed=f,
+                    pos=req.prefill_pos, target=req.pf_target)
                 if self.cache.prefix_index is not None:
                     # committed prefill progress is valid KV: index the
                     # newly completed full blocks so concurrent template

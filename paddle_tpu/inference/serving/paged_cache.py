@@ -458,6 +458,13 @@ class PagedKVCache:
             "trie_defects": idx.audit() if idx is not None else 0,
         }
         if any(report.values()):
+            # flight recorder (obs/reqtrace.py): an integrity violation
+            # is a postmortem trigger — when armed, ship the full ring
+            # + registry snapshot before raising. Lazy import keeps the
+            # cache importable without the obs package loaded first.
+            from ...obs import reqtrace
+            reqtrace.maybe_flight("check_integrity",
+                                  extra={"report": dict(report)})
             raise RuntimeError(f"paged cache integrity violated: {report} "
                                f"(tables={len(self._tables)}, "
                                f"cached={len(cached)}, "

@@ -136,10 +136,12 @@ class EngineReplica:
     # ------------------------------------------------------------ intake
     def dispatch(self, prompt_ids, sampling, request_id,
                  arrival_time=None, arrival=None, resume_tokens=None,
-                 readmit: bool = False):
+                 readmit: bool = False, trace_id=None):
         """Admit one request to this replica's engine (router-only
         entry; the dispatch beats the heartbeat so an idle replica's
-        clock starts when work lands). Returns the engine-stamped
+        clock starts when work lands). `trace_id` rides through to the
+        engine so the router-minted causal timeline (obs/reqtrace.py)
+        survives the hop. Returns the engine-stamped
         (arrival ticket, arrival_time)."""
         with self._lock:
             self.engine.add_request(prompt_ids, sampling,
@@ -147,7 +149,8 @@ class EngineReplica:
                                     arrival_time=arrival_time,
                                     arrival=arrival,
                                     resume_tokens=resume_tokens,
-                                    readmit=readmit)
+                                    readmit=readmit,
+                                    trace_id=trace_id)
             self.last_beat = time.monotonic()
             req = self.engine.get_request(request_id)
             return req.arrival, req.arrival_time

@@ -139,6 +139,11 @@ class RouterRequest:
     finish_reason: Optional[str] = None
     requeues: int = 0                    # failover re-admissions
     first_token_time: Optional[float] = None
+    # causal tracing (obs/reqtrace.py): the stable trace id minted at
+    # router admission, and the replica a failover orphaned it from —
+    # the re-admission event names its predecessor with it
+    trace_id: str = ""
+    prev_replica: Optional[int] = None
 
 
 class ReplicaSet:
@@ -148,6 +153,8 @@ class ReplicaSet:
     _GUARDED_BY = {
         "_requests": "_lock",
         "_next_id": "_lock",
+        "_next_trace": "_lock",
+        "_readmit_seq": "_lock",
         "_rr_next": "_lock",
         "_orphans": "_lock",
         "_pending": "_lock",
@@ -200,6 +207,8 @@ class ReplicaSet:
         self._lock = threading.RLock()
         self._requests: Dict[str, RouterRequest] = {}
         self._next_id = 0
+        self._next_trace = 0              # trace-id mint (reqtrace)
+        self._readmit_seq = 0             # failover re-admission batches
         self._rr_next = 0                 # round_robin cursor
         self._orphans: List[RouterRequest] = []
         self._pending: List[RequestOutput] = []
@@ -282,6 +291,8 @@ class ReplicaSet:
                             retry_after_s=self._retry_after())
                     self._shed_globally_oldest(ups)
             ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+            trace_id = f"tr-{self.label}-{self._next_trace}"
+            self._next_trace += 1
             last_exc = None
             for rep in self._rank(ups, prompt_ids=ids,
                                   demand=self._worst_demand(
@@ -289,7 +300,8 @@ class ReplicaSet:
                                       ups)):
                 try:
                     arrival, arrival_time = rep.dispatch(
-                        prompt_ids, sampling, request_id)
+                        prompt_ids, sampling, request_id,
+                        trace_id=trace_id)
                 except EngineOverloaded as e:
                     last_exc = e          # per-replica bound; try next
                     continue
@@ -297,7 +309,17 @@ class ReplicaSet:
                 self._requests[request_id] = RouterRequest(
                     request_id=request_id, prompt_ids=ids,
                     params=sampling, arrival_time=arrival_time,
-                    arrival=arrival, replica=rep.index)
+                    arrival=arrival, replica=rep.index,
+                    trace_id=trace_id)
+                # balance decision, recorded with the chosen replica's
+                # post-dispatch headroom (host-side load snapshot)
+                info = rep.load_info()
+                obs.reqtrace.record(
+                    "admitted", trace_id, request_id,
+                    router=self.label, replica=rep.index,
+                    policy=self.config.balance,
+                    headroom=info["free_blocks"] - info["block_demand"],
+                    waiting=info["waiting"])
                 return request_id
             # every up replica refused at ITS bound: surface overload
             # with the strongest hint we have
@@ -318,7 +340,9 @@ class ReplicaSet:
                 return True
             ok = self.replicas[rec.replica].cancel(request_id)
             if ok:
-                self._terminal(rec, "cancelled")
+                # the engine's cancel already recorded the terminal
+                # trace event; don't double-record it router-side
+                self._terminal(rec, "cancelled", record=False)
             return ok
 
     def get_request(self, request_id: str) -> RouterRequest:
@@ -497,14 +521,20 @@ class ReplicaSet:
             outs.append(o)
 
     @holds_lock("_lock")
-    def _terminal(self, rec: RouterRequest, reason: str) -> None:
+    def _terminal(self, rec: RouterRequest, reason: str,
+                  record: bool = True) -> None:
         """Router-side terminal (cancel of an orphan, orphans with no
         fleet left): synthesize the terminal output the engines would
-        have streamed."""
+        have streamed. `record=False` when an engine already emitted
+        the terminal trace event (exactly-one-terminal invariant)."""
         rec.finished = True
         rec.finish_reason = reason
         self._pending.append(RequestOutput(
             rec.request_id, None, list(rec.tokens), True, reason))
+        if record:
+            obs.reqtrace.record("finish", rec.trace_id or rec.request_id,
+                                rec.request_id, reason=reason,
+                                tokens=len(rec.tokens))
 
     # ----------------------------------------------------------- failover
     @holds_lock("_lock")
@@ -524,12 +554,26 @@ class ReplicaSet:
              if not rec.finished and rec.replica == rep.index),
             key=lambda rec: rec.arrival)
         for rec in victims:
+            rec.prev_replica = rep.index
             rec.replica = None
             rec.requeues += 1
             self._c_requeued.inc()
+            obs.reqtrace.record(
+                "failover", rec.trace_id or rec.request_id,
+                rec.request_id, replica=rep.index, reason=reason,
+                arrival=rec.arrival, tokens_streamed=len(rec.tokens))
         self._orphans.extend(victims)
         self._orphans.sort(key=lambda rec: rec.arrival)
         self._readmit_orphans(outs)
+        # flight recorder: a failover is a postmortem trigger — when
+        # armed, dump the victims' timelines (incl. the re-admission
+        # hops just recorded) plus the registry snapshot
+        obs.reqtrace.maybe_flight(
+            "failover",
+            [rec.trace_id or rec.request_id for rec in victims],
+            extra={"router": self.label, "replica": rep.index,
+                   "reason": reason, "detail": detail,
+                   "victims": [rec.request_id for rec in victims]})
 
     @holds_lock("_lock")
     def _readmit_orphans(self, outs) -> None:
@@ -545,6 +589,8 @@ class ReplicaSet:
             self._orphans.clear()
             return
         remaining: List[RouterRequest] = []
+        self._readmit_seq += 1
+        batch_id = self._readmit_seq
         for rec in self._orphans:
             ups = [r for r in self.replicas if r.accepts_admissions()]
             if not ups:
@@ -564,13 +610,20 @@ class ReplicaSet:
                                 rec.request_id,
                                 arrival_time=rec.arrival_time,
                                 arrival=rec.arrival,
-                                resume_tokens=rec.tokens, readmit=True)
+                                resume_tokens=rec.tokens, readmit=True,
+                                trace_id=rec.trace_id or None)
             except ValueError:
                 # can never fit the survivor's pool — terminal, loud
                 self._terminal(rec, "error")
                 outs.append(self._pending.pop())
                 continue
             rec.replica = target.index
+            obs.reqtrace.record(
+                "readmit", rec.trace_id or rec.request_id,
+                rec.request_id, to_replica=target.index,
+                from_replica=rec.prev_replica, arrival=rec.arrival,
+                resume=len(rec.tokens), requeues=rec.requeues,
+                batch=batch_id)
         self._orphans[:] = remaining
 
     @holds_lock("_lock")

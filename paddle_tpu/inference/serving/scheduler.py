@@ -51,6 +51,7 @@ from typing import List, Optional
 import numpy as np
 
 from ...analysis import holds_lock
+from ...obs import reqtrace
 from .paged_cache import CacheExhausted, PagedKVCache
 
 __all__ = ["EngineOverloaded", "SamplingParams", "Request", "RequestState",
@@ -142,6 +143,16 @@ class Request:
     # discipline: re-admission re-prefills from the token log).
     pf_target: int = 0
     prefill_pos: int = 0
+    # per-request causal tracing (obs/reqtrace.py): stable id minted at
+    # admission (router for fleet runs, engine for standalone) that
+    # survives preemption, requeue, and cross-engine failover
+    trace_id: Optional[str] = None
+
+    @property
+    def tid(self) -> str:
+        """Trace id for reqtrace events (request_id for bare Requests
+        built directly in tests)."""
+        return self.trace_id or self.request_id
 
     def all_token_ids(self) -> np.ndarray:
         """prompt + generated — the effective prompt after preemption."""
@@ -447,6 +458,10 @@ class Scheduler:
         self.num_preemptions += 1
         self._requeue(victim)
         batch.preempted.append(victim)
+        reqtrace.record("preempt", victim.tid, victim.request_id,
+                        arrival=victim.arrival,
+                        num_preemptions=victim.num_preemptions,
+                        tokens_kept=len(victim.output_ids))
 
     def requeue_for_recovery(self, req: Request):
         """Crash-recovery rebuild: drop the (possibly tainted) cache
@@ -460,6 +475,9 @@ class Scheduler:
             self.running.remove(req)
             self.cache.free(req.request_id, scrub=True)
             self._requeue(req)
+            reqtrace.record("requeue", req.tid, req.request_id,
+                            reason="recovery", arrival=req.arrival,
+                            tokens_kept=len(req.output_ids))
 
     def schedule(self) -> ScheduledBatch:
         with self._lock:
@@ -565,6 +583,17 @@ class Scheduler:
                 # rides THIS step's fused decode dispatch: first chunk
                 # of prompt feed goes out alongside the decode slots
                 batch.decode.append(req)
+                if got:
+                    bs = self.cache.block_size
+                    reqtrace.record(
+                        "prefix_match", req.tid, req.request_id,
+                        cached_tokens=got, blocks=-(-got // bs),
+                        cow_fork=bool(got % bs), probe=cached_probe)
+                reqtrace.record(
+                    "scheduled", req.tid, req.request_id, mode="chunked",
+                    price=float(price), budget=float(budget),
+                    arrival=req.arrival, cached=got,
+                    target=req.pf_target)
             else:
                 try:
                     self.cache.allocate(req.request_id, len(tokens))
@@ -575,6 +604,10 @@ class Scheduler:
                 req.state = RequestState.RUNNING
                 self.running.append(req)
                 batch.prefill.append(req)
+                reqtrace.record(
+                    "scheduled", req.tid, req.request_id, mode="dense",
+                    price=float(price), budget=float(budget),
+                    arrival=req.arrival, tokens=len(tokens))
             admitted += 1
             budget -= price
         return batch

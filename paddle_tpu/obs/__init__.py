@@ -12,6 +12,12 @@ the framework (PR 6; docs/observability.md is the catalog):
   profiler's RecordEvent machinery (old API is a shim over this).
 - `export` — JSON snapshot, Prometheus text format and chrome trace,
   on demand or periodically from a daemon thread.
+- `reqtrace` — per-request causal event log (PR 13): a bounded ring of
+  host-side lifecycle events keyed by stable trace ids that survive
+  preemption, requeue and cross-engine failover, plus the armed flight
+  recorder that dumps postmortem JSON artifacts on quarantine /
+  failover / integrity failures. `tools/reqtrace.py` is the offline
+  timeline / TTFT-decomposition / causality-check CLI over its dumps.
 
 Importing this package pulls in stdlib + numpy only (no jax), so
 tools/ptlint.py-style offline tooling can read metrics definitions
@@ -20,11 +26,12 @@ the telemetry layer adds ZERO device syncs (PT-T007 clean).
 """
 from __future__ import annotations
 
-from . import export, registry, trace
+from . import export, registry, reqtrace, trace
 from .export import (SnapshotExporter, dump_snapshot, export_chrome_trace,
                      snapshot, to_prometheus)
 from .registry import (DEFAULT_BUCKETS, Counter, Family, Gauge, Histogram,
                        MetricRegistry, REGISTRY)
+from .reqtrace import ReqTraceRing, TraceEvent
 from .trace import CATEGORIES, Span, SpanEvent, span
 
 __all__ = [
@@ -33,6 +40,8 @@ __all__ = [
     "DEFAULT_BUCKETS", "counter", "gauge", "histogram",
     # trace
     "Span", "SpanEvent", "span", "CATEGORIES", "trace",
+    # reqtrace
+    "reqtrace", "ReqTraceRing", "TraceEvent",
     # export
     "snapshot", "dump_snapshot", "to_prometheus", "export_chrome_trace",
     "SnapshotExporter", "export", "registry",

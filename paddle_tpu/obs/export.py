@@ -96,11 +96,47 @@ def to_prometheus(registry: Optional[MetricRegistry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
-def export_chrome_trace(path: str) -> str:
+# Gauge families rendered as Perfetto counter tracks in the chrome
+# export: pool pressure (block occupancy) and scheduler depth next to
+# the span / per-request tracks.
+DEFAULT_COUNTER_FAMILIES = ("serving_cache_blocks", "serving_running",
+                            "serving_waiting")
+
+
+def _gauge_counter_events(registry: MetricRegistry, families) -> list:
+    """ph:"C" chrome counter events from the bounded gauge histories
+    (registry.Gauge.samples), clipped to the active trace window and
+    rebased to its t0 like every span event."""
+    import os as _os
+    t0 = _trace._TraceState.t0
+    pid = _os.getpid()
+    out = []
+    for name in families:
+        fam = registry.get(name)
+        if fam is None or fam.kind != "gauge":
+            continue
+        for lbls, child in fam.children():
+            track = name if not lbls else name + "{" + ",".join(
+                f"{k}={v}" for k, v in sorted(lbls.items())) + "}"
+            for ts, v in child.samples():
+                if ts < t0:
+                    continue             # sampled before trace enable()
+                out.append({"name": track, "ph": "C", "cat": "gauge",
+                            "ts": (ts - t0) * 1e6, "pid": pid, "tid": 0,
+                            "args": {"value": v}})
+    return out
+
+
+def export_chrome_trace(path: str,
+                        registry: Optional[MetricRegistry] = None,
+                        counter_families=DEFAULT_COUNTER_FAMILIES) -> str:
     """Chrome-trace JSON of the recorded spans (delegates to
     obs.trace.export_chrome; same file profiler.export_chrome_tracing
-    writes)."""
-    return _trace.export_chrome(path)
+    writes) plus ph:"C" counter tracks from the listed gauge families
+    (pass counter_families=() for the spans-only historical shape)."""
+    reg = registry if registry is not None else REGISTRY
+    extra = _gauge_counter_events(reg, counter_families or ())
+    return _trace.export_chrome(path, extra_events=extra)
 
 
 class SnapshotExporter:

@@ -35,6 +35,8 @@ anywhere).
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -77,31 +79,50 @@ class Counter:
             return self._value
 
 
-class Gauge:
-    """Point-in-time value child (queue depth, free blocks, tokens/s)."""
+#: bounded per-gauge (ts, value) history window backing the chrome-trace
+#: counter tracks (obs/export.py); host-cheap: one deque append per set
+GAUGE_HISTORY_CAP = 512
 
-    _GUARDED_BY = {"_value": "_lock"}
+
+class Gauge:
+    """Point-in-time value child (queue depth, free blocks, tokens/s).
+
+    Every mutation also appends a (perf_counter, value) sample to a
+    bounded history ring so the chrome-trace export can render gauge
+    families as Perfetto counter tracks (pool pressure, queue depth)
+    alongside the span and per-request tracks."""
+
+    _GUARDED_BY = {"_value": "_lock", "_history": "_lock"}
 
     def __init__(self, lock: threading.RLock):
         self._lock = lock
         self._value = 0.0
+        self._history: deque = deque(maxlen=GAUGE_HISTORY_CAP)
 
     def set(self, v: float) -> None:
         with self._lock:
             self._value = float(v)
+            self._history.append((time.perf_counter(), self._value))
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
             self._value += n
+            self._history.append((time.perf_counter(), self._value))
 
     def dec(self, n: float = 1.0) -> None:
         with self._lock:
             self._value -= n
+            self._history.append((time.perf_counter(), self._value))
 
     @property
     def value(self) -> float:
         with self._lock:
             return self._value
+
+    def samples(self) -> List[Tuple[float, float]]:
+        """The bounded (perf_counter ts, value) history window."""
+        with self._lock:
+            return list(self._history)
 
 
 def _norm_bounds(buckets: Sequence[float]) -> Tuple[float, ...]:

@@ -1,0 +1,523 @@
+"""Per-request causal tracing: a bounded, process-wide event ring.
+
+Every layer that touches a request appends host-side events keyed by a
+stable *trace id* minted at admission (router ``ReplicaSet.add_request``
+for fleet runs, ``LLMEngine.add_request`` for standalone engines). The
+trace id rides the existing dispatch/readmit plumbing, so one request is
+one causal timeline across N engine incarnations: admission → prefix
+match → scheduling (price/budget) → prefill chunks → decode chunks →
+preempt/requeue → failover hop → re-admission → terminal.
+
+Design constraints (same contract as the rest of ``paddle_tpu.obs``):
+
+- stdlib only, no jax at import time, zero device syncs — every event
+  records already-fetched host values;
+- one ring, one lock, bounded memory (``deque(maxlen=capacity)``);
+- recording is cheap enough to stay on by default: a disabled-flag
+  fast path, one lock acquire, one deque append.
+
+On top of the ring sits the **flight recorder**: when armed, quarantine
+/ failover / ``check_integrity`` failures automatically dump the
+relevant traces plus a metric-registry snapshot to a postmortem JSON
+artifact; harnesses (chaos_serve, load_suite) also dump explicitly on
+gate failures. ``tools/reqtrace.py`` reconstructs timelines from these
+dumps, renders chrome-trace tracks, computes the TTFT decomposition,
+and machine-checks causality invariants via the pure helpers at the
+bottom of this module (they operate on plain event dicts so the CLI can
+load them without importing jax).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = [
+    "EVENT_KINDS", "TERMINAL_REASONS", "TraceEvent", "ReqTraceRing",
+    "RING", "record", "events", "traces", "clear", "enable", "disable",
+    "is_enabled", "arm", "disarm", "flight_dump", "maybe_flight",
+    "dump_payload", "group_traces", "ttft_components",
+    "ttft_decomposition", "check_causality",
+]
+
+# Catalog of event kinds; ``record`` rejects anything else so the dump
+# schema stays closed and the postmortem tool can rely on it.
+EVENT_KINDS = (
+    "admitted",       # router admission: replica chosen, policy, score
+    "engine_admit",   # engine add_request: arrival ticket, readmit, resume
+    "prefix_match",   # prefix-cache hit: cached tokens, COW fork
+    "scheduled",      # waiting -> running: mode, price charged, budget
+    "prefill",        # dense prefill done (tokens fed)
+    "prefill_chunk",  # chunked-prefill progress (fed, pos, target)
+    "first_token",    # first emitted token (TTFT latch)
+    "decode_chunk",   # fused-chunk boundary: tokens emitted, finish latch
+    "preempt",        # preempted back to waiting (FCFS ticket preserved)
+    "requeue",        # recovery requeue after a discarded chunk
+    "quarantine",     # engine/replica quarantined (reason)
+    "failover",       # replica died holding the request (old replica)
+    "readmit",        # re-admitted on a survivor (new replica, resume len)
+    "finish",         # terminal: stop|length|cancelled|timeout|shed|error
+)
+_KIND_SET = frozenset(EVENT_KINDS)
+
+TERMINAL_REASONS = ("stop", "length", "cancelled", "timeout", "shed",
+                    "error")
+
+DEFAULT_CAPACITY = 65536
+
+
+class TraceEvent:
+    """One host-side event. ``ts`` is ``time.perf_counter()`` at record
+    time; ``seq`` is a ring-wide monotone counter that gives a total
+    order even when perf_counter ties."""
+
+    __slots__ = ("seq", "ts", "trace_id", "request_id", "kind", "attrs")
+
+    def __init__(self, seq: int, ts: float, trace_id: str,
+                 request_id: Optional[str], kind: str,
+                 attrs: Optional[Dict[str, Any]]):
+        self.seq = seq
+        self.ts = ts
+        self.trace_id = trace_id
+        self.request_id = request_id
+        self.kind = kind
+        self.attrs = attrs
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"seq": self.seq, "ts": self.ts, "trace_id": self.trace_id,
+                "request_id": self.request_id, "kind": self.kind,
+                "attrs": dict(self.attrs) if self.attrs else {}}
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"TraceEvent({self.seq}, {self.kind}, {self.trace_id}, "
+                f"{self.attrs})")
+
+
+class ReqTraceRing:
+    """Thread-safe bounded ring of :class:`TraceEvent` plus the armed
+    flight recorder. All mutable state is guarded by one lock."""
+
+    _GUARDED_BY = {
+        "_events": "_lock",
+        "_seq": "_lock",
+        "_flight_dir": "_lock",
+        "_flight_limit": "_lock",
+        "_flight_count": "_lock",
+        "_dumps": "_lock",
+    }
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self.enabled = True          # plain flag: racy reads are benign
+        self._lock = threading.RLock()
+        self._events: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._flight_dir: Optional[str] = None
+        self._flight_limit = 0
+        self._flight_count = 0
+        self._dumps: List[str] = []
+
+    # ------------------------------------------------------------------
+    # recording / reading
+    # ------------------------------------------------------------------
+    def record(self, kind: str, trace_id: str,
+               request_id: Optional[str] = None, **attrs) -> None:
+        if not self.enabled:
+            return
+        if kind not in _KIND_SET:
+            raise ValueError(f"unknown reqtrace event kind: {kind!r}")
+        ts = time.perf_counter()
+        with self._lock:
+            self._seq += 1
+            self._events.append(TraceEvent(
+                self._seq, ts, str(trace_id), request_id, kind,
+                attrs or None))
+
+    def events(self, trace_id: Optional[str] = None,
+               prefix: Optional[str] = None) -> List[TraceEvent]:
+        """Snapshot of events in seq order, optionally filtered to one
+        trace id or a trace-id prefix (e.g. one engine's traces)."""
+        with self._lock:
+            evts = list(self._events)
+        if trace_id is not None:
+            evts = [e for e in evts if e.trace_id == trace_id]
+        if prefix is not None:
+            evts = [e for e in evts if e.trace_id.startswith(prefix)]
+        return evts
+
+    def traces(self, prefix: Optional[str] = None
+               ) -> Dict[str, List[TraceEvent]]:
+        """trace_id → ordered events."""
+        out: Dict[str, List[TraceEvent]] = {}
+        for e in self.events(prefix=prefix):
+            out.setdefault(e.trace_id, []).append(e)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # ------------------------------------------------------------------
+    # flight recorder
+    # ------------------------------------------------------------------
+    def arm(self, directory: str, max_dumps: int = 4) -> None:
+        """Arm automatic postmortem dumps (quarantine / failover /
+        integrity failures call :meth:`maybe_flight`). ``max_dumps``
+        bounds artifact noise on chaos runs where faults are expected."""
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            self._flight_dir = directory
+            self._flight_limit = int(max_dumps)
+            self._flight_count = 0
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._flight_dir = None
+
+    def is_armed(self) -> bool:
+        with self._lock:
+            return self._flight_dir is not None
+
+    def dumps(self) -> List[str]:
+        """Paths of every flight artifact written so far."""
+        with self._lock:
+            return list(self._dumps)
+
+    def dump_payload(self, reason: str,
+                     trace_ids: Optional[Iterable[str]] = None,
+                     complete: bool = True,
+                     extra: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+        """Build the postmortem JSON payload: relevant traces plus a
+        metric-registry snapshot. ``complete=False`` marks an in-flight
+        dump (taken mid-run, e.g. at quarantine time) so the causality
+        checker tolerates traces without a terminal event."""
+        wanted = set(trace_ids) if trace_ids is not None else None
+        evts = [e.as_dict() for e in self.events()
+                if wanted is None or e.trace_id in wanted]
+        try:  # lazy import: avoids a package-init ordering cycle
+            from .export import snapshot as _registry_snapshot
+            registry = _registry_snapshot()
+        except Exception:  # pragma: no cover - registry must not block
+            registry = {}
+        payload = {
+            "version": 1,
+            "reason": reason,
+            "wall_time": time.time(),
+            "complete": bool(complete),
+            "trace_ids": sorted({e["trace_id"] for e in evts}),
+            "events": evts,
+            "registry": registry,
+        }
+        if extra:
+            payload["extra"] = extra
+        return payload
+
+    def flight_dump(self, reason: str,
+                    trace_ids: Optional[Iterable[str]] = None,
+                    path: Optional[str] = None,
+                    complete: bool = True,
+                    extra: Optional[Dict[str, Any]] = None
+                    ) -> Optional[str]:
+        """Write a postmortem artifact. With an explicit ``path`` the
+        dump always happens; otherwise it requires an armed recorder
+        (and respects its dump budget). Returns the path, or None."""
+        if path is None:
+            with self._lock:
+                if self._flight_dir is None:
+                    return None
+                if self._flight_count >= self._flight_limit:
+                    return None
+                self._flight_count += 1
+                n = self._flight_count
+                safe = "".join(c if c.isalnum() else "-" for c in reason)
+                path = os.path.join(self._flight_dir,
+                                    f"flightrec-{n:02d}-{safe}.json")
+        payload = self.dump_payload(reason, trace_ids=trace_ids,
+                                    complete=complete, extra=extra)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True, default=str)
+        os.replace(tmp, path)
+        with self._lock:
+            self._dumps.append(path)
+        return path
+
+    def maybe_flight(self, reason: str,
+                     trace_ids: Optional[Iterable[str]] = None,
+                     extra: Optional[Dict[str, Any]] = None
+                     ) -> Optional[str]:
+        """Auto-trigger hook used by the serving stack: dumps only when
+        armed, never raises into the caller's failure path."""
+        try:
+            return self.flight_dump(reason, trace_ids=trace_ids,
+                                    complete=False, extra=extra)
+        except Exception:  # pragma: no cover - recorder must not crash
+            return None
+
+
+# Process-wide ring, mirroring REGISTRY / the trace span log.
+RING = ReqTraceRing()
+
+
+def record(kind: str, trace_id: str, request_id: Optional[str] = None,
+           **attrs) -> None:
+    RING.record(kind, trace_id, request_id=request_id, **attrs)
+
+
+def events(trace_id: Optional[str] = None,
+           prefix: Optional[str] = None) -> List[TraceEvent]:
+    return RING.events(trace_id=trace_id, prefix=prefix)
+
+
+def traces(prefix: Optional[str] = None) -> Dict[str, List[TraceEvent]]:
+    return RING.traces(prefix=prefix)
+
+
+def clear() -> None:
+    RING.clear()
+
+
+def enable() -> None:
+    RING.enabled = True
+
+
+def disable() -> None:
+    RING.enabled = False
+
+
+def is_enabled() -> bool:
+    return RING.enabled
+
+
+def arm(directory: str, max_dumps: int = 4) -> None:
+    RING.arm(directory, max_dumps=max_dumps)
+
+
+def disarm() -> None:
+    RING.disarm()
+
+
+def flight_dump(reason: str, trace_ids: Optional[Iterable[str]] = None,
+                path: Optional[str] = None, complete: bool = True,
+                extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    return RING.flight_dump(reason, trace_ids=trace_ids, path=path,
+                            complete=complete, extra=extra)
+
+
+def maybe_flight(reason: str, trace_ids: Optional[Iterable[str]] = None,
+                 extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    return RING.maybe_flight(reason, trace_ids=trace_ids, extra=extra)
+
+
+def dump_payload(reason: str, trace_ids: Optional[Iterable[str]] = None,
+                 complete: bool = True) -> Dict[str, Any]:
+    return RING.dump_payload(reason, trace_ids=trace_ids,
+                             complete=complete)
+
+
+# ----------------------------------------------------------------------
+# Pure helpers over *plain event dicts* (the dump schema). These carry
+# the timeline / TTFT / causality logic shared between the live ring
+# (tools/load_suite.py) and the offline CLI (tools/reqtrace.py, which
+# imports this module without jax via the ptlint-style package path).
+# ----------------------------------------------------------------------
+def group_traces(event_dicts: Iterable[Dict[str, Any]]
+                 ) -> Dict[str, List[Dict[str, Any]]]:
+    """trace_id → events sorted by seq."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for e in event_dicts:
+        out.setdefault(e["trace_id"], []).append(e)
+    for evts in out.values():
+        evts.sort(key=lambda e: e["seq"])
+    return out
+
+
+def _prefill_done_ts(evts: List[Dict[str, Any]]) -> Optional[float]:
+    for e in evts:
+        if e["kind"] == "prefill":
+            return e["ts"]
+        if e["kind"] == "prefill_chunk":
+            a = e.get("attrs") or {}
+            if a.get("pos", 0) >= a.get("target", float("inf")):
+                return e["ts"]
+    return None
+
+
+def ttft_components(evts: List[Dict[str, Any]]
+                    ) -> Optional[Dict[str, float]]:
+    """TTFT decomposition for one trace: queue (engine admit → first
+    schedule), admission (router admit → engine admit), prefill
+    (schedule → prefill complete), first-decode-gap (prefill complete →
+    first token). Returns None for traces that never emitted."""
+    t_router = t_admit = t_sched = t_first = None
+    for e in evts:
+        k = e["kind"]
+        if k == "admitted" and t_router is None:
+            t_router = e["ts"]
+        elif k == "engine_admit" and t_admit is None:
+            t_admit = e["ts"]
+        elif k == "scheduled" and t_sched is None:
+            t_sched = e["ts"]
+        elif k == "first_token" and t_first is None:
+            t_first = e["ts"]
+    if t_admit is None or t_sched is None or t_first is None:
+        return None
+    t_pf = _prefill_done_ts(evts)
+    if t_pf is None or t_pf > t_first:
+        t_pf = t_first
+    return {
+        "admission_s": max(0.0, t_admit - t_router) if t_router else 0.0,
+        "queue_s": max(0.0, t_sched - t_admit),
+        "prefill_s": max(0.0, t_pf - t_sched),
+        "first_gap_s": max(0.0, t_first - t_pf),
+        "ttft_s": max(0.0, t_first - (t_router or t_admit)),
+    }
+
+
+def ttft_decomposition(event_dicts: Iterable[Dict[str, Any]]
+                       ) -> Dict[str, float]:
+    """Median per-component decomposition across every trace that
+    emitted at least one token."""
+    comps = [c for c in (ttft_components(evts)
+                         for evts in group_traces(event_dicts).values())
+             if c is not None]
+    if not comps:
+        return {}
+
+    def med(key: str) -> float:
+        vals = sorted(c[key] for c in comps)
+        return vals[len(vals) // 2]
+
+    return {"n": float(len(comps)),
+            "admission_s": med("admission_s"), "queue_s": med("queue_s"),
+            "prefill_s": med("prefill_s"),
+            "first_gap_s": med("first_gap_s"), "ttft_s": med("ttft_s")}
+
+
+def check_causality(dump: Dict[str, Any]) -> List[str]:
+    """Machine-check the causal invariants over a dump. Returns a list
+    of violation strings (empty == pass).
+
+    1. no token emission before (re-)prefill completes;
+    2. requeue preserves the FCFS arrival ticket, per-engine admission
+       stays FCFS among simultaneously-waiting requests, and failover
+       re-admission batches stay arrival-ordered;
+    3. exactly one terminal event per trace (at most one for in-flight
+       dumps marked ``complete: false``);
+    4. every failover hop references a real predecessor: a ``readmit``
+       must follow a ``failover`` in its trace and name the replica it
+       came from.
+    """
+    complete = bool(dump.get("complete", True))
+    violations: List[str] = []
+    by_trace = group_traces(dump.get("events", []))
+
+    # per-engine FCFS state: engine label -> {trace_id: arrival}
+    waiting: Dict[str, Dict[str, float]] = {}
+    engine_of: Dict[str, str] = {}
+    all_events = sorted((e for e in dump.get("events", [])),
+                        key=lambda e: e["seq"])
+    readmit_batches: Dict[Any, List[Dict[str, Any]]] = {}
+
+    for e in all_events:
+        tid, kind = e["trace_id"], e["kind"]
+        a = e.get("attrs") or {}
+        if kind == "engine_admit":
+            eng = a.get("engine", "?")
+            engine_of[tid] = eng
+            if "arrival" in a:
+                waiting.setdefault(eng, {})[tid] = a["arrival"]
+        elif kind in ("preempt", "requeue"):
+            eng = engine_of.get(tid)
+            if eng is not None and "arrival" in a:
+                waiting.setdefault(eng, {})[tid] = a["arrival"]
+        elif kind == "scheduled":
+            eng = engine_of.get(tid)
+            if eng is not None:
+                mine = waiting.get(eng, {}).pop(tid, None)
+                if mine is not None:
+                    ahead = [(w, arr) for w, arr
+                             in waiting.get(eng, {}).items()
+                             if arr < mine]
+                    if ahead:
+                        w, arr = min(ahead, key=lambda p: p[1])
+                        violations.append(
+                            f"{tid}: scheduled (ticket {mine}) while "
+                            f"{w} (ticket {arr}) was still waiting on "
+                            f"{eng} — FCFS order broken")
+        elif kind in ("finish", "failover"):
+            eng = engine_of.get(tid)
+            if eng is not None:
+                waiting.get(eng, {}).pop(tid, None)
+        if kind == "readmit" and "batch" in a:
+            readmit_batches.setdefault(a["batch"], []).append(e)
+
+    for batch, evts in readmit_batches.items():
+        arrivals = [(e.get("attrs") or {}).get("arrival") for e in evts]
+        arrivals = [x for x in arrivals if x is not None]
+        if arrivals != sorted(arrivals):
+            violations.append(
+                f"readmit batch {batch}: re-admission order "
+                f"{arrivals} is not arrival-ordered")
+
+    for tid, evts in sorted(by_trace.items()):
+        prefilled = False
+        finishes = 0
+        last_failover_replica = None
+        ticket = None
+        for e in evts:
+            kind = e["kind"]
+            a = e.get("attrs") or {}
+            if "arrival" in a:
+                if ticket is None:
+                    ticket = a["arrival"]
+                elif a["arrival"] != ticket:
+                    violations.append(
+                        f"{tid}: arrival ticket changed "
+                        f"{ticket} -> {a['arrival']} at {kind} "
+                        f"(requeue must preserve the FCFS ticket)")
+                    ticket = a["arrival"]
+            if kind in ("engine_admit", "preempt", "requeue"):
+                prefilled = False
+            elif kind == "prefill":
+                prefilled = True
+            elif kind == "prefill_chunk":
+                if a.get("pos", 0) >= a.get("target", float("inf")):
+                    prefilled = True
+            elif kind in ("first_token", "decode_chunk"):
+                if not prefilled:
+                    violations.append(
+                        f"{tid}: {kind} before prefill completed")
+            elif kind == "failover":
+                last_failover_replica = a.get("replica")
+            elif kind == "readmit":
+                if last_failover_replica is None:
+                    violations.append(
+                        f"{tid}: readmit without a preceding failover")
+                elif a.get("from_replica") != last_failover_replica:
+                    violations.append(
+                        f"{tid}: readmit claims predecessor replica "
+                        f"{a.get('from_replica')} but the failover was "
+                        f"on replica {last_failover_replica}")
+            elif kind == "finish":
+                finishes += 1
+                if a.get("reason") not in TERMINAL_REASONS:
+                    violations.append(
+                        f"{tid}: finish with unknown reason "
+                        f"{a.get('reason')!r}")
+        if finishes > 1:
+            violations.append(
+                f"{tid}: {finishes} terminal events (expected exactly "
+                f"one)")
+        elif finishes == 0 and complete:
+            violations.append(
+                f"{tid}: no terminal event in a complete dump")
+    return violations

@@ -166,11 +166,12 @@ def span(name: str, cat: str = None, args: dict = None,
     return Span(name, cat=cat, args=args, annotate=annotate)
 
 
-def export_chrome(path: str) -> str:
+def export_chrome(path: str, extra_events=None) -> str:
     """Write recorded spans as chrome://tracing JSON (the substance of
     profiler.export_chrome_tracing, which now delegates here). ts/dur
     in microseconds relative to enable() time; category defaults to
-    "op" for unlabeled spans."""
+    "op" for unlabeled spans. `extra_events` are pre-built chrome event
+    dicts appended verbatim (obs.export adds gauge counter tracks)."""
     evs = events()
     trace = {"traceEvents": [
         dict({"name": e.name, "ph": "X", "cat": e.cat or "op",
@@ -179,7 +180,7 @@ def export_chrome(path: str) -> str:
               "pid": os.getpid(), "tid": e.tid},
              **({"args": e.args} if e.args else {}))
         for e in evs
-    ]}
+    ] + list(extra_events or [])}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -208,6 +208,44 @@ def test_chrome_trace_categories_and_nesting(tmp_path):
     assert depths == {"outer": 0, "inner": 1}
 
 
+def test_gauge_history_and_chrome_counter_track(tmp_path):
+    # every Gauge.set/inc/dec appends to a bounded history ring; the
+    # chrome export renders the listed gauge families as ph:"C"
+    # counter tracks clipped to the trace window
+    reg = MetricRegistry()
+    fam = reg.gauge("serving_waiting", labels=("engine",))
+    g = fam.labels(engine="e-0")
+    g.set(2.0)                               # before enable(): clipped
+    obs.trace.clear()
+    obs.trace.enable()
+    try:
+        with obs.span("step", cat="decode", annotate=False):
+            g.set(5.0)
+            g.inc(1.0)
+            g.dec(2.0)
+    finally:
+        obs.trace.disable()
+    assert [v for _, v in g.samples()] == [2.0, 5.0, 6.0, 4.0]
+    ts = [t for t, _ in g.samples()]
+    assert ts == sorted(ts)
+
+    p = tmp_path / "trace.json"
+    obs.export_chrome_trace(str(p), registry=reg)
+    evs = json.loads(p.read_text())["traceEvents"]
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert [e["args"]["value"] for e in counters] == [5.0, 6.0, 4.0]
+    assert all(e["name"] == "serving_waiting{engine=e-0}"
+               and e["ts"] >= 0 for e in counters)
+    # spans still come through alongside the counter track
+    assert any(e["ph"] == "X" and e["name"] == "step" for e in evs)
+
+    # history ring is bounded
+    from paddle_tpu.obs.registry import GAUGE_HISTORY_CAP
+    for i in range(GAUGE_HISTORY_CAP + 10):
+        g.set(float(i))
+    assert len(g.samples()) == GAUGE_HISTORY_CAP
+
+
 def test_profiler_shim_shares_trace_table():
     from paddle_tpu import profiler
     assert profiler.RecordEvent is obs.Span
@@ -326,6 +364,15 @@ def test_load_suite_steady_smoke():
     assert m["reject_rate"] == 0.0
     assert m["tokens_per_sec"] > 0
     assert 0 < m["ttft_p50"] <= m["ttft_p99"]
+    # trace-derived TTFT decomposition rides next to the quantiles
+    d = m["ttft_decomposition"]
+    assert d["n"] == 4
+    for k in ("queue_s", "prefill_s", "first_gap_s"):
+        assert d[k] >= 0.0
+    # the recorder-overhead A/B is pinned (gate skipped when the
+    # host's same-config noise floor drowns it — but always reported)
+    assert "recorder_overhead_pct" in m
+    assert isinstance(m["recorder_overhead_noisy"], bool)
 
 
 @pytest.mark.slow

@@ -1,0 +1,370 @@
+"""Per-request causal tracing (paddle_tpu/obs/reqtrace.py + the
+tools/reqtrace.py postmortem CLI).
+
+The load-bearing pins:
+
+- trace-id CONTINUITY: one request = one timeline, across preemption,
+  requeue-for-recovery and cross-engine failover (the readmit hop
+  carries the same `tr-...` id to the survivor engine);
+- the causality checker's invariants hold on real engine runs — no
+  token emission before prefill completes, requeue preserves the FCFS
+  arrival ticket, exactly-one terminal event per trace, every failover
+  hop references a real predecessor — including a 200-request churn
+  with cancellations;
+- the flight recorder dumps a postmortem artifact on quarantine, and
+  `tools/reqtrace.py --check` (run as a subprocess, the CI shape)
+  exits 0 on a recorded kill-replica run.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.inference.serving import (EngineConfig, LLMEngine,
+                                          ReplicaSet, RouterConfig,
+                                          SamplingParams)
+from paddle_tpu.obs.reqtrace import ReqTraceRing
+from paddle_tpu.testing.faults import ServingFaultInjector
+
+VOCAB = 97
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=24)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(autouse=True)
+def recording():
+    """Fresh, enabled process ring per test; always disarmed after."""
+    obs.reqtrace.clear()
+    obs.reqtrace.enable()
+    yield
+    obs.reqtrace.disarm()
+    obs.reqtrace.enable()
+    obs.reqtrace.clear()
+
+
+def _engine(model, faults=None, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("max_num_seqs", 4)
+    return LLMEngine.from_model(model, EngineConfig(**kw),
+                                faults=faults or ServingFaultInjector(""))
+
+
+def _prompts(n, seed=7, lo=3, hi=8):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, VOCAB, int(rng.randint(lo, hi))).astype(np.int32)
+            for _ in range(n)]
+
+
+def _dump(prefix=None, complete=True, reason="test"):
+    """A dump payload over the current ring (optionally one engine's
+    traces only), ready for check_causality."""
+    ids = None
+    if prefix is not None:
+        ids = sorted(obs.reqtrace.traces(prefix=prefix))
+    return obs.reqtrace.dump_payload(reason, trace_ids=ids,
+                                     complete=complete)
+
+
+def _kinds(evts):
+    return [e.kind for e in evts]
+
+
+# ------------------------------------------------------------- ring unit
+def test_ring_bounded_gated_and_closed_catalog():
+    r = ReqTraceRing(capacity=4)
+    for i in range(10):
+        r.record("finish", f"t{i}", reason="stop")
+    assert len(r) == 4                       # bounded: oldest dropped
+    assert [e.trace_id for e in r.events()] == ["t6", "t7", "t8", "t9"]
+
+    r.enabled = False
+    r.record("finish", "t-off", reason="stop")
+    assert len(r) == 4                       # disabled = dropped, free
+    r.enabled = True
+
+    with pytest.raises(ValueError):
+        r.record("not_a_kind", "t0")         # catalog is closed
+    r.clear()
+    assert len(r) == 0
+
+
+def test_event_seq_monotonic_and_as_dict_round_trip():
+    r = ReqTraceRing()
+    r.record("engine_admit", "t0", request_id="r0", engine="e-0")
+    r.record("finish", "t0", reason="stop", tokens=3)
+    a, b = r.events()
+    assert b.seq > a.seq and b.ts >= a.ts
+    d = b.as_dict()
+    assert d["kind"] == "finish" and d["attrs"]["tokens"] == 3
+    assert json.loads(json.dumps(d)) == d    # JSON-safe
+
+
+# ------------------------------------------------- single-engine timeline
+def test_single_engine_timeline_and_checker(model):
+    eng = _engine(model)
+    rids = [eng.add_request(p, SamplingParams(max_tokens=4))
+            for p in _prompts(3)]
+    eng.run()
+
+    prefix = f"tr-{eng.stats.label}-"
+    traces = obs.reqtrace.traces(prefix=prefix)
+    assert len(traces) == 3
+    for rid in rids:
+        tid = eng.get_request(rid).tid
+        ks = _kinds(traces[tid])
+        # lifecycle order within one engine's timeline
+        for a, b in [("engine_admit", "scheduled"),
+                     ("scheduled", "prefill"),
+                     ("prefill", "first_token"),
+                     ("first_token", "finish")]:
+            assert ks.index(a) < ks.index(b), (tid, ks)
+        assert ks.count("finish") == 1       # exactly-one terminal
+    assert obs.reqtrace.check_causality(_dump(prefix)) == []
+
+
+def test_ttft_decomposition_components_sane(model):
+    eng = _engine(model)
+    for p in _prompts(4):
+        eng.add_request(p, SamplingParams(max_tokens=3))
+    eng.run()
+    evts = [e.as_dict() for e in
+            obs.reqtrace.events(prefix=f"tr-{eng.stats.label}-")]
+    d = obs.reqtrace.ttft_decomposition(evts)
+    assert d["n"] == 4
+    for k in ("queue_s", "prefill_s", "first_gap_s", "ttft_s"):
+        assert d[k] >= 0.0
+    # per-trace (not the aggregate — medians of parts don't sum to the
+    # median of wholes): admission+queue+prefill+gap == ttft exactly
+    for evts_one in obs.reqtrace.traces(
+            prefix=f"tr-{eng.stats.label}-").values():
+        c = obs.reqtrace.ttft_components(
+            [e.as_dict() for e in evts_one])
+        assert c is not None
+        total = (c["admission_s"] + c["queue_s"] + c["prefill_s"]
+                 + c["first_gap_s"])
+        assert abs(total - c["ttft_s"]) < 1e-6
+
+
+# ------------------------------------------------- continuity: preemption
+def test_trace_continuity_across_preemption(model):
+    # the tight-pool acceptance mix from test_serving.py: at least one
+    # preemption, everything completes — each preempted request's
+    # preempt/requeue/re-schedule all land on its ONE trace id
+    eng = _engine(model, num_blocks=6)
+    rng = np.random.RandomState(3)
+    lens = [3, 6, 2, 8, 5, 4, 7, 3]
+    max_toks = [8, 5, 10, 6, 8, 12, 4, 9]
+    rids = []
+    for i, (n, mt) in enumerate(zip(lens, max_toks)):
+        rids.append(eng.add_request(
+            rng.randint(1, VOCAB, (n,)).astype(np.int32),
+            SamplingParams(max_tokens=mt)))
+        if i % 3 == 2:
+            eng.step()
+    eng.run()
+    assert eng.stats.preemptions >= 1        # pressure actually happened
+
+    prefix = f"tr-{eng.stats.label}-"
+    traces = obs.reqtrace.traces(prefix=prefix)
+    assert len(traces) == len(rids)          # no id splits or merges
+    preempted = [t for t, evts in traces.items()
+                 if "preempt" in _kinds(evts)]
+    assert preempted
+    for tid in preempted:
+        ks = _kinds(traces[tid])
+        # preempted → re-scheduled on the same timeline, one terminal
+        assert ks.index("preempt") < len(ks) - 1 - ks[::-1].index(
+            "scheduled")
+        assert ks.count("finish") == 1
+        # the FCFS ticket is constant across the preemption
+        arr = {e.attrs["arrival"] for e in traces[tid]
+               if "arrival" in e.attrs}
+        assert len(arr) == 1
+    assert obs.reqtrace.check_causality(_dump(prefix)) == []
+
+
+# -------------------------------------------- continuity: kill failover
+def test_trace_continuity_across_kill_replica_failover(model, tmp_path):
+    faults = ServingFaultInjector("kill_replica@3:1")
+    rc = RouterConfig(num_replicas=3, backoff_base=0.01,
+                      backoff_max=0.05, backoff_jitter=0.0)
+    ecfg = EngineConfig(block_size=4, num_blocks=16, max_num_seqs=4,
+                        decode_chunk_size=2)
+    rs = ReplicaSet.from_model(model, rc, engine_config=ecfg,
+                               faults=faults)
+    rids = [rs.add_request(p, SamplingParams(max_tokens=8))
+            for p in _prompts(6)]
+    rs.run(max_steps=3000)
+    assert faults.fired_log, "kill fault never fired"
+    assert rs.router_stats()["requeues"] >= 1
+
+    prefix = f"tr-{rs.label}-"
+    traces = obs.reqtrace.traces(prefix=prefix)
+    assert len(traces) == len(rids)
+    victims = [t for t, evts in traces.items()
+               if "failover" in _kinds(evts)]
+    assert victims
+    for tid in victims:
+        evts = traces[tid]
+        ks = _kinds(evts)
+        # ONE timeline spans both engines: admit on the dead replica,
+        # failover, readmit (naming the predecessor), finish
+        i_fo = ks.index("failover")
+        i_re = ks.index("readmit", i_fo)
+        assert ks.count("finish") == 1 and ks.index("finish") > i_re
+        fo, re_ = evts[i_fo], evts[i_re]
+        assert re_.attrs["from_replica"] == fo.attrs["replica"]
+        assert re_.attrs["to_replica"] != fo.attrs["replica"]
+        # two engine_admit hops, second is the readmit with resumed work
+        admits = [e for e in evts if e.kind == "engine_admit"]
+        assert len(admits) == 2 and admits[1].attrs["readmit"]
+        assert admits[1].attrs["resume"] == fo.attrs["tokens_streamed"]
+
+    dump = _dump(prefix, reason="kill_replica")
+    assert obs.reqtrace.check_causality(dump) == []
+
+    # the CI shape: the CLI verifies the same dump in a subprocess
+    path = tmp_path / "kill_replica_dump.json"
+    path.write_text(json.dumps(dump))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "reqtrace.py"),
+         str(path), "--check"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 violation(s)" in out.stdout
+
+    # ...and --timeline / --chrome work on the victim's trace
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "reqtrace.py"),
+         str(path), "--timeline", victims[0],
+         "--chrome", str(tmp_path / "tracks.json")],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "failover" in out.stdout and "readmit" in out.stdout
+    tracks = json.loads((tmp_path / "tracks.json").read_text())
+    assert any(e.get("ph") == "i" and e["name"] == "failover"
+               for e in tracks["traceEvents"])
+
+
+# --------------------------------------------------- churn with cancels
+def test_checker_on_200_request_churn_with_cancels(model):
+    eng = _engine(model, max_num_seqs=8, num_blocks=48, max_waiting=200)
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, VOCAB, int(rng.randint(3, 6)))
+               .astype(np.int32) for _ in range(200)]
+    rids, cancelled, submitted = [], set(), 0
+    steps = 0
+    while submitted < 200 or eng.has_unfinished():
+        for _ in range(4):                   # staggered arrivals
+            if submitted < 200:
+                rids.append(eng.add_request(
+                    prompts[submitted], SamplingParams(max_tokens=2)))
+                submitted += 1
+        if eng.has_unfinished():
+            eng.step()
+        steps += 1
+        if steps % 5 == 0:                   # churn: cancel a live one
+            live = [r for r in rids if r not in cancelled
+                    and not eng.get_request(r).finished]
+            if live:
+                victim = live[int(rng.randint(len(live)))]
+                eng.cancel(victim)
+                cancelled.add(victim)
+        assert steps < 4000
+    assert submitted == 200 and cancelled
+
+    prefix = f"tr-{eng.stats.label}-"
+    traces = obs.reqtrace.traces(prefix=prefix)
+    assert len(traces) == 200
+    for tid, evts in traces.items():
+        ks = _kinds(evts)
+        assert ks.count("finish") == 1, (tid, ks)
+    reasons = {e.attrs["reason"]
+               for r in cancelled
+               for e in traces[eng.get_request(r).tid]
+               if e.kind == "finish"}
+    assert reasons <= {"cancelled"}
+    assert obs.reqtrace.check_causality(_dump(prefix)) == []
+
+
+# ------------------------------------------------ flight recorder: auto
+def test_quarantine_auto_flight_dump(model, tmp_path):
+    obs.reqtrace.arm(str(tmp_path), max_dumps=2)
+    fi = ServingFaultInjector("nan_logits@1")
+    eng = _engine(model, faults=fi)
+    rids = [eng.add_request(p, SamplingParams(max_tokens=5))
+            for p in _prompts(3)]
+    eng.run()
+    assert eng.stats.errors == 1
+
+    dumps = obs.reqtrace.RING.dumps()
+    assert len(dumps) == 1 and "quarantine" in dumps[0]
+    dump = json.loads(open(dumps[0]).read())
+    assert dump["complete"] is False         # mid-run snapshot
+    assert dump["reason"] == "quarantine"
+    victim_tid = eng.get_request(rids[0]).tid
+    assert victim_tid in dump["trace_ids"]
+    ks = [e["kind"] for e in dump["events"]]
+    assert "quarantine" in ks
+    assert dump["extra"]["why"].startswith("non-finite")
+    assert "metrics" in dump["registry"]     # registry snapshot rides
+    # the checker tolerates in-flight traces on a complete=False dump
+    assert obs.reqtrace.check_causality(dump) == []
+
+    # armed cap: further triggers stop writing files once exhausted
+    obs.reqtrace.maybe_flight("failover")
+    obs.reqtrace.maybe_flight("failover")
+    assert len(obs.reqtrace.RING.dumps()) == 2
+
+
+def test_checker_flags_violations_on_synthetic_dumps():
+    r = ReqTraceRing()
+    # token emission before prefill completes
+    r.record("engine_admit", "tA", engine="e-9", arrival=0)
+    r.record("scheduled", "tA", arrival=0)
+    r.record("first_token", "tA")
+    r.record("finish", "tA", reason="stop")
+    bad = {"version": 1, "complete": True,
+           "events": [e.as_dict() for e in r.events()]}
+    assert any("prefill" in v for v in
+               obs.reqtrace.check_causality(bad))
+
+    # two terminal events
+    r.clear()
+    r.record("engine_admit", "tB", engine="e-9", arrival=0)
+    r.record("scheduled", "tB", arrival=0)
+    r.record("prefill", "tB")
+    r.record("finish", "tB", reason="stop")
+    r.record("finish", "tB", reason="stop")
+    bad = {"version": 1, "complete": True,
+           "events": [e.as_dict() for e in r.events()]}
+    assert any("terminal" in v for v in
+               obs.reqtrace.check_causality(bad))
+
+    # missing terminal is OK only when the dump is partial
+    r.clear()
+    r.record("engine_admit", "tC", engine="e-9", arrival=0)
+    partial = {"version": 1, "complete": False,
+               "events": [e.as_dict() for e in r.events()]}
+    assert obs.reqtrace.check_causality(partial) == []
+    full = dict(partial, complete=True)
+    assert any("terminal" in v for v in
+               obs.reqtrace.check_causality(full))

@@ -198,7 +198,9 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
             continue
         survivors += 1
         if list(req.output_ids) != ref_tokens[i]:
-            mismatched.append(i)
+            # the trace id names the request's causal timeline in the
+            # flight dump — the postmortem starts from here
+            mismatched.append({"request": i, "trace_id": req.tid})
     report["survivors"] = survivors
     assert not mismatched, \
         f"survivor token divergence vs unfaulted run: {mismatched}"
@@ -349,7 +351,9 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
             continue
         untouched += 1
         if list(rec.tokens) != ref_tokens[i]:
-            mismatched.append(i)
+            # trace id = the request's causal timeline in the flight
+            # dump (tools/reqtrace.py --timeline <id>)
+            mismatched.append({"request": i, "trace_id": rec.trace_id})
     report["untouched_survivors"] = untouched
     assert not mismatched, \
         f"untouched-replica token divergence vs unfaulted run: {mismatched}"
@@ -417,6 +421,17 @@ def main(argv=None) -> int:
     ap.add_argument("--max-reject-rate", type=float, default=0.5,
                     help="--slo threshold, fraction of submitted")
     args = ap.parse_args(argv)
+    # per-request flight recorder (obs/reqtrace.py): record every
+    # lifecycle event, arm auto dumps (quarantine / failover /
+    # integrity triggers, capped so a chaotic run can't spray files),
+    # and ALWAYS write one complete end-of-run dump —
+    # tools/reqtrace.py reconstructs each victim's single causal
+    # timeline from it and --check machine-verifies the invariants
+    from paddle_tpu import obs
+    obs.reqtrace.enable()
+    flight_dir = tempfile.mkdtemp(prefix="chaos-flight-")
+    obs.reqtrace.arm(flight_dir, max_dumps=4)
+    flight_path = os.path.join(flight_dir, "flightrec-exit.json")
     try:
         if args.replicas > 0:
             report = run_chaos_replicas(
@@ -436,14 +451,24 @@ def main(argv=None) -> int:
                 prefix_cache=args.prefix_cache)
     except AssertionError as e:
         print(f"CHAOS FAIL: {e}", file=sys.stderr)
+        print(json.dumps({"chaos_fail": str(e),
+                          "flight_dump": flight_path,
+                          "auto_flight_dumps": obs.reqtrace.RING.dumps()},
+                         indent=2))
         return 1
     finally:
         # post-mortem telemetry: full obs snapshot (both engines' metric
         # series — the labels differ, so ref vs faulted stay separate)
+        # + the complete flight dump (pass or fail)
+        obs.reqtrace.flight_dump("chaos_exit", path=flight_path,
+                                 complete=True)
+        obs.reqtrace.disarm()
+        print(f"flight dump: {flight_path}", file=sys.stderr)
         if args.snapshot:
-            from paddle_tpu import obs
             obs.dump_snapshot(args.snapshot)
             print(f"obs snapshot: {args.snapshot}", file=sys.stderr)
+    report["flight_dump"] = flight_path
+    report["auto_flight_dumps"] = obs.reqtrace.RING.dumps()
     rc = 0
     if args.slo:
         viol = []

@@ -91,6 +91,7 @@ import json
 import math
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -107,8 +108,14 @@ SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
 #: allowed, TTFT blowups, throughput collapse), while the absolute
 #: numbers are tracked over time through BENCH_FULL.
 SLOS = {
+    # max_recorder_overhead_pct pins the per-request trace recorder's
+    # cost (PR 13): steady tokens/s with the recorder on may trail the
+    # recorder-off baseline by at most 2% (max-of-2 paired passes; the
+    # gate is skipped — reported as `recorder_overhead_noisy` — when
+    # the same-config noise floor exceeds the bound itself)
     "steady":      {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 2.0,
-                    "max_reject_rate": 0.0},
+                    "max_reject_rate": 0.0,
+                    "max_recorder_overhead_pct": 2.0},
     "bursty":      {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 8.0,
                     "max_reject_rate": 0.6},
     # cost-based admission (jaxplan prefill cost model) prices long
@@ -370,6 +377,23 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
     return rs, rids, submitted, rejected, wall
 
 
+def _ttft_decomposition(label) -> dict:
+    """Trace-derived TTFT decomposition for one engine/router instance
+    (obs/reqtrace.py): median queue / admission / prefill /
+    first-decode-gap seconds over every trace the instance minted
+    (`tr-<label>-*`). Labels are per-instance unique, so the warmup
+    pass's traces never leak into the measured pass's numbers. Returns
+    {} when the recorder was off."""
+    from paddle_tpu import obs
+    evts = [e.as_dict()
+            for e in obs.reqtrace.events(prefix=f"tr-{label}-")]
+    d = obs.reqtrace.ttft_decomposition(evts)
+    if not d:
+        return {}
+    return {k: round(v, 4) if isinstance(v, float) else v
+            for k, v in d.items()}
+
+
 def _metrics_router(rs, rids, submitted, rejected, wall) -> dict:
     """The same four headline numbers as _metrics, measured at the
     ROUTER (TTFT is client-visible, spanning failovers), plus the
@@ -399,6 +423,7 @@ def _metrics_router(rs, rids, submitted, rejected, wall) -> dict:
         "replica_states": {k: str(v)
                            for k, v in st["replica_states"].items()},
         "rejected": rejected,
+        "ttft_decomposition": _ttft_decomposition(rs.label),
     }
 
 
@@ -431,6 +456,7 @@ def _metrics(eng, submitted, rejected, wall) -> dict:
         "preemptions": d["preemptions"],
         "errors": d["errors"],
         "rejected": rejected,
+        "ttft_decomposition": _ttft_decomposition(eng.stats.label),
     }
 
 
@@ -471,13 +497,78 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
         if ret is None or ret < ret_min:
             viol.append(f"affinity retention {ret} < {ret_min} "
                         "(3-replica vs single-replica hit rate)")
+    ov_max = slo.get("max_recorder_overhead_pct")
+    if ov_max is not None and "recorder_overhead_pct" in metrics:
+        if metrics.get("recorder_overhead_noisy"):
+            pass    # same-config noise floor above the bound on this
+            # host: the number is reported, the gate would only
+            # measure the machine
+        elif metrics["recorder_overhead_pct"] > ov_max:
+            viol.append(f"recorder_overhead_pct "
+                        f"{metrics['recorder_overhead_pct']} > {ov_max} "
+                        "(reqtrace recorder too expensive)")
     return {"pass": not viol, "violations": viol, "thresholds": dict(slo)}
+
+
+def _slo_verdict(name: str, m: dict) -> dict:
+    """Attach the SLO verdict; on failure also dump the recorded
+    traces + registry snapshot so the postmortem tool has the full
+    causal picture of the failing run (the dump path rides in the
+    report next to the violations)."""
+    from paddle_tpu import obs
+    m["slo"] = _check_slo(m, SLOS[name])
+    if not m["slo"]["pass"] and obs.reqtrace.is_enabled():
+        path = os.path.join(tempfile.gettempdir(),
+                            f"reqtrace-slo-{name}.json")
+        try:
+            m["slo"]["flight_dump"] = obs.reqtrace.flight_dump(
+                f"slo:{name}", path=path, complete=True)
+        except OSError:
+            pass
+    return m
+
+
+def _recorder_overhead(model, ecfg, arr) -> dict:
+    """Paired A/B overhead of the per-request trace recorder on the
+    steady workload: max-of-2 measured passes recorder-OFF vs
+    recorder-ON (max-of-N is the standard wall-clock noise filter).
+    The same-config spread of the two OFF passes is the host's noise
+    floor; when it exceeds the SLO bound the gate is meaningless on
+    this machine and `recorder_overhead_noisy` says so."""
+    from paddle_tpu import obs
+
+    def tps():
+        eng, submitted, _rej, wall = _drive(model, ecfg, arr)
+        return eng.stats.as_dict()["generated_tokens"] / max(wall, 1e-9)
+
+    was_on = obs.reqtrace.is_enabled()
+    obs.reqtrace.disable()
+    try:
+        off = [tps(), tps()]
+    finally:
+        if was_on:
+            obs.reqtrace.enable()
+    on = [tps(), tps()]
+    noise_pct = abs(off[0] - off[1]) / max(off) * 100.0
+    overhead_pct = (max(off) - max(on)) / max(off) * 100.0
+    bound = SLOS["steady"]["max_recorder_overhead_pct"]
+    return {
+        "recorder_overhead_pct": round(overhead_pct, 2),
+        "recorder_overhead_noise_pct": round(noise_pct, 2),
+        "recorder_overhead_noisy": noise_pct > bound,
+        "recorder_tokens_per_sec": {"off": round(max(off), 2),
+                                    "on": round(max(on), 2)},
+    }
 
 
 def run_scenario(name: str, model=None, cfg=None, n: int = None,
                  seed: int = 0, fast: bool = False) -> dict:
     """One scenario: warmup pass (compile all buckets), measured pass,
-    metrics + SLO verdict."""
+    metrics + SLO verdict. The per-request trace recorder is on for
+    every measured pass (it feeds `ttft_decomposition`); steady
+    additionally runs the recorder-off A/B that pins its overhead."""
+    from paddle_tpu import obs
+    obs.reqtrace.enable()
     if model is None:
         model, cfg = _build_model()
     if n is None:
@@ -492,8 +583,7 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
         rs, rids, submitted, rejected, wall = _drive_router(
             model, ecfg, arr, faults=REPLICA_FAULTS)
         m = _metrics_router(rs, rids, submitted, rejected, wall)
-        m["slo"] = _check_slo(m, SLOS[name])
-        return m
+        return _slo_verdict(name, m)
     if name == "mixed_prefill_decode":
         import dataclasses
         # measured pass draws long-prompt lengths of the OPPOSITE
@@ -519,8 +609,7 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
             "token_gap_p99": bm["token_gap_p99"],
             "slo_pass": _check_slo(bm, SLOS[name])["pass"],
         }
-        m["slo"] = _check_slo(m, SLOS[name])
-        return m
+        return _slo_verdict(name, m)
     if name == "prefix_heavy":
         import dataclasses
         # reuse ON (the SLO-gated default)
@@ -573,8 +662,7 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
             "lost": sum(1 for r in rids
                         if not rs.get_request(r).finished),
         }
-        m["slo"] = _check_slo(m, SLOS[name])
-        return m
+        return _slo_verdict(name, m)
     # warmup: same workload, unmeasured — every prompt-length and decode
     # bucket compiles here so measured TTFT is serving time, not XLA.
     # The chaos pass warms UNfaulted (compile time under a stall fault
@@ -583,8 +671,9 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
     eng, submitted, rejected, wall = _drive(model, ecfg, arr,
                                             faults=faults)
     m = _metrics(eng, submitted, rejected, wall)
-    m["slo"] = _check_slo(m, SLOS[name])
-    return m
+    if name == "steady":
+        m.update(_recorder_overhead(model, ecfg, arr))
+    return _slo_verdict(name, m)
 
 
 def run_suite(scenarios=None, seed: int = 0, fast: bool = False) -> dict:

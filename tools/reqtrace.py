@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""reqtrace CLI: postmortem timelines over flight-recorder dumps.
+
+    python tools/reqtrace.py DUMP.json                 summary table
+    python tools/reqtrace.py DUMP.json --timeline TID  one causal timeline
+    python tools/reqtrace.py DUMP.json --ttft          TTFT decomposition
+    python tools/reqtrace.py DUMP.json --check         causality invariants
+    python tools/reqtrace.py DUMP.json --chrome OUT    per-request tracks
+                            [--merge EXISTING.json]    ...appended to an
+                                                       existing chrome trace
+
+DUMP.json is a flight-recorder artifact (obs/reqtrace.py): written
+automatically on quarantine/failover/integrity triggers when the
+recorder is armed, or explicitly by chaos_serve.py / load_suite.py on
+gate failures and at exit.
+
+--check machine-verifies the causal invariants (no token emission
+before prefill completes, requeue preserves the FCFS arrival ticket
+and admission order, exactly-one terminal event per trace, every
+failover hop references a real predecessor replica) and exits 0/1 —
+the tier-1 suite runs it on a small recorded run. Dumps marked
+`"complete": false` (taken mid-run by an auto trigger) tolerate traces
+that have not reached their terminal event yet.
+
+Import trick (same as tools/ptlint.py): the obs package is imported
+standalone off paddle_tpu/ so this tool never pulls in jax.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_DIR = os.path.join(_REPO, "paddle_tpu")
+sys.path.insert(0, _PKG_DIR)
+try:
+    from obs import reqtrace as _rt  # noqa: E402
+finally:
+    sys.path.remove(_PKG_DIR)
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        dump = json.load(f)
+    if "events" not in dump:
+        raise ValueError(f"{path}: not a reqtrace dump (no 'events')")
+    return dump
+
+
+def _fmt_attrs(attrs: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def print_summary(dump: dict) -> None:
+    traces = _rt.group_traces(dump["events"])
+    print(f"reason={dump.get('reason')} complete={dump.get('complete')} "
+          f"traces={len(traces)} events={len(dump['events'])}")
+    for tid, evts in sorted(traces.items()):
+        kinds = [e["kind"] for e in evts]
+        finish = next((e for e in evts if e["kind"] == "finish"), None)
+        reason = (finish.get("attrs") or {}).get("reason") if finish \
+            else "(open)"
+        hops = kinds.count("readmit")
+        print(f"  {tid}: {len(evts)} events, terminal={reason}"
+              + (f", failover_hops={hops}" if hops else ""))
+
+
+def print_timeline(dump: dict, trace_id: str) -> int:
+    traces = _rt.group_traces(dump["events"])
+    evts = traces.get(trace_id)
+    if not evts:
+        print(f"no events for trace {trace_id!r}", file=sys.stderr)
+        return 1
+    t0 = evts[0]["ts"]
+    for e in evts:
+        print(f"  +{(e['ts'] - t0) * 1e3:10.3f}ms  {e['kind']:<14s} "
+              f"{_fmt_attrs(e.get('attrs') or {})}")
+    return 0
+
+
+def print_ttft(dump: dict) -> None:
+    traces = _rt.group_traces(dump["events"])
+    rows = []
+    for tid, evts in sorted(traces.items()):
+        c = _rt.ttft_components(evts)
+        if c is not None:
+            rows.append((tid, c))
+    hdr = ("trace", "admission_s", "queue_s", "prefill_s",
+           "first_gap_s", "ttft_s")
+    print("  ".join(f"{h:>12s}" for h in hdr))
+    for tid, c in rows:
+        print(f"{tid:>12s}  " + "  ".join(
+            f"{c[k]:12.6f}" for k in hdr[1:]))
+    agg = _rt.ttft_decomposition(dump["events"])
+    if agg:
+        print(f"{'p50':>12s}  " + "  ".join(
+            f"{agg[k]:12.6f}" for k in hdr[1:]))
+
+
+def _span_event(name, t0s, t1s, base, pid, tid):
+    return {"name": name, "ph": "X", "cat": "reqtrace",
+            "ts": (t0s - base) * 1e6, "dur": (t1s - t0s) * 1e6,
+            "pid": pid, "tid": tid}
+
+
+def render_chrome(dump: dict, out_path: str,
+                  merge_path: str = None) -> str:
+    """Per-request tracks: each trace becomes one tid row; lifecycle
+    phases render as spans (queue/prefill/decode per engine hop) with
+    every raw event as an instant marker. Optionally appended into an
+    existing chrome trace (obs.export_chrome_trace output) so request
+    tracks sit under the engine span and gauge counter tracks."""
+    events = sorted(dump["events"], key=lambda e: e["seq"])
+    if not events:
+        raise ValueError("dump holds no events")
+    base = min(e["ts"] for e in events)
+    chrome = []
+    pid = os.getpid()
+    traces = _rt.group_traces(events)
+    for row, (tid, evts) in enumerate(sorted(traces.items()), start=1):
+        # thread-name metadata labels the track with the trace id
+        chrome.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": row, "args": {"name": f"req {tid}"}})
+        # phase spans between lifecycle edges
+        open_since = {}                  # phase -> start ts
+        for e in evts:
+            k, ts = e["kind"], e["ts"]
+            if k == "engine_admit":
+                open_since["queue"] = ts
+            elif k == "scheduled":
+                q0 = open_since.pop("queue", None)
+                if q0 is not None:
+                    chrome.append(
+                        _span_event("queued", q0, ts, base, pid, row))
+                open_since["prefill"] = ts
+            elif k in ("prefill", "prefill_chunk"):
+                a = e.get("attrs") or {}
+                done = k == "prefill" or \
+                    a.get("pos", 0) >= a.get("target", float("inf"))
+                if done:
+                    p0 = open_since.pop("prefill", None)
+                    if p0 is not None:
+                        chrome.append(_span_event(
+                            "prefill", p0, ts, base, pid, row))
+                    open_since["decode"] = ts
+            elif k in ("finish", "failover", "preempt", "requeue"):
+                for phase, t0p in list(open_since.items()):
+                    chrome.append(
+                        _span_event(phase, t0p, ts, base, pid, row))
+                open_since.clear()
+            # every event also lands as an instant marker on its track
+            chrome.append(dict(
+                {"name": k, "ph": "i", "s": "t", "cat": "reqtrace",
+                 "ts": (ts - base) * 1e6, "pid": pid, "tid": row},
+                **({"args": e["attrs"]} if e.get("attrs") else {})))
+
+    payload = {"traceEvents": chrome}
+    if merge_path:
+        with open(merge_path) as f:
+            existing = json.load(f)
+        existing.setdefault("traceEvents", []).extend(chrome)
+        payload = existing
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(payload, f)
+    return out_path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="reqtrace", description=__doc__)
+    ap.add_argument("dump", help="flight-recorder dump (JSON)")
+    ap.add_argument("--timeline", metavar="TRACE_ID",
+                    help="print one request's causal timeline")
+    ap.add_argument("--ttft", action="store_true",
+                    help="TTFT decomposition per trace + p50 aggregate")
+    ap.add_argument("--check", action="store_true",
+                    help="verify causality invariants; exit 0 iff clean")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="render per-request tracks as chrome trace JSON")
+    ap.add_argument("--merge", metavar="EXISTING",
+                    help="with --chrome: append tracks into an existing "
+                         "chrome trace file")
+    args = ap.parse_args(argv)
+
+    try:
+        dump = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"reqtrace: {e}", file=sys.stderr)
+        return 2
+
+    rc = 0
+    did = False
+    if args.timeline:
+        rc = max(rc, print_timeline(dump, args.timeline))
+        did = True
+    if args.ttft:
+        print_ttft(dump)
+        did = True
+    if args.chrome:
+        out = render_chrome(dump, args.chrome, merge_path=args.merge)
+        print(f"chrome trace: {out}")
+        did = True
+    if args.check:
+        violations = _rt.check_causality(dump)
+        for v in violations:
+            print(f"VIOLATION: {v}")
+        n_traces = len(_rt.group_traces(dump["events"]))
+        print(f"reqtrace check: {n_traces} trace(s), "
+              f"{len(violations)} violation(s)")
+        if violations:
+            rc = 1
+        did = True
+    if not did:
+        print_summary(dump)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
