@@ -725,6 +725,24 @@ def bench_resnet(on_tpu: bool):
 
 
 def main():
+    import subprocess
+    import sys
+
+    # lockgraph preflight (docs/static_analysis.md): the serving
+    # fleet's lock-acquisition DAG must audit clean against the
+    # committed lockgraph.json before we bench it — the same gate
+    # tier-1 asserts (tests/test_lockgraph.py) and the chaos/load
+    # harnesses witness at runtime
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "lockgraph.py"), "--check"],
+        capture_output=True, text=True)
+    if res.returncode != 0:
+        sys.stderr.write(res.stdout + res.stderr)
+        raise SystemExit(
+            f"lockgraph preflight failed (exit {res.returncode})")
+
     import jax
     on_tpu = jax.default_backend() != "cpu"
     tokens_per_sec, mfu = bench_gpt(on_tpu)

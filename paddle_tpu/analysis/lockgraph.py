@@ -1,0 +1,1018 @@
+"""lockgraph: whole-program lock-order analysis for the serving fleet.
+
+PT-C001 (rules/concurrency.py) checks that guarded FIELDS are touched
+under their lock; this module checks the LOCKS themselves — that the
+acquisition ORDER the serving stack documents (router -> replica ->
+engine -> scheduler -> obs registry -> reqtrace ring) is acyclic,
+actually followed, and safe to extend. It is the capability-analysis
+half of the pair whose runtime half is paddle_tpu/testing/locktrace.py
+(the instrumented-lock witness chaos runs validate against this model).
+
+Three rules, emitted over an interprocedural acquisition graph:
+
+- PT-C002: an acquisition edge (held lock -> newly acquired lock) that
+  inverts the declared order, involves an undeclared lock, or closes a
+  cycle — a potential deadlock.
+- PT-C003: a blocking/slow call while holding a serving lock on a hot
+  path: ``time.sleep``, thread ``.join``, ``.block_until_ready``,
+  ``jax.device_get``, ``subprocess.*``, file I/O (``open``,
+  ``os.makedirs``, ``os.replace``). Reported at the blocking site when
+  the lock is held lexically, or at the locked CALL site when the
+  blocking happens transitively in a callee.
+- PT-C004: invoking an externally supplied callback (a constructor
+  parameter stored without a concrete type — fault injectors, engine
+  factories, cost models, exporter hooks) while holding a lock: a
+  lock-escape hazard, since the callee can block or re-enter the stack.
+
+How the graph is built (two passes, stdlib ``ast`` only):
+
+1. Collect every class (its ``self._lock``-style lock attributes,
+   ``_GUARDED_BY`` contract, attribute types inferred from
+   ``self.x = ClassName(...)`` assignments / parameter annotations /
+   dataclass fields), every module-level instance (``RING =
+   ReqTraceRing()``) and module function.
+2. Scan each method body tracking the lexically held lock set (seeded
+   by ``@holds_lock`` decorators, extended by ``with self._lock:`` and
+   local aliases), recording acquisition events, resolved calls,
+   blocking operations and external-callback invocations.
+
+A fixed point over method summaries then propagates transitive
+acquisitions (``router.step`` -> ``replica.step`` -> ``engine.step`` ->
+``scheduler.schedule``) so every *entry point* knows the full set of
+locks it may take, and every locked call site inherits its callees'
+acquisitions as edges.
+
+Lock identity is class-qualified (``ReplicaSet._lock``) because every
+class names its lock ``_lock``. The DECLARED order lives in the
+committed ``lockgraph.json`` (same artifact discipline as
+``jaxcost_budget.json`` / ``jaxplan.json``; ``tools/lockgraph.py`` is
+the CLI). Locks that are one runtime object under several classes (the
+obs registry lock threaded through Family/Counter/Gauge/Histogram) are
+declared in a ``shared`` group and canonicalized to one node. Test
+fixtures (single-file mode, rules/lockorder.py) declare order in-file
+via a module-level ``_LOCK_ORDER = [...]`` literal instead.
+
+Like ptlint, this file must import without jax — it lints the serving
+stack from outside it.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .ast_core import Finding
+
+__all__ = ["LOCKGRAPH_RULES", "LockModel", "LockGraphProgram",
+           "analyze_paths", "default_target_paths", "load_model",
+           "predicted_edges"]
+
+LOCKGRAPH_RULES = {
+    "PT-C002": ("error",
+                "lock acquisition inverts the declared order, closes a "
+                "cycle, or involves an undeclared lock"),
+    "PT-C003": ("warning",
+                "blocking/slow call (sleep, join, device sync, "
+                "subprocess, file I/O) while holding a serving lock"),
+    "PT-C004": ("warning",
+                "externally supplied callback invoked while holding a "
+                "lock (lock-escape hazard)"),
+}
+
+# Packages the whole-program analysis covers, relative to the repo root.
+DEFAULT_TARGETS = ("paddle_tpu/inference/serving", "paddle_tpu/obs",
+                   "paddle_tpu/testing/locktrace.py")
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock"}
+# Parameter annotations that mean "externally supplied, untyped":
+_EXTERNAL_ANNS = {"", "object", "Any", "Callable", "callable"}
+_EXTERNAL = "<external>"
+# Calls whose dotted name blocks (exact match / prefix match):
+_BLOCKING_EXACT = {"time.sleep": "time.sleep",
+                   "jax.device_get": "jax.device_get",
+                   "os.makedirs": "file I/O (os.makedirs)",
+                   "os.replace": "file I/O (os.replace)"}
+_BLOCKING_PREFIX = {"subprocess.": "subprocess"}
+# Method names that block when the receiver is a thread/event object:
+_THREADY = {"threading.Thread": ("join",),
+            "threading.Event": ("wait",),
+            "threading.Condition": ("wait",)}
+
+
+def _dotted(node) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _ann_name(ann) -> str:
+    """Flatten an annotation to its core type name: Optional["X"] -> X,
+    "LLMEngine" (string literal) -> LLMEngine, List[X] -> list[X]."""
+    if ann is None:
+        return ""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip()
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return _dotted(ann) or ""
+    if isinstance(ann, ast.Subscript):
+        head = _ann_name(ann.value)
+        inner = _ann_name(ann.slice)
+        if head in ("Optional",):
+            return inner
+        if head in ("List", "list", "Sequence", "Deque", "deque"):
+            return f"list[{inner}]"
+        if head in ("Dict", "dict"):
+            # Dict[K, V] -> container of V
+            if isinstance(ann.slice, ast.Tuple) and ann.slice.elts:
+                return f"dict[{_ann_name(ann.slice.elts[-1])}]"
+            return f"dict[{inner}]"
+        return head
+    if isinstance(ann, ast.Tuple) and ann.elts:
+        return _ann_name(ann.elts[-1])
+    return ""
+
+
+def _held_by_decorator(fn) -> Set[str]:
+    held: Set[str] = set()
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = _dotted(dec.func)
+            if name and name.split(".")[-1] == "holds_lock":
+                for a in dec.args:
+                    if isinstance(a, ast.Constant) \
+                            and isinstance(a.value, str):
+                        held.add(a.value)
+    return held
+
+
+def _guarded_map(cls: ast.ClassDef) -> Dict[str, str]:
+    for stmt in cls.body:
+        targets, value = [], None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "_GUARDED_BY" \
+                    and isinstance(value, ast.Dict):
+                out: Dict[str, str] = {}
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(v, ast.Constant):
+                        out[str(k.value)] = str(v.value)
+                return out
+    return {}
+
+
+# --------------------------------------------------------------- model
+@dataclass
+class LockModel:
+    """The DECLARED side of the analysis: lock order, shared-lock
+    groups, and the typing hints the AST cannot infer. Normally loaded
+    from the committed lockgraph.json; fixtures build one from an
+    in-file ``_LOCK_ORDER`` literal."""
+
+    order: List[str] = field(default_factory=list)
+    shared: List[List[str]] = field(default_factory=list)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    returns: Dict[str, List[str]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self._canon: Dict[str, str] = {}
+        for group in self.shared:
+            for name in group:
+                self._canon[name] = group[0]
+        self._rank: Dict[str, int] = {}
+        for i, q in enumerate(self.order):
+            self._rank[self.canonical(q)] = i
+
+    def canonical(self, qual: str) -> str:
+        return self._canon.get(qual, qual)
+
+    def rank(self, qual: str) -> Optional[int]:
+        return self._rank.get(self.canonical(qual))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LockModel":
+        returns = {k: (v if isinstance(v, list) else [v])
+                   for k, v in (d.get("returns") or {}).items()}
+        return cls(order=list(d.get("order") or ()),
+                   shared=[list(g) for g in (d.get("shared") or ())],
+                   attr_types=dict(d.get("attr_types") or {}),
+                   returns=returns)
+
+
+def load_model(path: str) -> LockModel:
+    with open(path, encoding="utf-8") as fh:
+        return LockModel.from_dict(json.load(fh))
+
+
+def _infile_order(tree: ast.Module) -> List[str]:
+    """Module-level ``_LOCK_ORDER = ["A._lock", ...]`` literal (fixture
+    / single-file mode)."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == "_LOCK_ORDER" \
+                        and isinstance(stmt.value, (ast.List, ast.Tuple)):
+                    return [e.value for e in stmt.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)]
+    return []
+
+
+# ------------------------------------------------------------ summaries
+@dataclass
+class ClassInfo:
+    name: str
+    module: str                       # module basename
+    path: str
+    node: ast.ClassDef
+    lock_attrs: Set[str] = field(default_factory=set)
+    guarded: Dict[str, str] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    field_anns: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    # raw `self.x = <expr>` assignments, typed in a later pass
+    _attr_exprs: Dict[str, ast.AST] = field(default_factory=dict)
+    init_params: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    basename: str
+    path: str
+    tree: ast.Module
+    functions: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    instances: Dict[str, str] = field(default_factory=dict)  # NAME -> cls
+    classes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Summary:
+    """Per-method/function facts gathered by the body scan."""
+    key: Tuple[str, str]              # (class-or-"mod:x", name)
+    path: str
+    # (held quals, acquired qual, line, col)
+    acquires: List[tuple] = field(default_factory=list)
+    # (held quals, callee key, line, col)
+    calls: List[tuple] = field(default_factory=list)
+    # (held quals, kind, line, col)
+    blocking: List[tuple] = field(default_factory=list)
+    # (held quals, description, line, col)
+    external: List[tuple] = field(default_factory=list)
+    # fixed-point state:
+    enters: Set[str] = field(default_factory=set)
+    # blocking reachable with NO lock held locally: {(kind, origin)}
+    prop_blocking: Set[tuple] = field(default_factory=set)
+    prop_external: Set[tuple] = field(default_factory=set)
+
+
+class LockGraphProgram:
+    """The whole-program (or single-module) analysis: feed modules in
+    with add_module(), then analyze(model)."""
+
+    def __init__(self):
+        self.classes: Dict[str, ClassInfo] = {}
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.summaries: Dict[Tuple[str, str], Summary] = {}
+        self._infile_orders: List[str] = []
+
+    # ------------------------------------------------------- pass 1
+    def add_module(self, path: str, source: str,
+                   tree: Optional[ast.Module] = None) -> None:
+        if tree is None:
+            tree = ast.parse(source, filename=path)
+        base = os.path.basename(path)
+        name = base[:-3] if base.endswith(".py") else base
+        if name == "__init__":
+            name = os.path.basename(os.path.dirname(path))
+        mod = ModuleInfo(basename=name, path=path, tree=tree)
+        self._infile_orders.extend(_infile_order(tree))
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._collect_class(stmt, mod)
+                mod.classes.append(stmt.name)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call):
+                ctor = _dotted(stmt.value.func)
+                if ctor:
+                    mod.instances[stmt.targets[0].id] = ctor.split(".")[-1]
+        self.modules[name] = mod
+
+    def _collect_class(self, cls: ast.ClassDef, mod: ModuleInfo) -> None:
+        info = ClassInfo(name=cls.name, module=mod.basename,
+                         path=mod.path, node=cls)
+        info.guarded = _guarded_map(cls)
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[stmt.name] = stmt
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                info.field_anns[stmt.target.id] = _ann_name(
+                    stmt.annotation)
+        init = info.methods.get("__init__")
+        if init is not None:
+            args = init.args
+            for a in list(args.args) + list(args.kwonlyargs):
+                if a.arg != "self":
+                    info.init_params[a.arg] = _ann_name(a.annotation)
+        for meth in info.methods.values():
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and isinstance(node.targets[0].value, ast.Name) \
+                        and node.targets[0].value.id == "self":
+                    attr = node.targets[0].attr
+                    val = node.value
+                    d = _dotted(val.func) if isinstance(val, ast.Call) \
+                        else None
+                    if d in _LOCK_FACTORIES:
+                        info.lock_attrs.add(attr)
+                    # __init__ wins; elsewhere first assignment wins
+                    if attr not in info._attr_exprs \
+                            or meth.name == "__init__":
+                        info._attr_exprs.setdefault(attr, val)
+                        if meth.name == "__init__":
+                            info._attr_exprs[attr] = val
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Attribute) \
+                        and isinstance(node.target.value, ast.Name) \
+                        and node.target.value.id == "self":
+                    info.field_anns.setdefault(node.target.attr,
+                                               _ann_name(node.annotation))
+        # every _GUARDED_BY value is a lock attr even without a visible
+        # factory call (e.g. the lock is passed in, registry children)
+        for lock in info.guarded.values():
+            info.lock_attrs.add(lock)
+        self.classes[cls.name] = info
+
+    # --------------------------------------------------- type queries
+    def _resolve_attr_type(self, cls: str, attr: str,
+                           model: LockModel,
+                           _seen: Optional[set] = None) -> str:
+        hint = model.attr_types.get(f"{cls}.{attr}")
+        if hint:
+            return hint
+        info = self.classes.get(cls)
+        if info is None:
+            return ""
+        if attr in info.attr_types:
+            return info.attr_types[attr]
+        _seen = _seen or set()
+        if (cls, attr) in _seen:
+            return ""
+        _seen.add((cls, attr))
+        t = ""
+        expr = info._attr_exprs.get(attr)
+        if expr is not None:
+            t = self._infer(expr, info, {}, model, _seen)
+        if not t or t == _EXTERNAL:
+            ann = info.field_anns.get(attr, "")
+            if ann:
+                # only a SCALAR untyped annotation marks the attr as
+                # externally supplied; containers (dict[object], ...)
+                # are ordinary internal state
+                if ann in _EXTERNAL_ANNS:
+                    t = _EXTERNAL
+                elif not t:
+                    t = ann
+        info.attr_types[attr] = t
+        return t
+
+    def _infer(self, expr, info: Optional[ClassInfo],
+               env: Dict[str, str], model: LockModel,
+               _seen: Optional[set] = None) -> str:
+        """Best-effort type of an expression: a class name, a container
+        'list[X]'/'dict[X]', the _EXTERNAL sentinel, or ''."""
+        if isinstance(expr, ast.Name):
+            if expr.id in env:
+                return env[expr.id]
+            if info is not None and expr.id in info.init_params:
+                ann = info.init_params[expr.id]
+                return _EXTERNAL if ann in _EXTERNAL_ANNS else ann
+            for mod in self.modules.values():
+                if expr.id in mod.instances:
+                    return mod.instances[expr.id]
+            if expr.id in self.classes:
+                return f"type[{expr.id}]"
+            return ""
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and info is not None:
+                return self._resolve_attr_type(info.name, expr.attr,
+                                               model, _seen)
+            base = self._infer(expr.value, info, env, model, _seen)
+            if base == _EXTERNAL:
+                return _EXTERNAL
+            if base.startswith("list[") or base.startswith("dict["):
+                return ""
+            if base in self.classes:
+                return self._resolve_attr_type(base, expr.attr, model,
+                                               _seen)
+            return ""
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d in _THREADY:
+                return d
+            if d is not None:
+                # ClassName(...) or mod.ClassName(...)
+                tail = d.split(".")[-1]
+                if tail in self.classes:
+                    return tail
+                # module function with a return annotation
+                fn = self._find_module_func(d)
+                if fn is not None:
+                    return _ann_name(fn.returns)
+            if isinstance(expr.func, ast.Attribute):
+                base = self._infer(expr.func.value, info, env, model,
+                                   _seen)
+                meth = expr.func.attr
+                for core in base.split("|") if base else ():
+                    hinted = model.returns.get(f"{core}.{meth}")
+                    if hinted:
+                        return "|".join(hinted)
+                    binfo = self.classes.get(core)
+                    if binfo is not None and meth in binfo.methods:
+                        ret = _ann_name(binfo.methods[meth].returns)
+                        if ret and ret not in ("None", "object"):
+                            return ret
+            # the PRODUCT of an external factory is unknown, not
+            # external — only calling the stored callable itself is a
+            # lock-escape (PT-C004); what it built is ordinary state
+            return ""
+        if isinstance(expr, ast.BoolOp):
+            best = ""
+            for v in expr.values:
+                t = self._infer(v, info, env, model, _seen)
+                if t and t != _EXTERNAL:
+                    return t
+                if t == _EXTERNAL:
+                    best = _EXTERNAL
+            return best
+        if isinstance(expr, ast.IfExp):
+            t = self._infer(expr.body, info, env, model, _seen)
+            return t or self._infer(expr.orelse, info, env, model, _seen)
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            for e in expr.elts:
+                t = self._infer(e, info, env, model, _seen)
+                if t and t != _EXTERNAL:
+                    return f"list[{t}]"
+            return ""
+        if isinstance(expr, ast.ListComp):
+            t = self._infer(expr.elt, info, env, model, _seen)
+            return f"list[{t}]" if t and t != _EXTERNAL else ""
+        if isinstance(expr, ast.DictComp):
+            t = self._infer(expr.value, info, env, model, _seen)
+            return f"dict[{t}]" if t and t != _EXTERNAL else ""
+        if isinstance(expr, ast.Dict):
+            for v in expr.values:
+                t = self._infer(v, info, env, model, _seen)
+                if t and t != _EXTERNAL:
+                    return f"dict[{t}]"
+            return ""
+        if isinstance(expr, ast.Subscript):
+            base = self._infer(expr.value, info, env, model, _seen)
+            if base.startswith("list[") or base.startswith("dict["):
+                return base[5:-1]
+            return ""
+        return ""
+
+    def _find_module_func(self, dotted: str):
+        """Resolve 'obs.reqtrace.record' / 'reqtrace.record' / 'record'
+        to a module-level function by basename suffix match."""
+        parts = dotted.split(".")
+        if len(parts) >= 2:
+            mod = self.modules.get(parts[-2])
+            if mod is not None and parts[-1] in mod.functions:
+                return mod.functions[parts[-1]]
+        return None
+
+    def _resolve_call(self, call: ast.Call, info: Optional[ClassInfo],
+                      env: Dict[str, str], model: LockModel,
+                      mod: Optional[ModuleInfo] = None):
+        """Resolve a call to a summary key, or None. Returns
+        (key, None) / (None, external_desc) / (None, None)."""
+        func = call.func
+        d = _dotted(func)
+        if isinstance(func, ast.Name):
+            if func.id in self.classes:
+                return ("cls", (func.id, "__init__")), None
+            # same-module bare function
+            if mod is not None and func.id in mod.functions:
+                return ("fn", (mod.basename, func.id)), None
+            t = env.get(func.id, "")
+            if not t and info is not None \
+                    and func.id in info.init_params:
+                t = info.init_params[func.id]
+                t = _EXTERNAL if t in _EXTERNAL_ANNS else t
+            if t == _EXTERNAL:
+                return None, f"callable '{func.id}'"
+            return None, None
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            # self.m()
+            if isinstance(func.value, ast.Name) \
+                    and func.value.id == "self" and info is not None:
+                if meth in info.methods:
+                    return ("cls", (info.name, meth)), None
+                # calling an external callable stored on self
+                t = self._resolve_attr_type(info.name, meth, model)
+                if t == _EXTERNAL:
+                    return None, f"self.{meth}"
+                return None, None
+            base_t = self._infer(func.value, info, env, model)
+            if base_t == _EXTERNAL:
+                return None, _dotted(func) or f"<expr>.{meth}"
+            for cand in base_t.split("|") if base_t else ():
+                cand = cand.strip()
+                if cand.startswith("type["):
+                    cand = cand[5:-1]
+                binfo = self.classes.get(cand)
+                if binfo is not None and meth in binfo.methods:
+                    return ("cls", (cand, meth)), None
+            # module function: obs.reqtrace.record / reqtrace.record
+            if d is not None and self._find_module_func(d) is not None:
+                parts = d.split(".")
+                return ("fn", (parts[-2], parts[-1])), None
+        return None, None
+
+    def _blocking_kind(self, call: ast.Call, info, env,
+                       model: LockModel) -> Optional[str]:
+        d = _dotted(call.func)
+        if d is not None:
+            if d in _BLOCKING_EXACT:
+                return _BLOCKING_EXACT[d]
+            for pre, kind in _BLOCKING_PREFIX.items():
+                if d.startswith(pre):
+                    return kind
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            return "file I/O (open)"
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            if meth == "block_until_ready":
+                return ".block_until_ready()"
+            base_t = self._infer(call.func.value, info, env, model)
+            for ty, meths in _THREADY.items():
+                if base_t == ty and meth in meths:
+                    return f"{ty.split('.')[-1]}.{meth}()"
+        return None
+
+    # ------------------------------------------------------- pass 2
+    def _lock_qual(self, expr, info: Optional[ClassInfo],
+                   env: Dict[str, str], aliases: Dict[str, str],
+                   model: LockModel) -> Optional[str]:
+        """`with <expr>:` -> class-qualified lock name, or None when the
+        context manager is not a known lock."""
+        if isinstance(expr, ast.Name):
+            return aliases.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and info is not None:
+                if expr.attr in info.lock_attrs:
+                    return f"{info.name}.{expr.attr}"
+                return None
+            base_t = self._infer(expr.value, info, env, model)
+            binfo = self.classes.get(base_t)
+            if binfo is not None and expr.attr in binfo.lock_attrs:
+                return f"{base_t}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Call):
+            # self._lock.acquire_timeout(...)-style wrappers
+            return self._lock_qual(expr.func, info, env, aliases, model)
+        return None
+
+    def scan(self, model: LockModel) -> None:
+        """Pass 2: build per-method summaries."""
+        for mod in self.modules.values():
+            for cname in mod.classes:
+                info = self.classes[cname]
+                for mname, meth in info.methods.items():
+                    key = (cname, mname)
+                    self.summaries[key] = self._scan_callable(
+                        key, meth, info, mod, model)
+            for fname, fn in mod.functions.items():
+                key = (f"mod:{mod.basename}", fname)
+                self.summaries[key] = self._scan_callable(
+                    key, fn, None, mod, model)
+
+    def _scan_callable(self, key, fn, info, mod: ModuleInfo,
+                       model: LockModel) -> Summary:
+        s = Summary(key=key, path=mod.path)
+        held0: Tuple[str, ...] = ()
+        if info is not None:
+            held0 = tuple(f"{info.name}.{a}"
+                          for a in sorted(_held_by_decorator(fn))
+                          )
+        env: Dict[str, str] = {}
+        if info is not None:
+            for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+                if a.arg != "self" and a.annotation is not None:
+                    env[a.arg] = _ann_name(a.annotation)
+        aliases: Dict[str, str] = {}
+        self._scan_block(fn.body, held0, info, mod, env, aliases,
+                         model, s, in_init=(fn.name == "__init__"))
+        return s
+
+    def _scan_block(self, body, held, info, mod, env, aliases, model,
+                    s: Summary, in_init: bool) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                newly = list(held)
+                for item in stmt.items:
+                    q = self._lock_qual(item.context_expr, info, env,
+                                        aliases, model)
+                    if q is None:
+                        self._scan_exprs([item.context_expr], held, info,
+                                         mod, env, model, s, in_init)
+                        continue
+                    if q not in newly:
+                        s.acquires.append((tuple(newly), q,
+                                           item.context_expr.lineno,
+                                           item.context_expr.col_offset))
+                        newly.append(q)
+                self._scan_block(stmt.body, tuple(newly), info, mod, env,
+                                 aliases, model, s, in_init)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner_held = ()
+                if info is not None:
+                    inner_held = tuple(
+                        f"{info.name}.{a}"
+                        for a in sorted(_held_by_decorator(stmt)))
+                self._scan_block(stmt.body, inner_held, info, mod, env,
+                                 dict(aliases), model, s, in_init=False)
+                continue
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                q = self._lock_qual(stmt.value, info, env, aliases, model)
+                if q is not None and not isinstance(stmt.value, ast.Call):
+                    aliases[name] = q
+                else:
+                    aliases.pop(name, None)
+                    # "" tombstones an unknown local so it cannot fall
+                    # back to a same-named __init__ param in _infer
+                    env[name] = self._infer(stmt.value, info, env, model)
+                self._scan_exprs([stmt.value], held, info, mod, env,
+                                 model, s, in_init)
+                continue
+            if isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._scan_block(blk, held, info, mod, env, aliases,
+                                     model, s, in_init)
+                for h in stmt.handlers:
+                    self._scan_block(h.body, held, info, mod, env,
+                                     aliases, model, s, in_init)
+                continue
+            if isinstance(stmt, ast.For):
+                # loop var type: iterating a list[T] yields T
+                if isinstance(stmt.target, ast.Name):
+                    t = self._infer(stmt.iter, info, env, model)
+                    if t.startswith("list[") or t.startswith("dict["):
+                        env[stmt.target.id] = t[5:-1]
+                    else:
+                        env[stmt.target.id] = ""
+                self._scan_exprs([stmt.iter], held, info, mod, env,
+                                 model, s, in_init)
+                self._scan_block(stmt.body, held, info, mod, env,
+                                 aliases, model, s, in_init)
+                self._scan_block(stmt.orelse, held, info, mod, env,
+                                 aliases, model, s, in_init)
+                continue
+            # generic compound statements: recurse into stmt-lists,
+            # scan hanging expressions
+            sub_exprs = []
+            for _f, value in ast.iter_fields(stmt):
+                if isinstance(value, list) and value \
+                        and isinstance(value[0], ast.stmt):
+                    self._scan_block(value, held, info, mod, env,
+                                     aliases, model, s, in_init)
+                elif isinstance(value, list):
+                    sub_exprs.extend(v for v in value
+                                     if isinstance(v, ast.AST))
+                elif isinstance(value, ast.AST):
+                    sub_exprs.append(value)
+            self._scan_exprs(sub_exprs, held, info, mod, env, model, s,
+                             in_init)
+
+    def _scan_exprs(self, exprs, held, info, mod, env, model,
+                    s: Summary, in_init: bool) -> None:
+        for root in exprs:
+            for node in _walk_no_lambda(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._blocking_kind(node, info, env, model)
+                if kind is not None:
+                    s.blocking.append((tuple(held), kind, node.lineno,
+                                       node.col_offset))
+                    continue
+                key, ext = self._resolve_call(node, info, env, model,
+                                              mod)
+                if key is not None:
+                    tag, target = key
+                    if tag == "cls":
+                        s.calls.append((tuple(held), target, node.lineno,
+                                        node.col_offset))
+                    else:
+                        s.calls.append((tuple(held),
+                                        (f"mod:{target[0]}", target[1]),
+                                        node.lineno, node.col_offset))
+                elif ext is not None and not in_init:
+                    s.external.append((tuple(held), ext, node.lineno,
+                                       node.col_offset))
+
+    # ---------------------------------------------------- fixed point
+    def propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for s in self.summaries.values():
+                enters = {q for (_h, q, _l, _c) in s.acquires}
+                blk = {(k, f"{os.path.basename(s.path)}:{l}")
+                       for (h, k, l, _c) in s.blocking if not h}
+                ext = {(d, f"{os.path.basename(s.path)}:{l}")
+                       for (h, d, l, _c) in s.external if not h}
+                for (h, callee, _l, _c) in s.calls:
+                    cs = self.summaries.get(callee)
+                    if cs is None:
+                        continue
+                    enters |= cs.enters
+                    if not h:
+                        blk |= cs.prop_blocking
+                        ext |= cs.prop_external
+                if enters - s.enters:
+                    s.enters |= enters
+                    changed = True
+                if blk - s.prop_blocking:
+                    s.prop_blocking |= blk
+                    changed = True
+                if ext - s.prop_external:
+                    s.prop_external |= ext
+                    changed = True
+
+    # -------------------------------------------------------- findings
+    def edges(self, model: LockModel) -> List[tuple]:
+        """Every acquisition edge: (held, acquired, path, line, col,
+        via) with held/acquired canonicalized. Same-lock (reentrant)
+        edges are dropped."""
+        out = []
+        seen = set()
+        for s in self.summaries.values():
+            for (held, q, line, col) in s.acquires:
+                a = model.canonical(q)
+                for h in held:
+                    h = model.canonical(h)
+                    if h == a:
+                        continue
+                    k = (h, a, s.path, line)
+                    if k not in seen:
+                        seen.add(k)
+                        out.append((h, a, s.path, line, col, None))
+            for (held, callee, line, col) in s.calls:
+                if not held:
+                    continue
+                cs = self.summaries.get(callee)
+                if cs is None:
+                    continue
+                name = callee[1] if callee[0].startswith("mod:") \
+                    else f"{callee[0]}.{callee[1]}"
+                for q in sorted(cs.enters):
+                    a = model.canonical(q)
+                    for h in held:
+                        h = model.canonical(h)
+                        if h == a:
+                            continue
+                        k = (h, a, s.path, line)
+                        if k not in seen:
+                            seen.add(k)
+                            out.append((h, a, s.path, line, col, name))
+        return out
+
+    def analyze(self, model: LockModel) -> List[Finding]:
+        # A module-level _LOCK_ORDER literal extends the committed order:
+        # its quals rank AFTER everything lockgraph.json declares, in
+        # their in-file sequence, so a fixture/tool file can declare an
+        # order without its locks reading as undeclared.
+        extra = [q for q in self._infile_orders if q not in model.order]
+        if extra:
+            model = LockModel(order=list(model.order) + extra,
+                              shared=model.shared,
+                              attr_types=model.attr_types,
+                              returns=model.returns)
+        self.scan(model)
+        self.propagate()
+        findings: List[Finding] = []
+        seen: Set[tuple] = set()
+
+        def emit(rule, path, line, col, msg):
+            sev = LOCKGRAPH_RULES[rule][0]
+            k = (rule, path, line)
+            if k in seen:
+                return
+            seen.add(k)
+            findings.append(Finding(rule=rule, path=path, line=line,
+                                    col=col, severity=sev, message=msg))
+
+        edges = self.edges(model)
+        # --- PT-C002: order inversions / undeclared locks
+        for (h, a, path, line, col, via) in edges:
+            rh, ra = model.rank(h), model.rank(a)
+            hint = f" (via {via})" if via else ""
+            if rh is None or ra is None:
+                missing = h if rh is None else a
+                emit("PT-C002", path, line, col,
+                     f"acquisition edge {h} -> {a}{hint}: {missing} is "
+                     f"not in the declared lock order; add it to "
+                     f"lockgraph.json (or _LOCK_ORDER) or suppress "
+                     f"with a reason")
+            elif rh > ra:
+                emit("PT-C002", path, line, col,
+                     f"acquiring {a} while holding {h}{hint} INVERTS "
+                     f"the declared lock order ({a} is level {ra}, "
+                     f"{h} is level {rh}) — potential deadlock")
+        # --- PT-C002: cycles in the edge graph itself
+        for cyc in _find_cycles({(h, a) for (h, a, *_r) in edges}):
+            h0, a0 = cyc[0], cyc[1 % len(cyc)]
+            site = next(((p, l, c) for (h, a, p, l, c, _v) in edges
+                         if h == h0 and a == a0), None)
+            if site is not None:
+                emit("PT-C002", site[0], site[1], site[2],
+                     "lock acquisition graph contains a cycle: "
+                     + " -> ".join(cyc + [cyc[0]])
+                     + " — deadlock when the paths interleave")
+        # --- PT-C003: blocking under a held lock (direct + transitive)
+        for s in self.summaries.values():
+            for (held, kind, line, col) in s.blocking:
+                if held:
+                    emit("PT-C003", s.path, line, col,
+                         f"{kind} while holding "
+                         f"{_fmt_locks(held, model)} — blocking call "
+                         f"on a locked serving path")
+            for (held, callee, line, col) in s.calls:
+                if not held:
+                    continue
+                cs = self.summaries.get(callee)
+                if cs is None or not cs.prop_blocking:
+                    continue
+                name = callee[1] if callee[0].startswith("mod:") \
+                    else f"{callee[0]}.{callee[1]}"
+                kinds = sorted({f"{k} at {o}"
+                                for (k, o) in cs.prop_blocking})
+                emit("PT-C003", s.path, line, col,
+                     f"call into {name} while holding "
+                     f"{_fmt_locks(held, model)} — it blocks "
+                     f"transitively ({'; '.join(kinds[:3])})")
+        # --- PT-C004: external callbacks under a held lock
+        for s in self.summaries.values():
+            for (held, desc, line, col) in s.external:
+                if held:
+                    emit("PT-C004", s.path, line, col,
+                         f"invoking externally supplied {desc} while "
+                         f"holding {_fmt_locks(held, model)} — "
+                         f"lock-escape hazard (the callback can block "
+                         f"or re-enter the serving stack)")
+            for (held, callee, line, col) in s.calls:
+                if not held:
+                    continue
+                cs = self.summaries.get(callee)
+                if cs is None or not cs.prop_external:
+                    continue
+                name = callee[1] if callee[0].startswith("mod:") \
+                    else f"{callee[0]}.{callee[1]}"
+                descs = sorted({f"{d} at {o}"
+                                for (d, o) in cs.prop_external})
+                emit("PT-C004", s.path, line, col,
+                     f"call into {name} while holding "
+                     f"{_fmt_locks(held, model)} — it invokes an "
+                     f"externally supplied callback "
+                     f"({'; '.join(descs[:3])})")
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
+
+
+def _walk_no_lambda(root):
+    """ast.walk, but do not descend into lambda bodies (deferred
+    execution — a lambda is data until somebody calls it)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _fmt_locks(held: Sequence[str], model: LockModel) -> str:
+    quals = sorted({model.canonical(h) for h in held})
+    return ", ".join(quals)
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Simple DFS cycle enumeration over the canonical edge set;
+    returns each cycle once (rotated to its lexicographically smallest
+    node)."""
+    graph: Dict[str, Set[str]] = {}
+    for h, a in edges:
+        graph.setdefault(h, set()).add(a)
+        graph.setdefault(a, set())
+    cycles: List[List[str]] = []
+    seen_keys: Set[tuple] = set()
+    path: List[str] = []
+    on_path: Set[str] = set()
+    done: Set[str] = set()
+
+    def dfs(n: str):
+        path.append(n)
+        on_path.add(n)
+        for m in sorted(graph.get(n, ())):
+            if m in on_path:
+                i = path.index(m)
+                cyc = path[i:]
+                j = cyc.index(min(cyc))
+                cyc = cyc[j:] + cyc[:j]
+                key = tuple(cyc)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(cyc)
+            elif m not in done:
+                dfs(m)
+        on_path.discard(n)
+        path.pop()
+        done.add(n)
+
+    for n in sorted(graph):
+        if n not in done:
+            dfs(n)
+    return cycles
+
+
+# ---------------------------------------------------------------- driver
+def default_target_paths(root: str) -> List[str]:
+    return [os.path.join(root, t) for t in DEFAULT_TARGETS
+            if os.path.exists(os.path.join(root, t))]
+
+
+def _iter_py(path: str):
+    if os.path.isfile(path):
+        if path.endswith(".py"):
+            yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def build_program(paths: Sequence[str], root: Optional[str] = None
+                  ) -> Tuple[LockGraphProgram, List[str]]:
+    """Parse every .py under `paths` into one program. Paths inside
+    findings are relative to `root`. Returns (program, parse_errors)."""
+    root = os.path.abspath(root or os.getcwd())
+    prog = LockGraphProgram()
+    errors: List[str] = []
+    for p in paths:
+        for f in _iter_py(p):
+            rel = os.path.relpath(os.path.abspath(f), root)
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    src = fh.read()
+                prog.add_module(rel, src)
+            except SyntaxError as e:
+                errors.append(f"{rel}: {e}")
+    return prog, errors
+
+
+def analyze_paths(paths: Sequence[str], model: LockModel,
+                  root: Optional[str] = None
+                  ) -> Tuple[List[Finding], List[str],
+                             "LockGraphProgram"]:
+    prog, errors = build_program(paths, root=root)
+    findings = prog.analyze(model)
+    return findings, errors, prog
+
+
+def predicted_edges(root: str, model: Optional[LockModel] = None
+                    ) -> Set[Tuple[str, str]]:
+    """The static DAG as a set of canonical (held, acquired) pairs —
+    what the runtime witness (testing/locktrace.py) cross-validates
+    against. `root` is the repo root holding lockgraph.json."""
+    if model is None:
+        model = load_model(os.path.join(root, "lockgraph.json"))
+    prog, _errs = build_program(default_target_paths(root), root=root)
+    prog.scan(model)
+    prog.propagate()
+    return {(h, a) for (h, a, *_rest) in prog.edges(model)}

@@ -7,15 +7,18 @@ what the engine and CLI run when no explicit rule set is given.
 from __future__ import annotations
 
 from .concurrency import CONCURRENCY_RULES, LockDisciplineRule
+from .lockorder import LOCKORDER_RULES, LockOrderRule
 from .policy import POLICY_RULES, PolicyCentralizationRule
 from .trace_safety import TRACE_RULES, TraceSafetyRule
 
 __all__ = ["RULE_CATALOG", "default_rules", "TraceSafetyRule",
-           "LockDisciplineRule", "PolicyCentralizationRule"]
+           "LockDisciplineRule", "LockOrderRule",
+           "PolicyCentralizationRule"]
 
-RULE_CATALOG = {**TRACE_RULES, **CONCURRENCY_RULES, **POLICY_RULES}
+RULE_CATALOG = {**TRACE_RULES, **CONCURRENCY_RULES, **LOCKORDER_RULES,
+                **POLICY_RULES}
 
 
 def default_rules():
-    return [TraceSafetyRule(), LockDisciplineRule(),
+    return [TraceSafetyRule(), LockDisciplineRule(), LockOrderRule(),
             PolicyCentralizationRule()]

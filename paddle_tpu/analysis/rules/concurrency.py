@@ -107,40 +107,58 @@ class LockDisciplineRule(Rule):
                     continue
                 held0 = _held_by_decorator(stmt)
                 self._scan(ctx, cls, stmt, stmt.body, guarded,
-                           held0, findings)
+                           held0, findings, {})
 
     def _scan(self, ctx: ModuleContext, cls: ast.ClassDef,
               method: ast.FunctionDef, body: List[ast.stmt],
               guarded: Dict[str, str], held: Set[str],
-              findings: List[Finding]):
-        """Walk statements tracking the set of held locks lexically."""
+              findings: List[Finding],
+              aliases: Optional[Dict[str, str]] = None):
+        """Walk statements tracking the set of held locks lexically.
+        `aliases` maps local names to the lock attr they alias
+        (`lk = self._lock; l2 = lk` makes both keys map to '_lock')."""
+        aliases = {} if aliases is None else aliases
         for stmt in body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                # track lock aliases through chains of any length; a
+                # non-alias assignment to the same name shadows it
+                lock = self._lock_of(stmt.value, aliases) \
+                    if isinstance(stmt.value, (ast.Name, ast.Attribute)) \
+                    else None
+                if lock is not None and lock in set(guarded.values()):
+                    aliases[stmt.targets[0].id] = lock
+                else:
+                    aliases.pop(stmt.targets[0].id, None)
+                self._check_expr(ctx, method, stmt.value, guarded, held,
+                                 findings)
+                continue
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
                 newly = set()
                 for item in stmt.items:
-                    lock = self._lock_of(item.context_expr)
+                    lock = self._lock_of(item.context_expr, aliases)
                     if lock is not None:
                         newly.add(lock)
                     # the with-item expression itself (e.g. self._lock)
                     # is a lock attribute, not guarded data — no check
                 self._scan(ctx, cls, method, stmt.body, guarded,
-                           held | newly, findings)
+                           held | newly, findings, aliases)
                 continue
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # nested def: runs later, with no lock guarantee
                 self._scan(ctx, cls, method, stmt.body, guarded,
-                           _held_by_decorator(stmt), findings)
+                           _held_by_decorator(stmt), findings, {})
                 continue
             if isinstance(stmt, ast.Try):
                 for blk in (stmt.body, stmt.orelse, stmt.finalbody):
                     self._scan(ctx, cls, method, blk, guarded, held,
-                               findings)
+                               findings, aliases)
                 for h in stmt.handlers:
                     if h.type is not None:
                         self._check_expr(ctx, method, h.type, guarded,
                                          held, findings)
                     self._scan(ctx, cls, method, h.body, guarded, held,
-                               findings)
+                               findings, aliases)
                 continue
             # compound statements: recurse into sub-blocks with the
             # same held set, and check expressions hanging off them
@@ -148,7 +166,7 @@ class LockDisciplineRule(Rule):
                 if isinstance(value, list) and value and \
                         isinstance(value[0], ast.stmt):
                     self._scan(ctx, cls, method, value, guarded,
-                               held, findings)
+                               held, findings, aliases)
                 elif isinstance(value, list):
                     for v in value:
                         if isinstance(v, ast.AST):
@@ -158,16 +176,21 @@ class LockDisciplineRule(Rule):
                     self._check_expr(ctx, method, value, guarded,
                                      held, findings)
 
-    def _lock_of(self, expr) -> Optional[str]:
+    def _lock_of(self, expr,
+                 aliases: Optional[Dict[str, str]] = None
+                 ) -> Optional[str]:
         """`with self._lock:` → '_lock' (also unwraps common wrappers
-        like `self._lock.acquire_timeout(...)` call expressions)."""
+        like `self._lock.acquire_timeout(...)` call expressions and
+        local aliases recorded by _scan)."""
+        if isinstance(expr, ast.Name) and aliases:
+            return aliases.get(expr.id)
         name = _dotted(expr)
         if name and name.startswith("self."):
             return name[len("self."):]
         if isinstance(expr, ast.Call):
-            return self._lock_of(expr.func)
+            return self._lock_of(expr.func, aliases)
         if isinstance(expr, ast.Attribute):
-            return self._lock_of(expr.value)
+            return self._lock_of(expr.value, aliases)
         return None
 
     def _check_expr(self, ctx: ModuleContext, method: ast.FunctionDef,

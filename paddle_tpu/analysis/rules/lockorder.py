@@ -1,0 +1,50 @@
+"""Per-module front end for the lockgraph analyzer (PT-C002..C004).
+
+The real analysis is whole-program (paddle_tpu/analysis/lockgraph.py,
+driven by tools/lockgraph.py against the committed lockgraph.json); this
+Rule runs the same engine over ONE module at a time so the three rules
+participate in the ordinary ptlint pipeline — fixtures, suppressions,
+baseline, `--select PT-C003` — without the CLI.
+
+In single-module mode the declared order comes from a module-level
+
+    _LOCK_ORDER = ["Outer._lock", "Inner._lock", ...]
+
+literal (outermost first), which is how the tests/data/ptlint fixtures
+declare theirs. A module with no such literal is checked for blocking
+calls and callback escapes (PT-C003/PT-C004 need no declared order) and
+for acquisition CYCLES, but edges cannot invert an order that was never
+declared — so repo modules without the literal stay quiet on PT-C002
+and the committed lockgraph.json remains the single source of truth for
+the fleet-wide order.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..ast_core import Finding, ModuleContext, Rule
+from ..lockgraph import (LOCKGRAPH_RULES, LockGraphProgram, LockModel,
+                         _infile_order)
+
+__all__ = ["LockOrderRule", "LOCKORDER_RULES"]
+
+LOCKORDER_RULES = dict(LOCKGRAPH_RULES)
+
+
+class LockOrderRule(Rule):
+    ids = tuple(LOCKORDER_RULES)
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        order = _infile_order(ctx.tree)
+        prog = LockGraphProgram()
+        prog.add_module(ctx.path, ctx.source, tree=ctx.tree)
+        model = LockModel(order=order)
+        findings: List[Finding] = prog.analyze(model)
+        if not order:
+            # no declared order -> every edge would be "undeclared";
+            # keep only rank-independent findings (cycles, blocking,
+            # callbacks) so undeclared modules aren't noise
+            findings = [f for f in findings
+                        if f.rule != "PT-C002"
+                        or "cycle" in f.message]
+        return findings

@@ -446,6 +446,7 @@ class LLMEngine:
         "_next_id": "_lock",
         "_next_trace": "_lock",
         "_pending_outputs": "_lock",
+        "_flights": "_lock",
         "stats": "_lock",
         "_step_start": "_lock",
     }
@@ -497,6 +498,7 @@ class LLMEngine:
         self._next_id = 0
         self._next_trace = 0
         self._pending_outputs: List[RequestOutput] = []
+        self._flights: List[tuple] = []   # deferred flight-recorder dumps
         self._step_start = 0.0
         if faults is None:
             # env-driven (PADDLE_TPU_SERVE_FAULTS), inert without a spec
@@ -774,11 +776,14 @@ class LLMEngine:
         self._finish_abnormal(req, RequestState.FINISHED_ERROR, "error",
                               outs, scrub=True)
         # flight recorder: a quarantine is a postmortem trigger — when
-        # armed, ship the victim's full timeline + registry snapshot
-        obs.reqtrace.maybe_flight(
+        # armed, ship the victim's full timeline + registry snapshot.
+        # The dump is file I/O, so it is only QUEUED here; step() writes
+        # it after the engine lock is released (PT-C003) — a slow disk
+        # must not stall intake threads mid-step.
+        self._flights.append((
             "quarantine", [req.tid],
-            extra={"why": why, "engine": self.stats.label,
-                   "request_id": req.request_id})
+            {"why": why, "engine": self.stats.label,
+             "request_id": req.request_id}))
 
     @holds_lock("_lock")
     def _recover(self, decode: List[Request], offenders: List[Request],
@@ -806,7 +811,16 @@ class LLMEngine:
         from ...distributed import elastic
         elastic.heartbeat()                  # no-op when unsupervised
         with self._lock:
-            return self._step_locked()
+            outs = self._step_locked()
+            flights, self._flights = self._flights, []
+        # flight-recorder dumps queued by _quarantine are written here,
+        # AFTER the engine lock is released (PT-C003). In fleet mode
+        # this still rides under the owning replica's lock — that lock
+        # is per-replica, so the blast radius of slow disk I/O is one
+        # replica, not the router or its siblings.
+        for reason, ids, extra in flights:
+            obs.reqtrace.maybe_flight(reason, ids, extra=extra)
+        return outs
 
     @holds_lock("_lock")
     def _step_locked(self) -> List[RequestOutput]:
@@ -816,6 +830,9 @@ class LLMEngine:
         step_no = self.stats.steps
         self._step_start = time.perf_counter()
         with RecordEvent("serving.engine_step", cat="serving") as step_ev:
+            # ptlint: disable=PT-C004  fault injector: inert no-op in
+            # production (env-gated); chaos tests NEED it inside the lock
+            # to corrupt state at the exact point a real fault would
             self.faults.corrupt_cache(step_no, self.cache)
             self._expire_and_abort(outs)
             t0 = time.perf_counter()
@@ -844,6 +861,7 @@ class LLMEngine:
                 self.stats.prefill_tokens += int(tokens.size)
                 prefill_spend += int(tokens.size)
                 self.stats.time_prefill += time.perf_counter() - t0
+                # ptlint: disable=PT-C004  fault injector (see step())
                 logits = self.faults.poison_logits(step_no, logits)
                 # logits are already host numpy (_prefill fetched them);
                 # the host-side check avoids re-uploading them through a
@@ -870,6 +888,8 @@ class LLMEngine:
                 k = self.config.decode_chunk_size
                 with RecordEvent("serving.decode", cat="decode") as ev:
                     ev.args = {"num_seqs": len(decode), "chunk": k}
+                    # ptlint: disable=PT-C004  fault injector: stalls ON
+                    # PURPOSE under the lock to exercise the watchdog
                     self.faults.stall(step_no)
                     try:
                         toks, bad = self._decode_chunk(decode, k)
@@ -884,6 +904,7 @@ class LLMEngine:
                     # the not-finite flags were computed IN-SCAN and
                     # arrived with the chunk fetch — anomaly attribution
                     # costs no extra sync (and no host re-reduction)
+                    # ptlint: disable=PT-C004  fault injector (see step())
                     bad = self.faults.poison_chunk(step_no, bad)
                     if bad.any():
                         # a bad row poisons the whole chunk: every

@@ -131,6 +131,9 @@ class EngineReplica:
         with self._lock:
             if self.engine is None:
                 return None
+            # ptlint: disable=PT-C003  postmortem-only I/O: the flight
+            # dump inside check_integrity fires IFF the pool is corrupt,
+            # right before the raise condemns this replica anyway
             return self.engine.cache.check_integrity()
 
     # ------------------------------------------------------------ intake
@@ -189,6 +192,10 @@ class EngineReplica:
                 self.last_step_end = time.monotonic()
                 return []
             try:
+                # ptlint: disable=PT-C003  engine.step flushes its OWN
+                # deferred flight dumps outside the ENGINE lock; here
+                # that tail rides under this replica's lock — per-replica
+                # blast radius, bounded by the ring's flight budget
                 outs = self.engine.step()
             except Exception as e:
                 raise ReplicaCrashed(
@@ -260,6 +267,10 @@ class EngineReplica:
         with self._lock:
             self.state = ReplicaState.STARTING
             try:
+                # ptlint: disable=PT-C004  restart MUST swap the engine
+                # atomically under the replica lock — a half-built engine
+                # visible to dispatch() is worse than a slow factory (the
+                # router tolerates a slow restart; it routes around DOWN)
                 self.engine = self._factory(self.index, self.restarts)
                 self._probe()
             except Exception as e:          # noqa: BLE001 — any probe
@@ -282,6 +293,8 @@ class EngineReplica:
             SamplingParams(max_tokens=1, temperature=0.0),
             request_id=f"warmup-probe-r{self.index}-i{self.restarts}")
         for _ in range(self.probe_timeout_steps):
+            # ptlint: disable=PT-C003  warmup probe of a PRIVATE engine
+            # not yet published to dispatch(); nothing else can contend
             eng.step()
             req = eng.get_request(rid)
             if req.finished:

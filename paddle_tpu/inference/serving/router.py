@@ -158,6 +158,7 @@ class ReplicaSet:
         "_rr_next": "_lock",
         "_orphans": "_lock",
         "_pending": "_lock",
+        "_flights": "_lock",
         "_steps": "_lock",
         "_step_ewma": "_lock",
         "recovery_times": "_lock",
@@ -212,6 +213,7 @@ class ReplicaSet:
         self._rr_next = 0                 # round_robin cursor
         self._orphans: List[RouterRequest] = []
         self._pending: List[RequestOutput] = []
+        self._flights: List[tuple] = []   # deferred flight-recorder dumps
         self._steps = 0
         self._step_ewma = 0.05            # drain-rate estimate seed (s)
         self.recovery_times: List[float] = []
@@ -460,7 +462,14 @@ class ReplicaSet:
         supervision, then run the heartbeat wedge check. Returns the
         merged streamed outputs."""
         with self._lock:
-            return self._step_locked()
+            outs = self._step_locked()
+            flights, self._flights = self._flights, []
+        # flight-recorder dumps are file I/O — run them AFTER releasing
+        # the router lock (PT-C003) so a slow disk cannot stall intake
+        # threads or the whole fleet's step loop
+        for reason, ids, extra in flights:
+            obs.reqtrace.maybe_flight(reason, ids, extra=extra)
+        return outs
 
     @holds_lock("_lock")
     def _step_locked(self) -> List[RequestOutput]:
@@ -567,13 +576,15 @@ class ReplicaSet:
         self._readmit_orphans(outs)
         # flight recorder: a failover is a postmortem trigger — when
         # armed, dump the victims' timelines (incl. the re-admission
-        # hops just recorded) plus the registry snapshot
-        obs.reqtrace.maybe_flight(
+        # hops just recorded) plus the registry snapshot. The dump is
+        # file I/O, so it is only QUEUED here; step() writes it after
+        # the router lock is released (PT-C003).
+        self._flights.append((
             "failover",
             [rec.trace_id or rec.request_id for rec in victims],
-            extra={"router": self.label, "replica": rep.index,
-                   "reason": reason, "detail": detail,
-                   "victims": [rec.request_id for rec in victims]})
+            {"router": self.label, "replica": rep.index,
+             "reason": reason, "detail": detail,
+             "victims": [rec.request_id for rec in victims]}))
 
     @holds_lock("_lock")
     def _readmit_orphans(self, outs) -> None:

@@ -333,6 +333,9 @@ class Scheduler:
                 remaining = max(0, req.params.max_tokens
                                 - len(req.output_ids))
                 demand += self.cache.blocks_needed(tokens + remaining)
+                # ptlint: disable=PT-C004  admission cost model: pure
+                # arithmetic over committed-plan coefficients (jaxplan),
+                # contractually non-blocking and non-reentrant
                 cost += cost_model.cost(tokens) if cost_model else tokens
             for req in self.running:
                 tokens = len(req.prompt_ids) + len(req.output_ids)
@@ -523,6 +526,7 @@ class Scheduler:
         #    way the head of line may overflow an untouched budget so a
         #    maximal request cannot starve.
         cost_model = self.config.prefill_cost_model
+        # ptlint: disable=PT-C004  admission cost model (see backlog())
         budget = cost_model.budget(self.config.max_prefill_tokens) \
             if cost_model else self.config.max_prefill_tokens
         mark = self.config.cache_high_watermark
@@ -547,6 +551,7 @@ class Scheduler:
             chunked = (thr is not None and len(tokens) > thr) \
                 or cached_probe > 0
             eff = min(chunk, uncached) if chunked else len(tokens)
+            # ptlint: disable=PT-C004  admission cost model (see backlog())
             price = cost_model.cost(eff) if cost_model else eff
             if price > budget and admitted:
                 break                        # budget spent; next step

@@ -1,0 +1,237 @@
+"""locktrace: runtime witness for the serving fleet's lock order.
+
+The static half of this check is paddle_tpu/analysis/lockgraph.py: it
+PREDICTS the lock-acquisition DAG from source. This module OBSERVES the
+real one. TracedLock wraps a threading.RLock/Lock; every successful
+acquisition records, per thread, the edge from each lock already held
+by that thread to the newly acquired one (class-qualified names, e.g.
+``ReplicaSet._lock -> LLMEngine._lock``), plus a bounded log of
+acquisition spans (wait start / acquired / released, perf_counter
+clock — the same clock as reqtrace events, so tools/reqtrace.py can
+merge the spans onto the per-request chrome timeline).
+
+Two checks close the loop, run by the chaos/load harnesses after a
+witnessed run:
+
+- ``witness.cycle_check()``: the WITNESSED graph must be acyclic — a
+  cycle here is two interleavable lock paths that can deadlock, caught
+  on real executions rather than inferred ones.
+- ``witness.cross_validate(predicted)``: every witnessed edge must
+  appear in the static DAG (``lockgraph.predicted_edges(repo_root)``).
+  A witnessed-but-unpredicted edge means the analyzer lost track of a
+  call path (or the code grew one the model never saw) — a finding in
+  either the analyzer or the code, so the static model cannot rot
+  silently.
+
+Reentrant re-acquisition is tracked per lock INSTANCE (an RLock held
+twice by one thread records no edge), while edges are recorded per lock
+NAME — two different replicas' ``_lock`` are distinct instances of one
+graph node, exactly like the static view.
+
+Instrumentation is by reference-swapping: ``instrument_fleet`` replaces
+``rs._lock``, every replica's/engine's/scheduler's ``_lock`` and wraps
+each replica's engine FACTORY so restarted incarnations come up traced;
+``instrument_obs`` swaps the metric registry's shared lock (walking
+existing families/children, which alias the same object) and the
+reqtrace ring's. Everything here is stdlib-only.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["TracedLock", "LockWitness", "instrument_fleet",
+           "instrument_engine", "instrument_obs"]
+
+
+class LockWitness:
+    """Collects acquisition edges + spans from every TracedLock that
+    shares it. Thread-safe; one witness per harness run."""
+
+    def __init__(self, max_spans: int = 65536):
+        self._mu = threading.Lock()          # guards edges/spans
+        self._tls = threading.local()        # per-thread holder stack
+        # (src, dst) -> {count, example holder stack}
+        self.edge_info: Dict[Tuple[str, str], dict] = {}
+        self.spans = deque(maxlen=max_spans)
+        self.acquisitions = 0
+
+    # ------------------------------------------------- TracedLock hooks
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquired(self, lock: "TracedLock", wait_start: float) -> None:
+        acquired = time.perf_counter()
+        st = self._stack()
+        reentrant = any(fr[1] is lock for fr in st)
+        if not reentrant:
+            held = []
+            seen = set()
+            for name, _inst, _t0, _t1 in st:
+                if name not in seen:
+                    seen.add(name)
+                    held.append(name)
+            with self._mu:
+                self.acquisitions += 1
+                for src in held:
+                    if src == lock.name:
+                        continue
+                    info = self.edge_info.setdefault(
+                        (src, lock.name),
+                        {"count": 0, "stack": list(held),
+                         "thread": threading.current_thread().name})
+                    info["count"] += 1
+        st.append((lock.name, lock, wait_start, acquired))
+
+    def on_released(self, lock: "TracedLock") -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][1] is lock:
+                name, _inst, wait_start, acquired = st.pop(i)
+                now = time.perf_counter()
+                with self._mu:
+                    self.spans.append(
+                        {"name": name, "wait_start": wait_start,
+                         "acquired": acquired, "released": now,
+                         "thread": threading.current_thread().name,
+                         "tid": threading.get_ident()})
+                return
+
+    # ------------------------------------------------------ the checks
+    def edges(self) -> Set[Tuple[str, str]]:
+        with self._mu:
+            return set(self.edge_info)
+
+    def cycle_check(self) -> List[List[str]]:
+        """Cycles in the witnessed graph (empty list == pass)."""
+        from ..analysis.lockgraph import _find_cycles
+        return _find_cycles(self.edges())
+
+    def cross_validate(self, predicted: Iterable[Tuple[str, str]]
+                       ) -> List[Tuple[str, str]]:
+        """Witnessed edges the static analyzer did NOT predict (empty
+        list == pass). Site-insensitive on purpose: a dynamic call path
+        (getattr-built stats properties, restarted engines) passes as
+        long as the static DAG predicts the PAIR via any path."""
+        predicted = set(predicted)
+        return sorted(e for e in self.edges() if e not in predicted)
+
+    def report(self, predicted: Optional[Iterable[Tuple[str, str]]]
+               = None) -> dict:
+        with self._mu:
+            edges = [{"src": s, "dst": d, "count": i["count"],
+                      "thread": i["thread"], "stack": i["stack"]}
+                     for (s, d), i in sorted(self.edge_info.items())]
+            n_spans = len(self.spans)
+        out = {"acquisitions": self.acquisitions, "edges": edges,
+               "spans": n_spans, "cycles": self.cycle_check()}
+        if predicted is not None:
+            out["unpredicted_edges"] = [list(e) for e in
+                                        self.cross_validate(predicted)]
+        return out
+
+    def span_list(self) -> List[dict]:
+        with self._mu:
+            return list(self.spans)
+
+
+class TracedLock:
+    """Drop-in wrapper over threading.RLock/Lock that reports to a
+    LockWitness. Only the acquire/release/context-manager surface is
+    wrapped — the serving stack uses locks exclusively as context
+    managers (enforced by PT-C001's lexical discipline)."""
+
+    __slots__ = ("name", "inner", "witness")
+
+    def __init__(self, name: str, inner, witness: LockWitness):
+        self.name = name
+        self.inner = inner
+        self.witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1
+                ) -> bool:
+        t0 = time.perf_counter()
+        ok = self.inner.acquire(blocking, timeout)
+        if ok:
+            self.witness.on_acquired(self, t0)
+        return ok
+
+    def release(self) -> None:
+        self.witness.on_released(self)
+        self.inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"TracedLock({self.name!r}, {self.inner!r})"
+
+
+def _swap(obj, attr: str, name: str, witness: LockWitness
+          ) -> Optional[TracedLock]:
+    inner = getattr(obj, attr, None)
+    if inner is None or isinstance(inner, TracedLock):
+        return inner if isinstance(inner, TracedLock) else None
+    traced = TracedLock(name, inner, witness)
+    setattr(obj, attr, traced)
+    return traced
+
+
+def instrument_obs(witness: LockWitness, registry=None, ring=None
+                   ) -> None:
+    """Trace the metric registry's shared lock and the reqtrace ring's.
+    The registry threads ONE lock object through every Family and child
+    metric (``_declare`` passes ``lock=self._lock``), so the existing
+    families/children must be re-pointed at the same TracedLock;
+    families declared AFTER instrumentation inherit it automatically."""
+    from .. import obs
+    from ..obs import reqtrace as reqtrace_mod
+    registry = registry if registry is not None else obs.REGISTRY
+    ring = ring if ring is not None else reqtrace_mod.RING
+    traced = _swap(registry, "_lock", "MetricRegistry._lock", witness)
+    if traced is not None:
+        for fam in registry.families():
+            fam._lock = traced
+            for _labels, child in fam.children():
+                child._lock = traced
+    _swap(ring, "_lock", "ReqTraceRing._lock", witness)
+
+
+def instrument_engine(engine, witness: LockWitness) -> None:
+    """Trace one LLMEngine's lock and its scheduler's."""
+    _swap(engine, "_lock", "LLMEngine._lock", witness)
+    if getattr(engine, "scheduler", None) is not None:
+        _swap(engine.scheduler, "_lock", "Scheduler._lock", witness)
+
+
+def instrument_fleet(rs, witness: LockWitness, obs_too: bool = True
+                     ) -> LockWitness:
+    """Trace a ReplicaSet end to end: router lock, every replica's
+    lock, every live engine (+scheduler), and — via a factory wrap —
+    every engine a future restart builds. Idempotent."""
+    _swap(rs, "_lock", "ReplicaSet._lock", witness)
+    for rep in rs.replicas:
+        _swap(rep, "_lock", "EngineReplica._lock", witness)
+        if rep.engine is not None:
+            instrument_engine(rep.engine, witness)
+        factory = rep._factory
+        if not getattr(factory, "_locktraced", False):
+            def traced_factory(index, incarnation, _orig=factory):
+                eng = _orig(index, incarnation)
+                instrument_engine(eng, witness)
+                return eng
+            traced_factory._locktraced = True
+            rep._factory = traced_factory
+    if obs_too:
+        instrument_obs(witness)
+    return witness

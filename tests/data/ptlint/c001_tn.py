@@ -35,3 +35,12 @@ class SafePool:
     def record(self):
         with self._lock:
             self._bump_locked()
+
+    def take_via_alias_chain(self):
+        # a local alias of the guard — even through a chain of
+        # assignments — still counts as holding it
+        lk = self._lock
+        l2 = lk
+        with l2:
+            self.hits += 1
+            return len(self.items)

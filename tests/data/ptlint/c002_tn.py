@@ -1,0 +1,39 @@
+"""PT-C002 true negative: every acquisition follows the declared order.
+
+``Outer._lock`` (outermost) is always taken before ``Inner._lock`` —
+directly nested and through a locked call — so the inferred edges all
+point down the declared order and the module is quiet.
+"""
+import threading
+
+_LOCK_ORDER = ["Outer._lock", "Inner._lock"]
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+
+    def tick(self):
+        with self._lock:
+            self.pending += 1
+
+
+class Outer:
+    def __init__(self, inner: Inner):
+        self._lock = threading.Lock()
+        self.inner = inner
+
+    def good_direct(self, inner: Inner):
+        with self._lock:
+            with inner._lock:
+                pass
+
+    def good_transitive(self):
+        with self._lock:
+            self.inner.tick()
+
+    def reentrant(self):
+        with self._lock:
+            with self._lock:
+                self.inner.tick()

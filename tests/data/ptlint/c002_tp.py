@@ -1,0 +1,35 @@
+"""PT-C002 true positives: acquisitions that invert the declared order.
+
+``Outer._lock`` is declared OUTERMOST, yet both methods below acquire
+it while already holding ``Inner._lock`` — once directly, once
+transitively through a locked call into ``Outer.flush`` — the
+interleaving-deadlock shape the rule exists to catch.
+"""
+import threading
+
+_LOCK_ORDER = ["Outer._lock", "Inner._lock"]
+
+
+class Outer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def flush(self):
+        with self._lock:
+            self.items.clear()
+
+
+class Inner:
+    def __init__(self, outer: Outer):
+        self._lock = threading.Lock()
+        self.outer = outer
+
+    def bad_direct(self, outer: Outer):
+        with self._lock:
+            with outer._lock:  # expect: PT-C002
+                pass
+
+    def bad_transitive(self):
+        with self._lock:
+            self.outer.flush()  # expect: PT-C002

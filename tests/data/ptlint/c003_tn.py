@@ -1,0 +1,25 @@
+"""PT-C003 true negative: the deferred-flush pattern.
+
+Blocking work (file I/O, pacing sleeps) happens strictly OUTSIDE the
+lock: state is drained under the lock, flushed after release — the
+shape router.step()/engine.step() use for flight-recorder dumps.
+"""
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+
+    def drain_then_flush(self, path):
+        with self._lock:
+            batch, self.pending = self.pending, []
+        with open(path, "w") as f:
+            f.write(repr(batch))
+
+    def paced_tick(self):
+        time.sleep(0.001)
+        with self._lock:
+            self.pending.append(time.monotonic())

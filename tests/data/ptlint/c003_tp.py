@@ -1,0 +1,31 @@
+"""PT-C003 true positives: blocking calls on locked paths.
+
+A sleep and file I/O directly under the lock, plus a locked call into
+a helper whose body blocks — the transitive case the interprocedural
+summary propagation exists for.
+"""
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.01)  # expect: PT-C003
+
+    def bad_io(self, path):
+        with self._lock:
+            with open(path) as f:  # expect: PT-C003
+                self.state["raw"] = f.read()
+
+    def _flush_slow(self, path):
+        with open(path, "w") as f:
+            f.write(repr(self.state))
+
+    def bad_transitive(self, path):
+        with self._lock:
+            self._flush_slow(path)  # expect: PT-C003

@@ -1,0 +1,26 @@
+"""PT-C004 true negative: drain-then-notify.
+
+The externally supplied callback fires only AFTER the lock is
+released; calls made under the lock go to this class's own methods,
+which the analyzer can see through.
+"""
+import threading
+
+
+class Engine:
+    def __init__(self, on_token):
+        self._lock = threading.Lock()
+        self._on_token = on_token
+        self.emitted = 0
+
+    def _bump(self):
+        self.emitted += 1
+
+    def step(self, toks):
+        fired = []
+        with self._lock:
+            for t in toks:
+                self._bump()
+                fired.append(t)
+        for t in fired:
+            self._on_token(t)

@@ -1,0 +1,29 @@
+"""PT-C004 true positives: externally supplied callbacks invoked while
+holding an engine lock.
+
+``on_token``/``exporter`` arrive unannotated through ``__init__`` — the
+analyzer cannot see their bodies, so invoking them under ``_lock`` is a
+lock-escape hazard (they can block, or re-enter the engine and
+deadlock). One direct invocation, one through a locked helper call.
+"""
+import threading
+
+
+class Engine:
+    def __init__(self, on_token, exporter):
+        self._lock = threading.Lock()
+        self._on_token = on_token
+        self._exporter = exporter
+        self.emitted = 0
+
+    def bad_callback(self, tok):
+        with self._lock:
+            self.emitted += 1
+            self._on_token(tok)  # expect: PT-C004
+
+    def _notify(self, snap):
+        self._exporter(snap)
+
+    def bad_transitive(self):
+        with self._lock:
+            self._notify(self.emitted)  # expect: PT-C004

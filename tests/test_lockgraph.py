@@ -1,0 +1,308 @@
+"""Tier-1 tests for the lockgraph suite (docs/static_analysis.md).
+
+Three layers, mirroring the check itself:
+
+  1. analyzer mechanics — order inversions, undeclared locks, cycles,
+     transitive acquisition inference, and the propagation boundary
+     (a blocking callee is reported at the locked call site, not at
+     every caller above it);
+  2. CLI gate — `tools/lockgraph.py --check` exit-code semantics
+     (0 clean / 1 findings / 2 model+parse errors), suppressions with
+     reasons, and the repo-wide zero-unsuppressed acceptance gate;
+  3. runtime witness — locktrace records real acquisition edges,
+     reentrancy is edge-free, the witnessed graph cycle-checks, and
+     cross-validation flags a seeded edge the static DAG never
+     predicted (the analyzer-rot tripwire).
+
+The PT-C002/3/4 fixture corpus in tests/data/ptlint/ is exercised by
+test_static_analysis.py's parametrized fixture runner.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import lockgraph as lg
+from paddle_tpu.analysis.lockgraph import (LockGraphProgram, LockModel,
+                                           _find_cycles)
+from paddle_tpu.testing.locktrace import LockWitness, TracedLock
+
+pytestmark = pytest.mark.lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CLI = REPO / "tools" / "lockgraph.py"
+FIXDIR = REPO / "tests" / "data" / "ptlint"
+
+
+def _analyze(src, order=()):
+    prog = LockGraphProgram()
+    prog.add_module("mod.py", textwrap.dedent(src))
+    model = LockModel(order=list(order))
+    return prog.analyze(model), prog, model
+
+
+# ---------------------------------------------------- analyzer mechanics
+_TWO_LOCKS = """
+import threading
+
+
+class Outer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self):
+        with self._lock:
+            pass
+
+
+class Inner:
+    def __init__(self, outer: Outer):
+        self._lock = threading.Lock()
+        self.outer = outer
+
+    def bad(self):
+        with self._lock:
+            self.outer.flush()
+"""
+
+
+def test_order_inversion_is_found():
+    findings, _, _ = _analyze(_TWO_LOCKS,
+                              order=["Outer._lock", "Inner._lock"])
+    assert [f.rule for f in findings] == ["PT-C002"]
+    assert "INVERTS" in findings[0].message
+
+
+def test_conforming_order_is_clean():
+    findings, _, _ = _analyze(_TWO_LOCKS,
+                              order=["Inner._lock", "Outer._lock"])
+    assert not findings
+
+
+def test_undeclared_lock_is_a_finding():
+    findings, _, _ = _analyze(_TWO_LOCKS, order=["Inner._lock"])
+    assert findings
+    assert "not in the declared lock order" in findings[0].message
+
+
+_CYCLE = """
+import threading
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def lockme(self):
+        with self._lock:
+            pass
+
+    def a_then_b(self, b: B):
+        with self._lock:
+            b.lockme()
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def lockme(self):
+        with self._lock:
+            pass
+
+    def b_then_a(self, a: A):
+        with self._lock:
+            a.lockme()
+"""
+
+
+def test_cycle_is_found():
+    findings, _, _ = _analyze(_CYCLE, order=["A._lock", "B._lock"])
+    assert any("cycle" in f.message for f in findings)
+    # the inverted direction is also called out on its own line
+    assert any("INVERTS" in f.message for f in findings)
+
+
+_TRANSITIVE = """
+import threading
+
+
+class Deep:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def leaf(self):
+        with self._lock:
+            pass
+
+    def mid(self):
+        self.leaf()
+
+
+class Top:
+    def __init__(self, deep: Deep):
+        self._lock = threading.Lock()
+        self.deep = deep
+
+    def entry(self):
+        with self._lock:
+            self.deep.mid()
+"""
+
+
+def test_transitive_acquisition_inference():
+    findings, prog, model = _analyze(
+        _TRANSITIVE, order=["Top._lock", "Deep._lock"])
+    assert not findings
+    # Deep.mid acquires Deep._lock only through leaf(), yet the edge
+    # from Top's locked call is still inferred
+    assert "Deep._lock" in prog.summaries[("Deep", "mid")].enters
+    assert ("Top._lock", "Deep._lock") in {
+        (h, a) for (h, a, *_rest) in prog.edges(model)}
+
+
+_BLOCKING = """
+import threading
+import time
+
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _slow(self):
+        time.sleep(0.5)
+
+    def locked_entry(self):
+        with self._lock:
+            self._slow()
+
+    def outer(self):
+        self.locked_entry()
+"""
+
+
+def test_blocking_reported_at_locked_call_site_only():
+    """The finding lands where the lock meets the blocking callee —
+    it is NOT propagated to every caller further up the stack."""
+    findings, _, _ = _analyze(_BLOCKING, order=["W._lock"])
+    assert len(findings) == 1
+    assert findings[0].rule == "PT-C003"
+    assert "_slow" in findings[0].message
+
+
+# ------------------------------------------------------------- CLI gate
+def _cli(*args):
+    return subprocess.run([sys.executable, str(CLI), *args],
+                          capture_output=True, text=True, cwd=str(REPO))
+
+
+def test_cli_repo_check_is_clean():
+    """Acceptance gate: zero unsuppressed findings over the fleet."""
+    res = _cli("--check")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "clean" in res.stdout
+
+
+def test_cli_findings_exit_one_and_name_the_rule():
+    res = _cli(str(FIXDIR / "c003_tp.py"))
+    assert res.returncode == 1
+    assert "PT-C003" in res.stdout
+
+
+def test_cli_json_output_is_parseable():
+    res = _cli("--format", "json", str(FIXDIR / "c002_tp.py"))
+    assert res.returncode == 1
+    payload = json.loads(res.stdout)
+    assert {f["rule"] for f in payload["findings"]} == {"PT-C002"}
+    assert payload["order"], "committed model carries a declared order"
+
+
+def test_cli_suppression_with_reason_silences(tmp_path):
+    bad = tmp_path / "w.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        import time
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.01)  # ptlint: disable=PT-C003  fixture
+        """))
+    res = _cli(str(bad))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "1 suppressed" in res.stdout
+
+
+def test_cli_bad_model_exits_two():
+    res = _cli("--model", "/nonexistent/lockgraph.json")
+    assert res.returncode == 2
+
+
+def test_cli_parse_error_exits_two(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    res = _cli(str(bad))
+    assert res.returncode == 2
+
+
+# ------------------------------------------------------ runtime witness
+def _nest(witness, names):
+    """Acquire the named locks in order, release in reverse."""
+    locks = [TracedLock(n, threading.Lock(), witness) for n in names]
+    for lk in locks:
+        lk.acquire()
+    for lk in reversed(locks):
+        lk.release()
+
+
+def test_witness_records_edges_and_cycle_checks():
+    w = LockWitness()
+    _nest(w, ["A._lock", "B._lock"])
+    assert w.edges() == {("A._lock", "B._lock")}
+    assert w.cycle_check() == []
+    assert w.cross_validate({("A._lock", "B._lock")}) == []
+    # the opposite interleaving closes a cycle
+    _nest(w, ["B._lock", "A._lock"])
+    assert w.cycle_check()
+
+
+def test_witness_reentrancy_is_edge_free():
+    w = LockWitness()
+    lk = TracedLock("R._lock", threading.RLock(), w)
+    with lk:
+        with lk:
+            pass
+    assert w.edges() == set()
+    assert w.acquisitions == 1
+    assert len(w.span_list()) == 2
+
+
+def test_predicted_edges_are_acyclic_and_cover_the_fleet():
+    predicted = lg.predicted_edges(str(REPO))
+    assert ("ReplicaSet._lock", "LLMEngine._lock") in predicted
+    assert ("LLMEngine._lock", "Scheduler._lock") in predicted
+    assert _find_cycles(predicted) == []
+
+
+def test_seeded_unpredicted_edge_fails_cross_validation():
+    """The analyzer-rot tripwire: a witnessed edge the static DAG never
+    predicted (here the seeded inversion Scheduler -> ReplicaSet) must
+    surface as a cross-validation failure."""
+    predicted = lg.predicted_edges(str(REPO))
+    w = LockWitness()
+    _nest(w, ["ReplicaSet._lock", "Scheduler._lock"])   # predicted
+    _nest(w, ["Scheduler._lock", "ReplicaSet._lock"])   # seeded rogue
+    assert w.cross_validate(predicted) == [
+        ("Scheduler._lock", "ReplicaSet._lock")]
+    rep = w.report(predicted)
+    assert rep["unpredicted_edges"] == [
+        ["Scheduler._lock", "ReplicaSet._lock"]]

@@ -65,6 +65,51 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 DEFAULT_FAULTS = "nan_logits@4,stall@7:0.1,cache_corrupt@10,nan_logits@13"
 
 
+def _lock_witness():
+    """Fresh runtime lock witness + the statically predicted DAG
+    (paddle_tpu/analysis/lockgraph.py over the committed
+    lockgraph.json). Chaos runs execute entirely under the witness; the
+    report gates on (a) the witnessed graph being cycle-free and (b)
+    every witnessed edge being statically predicted."""
+    import paddle_tpu
+    from paddle_tpu.analysis import lockgraph
+    from paddle_tpu.testing.locktrace import LockWitness
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+    predicted = lockgraph.predicted_edges(root)
+    return LockWitness(), predicted
+
+
+def _audit_witness(witness, predicted, report: dict,
+                   spans_path: str = "") -> None:
+    """Fold the lock-order audit into a chaos report and gate on it.
+    `spans_path` additionally persists the witnessed acquisition spans
+    (perf_counter clock — the same clock reqtrace events use) so
+    `tools/reqtrace.py --chrome OUT --locks spans.json` can overlay
+    lock wait/hold tracks on the per-request timeline."""
+    lock_rep = witness.report(predicted)
+    report["lockgraph"] = {
+        "acquisitions": lock_rep["acquisitions"],
+        "witnessed_edges": [f"{e['src']} -> {e['dst']}"
+                            for e in lock_rep["edges"]],
+        "cycles": lock_rep["cycles"],
+        "unpredicted_edges": lock_rep["unpredicted_edges"],
+    }
+    if spans_path:
+        # written BEFORE the asserts: a failing run's spans are exactly
+        # the ones the postmortem wants
+        with open(spans_path, "w") as f:
+            json.dump({"kind": "locktrace", "clock": "perf_counter",
+                       "spans": witness.span_list()}, f)
+        report["lockgraph"]["spans_path"] = spans_path
+    assert not lock_rep["cycles"], \
+        f"witnessed lock graph has cycles: {lock_rep['cycles']}"
+    assert not lock_rep["unpredicted_edges"], \
+        "witnessed lock edges the static analyzer did not predict " \
+        f"(stale lockgraph model?): {lock_rep['unpredicted_edges']}"
+
+
 def _build_model(vocab=97, hidden=32, layers=2, heads=4, seq=48):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig
@@ -78,7 +123,8 @@ def _build_model(vocab=97, hidden=32, layers=2, heads=4, seq=48):
 
 def run_chaos(seed: int = 0, n_requests: int = 16,
               faults: str = DEFAULT_FAULTS, max_steps: int = 400,
-              cancel_every: int = 0, prefix_cache: bool = False) -> dict:
+              cancel_every: int = 0, prefix_cache: bool = False,
+              witness_out: str = "") -> dict:
     """One seeded chaos run; returns the audit report dict. Raises
     AssertionError on a lost request, a leaked block, or a survivor
     whose tokens diverge from the unfaulted reference run.
@@ -91,7 +137,11 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
     from paddle_tpu.inference.serving import (EngineConfig, LLMEngine,
                                               SamplingParams)
     from paddle_tpu.testing.faults import ServingFaultInjector
+    from paddle_tpu.testing.locktrace import (instrument_engine,
+                                              instrument_obs)
 
+    witness, predicted = _lock_witness()
+    instrument_obs(witness)
     model, cfg = _build_model()
     rng = np.random.RandomState(seed)
     if prefix_cache:
@@ -118,6 +168,7 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
 
     def drive(injector, do_cancel):
         eng = LLMEngine.from_model(model, ecfg, faults=injector)
+        instrument_engine(eng, witness)
         # cancellation draws come from their own stream so the faulted
         # pass sees the same workload spec whether or not the reference
         # pass ran first
@@ -204,6 +255,10 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
     report["survivors"] = survivors
     assert not mismatched, \
         f"survivor token divergence vs unfaulted run: {mismatched}"
+    # 4. lock-order witness: cycle-free, and every witnessed edge was
+    #    statically predicted (docs/static_analysis.md, PT-C002)
+    _audit_witness(witness, predicted, report,
+                   spans_path=witness_out)
     return report
 
 
@@ -214,7 +269,8 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
                        replicas: int = 3,
                        faults: str = DEFAULT_REPLICA_FAULTS,
                        max_steps: int = 4000,
-                       prefix_cache: bool = False) -> dict:
+                       prefix_cache: bool = False,
+                       witness_out: str = "") -> dict:
     """One seeded multi-replica chaos run (module docstring). Raises
     AssertionError on a lost request, a leaked block on any live
     replica, an untouched-replica token divergence, or a faulted
@@ -230,7 +286,9 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
                                               RouterConfig,
                                               SamplingParams)
     from paddle_tpu.testing.faults import ServingFaultInjector
+    from paddle_tpu.testing.locktrace import instrument_fleet
 
+    witness, predicted = _lock_witness()
     model, cfg = _build_model()
     rng = np.random.RandomState(seed)
     if prefix_cache:
@@ -269,6 +327,7 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
     def drive(injector):
         rs = ReplicaSet.from_model(model, router_config(),
                                    engine_config=ecfg, faults=injector)
+        instrument_fleet(rs, witness)
         pending = list(enumerate(specs))
         rids, homes = {}, {}
         for i, (p, mt) in pending[:2 * replicas]:
@@ -386,6 +445,11 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
         if other not in targeted:
             rs.undrain(other)
     report["canaries_served"] = len(canaries)
+    # 5. lock-order witness over the whole fleet (incl. the restarted
+    #    incarnations the traced factories instrumented): cycle-free
+    #    and fully predicted by the static DAG
+    _audit_witness(witness, predicted, report,
+                   spans_path=witness_out)
     return report
 
 
@@ -414,6 +478,14 @@ def main(argv=None) -> int:
                                          "chaos_serve_obs.json"),
                     help="obs registry snapshot dumped on exit "
                          "(pass or fail); '' disables")
+    ap.add_argument("--witness-out", metavar="PATH",
+                    default=os.path.join(tempfile.gettempdir(),
+                                         "chaos_serve_locks.json"),
+                    help="lock-witness acquisition spans dumped after "
+                         "the run (perf_counter clock) — overlay them "
+                         "on the per-request timeline with "
+                         "tools/reqtrace.py --chrome OUT --locks PATH; "
+                         "'' disables")
     ap.add_argument("--slo", action="store_true",
                     help="exit nonzero on TTFT-p99 / reject-rate breach")
     ap.add_argument("--max-ttft-p99", type=float, default=10.0,
@@ -440,7 +512,8 @@ def main(argv=None) -> int:
                 faults=(args.faults if args.faults is not None
                         else DEFAULT_REPLICA_FAULTS),
                 max_steps=args.max_steps,
-                prefix_cache=args.prefix_cache)
+                prefix_cache=args.prefix_cache,
+                witness_out=args.witness_out)
         else:
             report = run_chaos(
                 seed=args.seed, n_requests=args.requests,
@@ -448,7 +521,8 @@ def main(argv=None) -> int:
                         else DEFAULT_FAULTS),
                 max_steps=args.max_steps,
                 cancel_every=args.cancel_every,
-                prefix_cache=args.prefix_cache)
+                prefix_cache=args.prefix_cache,
+                witness_out=args.witness_out)
     except AssertionError as e:
         print(f"CHAOS FAIL: {e}", file=sys.stderr)
         print(json.dumps({"chaos_fail": str(e),

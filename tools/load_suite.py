@@ -174,6 +174,33 @@ REPLICA_FAULTS = "kill_replica@6:1"
 REPLICA_COUNT = 3
 
 
+def _lock_witness():
+    """Fresh runtime lock witness + the statically predicted lock DAG
+    (paddle_tpu/analysis/lockgraph.py over the committed lockgraph.json;
+    same helper as tools/chaos_serve.py). The replica_kill and
+    prefix_heavy scenarios run under the witness, and their SLO gate
+    additionally requires the witnessed graph to be cycle-free with
+    every edge statically predicted."""
+    import paddle_tpu
+    from paddle_tpu.analysis import lockgraph
+    from paddle_tpu.testing.locktrace import LockWitness
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+    return LockWitness(), lockgraph.predicted_edges(root)
+
+
+def _lockgraph_report(witness, predicted) -> dict:
+    rep = witness.report(predicted)
+    return {
+        "acquisitions": rep["acquisitions"],
+        "witnessed_edges": [f"{e['src']} -> {e['dst']}"
+                            for e in rep["edges"]],
+        "cycles": rep["cycles"],
+        "unpredicted_edges": rep["unpredicted_edges"],
+    }
+
+
 def _build_model(seq=96):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig
@@ -293,7 +320,8 @@ def _arrivals(name: str, n: int, vocab: int, seed: int):
     return ecfg, arr
 
 
-def _drive(model, ecfg, arrivals, faults: str = "", max_steps=4000):
+def _drive(model, ecfg, arrivals, faults: str = "", max_steps=4000,
+           witness=None):
     """Run one workload to drain. Returns (engine, submitted, rejected,
     wall_seconds). Engine steps tick the arrival clock; arrivals due at
     or before the current step are submitted first."""
@@ -303,6 +331,9 @@ def _drive(model, ecfg, arrivals, faults: str = "", max_steps=4000):
 
     eng = LLMEngine.from_model(model, ecfg,
                                faults=ServingFaultInjector(faults))
+    if witness is not None:
+        from paddle_tpu.testing.locktrace import instrument_engine
+        instrument_engine(eng, witness)
     queue = sorted(arrivals, key=lambda a: a[0])
     i = submitted = rejected = 0
     step = 0
@@ -330,7 +361,8 @@ def _drive(model, ecfg, arrivals, faults: str = "", max_steps=4000):
 def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
                   faults: str = "", max_steps=6000,
                   balance: str = "free_blocks",
-                  obs_label: str = "load-replica-kill"):
+                  obs_label: str = "load-replica-kill",
+                  witness=None):
     """replica_kill / prefix_heavy fleet driver: the same arrival clock
     as _drive, but the workload flows through a ReplicaSet (and for
     replica_kill the fault schedule targets whole replicas). Returns
@@ -346,6 +378,9 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
                       obs_label=obs_label)
     rs = ReplicaSet.from_model(model, rc, engine_config=ecfg,
                                faults=ServingFaultInjector(faults))
+    if witness is not None:
+        from paddle_tpu.testing.locktrace import instrument_fleet
+        instrument_fleet(rs, witness)
     queue = sorted(arrivals, key=lambda a: a[0])
     i = submitted = rejected = 0
     step = 0
@@ -497,6 +532,17 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
         if ret is None or ret < ret_min:
             viol.append(f"affinity retention {ret} < {ret_min} "
                         "(3-replica vs single-replica hit rate)")
+    lg = metrics.get("lockgraph")
+    if lg is not None:
+        # lock-order witness gate (docs/static_analysis.md "Runtime
+        # witness"): the scenario ran under locktrace, so a witnessed
+        # cycle or a witnessed-but-unpredicted edge fails the scenario
+        # exactly like an SLO miss
+        if lg["cycles"]:
+            viol.append(f"witnessed lock-graph cycles: {lg['cycles']}")
+        if lg["unpredicted_edges"]:
+            viol.append("witnessed lock edges missing from the static "
+                        f"DAG: {lg['unpredicted_edges']}")
     ov_max = slo.get("max_recorder_overhead_pct")
     if ov_max is not None and "recorder_overhead_pct" in metrics:
         if metrics.get("recorder_overhead_noisy"):
@@ -578,11 +624,16 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
     if name == "replica_kill":
         # warmup WITH the kill so the restart + warmup-probe path (its
         # probe-length prefill bucket included) compiles unmeasured;
-        # each pass gets a fresh fire-once injector
-        _drive_router(model, ecfg, arr, faults=REPLICA_FAULTS)
+        # each pass gets a fresh fire-once injector. Both passes run
+        # under the lock witness — failover + restart exercise the
+        # deepest lock nesting the fleet has
+        witness, predicted = _lock_witness()
+        _drive_router(model, ecfg, arr, faults=REPLICA_FAULTS,
+                      witness=witness)
         rs, rids, submitted, rejected, wall = _drive_router(
-            model, ecfg, arr, faults=REPLICA_FAULTS)
+            model, ecfg, arr, faults=REPLICA_FAULTS, witness=witness)
         m = _metrics_router(rs, rids, submitted, rejected, wall)
+        m["lockgraph"] = _lockgraph_report(witness, predicted)
         return _slo_verdict(name, m)
     if name == "mixed_prefill_decode":
         import dataclasses
@@ -612,9 +663,15 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
         return _slo_verdict(name, m)
     if name == "prefix_heavy":
         import dataclasses
+        # every pass — single-engine and fleet — shares one lock
+        # witness: the trie's copy-on-write sharing runs under the
+        # engine lock, so this scenario is the prefix-cache coverage
+        # of the lock-order gate
+        witness, predicted = _lock_witness()
         # reuse ON (the SLO-gated default)
-        _drive(model, ecfg, arr)
-        eng, submitted, rejected, wall = _drive(model, ecfg, arr)
+        _drive(model, ecfg, arr, witness=witness)
+        eng, submitted, rejected, wall = _drive(model, ecfg, arr,
+                                                witness=witness)
         m = _metrics(eng, submitted, rejected, wall)
         ps = eng.cache.prefix_stats()
         lookups = ps["hits"] + ps["misses"]
@@ -631,8 +688,9 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
         # re-prefills its full template against the same tight budget
         ocfg = dataclasses.replace(ecfg, enable_prefix_cache=False,
                                    obs_label=f"load-{name}-nocache")
-        _drive(model, ocfg, arr)
-        oeng, osub, orej, owall = _drive(model, ocfg, arr)
+        _drive(model, ocfg, arr, witness=witness)
+        oeng, osub, orej, owall = _drive(model, ocfg, arr,
+                                         witness=witness)
         om = _metrics(oeng, osub, orej, owall)
         m["no_cache_baseline"] = {
             "tokens_per_sec": om["tokens_per_sec"],
@@ -645,10 +703,10 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
         # 3-replica fleet behind prefix-affinity routing: each
         # template's followers must land on the replica that cached it
         _drive_router(model, ecfg, arr, balance="prefix_affinity",
-                      obs_label=f"load-{name}-fleet")
+                      obs_label=f"load-{name}-fleet", witness=witness)
         rs, rids, rsub, rrej, rwall = _drive_router(
             model, ecfg, arr, balance="prefix_affinity",
-            obs_label=f"load-{name}-fleet")
+            obs_label=f"load-{name}-fleet", witness=witness)
         fps = rs.prefix_stats()
         flook = fps["hits"] + fps["misses"]
         fleet_rate = fps["hits"] / flook if flook else 0.0
@@ -662,6 +720,7 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
             "lost": sum(1 for r in rids
                         if not rs.get_request(r).finished),
         }
+        m["lockgraph"] = _lockgraph_report(witness, predicted)
         return _slo_verdict(name, m)
     # warmup: same workload, unmeasured — every prompt-length and decode
     # bucket compiles here so measured TTFT is serving time, not XLA.

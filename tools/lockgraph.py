@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""lockgraph CLI: whole-program lock-order analysis for the serving
+fleet (PT-C002 order/cycle, PT-C003 blocking-under-lock, PT-C004
+callback-under-lock).
+
+    python tools/lockgraph.py                 analyze serving + obs
+    python tools/lockgraph.py --check         gate mode (CI): exit 1 on
+                                              any unsuppressed finding
+    python tools/lockgraph.py --format json   machine output
+    python tools/lockgraph.py --edges         print the inferred
+                                              acquisition DAG
+    python tools/lockgraph.py --show-suppressed
+
+The declared order lives in the committed lockgraph.json (same artifact
+discipline as jaxcost_budget.json / jaxplan.json). Suppress a single
+site with `# ptlint: disable=PT-C003  <reason>` — same syntax as every
+other ptlint rule. Exit status: 0 clean, 1 findings, 2 usage/parse
+errors. Stdlib-only; never imports jax.
+
+The runtime half of this check is paddle_tpu/testing/locktrace.py: chaos
+runs witness the ACTUAL acquisition edges and cross-validate them
+against the DAG printed by --edges.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# import `analysis` as a top-level package so the lint core loads
+# without importing paddle_tpu/__init__ (which pulls in jax) — then
+# drop the path entry again (paddle_tpu/ shadows stdlib names)
+_PKG_DIR = os.path.join(_REPO, "paddle_tpu")
+sys.path.insert(0, _PKG_DIR)
+try:
+    import analysis  # noqa: E402,F401
+    from analysis.ast_core import (_is_suppressed,  # noqa: E402
+                                   collect_suppressions)
+    from analysis import lockgraph as lg  # noqa: E402
+finally:
+    sys.path.remove(_PKG_DIR)
+
+DEFAULT_MODEL = os.path.join(_REPO, "lockgraph.json")
+
+
+def _split_suppressed(findings, root):
+    """Partition findings by the per-line `# ptlint: disable=` comments
+    in their source files (identical semantics to LintEngine)."""
+    cache = {}
+    kept, suppressed = [], []
+    for f in findings:
+        if f.path not in cache:
+            try:
+                with open(os.path.join(root, f.path),
+                          encoding="utf-8") as fh:
+                    cache[f.path] = collect_suppressions(fh.read())
+            except OSError:
+                cache[f.path] = ({}, set())
+        per_line, file_level = cache[f.path]
+        if _is_suppressed(f, per_line, file_level):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="lockgraph", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the serving "
+                         "+ obs packages)")
+    ap.add_argument("--model", default=DEFAULT_MODEL,
+                    help="declared-order artifact (lockgraph.json)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode: same as default but states the "
+                         "verdict explicitly")
+    ap.add_argument("--edges", action="store_true",
+                    help="also print the inferred acquisition DAG")
+    ap.add_argument("--show-suppressed", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        model = lg.load_model(args.model)
+    except (OSError, ValueError) as e:
+        print(f"lockgraph: cannot load {args.model}: {e}",
+              file=sys.stderr)
+        return 2
+
+    paths = args.paths or lg.default_target_paths(_REPO)
+    if not paths:
+        print("lockgraph: no analyzable paths", file=sys.stderr)
+        return 2
+    findings, errors, prog = lg.analyze_paths(paths, model, root=_REPO)
+    findings, suppressed = _split_suppressed(findings, _REPO)
+    edges = sorted(set((h, a) for (h, a, *_r) in prog.edges(model)))
+
+    if args.format == "json":
+        payload = {
+            "model": os.path.relpath(args.model, _REPO),
+            "order": model.order,
+            "edges": [list(e) for e in edges],
+            "findings": [f.as_dict() for f in findings],
+            "parse_errors": errors,
+        }
+        if args.show_suppressed:
+            payload["suppressed_findings"] = [f.as_dict()
+                                              for f in suppressed]
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.format())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"{f.format()}  (suppressed)")
+        for err in errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        if args.edges:
+            print("acquisition DAG (held -> acquired, canonical):")
+            for h, a in edges:
+                print(f"  {h} -> {a}")
+        verdict = "clean" if not findings and not errors else "FAIL"
+        print(f"lockgraph: {len(edges)} edge(s), {len(findings)} "
+              f"finding(s), {len(suppressed)} suppressed"
+              + (f" — {verdict}" if args.check else ""))
+
+    if errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,11 +8,21 @@
     python tools/reqtrace.py DUMP.json --chrome OUT    per-request tracks
                             [--merge EXISTING.json]    ...appended to an
                                                        existing chrome trace
+                            [--locks SPANS.json]       ...plus lock wait/hold
+                                                       tracks from a locktrace
+                                                       witness span dump
 
 DUMP.json is a flight-recorder artifact (obs/reqtrace.py): written
 automatically on quarantine/failover/integrity triggers when the
 recorder is armed, or explicitly by chaos_serve.py / load_suite.py on
 gate failures and at exit.
+
+SPANS.json is a lock-witness span dump (testing/locktrace.py, written
+by `chaos_serve.py --witness-out`): reqtrace events and witness spans
+share the perf_counter clock, so `--locks` lays each thread's lock
+wait/hold spans under the request tracks — lock contention shows up ON
+the per-request timeline (a long "wait …" span under a long "queued"
+span IS the causal story).
 
 --check machine-verifies the causal invariants (no token emission
 before prefill completes, requeue preserves the FCFS arrival ticket
@@ -105,13 +115,53 @@ def _span_event(name, t0s, t1s, base, pid, tid):
             "pid": pid, "tid": tid}
 
 
+def _lock_tracks(locks_path: str, base: float, t_hi: float,
+                 pid: int, first_row: int) -> list:
+    """Chrome rows for a locktrace witness span dump: one track per
+    witnessed thread, each acquisition rendered as a "wait <lock>" span
+    (wait_start -> acquired: contention) followed by a "hold <lock>"
+    span (acquired -> released). Witness spans and reqtrace events
+    share the perf_counter clock, so `base` aligns them; spans wholly
+    outside the dump's window (warmup passes) are dropped."""
+    with open(locks_path) as f:
+        wit = json.load(f)
+    spans = wit.get("spans")
+    if spans is None:
+        raise ValueError(f"{locks_path}: not a locktrace span dump "
+                         "(no 'spans')")
+    # an uncontended acquire still shows a few µs of "wait" (clock
+    # resolution + the wrapper itself); only waits above this floor are
+    # contention worth a span of their own
+    wait_floor_s = 5e-5
+    chrome, rows = [], {}
+    for s in spans:
+        if s["released"] < base or s["wait_start"] > t_hi:
+            continue
+        row = rows.get(s["thread"])
+        if row is None:
+            row = rows[s["thread"]] = first_row + len(rows)
+            chrome.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": row,
+                           "args": {"name": f"locks {s['thread']}"}})
+        if s["acquired"] - s["wait_start"] > wait_floor_s:
+            chrome.append(dict(_span_event(
+                f"wait {s['name']}", s["wait_start"], s["acquired"],
+                base, pid, row), cat="locktrace"))
+        chrome.append(dict(_span_event(
+            f"hold {s['name']}", s["acquired"], s["released"],
+            base, pid, row), cat="locktrace"))
+    return chrome
+
+
 def render_chrome(dump: dict, out_path: str,
-                  merge_path: str = None) -> str:
+                  merge_path: str = None, locks_path: str = None) -> str:
     """Per-request tracks: each trace becomes one tid row; lifecycle
     phases render as spans (queue/prefill/decode per engine hop) with
     every raw event as an instant marker. Optionally appended into an
     existing chrome trace (obs.export_chrome_trace output) so request
-    tracks sit under the engine span and gauge counter tracks."""
+    tracks sit under the engine span and gauge counter tracks, and/or
+    merged with a lock-witness span dump (`--locks`) so each thread's
+    lock wait/hold spans sit under the request rows."""
     events = sorted(dump["events"], key=lambda e: e["seq"])
     if not events:
         raise ValueError("dump holds no events")
@@ -156,6 +206,10 @@ def render_chrome(dump: dict, out_path: str,
                  "ts": (ts - base) * 1e6, "pid": pid, "tid": row},
                 **({"args": e["attrs"]} if e.get("attrs") else {})))
 
+    if locks_path:
+        t_hi = max(e["ts"] for e in events)
+        chrome.extend(_lock_tracks(locks_path, base, t_hi, pid,
+                                   first_row=len(traces) + 1))
     payload = {"traceEvents": chrome}
     if merge_path:
         with open(merge_path) as f:
@@ -184,6 +238,10 @@ def main(argv=None) -> int:
     ap.add_argument("--merge", metavar="EXISTING",
                     help="with --chrome: append tracks into an existing "
                          "chrome trace file")
+    ap.add_argument("--locks", metavar="SPANS",
+                    help="with --chrome: merge lock wait/hold tracks "
+                         "from a locktrace witness span dump "
+                         "(chaos_serve.py --witness-out)")
     args = ap.parse_args(argv)
 
     try:
@@ -201,7 +259,13 @@ def main(argv=None) -> int:
         print_ttft(dump)
         did = True
     if args.chrome:
-        out = render_chrome(dump, args.chrome, merge_path=args.merge)
+        try:
+            out = render_chrome(dump, args.chrome,
+                                merge_path=args.merge,
+                                locks_path=args.locks)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"reqtrace: {e}", file=sys.stderr)
+            return 2
         print(f"chrome trace: {out}")
         did = True
     if args.check:
