@@ -19,11 +19,18 @@ PAPERS.md arxiv 2604.15464). Four cooperating modules:
                 spans, throughput/latency stats) + ServingPredictor
                 (the inference.create_predictor dispatch target).
 - replica:      EngineReplica — one supervised engine slot (heartbeat,
-                quarantine, capped-backoff restart + warmup probe).
+                quarantine, capped-backoff restart + warmup probe),
+                carrying its tier role (prefill | decode | mixed).
+- migration:    BlockMigration — live KV-block migration between
+                replicas (export/import of paged blocks, transactional
+                commit, bitwise-invariant resume); the primitive behind
+                disaggregated tiers, rebalance() and
+                drain(recompute=False).
 - router:       ReplicaSet — N replicas behind one front-end with
                 free-block load balancing, replica-level failover
                 (zero-lost-request requeue to survivors), draining,
-                and router-level backpressure.
+                prefill/decode tiering with live handoff, and
+                router-level backpressure.
 
 See docs/serving.md for architecture and tuning.
 """
@@ -38,6 +45,8 @@ from .engine import (EngineConfig, EngineStats, LLMEngine,  # noqa: F401
                      RequestOutput, ServingPredictor)
 from .replica import (EngineReplica, ReplicaCrashed,  # noqa: F401
                       ReplicaState)
+from .migration import (BlockMigration,  # noqa: F401
+                        MIGRATION_REASONS)
 from .router import ReplicaSet, RouterConfig, RouterRequest  # noqa: F401
 
 __all__ = [
@@ -49,5 +58,6 @@ __all__ = [
     "Scheduler", "SchedulerConfig", "ScheduledBatch", "EngineConfig",
     "EngineStats", "LLMEngine", "RequestOutput", "ServingPredictor",
     "EngineReplica", "ReplicaCrashed", "ReplicaState",
+    "BlockMigration", "MIGRATION_REASONS",
     "ReplicaSet", "RouterConfig", "RouterRequest",
 ]

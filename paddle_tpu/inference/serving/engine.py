@@ -70,7 +70,7 @@ from ...core import anomaly
 from ...models import generation as gen
 from ...profiler import RecordEvent
 from .attention import PACK_COLS, fused_decode_chunk, pack_f32
-from .paged_cache import PagedKVCache
+from .paged_cache import CacheExhausted, PagedKVCache
 from .scheduler import (EngineOverloaded, Request, RequestState,
                         SamplingParams, ScheduledBatch, Scheduler,
                         SchedulerConfig)
@@ -557,7 +557,13 @@ class LLMEngine:
             if request_id is None:
                 request_id = f"req-{self._next_id}"
                 self._next_id += 1
-            if request_id in self._requests:
+            old = self._requests.get(request_id)
+            if old is not None and old.state != RequestState.MIGRATED:
+                # a migrated-out tombstone does NOT block re-admission:
+                # a request can legitimately come back to an engine it
+                # once left (failover after its new home died, drain
+                # round trip) — only a live or truly-terminal record is
+                # a duplicate
                 raise ValueError(f"duplicate request_id {request_id!r}")
             now = time.perf_counter()
             req = Request(request_id=request_id, prompt_ids=ids,
@@ -659,6 +665,171 @@ class LLMEngine:
             info["free_blocks"] = self.cache.num_free()
             info["running"] = self.scheduler.num_running()
             return info
+
+    # ------------------------------------------- block migration surface
+    # (serving/migration.py; docs/serving.md "Disaggregated serving and
+    # block migration"). All four methods run at step boundaries only —
+    # the BlockMigration coordinator calls them through the owning
+    # replica's lock from the router's step frame, where the per-request
+    # invariant holds that every reserved cache slot has written KV.
+
+    def migratable_requests(self, decode_only: bool = True) -> List[str]:
+        """Request ids safe to export at this step boundary: RUNNING and
+        unfinished. `decode_only=True` (handoff/rebalance) keeps only
+        requests PAST prefill — the prefill→decode handoff point;
+        `decode_only=False` (drain) also includes mid-prefill rows,
+        whose committed prefix migrates and finishes prefilling at the
+        destination."""
+        with self._lock:
+            out = []
+            for req in self.scheduler.running_requests():
+                if req.finished:
+                    continue
+                if decode_only and req.pf_target \
+                        and req.prefill_pos < req.pf_target:
+                    continue
+                out.append(req.request_id)
+            return out
+
+    def export_request(self, request_id: str) -> dict:
+        """Snapshot one RUNNING request for migration: the full request
+        record (prompt, params, token log, FCFS ticket, deadline clock,
+        prefill progress, trace id) plus its KV payload gathered from
+        the pool (PagedKVCache.export_blocks — a COPY; source state is
+        untouched, so a failed migration just keeps running here).
+        Sampling needs no extra state: in-scan keys are
+        fold_in(seed, tokens_generated), a function of progress the
+        snapshot already carries."""
+        with self._lock:
+            req = self._requests[request_id]
+            if req.state != RequestState.RUNNING:
+                raise ValueError(
+                    f"export_request: {request_id!r} is {req.state}, "
+                    f"not running")
+            payload, num_tokens = self.cache.export_blocks(request_id)
+            if req.pf_target and req.prefill_pos < req.pf_target:
+                valid = req.prefill_pos
+            else:
+                valid = len(req.prompt_ids) \
+                    + max(0, len(req.output_ids) - 1)
+            if num_tokens != valid:
+                # only clean step boundaries satisfy written-KV == length
+                raise ValueError(
+                    f"export_request: {request_id!r} cache length "
+                    f"{num_tokens} != written KV {valid} — not at a "
+                    f"clean step boundary")
+            return {
+                "request_id": request_id,
+                "prompt_ids": np.array(req.prompt_ids, np.int32),
+                "params": req.params,
+                "arrival": req.arrival,
+                "arrival_time": req.arrival_time,
+                "first_token_time": req.first_token_time,
+                "last_token_time": req.last_token_time,
+                "output_ids": list(req.output_ids),
+                "pf_target": req.pf_target,
+                "prefill_pos": req.prefill_pos,
+                "trace_id": req.trace_id,
+                "payload": payload,
+                "num_tokens": num_tokens,
+                "blocks": len(self.cache.block_table(request_id)),
+                "bytes": self.cache.payload_bytes(payload),
+            }
+
+    def admit_migrated(self, snap: dict) -> str:
+        """Destination half of a migration: import the KV payload into
+        fresh private blocks, register its clean prefix into this
+        engine's trie (hit rates survive the hop), and adopt the
+        request straight into the RUNNING set — no re-prefill, no
+        waiting-queue pass, FCFS ticket and deadline clock preserved.
+        Raises CacheExhausted with NO side effects when the pool can't
+        hold the table (the coordinator aborts; the request keeps
+        running at the source)."""
+        rid = snap["request_id"]
+        with self._lock:
+            old = self._requests.get(rid)
+            if old is not None and not old.finished:
+                raise ValueError(
+                    f"admit_migrated: {rid!r} already live here")
+            req = Request(request_id=rid,
+                          prompt_ids=snap["prompt_ids"],
+                          params=snap["params"],
+                          arrival_time=snap["arrival_time"])
+            req.arrival = snap["arrival"]
+            req.trace_id = snap["trace_id"]
+            req.output_ids = list(snap["output_ids"])
+            req.pf_target = snap["pf_target"]
+            req.prefill_pos = snap["prefill_pos"]
+            # TTFT was observed (once) wherever the first token was
+            # emitted; preserving the stamps keeps the gap histograms
+            # honest — the next emission's gap includes migration time
+            req.first_token_time = snap["first_token_time"]
+            req.last_token_time = snap["last_token_time"]
+            worst = len(req.prompt_ids) + req.params.max_tokens
+            if self.cache.blocks_needed(worst) > self.cache.num_blocks:
+                raise ValueError(
+                    f"admit_migrated: {rid!r} can never fit this pool "
+                    f"({self.cache.blocks_needed(worst)} blocks at its "
+                    f"longest vs {self.cache.num_blocks} total)")
+            # the decode packing is a FIXED max_num_seqs rows — adopting
+            # past it would index off the end of the batch, so sequence
+            # slots exhaust with the same clean-abort signal as blocks
+            live = sum(1 for r in self.scheduler.running
+                       if not r.finished)
+            if live >= self.config.max_num_seqs:
+                raise CacheExhausted(rid, 1, 0,
+                                     self.config.max_num_seqs,
+                                     what="sequence slot")
+            self.cache.import_blocks(rid, snap["payload"],
+                                     snap["num_tokens"])
+            self.scheduler.adopt_running(req)
+            if self.cache.prefix_index is not None \
+                    and snap["num_tokens"]:
+                self.cache.register_prefix(
+                    rid, req.all_token_ids()[:snap["num_tokens"]])
+            self._requests[rid] = req
+            self._rngs[rid] = np.random.RandomState(
+                req.params.seed & 0x7FFFFFFF)
+            return self.stats.label
+
+    def release_migrated(self, request_id: str) -> None:
+        """Source half, called only AFTER the destination committed:
+        detach the request (state MIGRATED — terminal for this engine,
+        no finish output) and free its blocks through the normal
+        completion path, registering the clean prefix so the SOURCE
+        trie keeps its entries and shared blocks just drop one
+        reference."""
+        with self._lock:
+            req = self._requests[request_id]
+            if req.state != RequestState.RUNNING:
+                raise ValueError(
+                    f"release_migrated: {request_id!r} is {req.state}, "
+                    f"not running")
+            self.scheduler.release_running(req)
+            self._rngs.pop(request_id, None)
+
+    def abort_migrated(self, request_id: str) -> None:
+        """Destination rollback for a migration that failed AFTER
+        admit_migrated (source died before releasing): drop the adopted
+        request and free its imported blocks. The router re-admits the
+        victim from its authoritative token log via the failover path —
+        zero blocks leak on either end."""
+        with self._lock:
+            req = self._requests.pop(request_id, None)
+            self._rngs.pop(request_id, None)
+            if req is not None and req.state == RequestState.RUNNING:
+                self.scheduler.abort_adopted(req)
+
+    def release_waiting(self, request_id: str) -> Optional[Request]:
+        """Drain evacuation of QUEUED work: pull a waiting request out
+        without a terminal output (it has no KV to migrate — the router
+        re-dispatches it to another replica from its token log).
+        Returns the request, or None when it is not waiting."""
+        with self._lock:
+            req = self.scheduler.remove_waiting(request_id)
+            if req is not None:
+                self._rngs.pop(request_id, None)
+            return req
 
     # ---------------------------------------------------------- sampling
     @holds_lock("_lock")
@@ -1079,9 +1250,13 @@ class LLMEngine:
                 raise RuntimeError(
                     f"engine did not drain within {max_steps} steps")
         with self._lock:
+            # MIGRATED tombstones hold a PARTIAL stream — the request's
+            # real output finishes on its destination engine (the router
+            # record is the place to read it)
             return {rid: np.asarray(r.output_ids, np.int64)
                     for rid, r in self._requests.items()
-                    if r.state != RequestState.CANCELLED}
+                    if r.state not in (RequestState.CANCELLED,
+                                       RequestState.MIGRATED)}
 
 
 class ServingPredictor:

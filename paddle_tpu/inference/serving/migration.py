@@ -1,0 +1,192 @@
+"""Live KV-block migration between serving replicas.
+
+The disaggregated-serving primitive (docs/serving.md "Disaggregated
+serving and block migration"): move one in-flight request — its KV
+blocks, its token log, its FCFS ticket, its deadline clock — from one
+replica's paged pool to another's WITHOUT re-prefilling and without
+perturbing the token stream. One primitive pays three times:
+
+- HANDOFF: prefill-tier replicas push every request that finishes
+  prefill onto the decode tier (`ReplicaSet` roles, router step loop);
+- REBALANCE: `ReplicaSet.rebalance()` moves the coldest decode requests
+  off a pool running past a high watermark;
+- DRAIN: `ReplicaSet.drain(index, recompute=False)` evacuates a
+  replica's live work before a restart/deploy instead of recomputing it.
+
+Transfer mechanics: the source pool GATHERS the request's blocks into a
+contiguous payload (`PagedKVCache.export_blocks` — a device-to-device
+copy on TPU, an array copy on CPU; the source is untouched), the
+destination allocates fresh physical blocks, scatters the payload in,
+rewrites the block table, and registers the request's clean prefix into
+its own trie so prefix-cache hit rates survive the hop. Prefix-shared
+blocks under refcount are therefore **copied, never stolen**: the
+source trie keeps its cached entry (release registers the prefix back,
+exactly like request completion), the destination gets a private,
+freshly-registered copy, and `check_integrity` passes on both ends at
+every step.
+
+Bitwise invariance: decode sampling keys are
+``fold_in(seed, tokens_generated)`` — a pure function of progress the
+snapshot carries — and the ragged kernels mask stale block-tail
+positions to exact zeros, so greedy output after a migration is
+bitwise-identical to the same request served unmigrated.
+
+The protocol is TRANSACTIONAL, ordered so every failure leaves both
+ends clean:
+
+1. EXPORT from the source (pure copy; aborting costs nothing);
+2. ADMIT at the destination — fresh blocks, adopted straight into the
+   RUNNING set. ``CacheExhausted`` here aborts the whole migration with
+   no side effects and NO trace events: the request keeps decoding at
+   the source as if nothing happened;
+3. the mid-migration fault window (`kill_migration`): a source that
+   dies here rolls the destination back (`abort_migrated`) and raises
+   ``ReplicaCrashed`` — the router's failover re-prefills the victim
+   from its authoritative token log, so a half-migrated request is
+   never half-served;
+4. COMMIT: record ``migrate_out``, release the source copy (state
+   MIGRATED — terminal for that engine, no finish event), record
+   ``migrate_in``.
+
+Thread contract (ptlint PT-C001 via _GUARDED_BY): the coordinator runs
+in the router's locked step frame and serializes migrations under its
+own lock, slotted into the declared order as
+router → **migration** → replica → engine → scheduler
+(lockgraph.json). It acquires ONE replica's lock at a time — source and
+destination locks are never held together, so the cross-pool copy can
+never deadlock against a concurrent migration in the other direction.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ... import obs
+from ...analysis import holds_lock
+from .paged_cache import CacheExhausted
+from .replica import EngineReplica, ReplicaCrashed
+
+__all__ = ["BlockMigration", "MIGRATION_REASONS"]
+
+MIGRATION_REASONS = ("handoff", "rebalance", "drain")
+
+
+class BlockMigration:
+    """Migration coordinator for one ReplicaSet (module docstring).
+    Owns the migration counters and the obs families; one instance per
+    router, driven from the router's locked step frame."""
+
+    _GUARDED_BY = {
+        "migrations": "_lock",
+        "aborted": "_lock",
+        "rolled_back": "_lock",
+        "bytes_moved": "_lock",
+    }
+
+    def __init__(self, router_label: str):
+        self.label = router_label
+        self._lock = threading.RLock()
+        self.migrations = 0               # committed
+        self.aborted = 0                  # destination pool full
+        self.rolled_back = 0              # source died mid-migration
+        self.bytes_moved = 0
+        self._c_migrations = obs.counter(
+            "serving_migrations_total",
+            "committed KV-block migrations by reason "
+            "(handoff|rebalance|drain)", labels=("router", "reason"))
+        self._h_seconds = obs.histogram(
+            "serving_migration_seconds",
+            "export -> committed wall time per migration",
+            labels=("router",), unit="seconds").labels(
+                router=router_label)
+        self._h_bytes = obs.histogram(
+            "serving_migration_bytes",
+            "KV payload size per migration (all layers, k and v)",
+            labels=("router",), unit="bytes").labels(
+                router=router_label)
+
+    def migrate(self, src: EngineReplica, dst: EngineReplica,
+                request_id: str, reason: str, router_step: int = 0,
+                faults=None) -> Optional[dict]:
+        """Move one request src → dst (module-docstring protocol).
+        Returns the committed migration's stats dict, or None when the
+        destination pool could not hold it (clean abort — the request
+        keeps running at the source). Raises ReplicaCrashed when the
+        `kill_migration` fault fires in the commit window; the caller
+        (router) fails the SOURCE replica over, and the destination has
+        already been rolled back here."""
+        if reason not in MIGRATION_REASONS:
+            raise ValueError(
+                f"migration reason {reason!r} not in "
+                f"{MIGRATION_REASONS}")
+        if src is dst:
+            raise ValueError(
+                f"cannot migrate {request_id!r} onto its own replica "
+                f"{src.index}")
+        with self._lock:
+            return self._migrate_locked(src, dst, request_id, reason,
+                                        router_step, faults)
+
+    @holds_lock("_lock")
+    def _migrate_locked(self, src: EngineReplica, dst: EngineReplica,
+                        request_id: str, reason: str,
+                        router_step: int, faults) -> Optional[dict]:
+        t0 = time.perf_counter()
+        snap = src.export_request(request_id)
+        try:
+            dst_engine = dst.admit_migrated(snap)
+        except CacheExhausted:
+            # abort with no side effects and no trace events: export
+            # was a pure copy, the destination rejected atomically
+            self.aborted += 1
+            return None
+        if faults is not None \
+                and faults.kill_migration(router_step, src.index):
+            # source died between destination-admit and source-release:
+            # roll the destination back and let the router's failover
+            # re-prefill the victim from its authoritative token log
+            dst.abort_migrated(request_id)
+            self.rolled_back += 1
+            raise ReplicaCrashed(
+                f"replica {src.index} killed mid-migration of "
+                f"{request_id!r} at router step {router_step}")
+        prefilled = not snap["pf_target"] \
+            or snap["prefill_pos"] >= snap["pf_target"]
+        trace_id = snap["trace_id"] or request_id
+        obs.reqtrace.record(
+            "migrate_out", trace_id, request_id,
+            replica=src.index, to_replica=dst.index, reason=reason,
+            blocks=snap["blocks"], bytes=snap["bytes"],
+            resume_pos=snap["num_tokens"], arrival=snap["arrival"])
+        src.release_migrated(request_id)
+        obs.reqtrace.record(
+            "migrate_in", trace_id, request_id,
+            replica=dst.index, from_replica=src.index, reason=reason,
+            engine=dst_engine, blocks=snap["blocks"],
+            bytes=snap["bytes"], resume_pos=snap["num_tokens"],
+            arrival=snap["arrival"], prefilled=prefilled)
+        dt = time.perf_counter() - t0
+        self.migrations += 1
+        self.bytes_moved += snap["bytes"]
+        self._c_migrations.labels(router=self.label,
+                                  reason=reason).inc()
+        self._h_seconds.observe(dt)
+        self._h_bytes.observe(snap["bytes"])
+        return {"request_id": request_id, "src": src.index,
+                "dst": dst.index, "reason": reason,
+                "blocks": snap["blocks"], "bytes": snap["bytes"],
+                "resume_pos": snap["num_tokens"], "seconds": dt}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"migrations": self.migrations,
+                    "aborted": self.aborted,
+                    "rolled_back": self.rolled_back,
+                    "bytes_moved": self.bytes_moved}
+
+    def seconds_quantile(self, q: float) -> float:
+        """Migration latency quantile (export -> committed wall time)
+        from this router's serving_migration_seconds series; NaN when
+        no migration has committed yet."""
+        return self._h_seconds.quantile(q)

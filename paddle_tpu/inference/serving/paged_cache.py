@@ -49,14 +49,15 @@ class CacheExhausted(RuntimeError):
     The scheduler catches this to preempt; anyone else sees a precise
     message instead of a silent mis-allocation."""
 
-    def __init__(self, seq_id, needed: int, free: int, total: int):
+    def __init__(self, seq_id, needed: int, free: int, total: int,
+                 what: str = "block"):
         self.seq_id = seq_id
         self.needed = needed
         self.free = free
         self.total = total
         super().__init__(
-            f"KV block pool exhausted: seq {seq_id!r} needs {needed} "
-            f"block(s), {free}/{total} free")
+            f"KV {what} pool exhausted: seq {seq_id!r} needs {needed} "
+            f"{what}(s), {free}/{total} free")
 
 
 class PagedKVCache:
@@ -346,6 +347,58 @@ class PagedKVCache:
         self.pools = tuple(
             (kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
             for kp, vp in self.pools)
+
+    # ---------------------------------------------------- block migration
+    def export_blocks(self, seq_id) -> Tuple[tuple, int]:
+        """Snapshot one sequence's KV payload for migration to another
+        pool (serving/migration.py): an L-tuple of (k, v) arrays, each
+        [len(table), block_size, H, D] — a device-side gather per layer
+        pool, so the snapshot is a COPY and the source's table,
+        refcounts and trie entries are untouched. Shared (refcount >= 2)
+        and trie-cached blocks are therefore copied out, never stolen:
+        the source keeps serving its other holders, and frees this
+        sequence normally after the migration commits. Returns
+        (payload, num_tokens); num_tokens is the sequence's current
+        length — at a clean step boundary every one of those positions
+        holds written KV."""
+        table = self._tables[seq_id]
+        if not table:
+            return tuple((None, None) for _ in self.pools), \
+                self._lens[seq_id]
+        idx = jnp.asarray(table, jnp.int32)
+        return tuple((kp[idx], vp[idx]) for kp, vp in self.pools), \
+            self._lens[seq_id]
+
+    def import_blocks(self, seq_id, payload, num_tokens: int) -> List[int]:
+        """Admit a migrated sequence's KV payload (export_blocks from a
+        SOURCE pool of identical geometry): allocate fresh private
+        blocks, scatter the payload into them (one scatter per layer
+        pool), and install the rewritten block table at `num_tokens`.
+        Raises CacheExhausted with no side effects when the pool can't
+        hold the table — migration aborts and the request keeps running
+        at the source. The caller registers clean prefixes afterwards
+        (register_prefix) so cached-prefix hit rates survive the hop."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id!r} already allocated")
+        n = 0 if payload[0][0] is None else int(payload[0][0].shape[0])
+        if n < self.blocks_needed(num_tokens):
+            raise ValueError(
+                f"migration payload holds {n} block(s) but {num_tokens} "
+                f"tokens need {self.blocks_needed(num_tokens)}")
+        ids = self._take_blocks(seq_id, n) if n else []
+        if n:
+            idx = jnp.asarray(ids, jnp.int32)
+            self.pools = tuple(
+                (kp.at[idx].set(pk), vp.at[idx].set(pv))
+                for (kp, vp), (pk, pv) in zip(self.pools, payload))
+        self._tables[seq_id] = ids
+        self._lens[seq_id] = num_tokens
+        return ids
+
+    def payload_bytes(self, payload) -> int:
+        """Wire size of an export_blocks payload (obs histogram food)."""
+        return sum(int(a.size) * a.dtype.itemsize
+                   for pair in payload for a in pair if a is not None)
 
     def _distrust(self, b: int, to_scrub: List[int]) -> None:
         """Scrub-path hygiene for block b's trie entry: remove its

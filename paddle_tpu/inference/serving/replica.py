@@ -79,11 +79,21 @@ class EngineReplica:
         "probe_tokens": "_lock",
     }
 
+    ROLES = ("prefill", "decode", "mixed")
+
     def __init__(self, index: int, engine_factory: Callable,
                  backoff: BackoffPolicy, max_restarts: int = 3,
                  heartbeat_timeout: Optional[float] = None,
-                 probe_prompt=(1,), probe_timeout_steps: int = 64):
+                 probe_prompt=(1,), probe_timeout_steps: int = 64,
+                 role: str = "mixed"):
+        if role not in self.ROLES:
+            raise ValueError(f"replica role {role!r} not in {self.ROLES}")
         self.index = index
+        # tier assignment (docs/serving.md): immutable after construction
+        # — "prefill" replicas take new prompts and hand finished
+        # prefills off, "decode" replicas only receive migrations,
+        # "mixed" does both (the homogeneous default)
+        self.role = role
         self._factory = engine_factory
         self._backoff = backoff
         self.max_restarts = int(max_restarts)
@@ -305,6 +315,48 @@ class EngineReplica:
                 f"warmup probe ended {req.state!r} instead of serving "
                 f"its token")
         self.probe_tokens += len(req.output_ids)
+
+    # ----------------------------------------------------------- migration
+    # Locked pass-throughs for the BlockMigration coordinator
+    # (serving/migration.py). The coordinator runs in the router's step
+    # frame and acquires ONE replica's lock at a time — never source and
+    # destination together (lock order: BlockMigration._lock →
+    # EngineReplica._lock; two same-named locks held at once would be a
+    # witnessed self-cycle).
+
+    def migratable_requests(self, decode_only: bool = True) -> List[str]:
+        with self._lock:
+            if self.state not in ReplicaState.SERVING \
+                    or self.engine is None:
+                return []
+            return self.engine.migratable_requests(decode_only=decode_only)
+
+    def export_request(self, request_id: str) -> dict:
+        with self._lock:
+            return self.engine.export_request(request_id)
+
+    def admit_migrated(self, snap: dict) -> str:
+        """Destination admission; beats the heartbeat like dispatch()
+        does, so a migration landing on an idle decode replica can't
+        trip the stale-beat wedge check before its first step. Returns
+        the destination engine's obs label (migrate_in event home)."""
+        with self._lock:
+            label = self.engine.admit_migrated(snap)
+            self.last_beat = time.monotonic()
+            return label
+
+    def release_migrated(self, request_id: str) -> None:
+        with self._lock:
+            self.engine.release_migrated(request_id)
+
+    def abort_migrated(self, request_id: str) -> None:
+        with self._lock:
+            if self.engine is not None:
+                self.engine.abort_migrated(request_id)
+
+    def release_waiting(self, request_id: str):
+        with self._lock:
+            return self.engine.release_waiting(request_id)
 
     # ------------------------------------------------------------ draining
     def drain(self) -> None:

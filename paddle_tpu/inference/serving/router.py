@@ -83,6 +83,7 @@ import numpy as np
 from ... import obs
 from ...analysis import holds_lock
 from ...distributed.elastic import BackoffPolicy
+from .migration import BlockMigration
 from .replica import EngineReplica, ReplicaCrashed, ReplicaState
 from .scheduler import EngineOverloaded, SamplingParams
 from .engine import RequestOutput
@@ -119,6 +120,13 @@ class RouterConfig:
     affinity_prefix_blocks: int = 4
     # warmup probe for rejoining replicas (token ids; must be < vocab)
     probe_prompt: tuple = (1,)
+    # disaggregated tiers (docs/serving.md "Disaggregated serving and
+    # block migration"): one role per replica, 'prefill' | 'decode' |
+    # 'mixed'. None keeps the homogeneous all-'mixed' fleet. New
+    # prompts admit to the prefill/mixed tier; a prefill replica hands
+    # every request that completes prefill off to the decode tier via
+    # live KV-block migration (serving/migration.py)
+    roles: Optional[tuple] = None
     obs_label: Optional[str] = None
 
 
@@ -185,6 +193,24 @@ class ReplicaSet:
             raise ValueError(
                 f"admission_policy must be 'reject' or 'shed_oldest', "
                 f"got {config.admission_policy!r}")
+        roles = config.roles
+        if roles is not None:
+            if len(roles) != config.num_replicas:
+                raise ValueError(
+                    f"roles has {len(roles)} entries for "
+                    f"{config.num_replicas} replicas")
+            bad = [r for r in roles if r not in EngineReplica.ROLES]
+            if bad:
+                raise ValueError(
+                    f"unknown replica roles {bad}; expected one of "
+                    f"{EngineReplica.ROLES}")
+            if "prefill" in roles and not any(
+                    r in ("decode", "mixed") for r in roles):
+                raise ValueError(
+                    "a prefill tier needs at least one decode or mixed "
+                    "replica to hand off to")
+        else:
+            roles = ("mixed",) * config.num_replicas
         self.config = config
         self.label = f"{config.obs_label or 'router'}-{next(_ROUTER_IDS)}"
         if faults is None:
@@ -203,8 +229,12 @@ class ReplicaSet:
             EngineReplica(i, engine_factory, backoff,
                           max_restarts=config.max_restarts,
                           heartbeat_timeout=config.heartbeat_timeout_s,
-                          probe_prompt=config.probe_prompt)
+                          probe_prompt=config.probe_prompt,
+                          role=roles[i])
             for i in range(config.num_replicas)]
+        # migration coordinator: one per router, immutable after
+        # construction (it carries its own lock — see lockgraph.json)
+        self.migrator = BlockMigration(self.label)
         self._lock = threading.RLock()
         self._requests: Dict[str, RouterRequest] = {}
         self._next_id = 0
@@ -238,8 +268,14 @@ class ReplicaSet:
             "serving_failover_recovery_seconds",
             "quarantine -> rejoined-UP wall time per replica restart",
             labels=("router",), unit="seconds").labels(**lbl)
+        g_role = obs.gauge(
+            "serving_replica_role",
+            "1 for the replica's assigned tier (prefill|decode|mixed)",
+            labels=("router", "replica", "role"))
         for r in self.replicas:
             self._set_up_gauge(r)
+            g_role.labels(router=self.label, replica=str(r.index),
+                          role=r.role).set(1)
 
     @classmethod
     def from_model(cls, model, config: RouterConfig = None,
@@ -278,7 +314,7 @@ class ReplicaSet:
                 self._next_id += 1
             if request_id in self._requests:
                 raise ValueError(f"duplicate request_id {request_id!r}")
-            ups = [r for r in self.replicas if r.accepts_admissions()]
+            ups = self._admission_candidates()
             if not ups:
                 raise EngineOverloaded(
                     request_id, 0, 0,
@@ -357,6 +393,17 @@ class ReplicaSet:
                        for rec in self._requests.values())
 
     # ------------------------------------------------------------ routing
+    @holds_lock("_lock")
+    def _admission_candidates(self) -> List[EngineReplica]:
+        """New prompts (and failover re-prefills) are prefill work:
+        they admit to the prefill/mixed tier. Falls back to EVERY
+        accepting replica when that whole tier is down — availability
+        beats tiering, and a decode replica can still prefill, just not
+        at its sized-for roofline."""
+        ups = [r for r in self.replicas if r.accepts_admissions()]
+        tier = [r for r in ups if r.role in ("prefill", "mixed")]
+        return tier or ups
+
     @holds_lock("_lock")
     def _rank(self, candidates: List[EngineReplica],
               prompt_ids=None, demand: int = 0):
@@ -500,6 +547,7 @@ class ReplicaSet:
                     continue
                 self._absorb(r_outs, outs)
                 rep.maybe_drained()
+            self._handoffs(step_no, outs)
             for rep in self.replicas:
                 if rep.wedged():
                     self._failover(rep, "wedge",
@@ -509,6 +557,140 @@ class ReplicaSet:
         dt = time.perf_counter() - t0
         self._step_ewma = 0.8 * self._step_ewma + 0.2 * dt
         return outs
+
+    # ---------------------------------------------------------- migration
+    @holds_lock("_lock")
+    def _migration_targets(self, exclude: EngineReplica,
+                           decode_phase: bool = True
+                           ) -> List[EngineReplica]:
+        """Destination preference for one migration: UP replicas other
+        than the source, decode tier first for decode-phase requests
+        (that is what the tier is sized for, and the router's tier
+        filter keeps prompts off it), then descending effective
+        headroom, mid-prefill migrations prefer prefill/mixed instead
+        (their remaining chunks are prefill work)."""
+        cands = [r for r in self.replicas
+                 if r is not exclude and r.accepts_admissions()]
+        if decode_phase:
+            cands = [r for r in cands if r.role != "prefill"] \
+                or cands
+
+        def score(rep):
+            info = rep.load_info()
+            return (rep.role == "decode" if decode_phase
+                    else rep.role != "decode",
+                    info["free_blocks"] - info["block_demand"],
+                    -rep.index)
+
+        return sorted(cands, key=score, reverse=True)
+
+    @holds_lock("_lock")
+    def _handoffs(self, step_no: int, outs) -> None:
+        """Prefill→decode tier handoff, run once per router step:
+        every request that COMPLETED prefill on a prefill-role replica
+        migrates to the decode tier before its next decode chunk. No
+        decode-tier headroom → the request simply keeps decoding where
+        it is (tiering degrades to mixed, never wedges); a source that
+        dies mid-migration fails over like any other crash."""
+        for rep in self.replicas:
+            if rep.role != "prefill" or not rep.is_serving():
+                continue
+            try:
+                rids = rep.migratable_requests(decode_only=True)
+            except ReplicaCrashed:  # pragma: no cover - defensive
+                continue
+            for rid in rids:
+                rec = self._requests.get(rid)
+                if rec is None or rec.finished:
+                    continue          # warmup probe / already terminal
+                targets = self._migration_targets(rep)
+                if not targets:
+                    break             # no decode tier up: decode here
+                try:
+                    info = self.migrator.migrate(
+                        rep, targets[0], rid, "handoff",
+                        router_step=step_no, faults=self.faults)
+                except ReplicaCrashed as e:
+                    self._failover(rep, "crash", str(e), outs)
+                    break             # source gone; victims re-admit
+                if info is None:
+                    break             # tier full this step; retry next
+                rec.replica = targets[0].index
+
+    @holds_lock("_lock")
+    def _evacuate(self, rep: EngineReplica, outs) -> int:
+        """drain(recompute=False) body: move every live request's KV
+        off `rep` (arrival order — FCFS fairness at the destinations),
+        then re-dispatch its queued requests from the router's token
+        log. Anything no survivor can hold stays behind and finishes
+        under classic drain. Returns the number of requests moved."""
+        moved = 0
+        live = sorted(
+            (self._requests[rid] for rid
+             in rep.migratable_requests(decode_only=False)
+             if rid in self._requests),
+            key=lambda rec: rec.arrival)
+        for rec in live:
+            if rec.finished:
+                continue
+            # streamed tokens ⇒ past prefill ⇒ decode-tier preference
+            targets = self._migration_targets(
+                rep, decode_phase=bool(rec.tokens))
+            done = None
+            for target in targets:
+                try:
+                    done = self.migrator.migrate(
+                        rep, target, rec.request_id, "drain",
+                        router_step=self._steps, faults=self.faults)
+                except ReplicaCrashed as e:
+                    self._failover(rep, "crash", str(e), outs)
+                    return moved
+                if done is not None:
+                    rec.replica = target.index
+                    moved += 1
+                    break
+        # queued work second: no KV exists yet, so this is a plain
+        # re-dispatch — the first prefill at the new home recomputes
+        # nothing. The migrate_out event (blocks=0, queued) closes the
+        # request's timeline on this replica; the dispatch's
+        # engine_admit opens it on the next.
+        queued = sorted(
+            (rec for rec in self._requests.values()
+             if rec.replica == rep.index and not rec.finished),
+            key=lambda rec: rec.arrival)
+        for rec in queued:
+            ups = self._admission_candidates()   # excludes DRAINING rep
+            if not ups:
+                break
+            if rep.release_waiting(rec.request_id) is None:
+                continue      # running but unmovable: finishes here
+            target = self._rank(
+                ups, prompt_ids=rec.prompt_ids,
+                demand=self._worst_demand(
+                    rec.prompt_ids.size + rec.params.max_tokens,
+                    ups))[0]
+            obs.reqtrace.record(
+                "migrate_out", rec.trace_id or rec.request_id,
+                rec.request_id, replica=rep.index,
+                to_replica=target.index, reason="drain",
+                blocks=0, bytes=0, resume_pos=0, arrival=rec.arrival,
+                queued=True)
+            try:
+                target.dispatch(rec.prompt_ids, rec.params,
+                                rec.request_id,
+                                arrival_time=rec.arrival_time,
+                                arrival=rec.arrival,
+                                resume_tokens=rec.tokens, readmit=True,
+                                trace_id=rec.trace_id or None)
+            except ValueError:
+                # can never fit any pool — terminal, loud (the same
+                # contract as failover re-admission)
+                self._terminal(rec, "error")
+                outs.append(self._pending.pop())
+                continue
+            rec.replica = target.index
+            moved += 1
+        return moved
 
     @holds_lock("_lock")
     def _absorb(self, replica_outputs, outs) -> None:
@@ -603,7 +785,7 @@ class ReplicaSet:
         self._readmit_seq += 1
         batch_id = self._readmit_seq
         for rec in self._orphans:
-            ups = [r for r in self.replicas if r.accepts_admissions()]
+            ups = self._admission_candidates()
             if not ups:
                 remaining.append(rec)
                 continue
@@ -644,12 +826,75 @@ class ReplicaSet:
             1 if rep.accepts_admissions() else 0)
 
     # ------------------------------------------------------------ control
-    def drain(self, index: int) -> None:
-        """Stop routing new work to replica `index`; it finishes what
-        it holds and parks DRAINED (undrain() to rejoin)."""
+    def rebalance(self, watermark: float = 0.85) -> int:
+        """Move the COLDEST decode requests off every pool running past
+        `watermark` occupancy (used / total blocks) until it drops back
+        under. Coldest = latest arrival: under pressure those are
+        exactly the requests the FCFS preemption rule would recompute
+        anyway, so moving them is strictly cheaper than losing them.
+        Returns the number of requests migrated."""
+        if not 0.0 < watermark <= 1.0:
+            raise ValueError(
+                f"watermark must be in (0, 1], got {watermark}")
         with self._lock:
-            self.replicas[index].drain()
-            self._set_up_gauge(self.replicas[index])
+            moved = 0
+            outs: List[RequestOutput] = []
+            for rep in self.replicas:
+                if not rep.is_serving() or rep.engine is None:
+                    continue
+                total = rep.engine.cache.num_blocks
+                victims = sorted(
+                    (self._requests[rid] for rid
+                     in rep.migratable_requests(decode_only=True)
+                     if rid in self._requests),
+                    key=lambda rec: rec.arrival, reverse=True)
+                for rec in victims:
+                    info = rep.load_info()
+                    if (total - info["free_blocks"]) / total \
+                            <= watermark:
+                        break
+                    targets = [t for t in self._migration_targets(rep)
+                               if t.engine is not None
+                               and (t.engine.cache.num_blocks
+                                    - t.load_info()["free_blocks"])
+                               / t.engine.cache.num_blocks < watermark]
+                    if not targets:
+                        break     # nowhere under the bar: stop moving
+                    try:
+                        done = self.migrator.migrate(
+                            rep, targets[0], rec.request_id,
+                            "rebalance", router_step=self._steps,
+                            faults=self.faults)
+                    except ReplicaCrashed as e:
+                        self._failover(rep, "crash", str(e), outs)
+                        break
+                    if done is None:
+                        break
+                    rec.replica = targets[0].index
+                    moved += 1
+            self._pending.extend(outs)
+            return moved
+
+    def drain(self, index: int, recompute: bool = True) -> None:
+        """Stop routing new work to replica `index`; it parks DRAINED
+        once empty (undrain() to rejoin). `recompute=True` (classic)
+        lets it finish everything it holds in place.
+        `recompute=False` EVACUATES it instead: live requests (decode
+        AND mid-prefill) migrate their KV blocks to the other replicas
+        — zero re-prefilled tokens — and queued requests re-dispatch
+        from the router's token log (they never prefilled, so their
+        first prefill elsewhere recomputes nothing). Work that no
+        survivor can hold stays and finishes here under the classic
+        drain semantics."""
+        with self._lock:
+            rep = self.replicas[index]
+            rep.drain()
+            self._set_up_gauge(rep)
+            if recompute or rep.engine is None:
+                return
+            outs: List[RequestOutput] = []
+            self._evacuate(rep, outs)
+            self._pending.extend(outs)
 
     def undrain(self, index: int) -> None:
         with self._lock:
@@ -712,6 +957,7 @@ class ReplicaSet:
                 "unfinished": sum(1 for r in recs if not r.finished),
                 "generated_tokens": sum(len(r.tokens) for r in recs),
                 "requeues": sum(r.requeues for r in recs),
+                "migrations": self.migrator.stats(),
                 "finish_reasons": by_reason,
                 "replica_states": {r.index: r.state
                                    for r in self.replicas},

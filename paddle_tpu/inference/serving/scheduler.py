@@ -111,9 +111,13 @@ class RequestState:
     FINISHED_SHED = "finished_shed"          # evicted by admission control
     FINISHED_ERROR = "finished_error"        # quarantined by the watchdog
     CANCELLED = "cancelled"
+    MIGRATED = "migrated"                    # handed off to another engine
 
+    # terminal FOR THIS ENGINE: a MIGRATED request lives on at its
+    # destination (the router's record tracks it there), but this
+    # engine will never step it again
     FINISHED = (FINISHED_STOPPED, FINISHED_LENGTH, FINISHED_TIMEOUT,
-                FINISHED_SHED, FINISHED_ERROR, CANCELLED)
+                FINISHED_SHED, FINISHED_ERROR, CANCELLED, MIGRATED)
 
 
 _arrival_counter = itertools.count()
@@ -297,6 +301,80 @@ class Scheduler:
                 f"{self.cache.num_blocks}")
         with self._lock:
             self._requeue(req)
+
+    # ----------------------------------------------------- block migration
+    def adopt_running(self, req: Request):
+        """Migration admission (serving/migration.py): install an
+        in-flight request straight into the RUNNING set — its KV blocks
+        were already imported into this scheduler's cache
+        (PagedKVCache.import_blocks), so unlike `readmit` there is
+        nothing to re-prefill: the next schedule() reserves its decode
+        chunk and the fused scan continues exactly where the source
+        stopped. Bypasses max_waiting for the same reason readmit does
+        (the bound is backpressure against NEW arrivals)."""
+        worst = len(req.prompt_ids) + req.params.max_tokens
+        if self.cache.blocks_needed(worst) > self.cache.num_blocks:
+            raise ValueError(
+                f"request {req.request_id!r} needs "
+                f"{self.cache.blocks_needed(worst)} blocks at its longest"
+                f" ({worst} tokens) but the pool only has "
+                f"{self.cache.num_blocks}")
+        if not self.cache.has_seq(req.request_id):
+            raise ValueError(
+                f"adopt_running: seq {req.request_id!r} has no imported "
+                f"cache state — import_blocks must run first")
+        with self._lock:
+            req.slot = None
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+
+    def release_running(self, req: Request):
+        """Migration release (source side): detach a RUNNING request
+        whose KV payload has been committed at the destination. Frees
+        its blocks through the normal completion path — `cache_tokens`
+        registers the clean prefix, so the SOURCE trie keeps (or gains)
+        the entries this sequence wrote and shared blocks just drop one
+        reference. No terminal output, no finish event: the request is
+        still live, it just lives somewhere else now."""
+        with self._lock:
+            self.running.remove(req)
+            self.cache.free(req.request_id,
+                            cache_tokens=self._cache_tokens(req))
+            req.slot = None
+            req.state = RequestState.MIGRATED
+
+    def remove_waiting(self, request_id: str) -> Optional[Request]:
+        """Pull a WAITING request out of the queue without a terminal
+        state (drain evacuation: queued work has no KV to migrate, so
+        the router re-dispatches it to another replica from its token
+        log). Returns the request, or None when it is not waiting."""
+        with self._lock:
+            for req in list(self.waiting):
+                if req.request_id == request_id:
+                    self.waiting.remove(req)
+                    req.state = RequestState.MIGRATED
+                    return req
+            return None
+
+    def abort_adopted(self, req: Request):
+        """Roll back an adopt_running whose migration failed before the
+        source released (kill-mid-migration): drop the request from the
+        RUNNING set and free its imported blocks WITHOUT registering a
+        prefix — the destination never decoded a token, and the victim
+        re-prefills elsewhere from the router's token log."""
+        with self._lock:
+            if req in self.running:
+                self.running.remove(req)
+            if self.cache.has_seq(req.request_id):
+                self.cache.free(req.request_id)
+            req.slot = None
+            req.state = RequestState.MIGRATED
+
+    def running_requests(self) -> List[Request]:
+        """Stable snapshot of the RUNNING set (migration coordinator
+        scans it at step boundaries)."""
+        with self._lock:
+            return list(self.running)
 
     def shed_oldest(self) -> Optional[Request]:
         """Evict the oldest waiting request (router-level 'shed_oldest'

@@ -59,6 +59,8 @@ EVENT_KINDS = (
     "quarantine",     # engine/replica quarantined (reason)
     "failover",       # replica died holding the request (old replica)
     "readmit",        # re-admitted on a survivor (new replica, resume len)
+    "migrate_out",    # KV blocks left this replica (dst, blocks, bytes)
+    "migrate_in",     # KV blocks landed here (src, resume position)
     "finish",         # terminal: stop|length|cancelled|timeout|shed|error
 )
 _KIND_SET = frozenset(EVENT_KINDS)
@@ -414,7 +416,11 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
        dumps marked ``complete: false``);
     4. every failover hop references a real predecessor: a ``readmit``
        must follow a ``failover`` in its trace and name the replica it
-       came from.
+       came from;
+    5. every migration hop likewise: a ``migrate_in`` must follow a
+       ``migrate_out`` in its trace and name the replica the blocks
+       came from, and no decode emission may land between the two (the
+       request has no engine while its KV is in flight).
     """
     complete = bool(dump.get("complete", True))
     violations: List[str] = []
@@ -453,10 +459,18 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
                             f"{tid}: scheduled (ticket {mine}) while "
                             f"{w} (ticket {arr}) was still waiting on "
                             f"{eng} — FCFS order broken")
-        elif kind in ("finish", "failover"):
+        elif kind in ("finish", "failover", "migrate_out"):
+            # migrate_out leaves the per-engine FCFS simulation the same
+            # way failover does: the request is gone from this engine
+            # (a drained WAITING request re-enters it via the
+            # engine_admit its re-dispatch emits on the new engine)
             eng = engine_of.get(tid)
             if eng is not None:
                 waiting.get(eng, {}).pop(tid, None)
+        elif kind == "migrate_in" and "engine" in a:
+            # adopted straight into RUNNING: re-home the trace without a
+            # waiting entry — migrated requests never queue again
+            engine_of[tid] = a["engine"]
         if kind == "readmit" and "batch" in a:
             readmit_batches.setdefault(a["batch"], []).append(e)
 
@@ -472,6 +486,7 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
         prefilled = False
         finishes = 0
         last_failover_replica = None
+        pending_migration = None
         ticket = None
         for e in evts:
             kind = e["kind"]
@@ -498,6 +513,27 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
                         f"{tid}: {kind} before prefill completed")
             elif kind == "failover":
                 last_failover_replica = a.get("replica")
+            elif kind == "migrate_out":
+                # KV in flight: no engine may emit for this request
+                # until migrate_in re-homes it (or engine_admit, for a
+                # drained WAITING request that re-dispatches normally)
+                prefilled = False
+                pending_migration = a.get("replica")
+            elif kind == "migrate_in":
+                if pending_migration is None:
+                    violations.append(
+                        f"{tid}: migrate_in without a preceding "
+                        f"migrate_out")
+                elif a.get("from_replica") != pending_migration:
+                    violations.append(
+                        f"{tid}: migrate_in claims source replica "
+                        f"{a.get('from_replica')} but the migrate_out "
+                        f"was on replica {pending_migration}")
+                pending_migration = None
+                # the event says whether the payload already covers the
+                # whole prompt; a mid-prefill migration stays unprefilled
+                # until destination prefill_chunk events catch up
+                prefilled = bool(a.get("prefilled", True))
             elif kind == "readmit":
                 if last_failover_replica is None:
                     violations.append(

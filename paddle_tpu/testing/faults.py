@@ -36,7 +36,7 @@ SERVE_SPEC_ENV = "PADDLE_TPU_SERVE_FAULTS"
 
 KINDS = ("kill", "nan", "stall", "corrupt")
 SERVE_KINDS = ("nan_logits", "stall", "cache_corrupt", "burst",
-               "kill_replica", "wedge_replica")
+               "kill_replica", "wedge_replica", "kill_migration")
 KILL_EXIT_CODE = 37  # distinctive, so supervisors/tests can assert on it
 
 
@@ -207,6 +207,15 @@ class ServingFaultInjector:
                               heartbeat — models a hung device call;
                               detected by the router's heartbeat-based
                               wedge check (heartbeat_timeout_s)
+        kill_migration@6[:r]  replica `r` dies INSIDE a KV-block
+                              migration it is the SOURCE of, in the
+                              window after the destination admitted but
+                              before the source released — the
+                              narrowest transactional window; the
+                              coordinator rolls the destination back
+                              and the router fails the source over
+                              (kill_replica can never land there: the
+                              replica's own step claims it first)
 
     Each fault fires ONCE per injector instance, at the first
     opportunity AT OR AFTER its step (a fault armed for a step where its
@@ -329,6 +338,17 @@ class ServingFaultInjector:
         if not self.enabled:
             return False
         return self._claim_targeted("wedge_replica", step, replica)
+
+    def kill_migration(self, step: int, replica: int) -> bool:
+        """Migration-coordinator hook, between destination-admit and
+        source-release of a migration whose SOURCE is `replica`: True
+        exactly once when a kill_migration fault targeting it is due —
+        the coordinator rolls the destination back and raises
+        ReplicaCrashed for the source, driving the half-migrated
+        re-prefill path end to end."""
+        if not self.enabled:
+            return False
+        return self._claim_targeted("kill_migration", step, replica)
 
     def burst(self, step: int) -> int:
         """Harness hook: number of extra arrivals due now (0 if none) —

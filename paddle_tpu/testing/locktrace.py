@@ -220,6 +220,8 @@ def instrument_fleet(rs, witness: LockWitness, obs_too: bool = True
     lock, every live engine (+scheduler), and — via a factory wrap —
     every engine a future restart builds. Idempotent."""
     _swap(rs, "_lock", "ReplicaSet._lock", witness)
+    if getattr(rs, "migrator", None) is not None:
+        _swap(rs.migrator, "_lock", "BlockMigration._lock", witness)
     for rep in rs.replicas:
         _swap(rep, "_lock", "EngineReplica._lock", witness)
         if rep.engine is not None:

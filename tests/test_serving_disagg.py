@@ -1,0 +1,342 @@
+"""Disaggregated prefill/decode serving with live KV-block migration
+(paddle_tpu/inference/serving/migration.py + router roles/tiering).
+
+The load-bearing pins (docs/serving.md "Disaggregated serving and
+block migration"):
+
+- greedy output after a migration is BITWISE-identical to the same
+  request served unmigrated — pinned for handoff (prefill tier ->
+  decode tier), rebalance() and drain(recompute=False);
+- zero leaked blocks and a clean check_integrity on BOTH ends of every
+  migration, including prefix-shared blocks under refcount (shared
+  blocks are copied, never stolen — the source trie keeps its entry);
+- drain(recompute=False) evacuates live requests with ZERO
+  re-prefilled tokens (prefill counters frozen across the drain);
+- migrate_out/migrate_in trace events pair up (same arrival ticket,
+  matching src/dst replicas) and the reqtrace causality checker
+  machine-verifies the pairing;
+- a source replica killed INSIDE the migration commit window loses
+  nothing: the destination rolls back, the victim re-prefills from the
+  router's token log, survivors stay bitwise (chaos gate, 3 seeds).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.inference.serving import (EngineConfig, ReplicaSet,
+                                          RouterConfig, SamplingParams)
+from paddle_tpu.obs.reqtrace import ReqTraceRing
+from paddle_tpu.testing.faults import ServingFaultInjector
+
+VOCAB = 97
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=48)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _ecfg(**kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("decode_chunk_size", 2)   # keep requests in flight
+    return EngineConfig(**kw)
+
+
+def _router(model, n=2, roles=None, ecfg=None, **rkw):
+    rkw.setdefault("backoff_base", 0.01)
+    rkw.setdefault("backoff_max", 0.05)
+    rkw.setdefault("backoff_jitter", 0.0)
+    return ReplicaSet.from_model(
+        model, RouterConfig(num_replicas=n, roles=roles, **rkw),
+        engine_config=ecfg or _ecfg(),
+        faults=ServingFaultInjector(""))
+
+
+def _prompts(n, seed=7, lo=6, hi=14):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, VOCAB, int(rng.randint(lo, hi))).astype(np.int32)
+            for _ in range(n)]
+
+
+def _run(rs, prompts, max_tokens=12, max_steps=400):
+    rids = [rs.add_request(p, SamplingParams(max_tokens=max_tokens))
+            for p in prompts]
+    steps = 0
+    while rs.has_unfinished():
+        rs.step()
+        steps += 1
+        assert steps <= max_steps, "router failed to drain"
+    return rids
+
+
+def _tokens(rs, rids):
+    return [list(rs.get_request(r).tokens) for r in rids]
+
+
+def _assert_clean(rs):
+    for idx, audit in rs.check_integrity().items():
+        assert audit is not None, f"replica {idx} has no live engine"
+        for k, v in audit.items():
+            if isinstance(v, int):
+                assert v == 0, (idx, k, audit)
+
+
+# ------------------------------------------------------- role plumbing
+def test_roles_validation(model):
+    with pytest.raises(ValueError):        # wrong length
+        _router(model, n=2, roles=("prefill",))
+    with pytest.raises(ValueError):        # unknown role
+        _router(model, n=2, roles=("prefill", "turbo"))
+    with pytest.raises(ValueError):        # nobody to decode
+        _router(model, n=2, roles=("prefill", "prefill"))
+    rs = _router(model, n=2, roles=("prefill", "decode"))
+    assert [r.role for r in rs.replicas] == ["prefill", "decode"]
+    rs2 = _router(model, n=2)              # default: all mixed
+    assert [r.role for r in rs2.replicas] == ["mixed", "mixed"]
+
+
+# ------------------------------------------------ handoff: bitwise pin
+def test_handoff_bitwise_and_integrity(model):
+    prompts = _prompts(5)
+    base = _tokens(*((rs := _router(model, n=2)),
+                     _run(rs, prompts)))
+    tiered = _router(model, n=2, roles=("prefill", "decode"))
+    rids = _run(tiered, prompts)
+    # every request was handed off exactly once and finished on the
+    # decode tier
+    assert tiered.migrator.stats()["migrations"] == len(prompts)
+    assert all(tiered.get_request(r).replica == 1 for r in rids)
+    # greedy output is bitwise-identical to the unmigrated fleet
+    assert _tokens(tiered, rids) == base
+    _assert_clean(tiered)
+
+
+def test_handoff_preserves_fcfs_arrival_ticket(model):
+    tiered = _router(model, n=2, roles=("prefill", "decode"))
+    prompts = _prompts(4, seed=11)
+    rids = _run(tiered, prompts)
+    assert tiered.migrator.stats()["migrations"] == len(prompts)
+    # the router record's arrival stamp is the FCFS ticket; migration
+    # must carry it unchanged (resume, not re-enqueue)
+    arrivals = [tiered.get_request(r).arrival for r in rids]
+    assert arrivals == sorted(arrivals)
+
+
+# ---------------------------- shared prefix: copied, never stolen
+def test_migration_copies_shared_prefix_blocks(model):
+    tpl = np.arange(1, 17, dtype=np.int32)          # 4 full blocks
+    leader = np.concatenate([tpl, np.array([40, 41, 42], np.int32)])
+    follower = np.concatenate([tpl, np.array([50, 51], np.int32)])
+
+    # reference: same two prompts, tiered, prefix cache OFF
+    ref = _router(model, n=2, roles=("prefill", "decode"))
+    ref_toks = _tokens(ref, _run(ref, [leader, follower]))
+
+    ecfg = _ecfg(enable_prefix_cache=True)
+    rs = _router(model, n=2, roles=("prefill", "decode"), ecfg=ecfg)
+    r0 = rs.add_request(leader, SamplingParams(max_tokens=12))
+    steps = 0
+    # run until the leader has been migrated off the prefill tier —
+    # its template blocks now live ONLY via the source trie's entry
+    while rs.migrator.stats()["migrations"] < 1:
+        rs.step()
+        steps += 1
+        assert steps <= 50, "leader never handed off"
+    r1 = rs.add_request(follower, SamplingParams(max_tokens=12))
+    while rs.has_unfinished():
+        rs.step()
+        steps += 1
+        assert steps <= 400
+    # the follower HIT the trie entry the migrated leader left behind:
+    # migration copied the shared blocks, it did not steal them
+    src = rs.replicas[0].engine.cache.prefix_stats()
+    assert src["hits"] >= 1, src
+    # the destination registered the migrated prefixes into its own
+    # trie (entries survive the requests finishing)
+    dst = rs.replicas[1].engine.cache.prefix_stats()
+    assert dst["evictable_blocks"] > 0, dst
+    assert rs.migrator.stats()["migrations"] == 2
+    assert _tokens(rs, [r0, r1]) == ref_toks
+    _assert_clean(rs)
+
+
+# ------------------------------------------------- rebalance: bitwise
+def test_rebalance_moves_cold_requests_bitwise(model):
+    prompts = _prompts(4, seed=3, lo=10, hi=14)
+    base = _tokens(*((rs := _router(model, n=2)),
+                     _run(rs, prompts, max_tokens=16)))
+    # roles ("mixed","decode") funnel every admission onto replica 0,
+    # manufacturing the occupancy skew rebalance exists to fix
+    skew = _router(model, n=2, roles=("mixed", "decode"))
+    rids = [skew.add_request(p, SamplingParams(max_tokens=16))
+            for p in prompts]
+    for _ in range(3):
+        skew.step()
+    occ0 = 1 - (skew.replicas[0].load_info()["free_blocks"]
+                / skew.replicas[0].engine.cache.num_blocks)
+    assert occ0 > 0.3                       # the skew is real
+    moved = skew.rebalance(watermark=0.3)
+    assert moved >= 1
+    assert skew.migrator.stats()["migrations"] == moved
+    steps = 0
+    while skew.has_unfinished():
+        skew.step()
+        steps += 1
+        assert steps <= 400
+    assert _tokens(skew, rids) == base
+    _assert_clean(skew)
+
+
+def test_rebalance_noop_below_watermark(model):
+    rs = _router(model, n=2)
+    _run(rs, _prompts(3))
+    assert rs.rebalance(watermark=0.95) == 0
+    with pytest.raises(ValueError):
+        rs.rebalance(watermark=0.0)
+
+
+# --------------------------------------- drain without recomputation
+def test_drain_evacuates_with_zero_reprefill(model):
+    prompts = _prompts(3, seed=5, lo=8, hi=12)
+    base = _tokens(*((rs := _router(model, n=2)),
+                     _run(rs, prompts, max_tokens=16)))
+    rs = _router(model, n=2)
+    rids = [rs.add_request(p, SamplingParams(max_tokens=16))
+            for p in prompts]
+    for _ in range(2):                      # all rows prefilled, mid-decode
+        rs.step()
+
+    def prefill_spend():
+        return sum(r.engine.stats.as_dict()["prefill_tokens"]
+                   + r.engine.stats.prefill_chunks()
+                   for r in rs.replicas if r.engine is not None)
+
+    spent = prefill_spend()
+    rs.drain(0, recompute=False)
+    steps = 0
+    while rs.has_unfinished():
+        rs.step()
+        steps += 1
+        assert steps <= 400
+    # live requests moved via KV-block migration: not one prefill token
+    # (dense or chunked) was recomputed anywhere in the fleet
+    assert prefill_spend() == spent
+    assert rs.migrator.stats()["migrations"] >= 1
+    assert str(rs.states()[0]) == "drained"
+    assert _tokens(rs, rids) == base
+    _assert_clean(rs)
+
+
+# ------------------------------------------------ reqtrace invariants
+def test_migrate_trace_events_pair_and_check_clean(model):
+    obs.reqtrace.enable()
+    rs = _router(model, n=2, roles=("prefill", "decode"))
+    _run(rs, _prompts(3, seed=9))
+    ids = sorted(obs.reqtrace.traces(prefix=f"tr-{rs.label}-"))
+    dump = obs.reqtrace.dump_payload("test", trace_ids=ids,
+                                     complete=True)
+    assert obs.reqtrace.check_causality(dump) == []
+    by_trace = {}
+    for e in dump["events"]:
+        by_trace.setdefault(e["trace_id"], []).append(e)
+    assert len(by_trace) == 3
+    for tid, evts in by_trace.items():
+        outs = [e for e in evts if e["kind"] == "migrate_out"]
+        ins = [e for e in evts if e["kind"] == "migrate_in"]
+        assert len(outs) == 1 and len(ins) == 1, tid
+        o, i = outs[0]["attrs"], ins[0]["attrs"]
+        assert o["to_replica"] == i["replica"]
+        assert i["from_replica"] == o["replica"]
+        assert o["arrival"] == i["arrival"]     # FCFS ticket constant
+        assert o["blocks"] == i["blocks"] and o["bytes"] == i["bytes"]
+        assert i["prefilled"] is True
+
+
+def test_checker_flags_migrate_violations():
+    # migrate_in with no preceding migrate_out
+    r = ReqTraceRing()
+    r.record("engine_admit", "tM0", engine="e-0", arrival=0)
+    r.record("scheduled", "tM0", arrival=0)
+    r.record("prefill", "tM0")
+    r.record("migrate_in", "tM0", replica=1, from_replica=0,
+             engine="e-1", arrival=0, prefilled=True)
+    r.record("finish", "tM0", reason="stop")
+    bad = {"version": 1, "complete": True,
+           "events": [e.as_dict() for e in r.events()]}
+    assert any("migrate_out" in v for v in
+               obs.reqtrace.check_causality(bad))
+
+    # migrate_in naming the wrong source replica
+    r.clear()
+    r.record("engine_admit", "tM1", engine="e-0", arrival=0)
+    r.record("scheduled", "tM1", arrival=0)
+    r.record("prefill", "tM1")
+    r.record("migrate_out", "tM1", replica=0, to_replica=1, arrival=0)
+    r.record("migrate_in", "tM1", replica=1, from_replica=2,
+             engine="e-1", arrival=0, prefilled=True)
+    r.record("finish", "tM1", reason="stop")
+    bad = {"version": 1, "complete": True,
+           "events": [e.as_dict() for e in r.events()]}
+    assert any("source replica" in v for v in
+               obs.reqtrace.check_causality(bad))
+
+    # token emission between migrate_out and migrate_in: the request
+    # has no home engine in that window, nothing may decode it
+    r.clear()
+    r.record("engine_admit", "tM2", engine="e-0", arrival=0)
+    r.record("scheduled", "tM2", arrival=0)
+    r.record("prefill", "tM2")
+    r.record("migrate_out", "tM2", replica=0, to_replica=1, arrival=0)
+    r.record("first_token", "tM2")
+    r.record("migrate_in", "tM2", replica=1, from_replica=0,
+             engine="e-1", arrival=0, prefilled=True)
+    r.record("finish", "tM2", reason="stop")
+    bad = {"version": 1, "complete": True,
+           "events": [e.as_dict() for e in r.events()]}
+    assert any("prefill" in v for v in
+               obs.reqtrace.check_causality(bad))
+
+
+# ------------------------------------------- chaos: kill mid-migration
+def _run_chaos_disagg(**kw):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        from chaos_serve import run_chaos_disagg
+    finally:
+        sys.path.pop(0)
+    return run_chaos_disagg(**kw)
+
+
+@pytest.mark.chaos
+def test_chaos_kill_mid_migration(model):
+    # the harness itself asserts the gates (zero lost, zero leaks on
+    # both ends, bitwise survivors, witness clean); here we pin that
+    # the fault actually landed in the commit window and rolled back
+    rep = _run_chaos_disagg(seed=0, n_requests=10)
+    assert rep["migrations"]["rolled_back"] >= 1
+    assert rep["migrations"]["migrations"] >= 1
+    assert rep["survivors"] == 10
+    assert not rep["lockgraph"]["cycles"]
+    assert not rep["lockgraph"]["unpredicted_edges"]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2])
+def test_chaos_kill_mid_migration_more_seeds(seed):
+    rep = _run_chaos_disagg(seed=seed, n_requests=10)
+    assert rep["migrations"]["rolled_back"] >= 1
+    assert rep["survivors"] == 10

@@ -39,6 +39,21 @@ engines mid-traffic, and the audit gates widen to the router's promises
     JAX_PLATFORMS=cpu python tools/chaos_serve.py --replicas 3 \
         --faults "kill_replica@6:1,nan_logits@10,stall@12:0.05"
 
+`--disagg` switches to the disaggregated-serving harness
+(`run_chaos_disagg`): replica 0 becomes a prefill tier that hands every
+prefill-complete request to decode replicas via live KV-block migration
+(paddle_tpu/inference/serving/migration.py), while `kill_migration@step:0`
+kills the source INSIDE the commit window — between destination admit
+and source release, the one window plain kill_replica can never reach.
+Gates: zero lost requests (the half-migrated victim re-prefills from
+the router's authoritative token log), zero leaked blocks on BOTH ends,
+every completed request bitwise-identical to the unfaulted
+disaggregated run, non-vacuous handoffs + rollback, and the migration
+coordinator's cross-replica lock edges cycle-free and statically
+predicted.
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --disagg --seed 0
+
 `--prefix-cache` reruns either harness on TEMPLATED prompts with
 radix-trie block sharing enabled (docs/serving.md "Prefix caching") —
 multi-replica mode additionally routes by prefix affinity so the
@@ -453,6 +468,164 @@ def run_chaos_replicas(seed: int = 0, n_requests: int = 24,
     return report
 
 
+DEFAULT_DISAGG_FAULTS = "kill_migration@3:0,kill_migration@7:0"
+
+
+def run_chaos_disagg(seed: int = 0, n_requests: int = 18,
+                     replicas: int = 3,
+                     faults: str = DEFAULT_DISAGG_FAULTS,
+                     max_steps: int = 4000,
+                     witness_out: str = "") -> dict:
+    """One seeded disaggregated-serving chaos run: a prefill-tier
+    replica 0 hands every prefill-complete request off to the decode
+    tier via live KV-block migration, while `kill_migration@step:0`
+    kills the SOURCE inside the commit window (between destination
+    admit and source release — the one window `kill_replica` can never
+    reach, because the replica's own step claims that fault first).
+    The audit gates on docs/serving.md "Disaggregated serving and
+    block migration":
+
+    - zero lost requests: the half-migrated victim's destination copy
+      is rolled back and the router re-prefills it from its
+      authoritative token log, so every id still reaches a terminal
+      state;
+    - zero leaked blocks on BOTH ends of every migration (router-wide
+      check_integrity — the rolled-back destination must not strand
+      its freshly imported blocks, the dead source's restart must come
+      up clean);
+    - bitwise survivors: EVERY completed request — migrated, re-
+      prefilled after the mid-migration kill, or untouched — matches
+      the unfaulted disaggregated run token-for-token (migration
+      invariance + replay invariance compose);
+    - non-vacuous: the run must commit handoffs AND roll at least one
+      migration back when the spec schedules a kill_migration;
+    - lock-order witness: the migration coordinator's cross-replica
+      edges (BlockMigration -> EngineReplica -> ...) are cycle-free
+      and statically predicted."""
+    import time
+
+    from paddle_tpu.inference.serving import (EngineConfig, ReplicaSet,
+                                              RouterConfig,
+                                              SamplingParams)
+    from paddle_tpu.testing.faults import ServingFaultInjector
+    from paddle_tpu.testing.locktrace import instrument_fleet
+
+    if replicas < 2:
+        raise ValueError("disaggregated chaos needs >= 2 replicas "
+                         "(one prefill, one+ decode)")
+    witness, predicted = _lock_witness()
+    model, cfg = _build_model()
+    rng = np.random.RandomState(seed)
+    specs = [(rng.randint(0, cfg.vocab_size,
+                          (int(rng.randint(4, 12)),), dtype=np.int32),
+              int(rng.randint(8, 16))) for _ in range(n_requests)]
+    # decode_chunk_size=2 keeps migrated requests decoding across many
+    # router steps, so the scheduled kill lands on live handoffs
+    ecfg = EngineConfig(block_size=4, num_blocks=48, max_num_seqs=4,
+                        decode_chunk_size=2, enable_prefix_cache=True)
+    roles = ("prefill",) + ("decode",) * (replicas - 1)
+
+    def router_config():
+        return RouterConfig(num_replicas=replicas, roles=roles,
+                            heartbeat_timeout_s=0.02,
+                            backoff_base=0.01, backoff_max=0.05,
+                            backoff_jitter=0.0)
+
+    def drive(injector):
+        rs = ReplicaSet.from_model(model, router_config(),
+                                   engine_config=ecfg, faults=injector)
+        instrument_fleet(rs, witness)
+        pending = list(enumerate(specs))
+        rids = {}
+        for i, (p, mt) in pending[:2 * replicas]:
+            rids[i] = rs.add_request(p, SamplingParams(max_tokens=mt))
+        pending = pending[2 * replicas:]
+        steps = 0
+        while rs.has_unfinished() or pending:
+            rs.step()
+            steps += 1
+            assert steps <= max_steps, \
+                f"router failed to drain within {max_steps} steps"
+            if steps % 2 == 0 and pending:      # staggered arrivals
+                i, (p, mt) = pending.pop(0)
+                rids[i] = rs.add_request(p, SamplingParams(max_tokens=mt))
+            if not any(r.has_unfinished() for r in rs.replicas) \
+                    and rs.has_unfinished():
+                time.sleep(0.002)               # restart backoff pending
+        return rs, rids
+
+    # reference pass: same workload, same tiers, no faults — handoffs
+    # still happen, so the comparison also pins migration invariance
+    ref_rs, ref_rids = drive(ServingFaultInjector(""))
+    assert ref_rs.migrator.stats()["migrations"] > 0, \
+        "disagg reference run committed no handoffs — vacuous tiering"
+    ref_tokens = {i: list(ref_rs.get_request(r).tokens)
+                  for i, r in ref_rids.items()}
+
+    injector = ServingFaultInjector(faults)
+    scheduled_kills = sum(1 for k, _s, _a in injector.faults
+                          if k == "kill_migration")
+    rs, rids = drive(injector)
+
+    st = rs.router_stats()
+    mig = rs.migrator.stats()
+    p99 = rs.ttft_quantile(0.99)
+    unserved = sum(v for k, v in st["finish_reasons"].items()
+                   if k not in ("stop", "length"))
+    report = {
+        "seed": seed, "requests": n_requests, "replicas": replicas,
+        "roles": list(roles), "faults": faults,
+        "fired": list(injector.fired_log),
+        "migrations": mig,
+        "requeues": st["requeues"],
+        "finish_reasons": st["finish_reasons"],
+        "replica_states": {k: str(v)
+                           for k, v in st["replica_states"].items()},
+        "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
+                "reject_rate": round(unserved / max(n_requests, 1), 4)},
+    }
+    # 1. no lost requests — and stronger than the failover harness:
+    #    every id must actually COMPLETE (stop/length), because the
+    #    only faults here are mid-migration kills and the victim always
+    #    re-prefills from the router's authoritative token log
+    lost = [i for i, r in rids.items()
+            if rs.get_request(r).finish_reason not in ("stop", "length")]
+    assert not lost, f"requests lost or errored after drain: {lost}"
+    # 2. zero leaked blocks on BOTH ends: check_integrity raises on any
+    #    violation, and a replica that ended without a live engine is
+    #    itself a failure (the killed source must have restarted)
+    report["integrity"] = rs.check_integrity()
+    for idx, audit in report["integrity"].items():
+        assert audit is not None, \
+            f"replica {idx} ended the run without a live engine"
+    # 3. bitwise survivors: every completed request matches the
+    #    unfaulted disaggregated run — migrated, re-prefilled or not
+    mismatched, survivors = [], 0
+    for i, r in rids.items():
+        rec = rs.get_request(r)
+        if rec.finish_reason not in ("stop", "length"):
+            continue
+        survivors += 1
+        if list(rec.tokens) != ref_tokens[i]:
+            mismatched.append({"request": i, "trace_id": rec.trace_id})
+    report["survivors"] = survivors
+    assert not mismatched, \
+        f"survivor token divergence vs unfaulted run: {mismatched}"
+    # 4. non-vacuous: handoffs committed, and the scheduled
+    #    mid-migration kill actually rolled a destination back
+    assert mig["migrations"] > 0, \
+        "disagg chaos run committed no handoffs — vacuous tiering"
+    if scheduled_kills:
+        assert mig["rolled_back"] > 0, \
+            "kill_migration was scheduled but no migration rolled " \
+            "back — the fault never landed in the commit window"
+    # 5. lock-order witness across the migration coordinator's
+    #    cross-replica call path: cycle-free, statically predicted
+    _audit_witness(witness, predicted, report,
+                   spans_path=witness_out)
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -462,6 +635,13 @@ def main(argv=None) -> int:
                          "replicas behind a ReplicaSet (0 = single-"
                          "engine mode); default faults become "
                          f"{DEFAULT_REPLICA_FAULTS!r}")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated-serving harness: replica 0 is "
+                         "a prefill tier handing off to decode "
+                         "replicas via live KV-block migration, with "
+                         "kill-mid-migration coverage (default faults "
+                         f"{DEFAULT_DISAGG_FAULTS!r}; --replicas "
+                         "defaults to 3)")
     ap.add_argument("--faults", default=None,
                     help="ServingFaultInjector spec (see testing/faults.py)")
     ap.add_argument("--cancel-every", type=int, default=0,
@@ -505,7 +685,15 @@ def main(argv=None) -> int:
     obs.reqtrace.arm(flight_dir, max_dumps=4)
     flight_path = os.path.join(flight_dir, "flightrec-exit.json")
     try:
-        if args.replicas > 0:
+        if args.disagg:
+            report = run_chaos_disagg(
+                seed=args.seed, n_requests=args.requests,
+                replicas=(args.replicas if args.replicas > 0 else 3),
+                faults=(args.faults if args.faults is not None
+                        else DEFAULT_DISAGG_FAULTS),
+                max_steps=args.max_steps,
+                witness_out=args.witness_out)
+        elif args.replicas > 0:
             report = run_chaos_replicas(
                 seed=args.seed, n_requests=args.requests,
                 replicas=args.replicas,

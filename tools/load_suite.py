@@ -70,6 +70,18 @@ Scenarios (docs/observability.md "Load suite"):
                  `balance="prefix_affinity"` must retain >= 80% of the
                  single-replica hit rate.
 
+- disagg       — the mixed_prefill_decode traffic on a 4-replica
+                 budget, run 2-prefill+2-decode (live KV-block handoff
+                 at prefill completion, docs/serving.md "Disaggregated
+                 serving and block migration") and again 4-mixed on the
+                 SAME traffic. Reports the decode tier's
+                 inter-token-gap p99, migration latency p99
+                 (serving_migration_seconds) and client-visible TTFT
+                 into BENCH_FULL; SLO-gates the gap p99 (the number
+                 disaggregation exists to protect), zero lost requests
+                 and non-vacuous handoffs, with the mixed baseline
+                 riding along on the same gap bound for attribution.
+
 Each scenario runs its full workload once unmeasured (compiles every
 prefill/decode bucket — TTFT must not include XLA compile time), then
 once measured on a fresh engine. `reject_rate` counts every submitted
@@ -101,7 +113,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
              "decode_heavy", "replica_kill", "mixed_prefill_decode",
-             "prefix_heavy")
+             "prefix_heavy", "disagg")
 
 #: per-scenario SLOs. Latency bounds are generous (CPU-smoke friendly)
 #: — the point is catching regressions in KIND (rejects where none are
@@ -167,6 +179,17 @@ SLOS = {
                      "max_reject_rate": 0.0, "min_hit_rate": 0.5,
                      "min_ttft_speedup": 2.0,
                      "min_affinity_retention": 0.8},
+    # disaggregated tiers (docs/serving.md "Disaggregated serving and
+    # block migration"): the PR 10 mixed prefill+decode traffic on a
+    # 4-replica budget, 2-prefill+2-decode with live KV-block handoff.
+    # Gated on the decode tier's inter-token-gap p99 (the number
+    # disaggregation exists to protect: prefill bursts land on the
+    # prefill tier, so decode cadence holds), zero lost requests, and
+    # non-vacuous handoffs; the 4-mixed baseline runs the SAME traffic
+    # and rides along on the same gap SLO for attribution.
+    "disagg": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 10.0,
+               "max_reject_rate": 0.0, "max_token_gap_p99_s": 4.0,
+               "max_lost": 0, "min_migrations": 1},
 }
 
 CHAOS_FAULTS = "nan_logits@6,stall@9:0.05,cache_corrupt@12"
@@ -288,6 +311,17 @@ def _arrivals(name: str, n: int, vocab: int, seed: int):
             arr.append((3 + 2 * j,
                         rng.randint(1, vocab, (plen,), dtype=np.int32),
                         int(rng.randint(4, 8))))
+    elif name == "disagg":
+        # same traffic as mixed_prefill_decode (the PR 10 mix — decode
+        # floor + unseen-length long prompts), but served by a
+        # 4-replica fleet: smaller per-replica pools and small decode
+        # chunks keep requests in flight across many router steps, so
+        # every prefill-tier completion takes the live-handoff path
+        ecfg, arr = _arrivals("mixed_prefill_decode", n, vocab, seed)
+        ecfg.obs_label = f"load-{name}"
+        ecfg.decode_chunk_size = 2
+        ecfg.num_blocks = 64
+        return ecfg, arr
     elif name == "prefix_heavy":
         # templated traffic: 3 fixed 40-token templates (10 full
         # blocks), each request = template + unique 2..6-token suffix.
@@ -362,10 +396,12 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
                   faults: str = "", max_steps=6000,
                   balance: str = "free_blocks",
                   obs_label: str = "load-replica-kill",
-                  witness=None):
-    """replica_kill / prefix_heavy fleet driver: the same arrival clock
-    as _drive, but the workload flows through a ReplicaSet (and for
-    replica_kill the fault schedule targets whole replicas). Returns
+                  roles=None, witness=None):
+    """replica_kill / prefix_heavy / disagg fleet driver: the same
+    arrival clock as _drive, but the workload flows through a
+    ReplicaSet (for replica_kill the fault schedule targets whole
+    replicas; for disagg `roles` splits the fleet into prefill/decode
+    tiers with live KV-block handoff). Returns
     (router, request_ids, submitted, rejected, wall_seconds)."""
     from paddle_tpu.inference.serving import (ReplicaSet, RouterConfig,
                                               SamplingParams)
@@ -375,7 +411,7 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
     rc = RouterConfig(num_replicas=replicas, heartbeat_timeout_s=0.02,
                       backoff_base=0.01, backoff_max=0.05,
                       backoff_jitter=0.0, balance=balance,
-                      obs_label=obs_label)
+                      roles=roles, obs_label=obs_label)
     rs = ReplicaSet.from_model(model, rc, engine_config=ecfg,
                                faults=ServingFaultInjector(faults))
     if witness is not None:
@@ -462,6 +498,21 @@ def _metrics_router(rs, rids, submitted, rejected, wall) -> dict:
     }
 
 
+def _fleet_gap_p99(rs):
+    """Decode inter-token-gap p99 across the fleet: the max over every
+    live DECODE-SERVING replica's engine series (prefill-tier replicas
+    are excluded — they hand decode work off, so their few pre-handoff
+    gaps are not the number disaggregation protects)."""
+    gaps = []
+    for rep in rs.replicas:
+        if rep.role == "prefill" or rep.engine is None:
+            continue
+        v = rep.engine.stats.token_gap_quantile(0.99)
+        if not math.isnan(v):
+            gaps.append(v)
+    return round(max(gaps), 4) if gaps else None
+
+
 def _quantile(eng, q):
     v = eng.stats.ttft_quantile(q)
     return None if math.isnan(v) else round(v, 4)
@@ -532,6 +583,12 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
         if ret is None or ret < ret_min:
             viol.append(f"affinity retention {ret} < {ret_min} "
                         "(3-replica vs single-replica hit rate)")
+    mig_min = slo.get("min_migrations")
+    if mig_min is not None:
+        got = metrics["migrations"]["migrations"]
+        if got < mig_min:
+            viol.append(f"migrations {got} < {mig_min} "
+                        "(prefill->decode handoff tiering was vacuous)")
     lg = metrics.get("lockgraph")
     if lg is not None:
         # lock-order witness gate (docs/static_analysis.md "Runtime
@@ -660,6 +717,48 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
             "token_gap_p99": bm["token_gap_p99"],
             "slo_pass": _check_slo(bm, SLOS[name])["pass"],
         }
+        return _slo_verdict(name, m)
+    if name == "disagg":
+        # the PR 10 mixed traffic served twice on the same 4-replica
+        # budget: 2-prefill+2-decode tiers (live KV-block handoff at
+        # prefill completion) vs the 4-mixed baseline. Measured passes
+        # draw long-prompt lengths of the OPPOSITE parity from warmup
+        # (unseen prefill shapes, exactly like mixed_prefill_decode);
+        # both configurations run under one lock witness — handoff is
+        # the deepest cross-replica lock path the fleet has
+        witness, predicted = _lock_witness()
+        _, meas = _arrivals(name, n, cfg.vocab_size, seed + 1)
+        roles = ("prefill", "prefill", "decode", "decode")
+        _drive_router(model, ecfg, arr, replicas=4, roles=roles,
+                      obs_label=f"load-{name}", witness=witness)
+        rs, rids, submitted, rejected, wall = _drive_router(
+            model, ecfg, meas, replicas=4, roles=roles,
+            obs_label=f"load-{name}", witness=witness)
+        m = _metrics_router(rs, rids, submitted, rejected, wall)
+        m["token_gap_p99"] = _fleet_gap_p99(rs)
+        m["migrations"] = rs.migrator.stats()
+        mp99 = rs.migrator.seconds_quantile(0.99)
+        m["migration_p99_s"] = None if math.isnan(mp99) \
+            else round(mp99, 4)
+        # 4-mixed baseline: same traffic, no tiers, no handoffs — it
+        # rides along on the same gap SLO so the report attributes any
+        # cadence win to disaggregation, not to the fleet size
+        _drive_router(model, ecfg, arr, replicas=4,
+                      obs_label=f"load-{name}-mixed", witness=witness)
+        brs, brids, bsub, brej, bwall = _drive_router(
+            model, ecfg, meas, replicas=4,
+            obs_label=f"load-{name}-mixed", witness=witness)
+        bm = _metrics_router(brs, brids, bsub, brej, bwall)
+        bgap = _fleet_gap_p99(brs)
+        m["mixed_baseline"] = {
+            "tokens_per_sec": bm["tokens_per_sec"],
+            "ttft_p50": bm["ttft_p50"],
+            "ttft_p99": bm["ttft_p99"],
+            "token_gap_p99": bgap,
+            "gap_slo_pass": (bgap is not None and
+                             bgap <= SLOS[name]["max_token_gap_p99_s"]),
+        }
+        m["lockgraph"] = _lockgraph_report(witness, predicted)
         return _slo_verdict(name, m)
     if name == "prefix_heavy":
         import dataclasses

@@ -27,7 +27,9 @@ span IS the causal story).
 --check machine-verifies the causal invariants (no token emission
 before prefill completes, requeue preserves the FCFS arrival ticket
 and admission order, exactly-one terminal event per trace, every
-failover hop references a real predecessor replica) and exits 0/1 —
+failover hop references a real predecessor replica, every migrate_in
+references the replica its migrate_out named and no decode emission
+lands between them) and exits 0/1 —
 the tier-1 suite runs it on a small recorded run. Dumps marked
 `"complete": false` (taken mid-run by an auto trigger) tolerate traces
 that have not reached their terminal event yet.
@@ -73,8 +75,10 @@ def print_summary(dump: dict) -> None:
         reason = (finish.get("attrs") or {}).get("reason") if finish \
             else "(open)"
         hops = kinds.count("readmit")
+        migs = kinds.count("migrate_in")
         print(f"  {tid}: {len(evts)} events, terminal={reason}"
-              + (f", failover_hops={hops}" if hops else ""))
+              + (f", failover_hops={hops}" if hops else "")
+              + (f", migrations={migs}" if migs else ""))
 
 
 def print_timeline(dump: dict, trace_id: str) -> int:
@@ -195,6 +199,17 @@ def render_chrome(dump: dict, out_path: str,
                         chrome.append(_span_event(
                             "prefill", p0, ts, base, pid, row))
                     open_since["decode"] = ts
+            elif k == "migrate_out":
+                # live KV-block migration off this replica: close the
+                # open phases here; migrate_in reopens on the new one
+                for phase, t0p in list(open_since.items()):
+                    chrome.append(
+                        _span_event(phase, t0p, ts, base, pid, row))
+                open_since.clear()
+            elif k == "migrate_in":
+                a = e.get("attrs") or {}
+                open_since["decode" if a.get("prefilled", True)
+                           else "prefill"] = ts
             elif k in ("finish", "failover", "preempt", "requeue"):
                 for phase, t0p in list(open_since.items()):
                     chrome.append(
