@@ -828,7 +828,13 @@ def main():
                    "slo_violations": m["slo"]["violations"]}
                 | {k: m[k] for k in ("ttft_decomposition",
                                      "recorder_overhead_pct",
-                                     "recorder_overhead_noisy")
+                                     "recorder_overhead_noisy",
+                                     # tiered_prefix: hit rate,
+                                     # demote/promote counts,
+                                     # promote-latency p99 and the
+                                     # no-tiering TTFT-p50 ratio
+                                     "prefix", "tiering",
+                                     "ttft_speedup", "peer_fetch")
                    if k in m}
                 for name, m in ls["scenarios"].items()},
         }

@@ -10,7 +10,13 @@ PAPERS.md arxiv 2604.15464). Four cooperating modules:
                 refcounted block sharing when prefix caching is on.
 - prefix_cache: PrefixCacheIndex — radix-trie prefix index (token ids
                 -> cached blocks) behind copy-on-write block sharing
-                (docs/serving.md "Prefix caching").
+                (docs/serving.md "Prefix caching"); trie nodes carry a
+                device|host tier tag for hierarchical tiering.
+- host_tier:    HostTierStore — host-RAM KV tier behind the prefix
+                trie: evicted-but-reusable prefix blocks spill here
+                (sha256-verified) instead of being freed, and promote
+                back on the next match (docs/serving.md "Hierarchical
+                KV-cache tiering").
 - attention:    ragged paged-attention decode step (pure-JAX reference,
                 bitwise-pinned to models.generation.decode_step).
 - scheduler:    FCFS continuous batching — admission, prefill/decode
@@ -36,6 +42,7 @@ See docs/serving.md for architecture and tuning.
 """
 from .paged_cache import CacheExhausted, PagedKVCache  # noqa: F401
 from .prefix_cache import PrefixCacheIndex, PrefixNode  # noqa: F401
+from .host_tier import HostTierStore  # noqa: F401
 from .attention import (gather_block_kv, paged_decode_step,  # noqa: F401
                         fused_decode_chunk)
 from .scheduler import (EngineOverloaded, Request,  # noqa: F401
@@ -51,7 +58,7 @@ from .router import ReplicaSet, RouterConfig, RouterRequest  # noqa: F401
 
 __all__ = [
     "PagedKVCache", "CacheExhausted", "EngineOverloaded",
-    "PrefixCacheIndex", "PrefixNode",
+    "PrefixCacheIndex", "PrefixNode", "HostTierStore",
     "gather_block_kv",
     "paged_decode_step", "fused_decode_chunk",
     "SamplingParams", "Request", "RequestState",

@@ -73,7 +73,7 @@ from .attention import PACK_COLS, fused_decode_chunk, pack_f32
 from .paged_cache import CacheExhausted, PagedKVCache
 from .scheduler import (EngineOverloaded, Request, RequestState,
                         SamplingParams, ScheduledBatch, Scheduler,
-                        SchedulerConfig)
+                        SchedulerConfig, record_promotion_events)
 
 __all__ = ["EngineConfig", "EngineStats", "LLMEngine", "RequestOutput",
            "ServingPredictor"]
@@ -119,6 +119,16 @@ class EngineConfig:
     # a cached prefix are admitted chunked and prefill only their
     # uncached suffix; greedy output is bitwise-identical either way.
     enable_prefix_cache: bool = False
+    # hierarchical tiering (docs/serving.md "Hierarchical KV-cache
+    # tiering"): > 0 gives the prefix cache a host-RAM spill tier of
+    # that many blocks — LRU eviction demotes payloads into it instead
+    # of destroying them, and a later match promotes them back (sha256-
+    # verified) instead of re-prefilling. Needs enable_prefix_cache.
+    host_tier_blocks: int = 0
+    # wall-clock budget for one promotion run; an overrun stops the run
+    # (entries stay host-resident, retryable) and the request re-prefills
+    # the unpromoted suffix. None = unbounded.
+    promote_timeout_s: Optional[float] = None
     # ----------------------------- robustness layer (docs/serving.md)
     max_waiting: Optional[int] = None    # bounded waiting queue (None=∞)
     admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
@@ -295,6 +305,35 @@ class EngineStats:
             labels=("engine", "kind"), unit="blocks")
         self._g_prefix_cached = g_pfx.labels(kind="cached", **lbl)
         self._g_prefix_shared = g_pfx.labels(kind="shared", **lbl)
+        # hierarchical tiering (docs/serving.md "Hierarchical KV-cache
+        # tiering"): per-tier residency, demote/promote lifecycle
+        # counters and the promotion-latency histogram
+        g_tier = obs.gauge(
+            "serving_prefix_tier_blocks",
+            "prefix-cache residency per tier: device (trie-indexed HBM "
+            "blocks) | host (demoted host-RAM payloads)",
+            labels=("engine", "tier"), unit="blocks")
+        self._g_tier_device = g_tier.labels(tier="device", **lbl)
+        self._g_tier_host = g_tier.labels(tier="host", **lbl)
+        self._c_demotions = obs.counter(
+            "serving_tier_demotions_total",
+            "device->host spills (demote-instead-of-free evictions)",
+            labels=("engine",)).labels(**lbl)
+        pr = obs.counter(
+            "serving_tier_promotions_total",
+            "host->device promotion attempts by outcome: hit (filled, "
+            "digest verified) | timeout (killed/over budget/pool hot — "
+            "entry stays resident) | integrity (sha256 mismatch, "
+            "dropped) | raced (store evicted first, dropped)",
+            labels=("engine", "outcome"))
+        self._promotions = {o: pr.labels(outcome=o, **lbl)
+                            for o in ("hit", "timeout",
+                                      "integrity", "raced")}
+        self._promote_hist = obs.histogram(
+            "serving_tier_promote_seconds",
+            "wall time of one host->device promotion run (all blocks "
+            "promoted for one request probe)",
+            labels=("engine",), unit="seconds").labels(**lbl)
 
     # -------------------------------------------------- record helpers
     def observe_ttft(self, dt: float) -> None:
@@ -359,12 +398,37 @@ class EngineStats:
         self._g_prefix_ratio.set(ps["cached_tokens_ratio"])
         self._g_prefix_cached.set(ps["cached_blocks"])
         self._g_prefix_shared.set(ps["shared_blocks"])
+        delta = ps["tier_demotions"] - self._c_demotions.value
+        if delta > 0:
+            self._c_demotions.inc(delta)
+        for o, child in self._promotions.items():
+            delta = ps[f"promote_{o}"] - child.value
+            if delta > 0:
+                child.inc(delta)
+        self._g_tier_device.set(ps["cached_blocks"])
+        self._g_tier_host.set(ps["host_blocks"])
 
     def prefix_counter(self, kind: str) -> int:
         """Exact published counter value (kind='hits'|'misses'|
         'evictions') — tests pin these against the cache's own
         counters."""
         return int(self._prefix_counters[kind].value)
+
+    def observe_promote(self, dt: float) -> None:
+        self._promote_hist.observe(dt)
+
+    def promote_quantile(self, q: float) -> float:
+        """Exact promotion-latency quantile (tiered_prefix reports
+        p99 here)."""
+        return self._promote_hist.quantile(q)
+
+    def tier_demotions(self) -> int:
+        return int(self._c_demotions.value)
+
+    def promotion_counter(self, outcome: str) -> int:
+        """Published promotion count for one outcome ('hit'|'timeout'|
+        'integrity'|'raced') — tests pin these against the cache."""
+        return int(self._promotions[outcome].value)
 
     def ttft_quantile(self, q: float) -> float:
         """Exact TTFT quantile (bench / load suite read p50/p99 here)."""
@@ -471,7 +535,9 @@ class LLMEngine:
         self.max_blocks_per_seq = S // config.block_size
         self.cache = PagedKVCache(
             L, H, D, config.num_blocks, config.block_size,
-            enable_prefix_cache=config.enable_prefix_cache)
+            enable_prefix_cache=config.enable_prefix_cache,
+            host_tier_blocks=config.host_tier_blocks,
+            promote_timeout_s=config.promote_timeout_s)
         cost_model = config.prefill_cost_model
         if cost_model == "auto":
             # committed-plan admission pricing; a repo without a plan
@@ -507,6 +573,8 @@ class LLMEngine:
             from ...testing.faults import ServingFaultInjector
             faults = ServingFaultInjector()
         self.faults = faults
+        # ptlint: disable=PT-C004  fault injector (see step())
+        self.cache.arm_tier_faults(self.faults, 0)
 
     @classmethod
     def from_model(cls, model, config: EngineConfig = None, faults=None):
@@ -608,7 +676,37 @@ class LLMEngine:
                 engine=self.stats.label, arrival=req.arrival,
                 readmit=bool(readmit), resume=len(req.output_ids),
                 waiting=self.scheduler.num_waiting())
+            if self.cache.host_tier is not None:
+                # enqueue-time prefetch: promote the host-resident
+                # prefix while the request queues, overlapping the fill
+                # with queue wait instead of serialising it into the
+                # admission step
+                self._prefetch_promote(req)
             return request_id
+
+    @holds_lock("_lock")
+    def _prefetch_promote(self, req: Request) -> None:
+        """Asynchronous-in-spirit host→device prefetch at enqueue (the
+        scheduler's admission probe is the retry for anything this run
+        leaves behind). Never raises: ensure_promoted degrades every
+        failure to re-prefill of the missing suffix."""
+        tokens = req.all_token_ids()
+        host = self.cache.host_match_len(tokens)
+        if not host:
+            return
+        cached = self.cache.match_len(tokens)
+        obs.reqtrace.record("prefix_match", req.tid, req.request_id,
+                            cached_tokens=cached, host_tokens=host,
+                            probe=cached)
+        with RecordEvent("serving.promote", cat="promote") as ev:
+            promo = self.cache.ensure_promoted(tokens)
+            ev.args = {"request_id": req.request_id,
+                       "host_tokens": host,
+                       "promoted": 0 if promo is None
+                       else promo["promoted_blocks"],
+                       "outcomes": [] if promo is None
+                       else promo["outcomes"]}
+        record_promotion_events(req.tid, req.request_id, promo)
 
     def cancel(self, request_id: str) -> bool:
         with self._lock:
@@ -831,6 +929,39 @@ class LLMEngine:
                 self._rngs.pop(request_id, None)
             return req
 
+    # --------------------------------------------- peer prefix fetch
+    # (docs/serving.md "Hierarchical KV-cache tiering": a replica
+    # missing a prefix pulls its blocks from a peer that holds them —
+    # a BlockMigration-shaped transactional pull — before falling back
+    # to re-prefill, so prefix-affinity routing degrades gracefully
+    # after rebalance/failover instead of cliff-ing into cold caches.)
+
+    def prefix_probe(self, prompt_ids) -> int:
+        """Leading tokens of `prompt_ids` this engine could serve from
+        its prefix cache, across BOTH tiers (device match + promotable
+        host run) — the router compares probes to pick the donor."""
+        with self._lock:
+            toks = np.asarray(prompt_ids, np.int32).reshape(-1)
+            return self.cache.match_len(toks) \
+                + self.cache.host_match_len(toks)
+
+    def export_prefix(self, prompt_ids) -> Optional[dict]:
+        """Donor half of a peer prefix fetch: snapshot the longest
+        cached full-block prefix of `prompt_ids` (both tiers, digests
+        included). Read-only; None when nothing matches."""
+        with self._lock:
+            return self.cache.export_prefix(
+                np.asarray(prompt_ids, np.int32).reshape(-1))
+
+    def admit_prefix(self, prompt_ids, blocks) -> int:
+        """Receiver half: verify and install a peer's prefix snapshot
+        as locally cached (evictable) blocks. Raises ValueError on an
+        integrity mismatch and CacheExhausted when the pool cannot hold
+        it — both with atomic-abort semantics (nothing mutated)."""
+        with self._lock:
+            return self.cache.admit_prefix(
+                np.asarray(prompt_ids, np.int32).reshape(-1), blocks)
+
     # ---------------------------------------------------------- sampling
     @holds_lock("_lock")
     def _sample(self, req: Request, logits: np.ndarray) -> int:
@@ -1005,6 +1136,13 @@ class LLMEngine:
             # production (env-gated); chaos tests NEED it inside the lock
             # to corrupt state at the exact point a real fault would
             self.faults.corrupt_cache(step_no, self.cache)
+            # ptlint: disable=PT-C004  fault injector (see above)
+            self.faults.corrupt_host_block(step_no, self.cache)
+            # re-arm the cache's demote/promote fault hooks at this
+            # step so kill_promotion/kill_demotion specs fire on the
+            # engine-step clock like every other serving fault
+            # ptlint: disable=PT-C004  fault injector (see above)
+            self.cache.arm_tier_faults(self.faults, step_no)
             self._expire_and_abort(outs)
             t0 = time.perf_counter()
             with RecordEvent("serving.schedule", cat="schedule") as ev:
@@ -1138,6 +1276,8 @@ class LLMEngine:
             blocks_free=self.cache.num_free())
         if self.cache.prefix_index is not None:
             self.stats.record_prefix(self.cache.prefix_stats())
+            for dt in self.cache.drain_promote_seconds():
+                self.stats.observe_promote(dt)
         return outs
 
     @holds_lock("_lock")

@@ -82,6 +82,9 @@ class BlockMigration:
         "aborted": "_lock",
         "rolled_back": "_lock",
         "bytes_moved": "_lock",
+        "prefix_fetches": "_lock",
+        "prefix_aborted": "_lock",
+        "prefix_bytes": "_lock",
     }
 
     def __init__(self, router_label: str):
@@ -91,6 +94,9 @@ class BlockMigration:
         self.aborted = 0                  # destination pool full
         self.rolled_back = 0              # source died mid-migration
         self.bytes_moved = 0
+        self.prefix_fetches = 0           # committed peer prefix pulls
+        self.prefix_aborted = 0           # dst full / digest mismatch
+        self.prefix_bytes = 0
         self._c_migrations = obs.counter(
             "serving_migrations_total",
             "committed KV-block migrations by reason "
@@ -105,6 +111,11 @@ class BlockMigration:
             "KV payload size per migration (all layers, k and v)",
             labels=("router",), unit="bytes").labels(
                 router=router_label)
+        self._c_peer_fetch = obs.counter(
+            "serving_peer_fetches_total",
+            "peer prefix pulls by outcome (hit|aborted); an abort "
+            "leaves the destination pool untouched and the request "
+            "re-prefills", labels=("router", "outcome"))
 
     def migrate(self, src: EngineReplica, dst: EngineReplica,
                 request_id: str, reason: str, router_step: int = 0,
@@ -178,12 +189,82 @@ class BlockMigration:
                 "blocks": snap["blocks"], "bytes": snap["bytes"],
                 "resume_pos": snap["num_tokens"], "seconds": dt}
 
+    # --------------------------------------------------- peer prefix pull
+    def fetch_prefix(self, src: EngineReplica, dst: EngineReplica,
+                     request_id: str, trace_id: str, prompt_ids,
+                     router_step: int = 0) -> Optional[dict]:
+        """Transactional peer prefix pull (docs/serving.md "Hierarchical
+        KV-cache tiering"): a replica missing a prompt's prefix copies
+        the cached blocks from a peer that holds them instead of
+        re-prefilling. Same shape and same atomic-abort semantics as
+        `migrate`: the source export is a pure copy (host-resident
+        blocks are integrity-checked against their spill digests during
+        export), and the destination's `admit_prefix` re-verifies EVERY
+        per-block digest before claiming a single block — a pool-full
+        `CacheExhausted` or a digest-mismatch `ValueError` aborts with
+        the destination untouched and the request degrades to ordinary
+        re-prefill. Blocks are copied, never stolen: the source trie
+        keeps its entry. Returns the committed pull's stats dict, or
+        None on abort / nothing-to-pull."""
+        if src is dst:
+            raise ValueError(
+                f"cannot pull prefix for {request_id!r} from its own "
+                f"replica {src.index}")
+        with self._lock:
+            return self._fetch_prefix_locked(src, dst, request_id,
+                                             trace_id, prompt_ids,
+                                             router_step)
+
+    @holds_lock("_lock")
+    def _fetch_prefix_locked(self, src: EngineReplica,
+                             dst: EngineReplica, request_id: str,
+                             trace_id: str, prompt_ids,
+                             router_step: int) -> Optional[dict]:
+        t0 = time.perf_counter()
+        snap = src.export_prefix(prompt_ids)
+        if snap is None:
+            return None                   # peer held nothing after all
+        tid = trace_id or request_id
+        try:
+            added = dst.admit_prefix(prompt_ids, snap["blocks"])
+        except (CacheExhausted, ValueError):
+            # atomic abort: admit_prefix verifies all digests BEFORE
+            # claiming blocks and CacheExhausted claims nothing — the
+            # destination pool is untouched either way, and the request
+            # re-prefills its missing suffix like any cache miss
+            self.prefix_aborted += 1
+            self._c_peer_fetch.labels(router=self.label,
+                                      outcome="aborted").inc()
+            obs.reqtrace.record(
+                "peer_fetch", tid, request_id, outcome="aborted",
+                from_replica=src.index, to_replica=dst.index,
+                blocks=len(snap["blocks"]), bytes=snap["bytes"],
+                step=router_step)
+            return None
+        dt = time.perf_counter() - t0
+        self.prefix_fetches += 1
+        self.prefix_bytes += snap["bytes"]
+        self._c_peer_fetch.labels(router=self.label,
+                                  outcome="hit").inc()
+        obs.reqtrace.record(
+            "peer_fetch", tid, request_id, outcome="hit",
+            from_replica=src.index, to_replica=dst.index, blocks=added,
+            tokens=len(snap["tokens"]), bytes=snap["bytes"],
+            step=router_step, seconds=round(dt, 6))
+        return {"request_id": request_id, "src": src.index,
+                "dst": dst.index, "blocks": added,
+                "tokens": len(snap["tokens"]), "bytes": snap["bytes"],
+                "seconds": dt}
+
     def stats(self) -> dict:
         with self._lock:
             return {"migrations": self.migrations,
                     "aborted": self.aborted,
                     "rolled_back": self.rolled_back,
-                    "bytes_moved": self.bytes_moved}
+                    "bytes_moved": self.bytes_moved,
+                    "prefix_fetches": self.prefix_fetches,
+                    "prefix_aborted": self.prefix_aborted,
+                    "prefix_bytes": self.prefix_bytes}
 
     def seconds_quantile(self, q: float) -> float:
         """Migration latency quantile (export -> committed wall time)

@@ -25,6 +25,19 @@ a quarantined sequence scrubs only blocks it was the LAST holder of,
 and distrusts (trie-evicts + taints) anything it shared — a tainted
 block is scrubbed the moment its final reference drops.
 
+Hierarchical tiering (docs/serving.md "Hierarchical KV-cache
+tiering"): with `host_tier_blocks > 0` LRU eviction becomes
+demote-instead-of-free — the victim block's payload is spilled to a
+host-RAM HostTierStore (per-block numpy copy + sha256 digest) and the
+trie node is retagged host-resident instead of destroyed. A later
+match promotes the payload back into a fresh device block
+(`ensure_promoted`), re-verifying the digest on fill; a promotion
+that is killed, times out, races a store-side eviction or fails the
+integrity check degrades to ordinary re-prefill of the missing
+suffix. The zero-leak, refcount and scrub-taint invariants span both
+tiers (`check_integrity` cross-tier keys; a distrusted subtree's
+host copies are poisoned, never promoted).
+
 Host/device split: block accounting (free list, tables, lengths,
 refcounts, trie, counters) is plain Python — it feeds the scheduler
 and never traces. The pools themselves are jax arrays; `write_prefill`
@@ -33,12 +46,16 @@ decode step returns updated pools that the engine assigns back.
 """
 from __future__ import annotations
 
+import hashlib
+import time
 from collections import Counter
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
-from .prefix_cache import PrefixCacheIndex
+from .host_tier import HostTierStore
+from .prefix_cache import PrefixCacheIndex, PrefixNode
 
 __all__ = ["PagedKVCache", "CacheExhausted"]
 
@@ -80,7 +97,9 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_blocks: int, block_size: int, dtype=jnp.float32,
-                 enable_prefix_cache: bool = False):
+                 enable_prefix_cache: bool = False,
+                 host_tier_blocks: int = 0,
+                 promote_timeout_s: Optional[float] = None):
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
         self.num_layers = num_layers
@@ -105,6 +124,25 @@ class PagedKVCache:
         self._tainted: set = set()
         self.prefix_index: Optional[PrefixCacheIndex] = \
             PrefixCacheIndex(block_size) if enable_prefix_cache else None
+        # host-RAM spill tier behind the trie: eviction demotes into it
+        # instead of destroying (meaningless without the trie, so gated
+        # on enable_prefix_cache)
+        self.host_tier: Optional[HostTierStore] = \
+            HostTierStore(host_tier_blocks) \
+            if (enable_prefix_cache and host_tier_blocks > 0) else None
+        self.promote_timeout_s = promote_timeout_s
+        # tiering counters + promote-latency samples (the engine drains
+        # the samples into its serving_tier_promote_seconds histogram)
+        self.tier_demotions = 0
+        self.tier_promotions = {"hit": 0, "timeout": 0,
+                                "integrity": 0, "raced": 0}
+        self._promote_seconds: List[float] = []
+        # fault-injection hooks, armed per step by the owning engine
+        # (inert when never armed); the promote guard excludes the
+        # in-progress promotion path from demotion victim selection
+        self._tier_faults = None
+        self._tier_step = 0
+        self._promote_guard: set = set()
         # lifetime counters (the zero-leak invariant is
         # blocks_allocated == blocks_freed once every sequence is freed
         # and, with prefix caching, the trie is cleared)
@@ -113,6 +151,13 @@ class PagedKVCache:
         self.blocks_attached = 0             # shared-prefix attaches
         self.alloc_failures = 0
         self.high_water = 0
+
+    def arm_tier_faults(self, faults: "ServingFaultInjector",
+                        step: int) -> None:
+        """Point the demote/promote fault hooks (kill_demotion /
+        kill_promotion) at the engine's injector for this step."""
+        self._tier_faults = faults
+        self._tier_step = step
 
     # ------------------------------------------------------------ queries
     def num_free(self) -> int:
@@ -164,9 +209,47 @@ class PagedKVCache:
         (leaf-only removal keeps the trie rooted; clocks are monotone
         root-ward so the coldest extremity goes first). Evicted blocks
         are NOT scrubbed — finite stale KV is erased exactly by the
-        attention length mask, the same contract as a non-scrub free."""
+        attention length mask, the same contract as a non-scrub free.
+
+        With a host tier, eviction is demote-instead-of-free: the LRU
+        node on the demotion frontier spills its payload to host RAM
+        and keeps its trie position (`_flush_demotions`); the device
+        block is reclaimed either way, so each iteration makes
+        progress."""
         idx = self.prefix_index
         evicted = 0
+        if self.host_tier is not None:
+            # batched demotion: select every victim first (pending
+            # nodes count as demoted for frontier eligibility, so the
+            # selection sequence matches the one-at-a-time loop), then
+            # spill all payloads with ONE gather per pool tensor (on
+            # TPU: one DMA per tensor instead of one per block; the
+            # dispatch-bound CPU path gains the same way). A victim
+            # the demote path refuses (tainted / injected
+            # kill_demotion) flushes what is staged — its children
+            # must be host-resident before _plain_evict drops them —
+            # and plain-evicts
+            pending: List[PrefixNode] = []
+            pset: set = set()
+            faults = self._tier_faults
+            while evicted < n:
+                node = idx.lru_demotable(
+                    lambda b: self._refcount.get(b, 0) == 0,
+                    skip=self._promote_guard, pending=pset)
+                if node is None:
+                    break
+                evicted += 1
+                if node.block in self._tainted or (
+                        faults is not None
+                        and faults.kill_demotion(self._tier_step)):  # ptlint: disable=PT-C004
+                    self._flush_demotions(pending)
+                    pending, pset = [], set()
+                    self._plain_evict(node)
+                    continue
+                pending.append(node)
+                pset.add(node)
+            self._flush_demotions(pending)
+            return evicted
         while evicted < n:
             node = idx.pop_lru_leaf(
                 lambda b: self._refcount.get(b, 0) == 0)
@@ -178,6 +261,232 @@ class PagedKVCache:
             idx.evictions += 1
             evicted += 1
         return evicted
+
+    # ---------------------------------------------------- host tiering
+    def _payload_digest(self, payload) -> str:
+        """sha256 over a per-block payload (L-tuple of (k, v) numpy
+        arrays), taken at spill time and re-checked on every fill —
+        the tier's end-to-end integrity contract."""
+        h = hashlib.sha256()
+        for k, v in payload:
+            h.update(np.ascontiguousarray(k).tobytes())
+            h.update(np.ascontiguousarray(v).tobytes())
+        return h.hexdigest()
+
+    def _flush_demotions(self, nodes: List[PrefixNode]) -> None:
+        """Spill the staged victims' payloads to the host tier and free
+        their device blocks (demote-instead-of-free). The payload read
+        is ONE gather per pool tensor for the whole batch; blocks stay
+        valid until here because nothing reclaims the free list inside
+        `_evict_cached`. Victims that must not be spilled (tainted,
+        kill_demotion) never reach this path — the selection loop
+        routes them to `_plain_evict` before anything of theirs is
+        read, so nothing hits the host tier half-written. `nodes` is
+        leaf-ward (children before parents, the selection order), so
+        each `demote` sees its device children already host-resident."""
+        if not nodes:
+            return
+        ids = jnp.asarray([n.block for n in nodes], dtype=jnp.int32)
+        per_layer = [(np.asarray(kp[ids]), np.asarray(vp[ids]))
+                     for kp, vp in self.pools]
+        for i, node in enumerate(nodes):
+            b = node.block
+            payload = tuple((np.array(pk[i]), np.array(pv[i]))
+                            for pk, pv in per_layer)
+            hid, dropped = self.host_tier.put(
+                payload, self._payload_digest(payload))
+            self.prefix_index.demote(node, hid)
+            for dh in dropped:
+                # store-side LRU eviction: unlink the orphaned trie
+                # subtrees (host nodes hang below the frontier, so the
+                # subtree is all host-resident)
+                dn = self.prefix_index.node_of_host(dh)
+                if dn is not None:
+                    self._drop_host_subtree(dn)
+            del self._refcount[b]
+            self._free.append(b)
+            self.blocks_freed += 1
+            self.tier_demotions += 1
+
+    def _plain_evict(self, node: PrefixNode) -> None:
+        """Destroy a frontier node the demote path refused: its host
+        children (if any) are dropped with it — an unlinked host
+        subtree is unreachable — and the device block returns to the
+        free list, scrubbed if tainted."""
+        for child in list(node.children.values()):
+            self._drop_host_subtree(child)
+        idx = self.prefix_index
+        idx.remove(node)
+        del self._refcount[node.block]
+        self._free.append(node.block)
+        self.blocks_freed += 1
+        idx.evictions += 1
+        if node.block in self._tainted:
+            self._tainted.discard(node.block)
+            self.scrub_blocks([node.block])
+
+    def _drop_host_subtree(self, node: PrefixNode,
+                           poison: bool = False) -> int:
+        """Unlink a subtree rooted at a HOST node and drop its store
+        entries (raced store eviction, failed integrity, distrust).
+        `poison=True` marks the drops as taint-driven. Returns the
+        number of host entries dropped."""
+        dropped = 0
+        for n in self.prefix_index.remove_subtree(node):
+            if n.tier == "host":
+                if self.host_tier is not None:
+                    if poison:
+                        self.host_tier.poison(n.host_id)
+                    else:
+                        self.host_tier.drop(n.host_id)
+                dropped += 1
+            elif self._refcount.get(n.block, 0) == 0:
+                # defensive: device below host cannot exist (insert
+                # stops at host nodes), but never strand a block
+                del self._refcount[n.block]
+                self._free.append(n.block)
+                self.blocks_freed += 1
+        return dropped
+
+    def host_match_len(self, tokens) -> int:
+        """Tier-aware pricing probe companion to `match_len`: how many
+        ADDITIONAL leading tokens are host-resident behind the device
+        match — promotable before prefill, so the scheduler prices the
+        prompt at its true uncached cost at enqueue."""
+        if self.host_tier is None or len(tokens) < 2:
+            return 0
+        toks = [int(t) for t in tokens[:len(tokens) - 1]]
+        _dev, host_path = self.prefix_index.match_tiered(toks)
+        return len(host_path) * self.block_size
+
+    def ensure_promoted(self, tokens) -> Optional[dict]:
+        """Fill the host-resident run extending `tokens`' device match
+        back into fresh device blocks, root-ward, stopping at the
+        first failure. Outcomes per node: "hit" (digest verified,
+        scattered, trie retagged), "timeout" (injected kill_promotion,
+        promote_timeout_s exceeded, or no device block free — entry
+        stays host-resident and retryable), "raced" (store evicted the
+        payload first) or "integrity" (sha256 mismatch) — the last two
+        drop the subtree so the suffix re-prefills. Returns None when
+        tiering is off or nothing host-resident matches, else
+        {"promoted_blocks", "promoted_tokens", "outcomes", "seconds"}.
+        Never raises: a misbehaving tier degrades to re-prefill."""
+        if self.host_tier is None or len(tokens) < 2:
+            return None
+        toks = [int(t) for t in tokens[:len(tokens) - 1]]
+        dev_path, host_path = self.prefix_index.match_tiered(toks)
+        if not host_path:
+            return None
+        t0 = time.perf_counter()
+        outcomes: List[str] = []
+        staged: List[Tuple[PrefixNode, int, tuple]] = []
+        # guard the active path: _take_blocks inside _promote_stage may
+        # recurse into _evict_cached, which must not demote the parent
+        # of the node being promoted
+        self._promote_guard = set(dev_path)
+        try:
+            tail: List[str] = []
+            for node in host_path:
+                out, b, payload = self._promote_stage(node, t0)
+                if out != "hit":
+                    tail.append(out)
+                    break
+                staged.append((node, b, payload))
+                self._promote_guard.add(node)
+            # commit. Staging verified each node in hand, but a LATER
+            # stage's _take_blocks may have demoted into a full host
+            # store whose LRU eviction dropped an EARLIER staged entry
+            # and unlinked its subtree — that node and everything
+            # staged below it raced; give their blocks back
+            live: List[Tuple[PrefixNode, int, tuple]] = []
+            raced = False
+            for node, b, payload in staged:
+                if not raced and self.prefix_index.node_of_host(
+                        node.host_id) is node:
+                    live.append((node, b, payload))
+                else:
+                    raced = True
+                    del self._refcount[b]
+                    self._free.append(b)
+                    self.blocks_freed += 1
+            if raced:
+                tail = ["raced"]
+            outcomes = ["hit"] * len(live) + tail
+            if live:
+                # ONE batched scatter per pool tensor for the whole
+                # chain (on TPU: one DMA per tensor instead of one per
+                # block; the dispatch-bound CPU path gains the same
+                # way — promote latency is the tail of revisit TTFT)
+                ids = jnp.asarray([b for _n, b, _p in live],
+                                  dtype=jnp.int32)
+                self.pools = tuple(
+                    (kp.at[ids].set(jnp.asarray(np.stack(
+                        [p[li][0] for _n, _b, p in live]))),
+                     vp.at[ids].set(jnp.asarray(np.stack(
+                         [p[li][1] for _n, _b, p in live]))))
+                    for li, (kp, vp) in enumerate(self.pools))
+                for node, b, _p in live:
+                    hid = node.host_id       # promote() clears it
+                    self._refcount[b] = 0    # trie-cached, unreferenced
+                    self.prefix_index.promote(node, b)
+                    self.host_tier.drop(hid)
+        finally:
+            self._promote_guard = set()
+        for out in outcomes:
+            self.tier_promotions[out] += 1
+        seconds = time.perf_counter() - t0
+        if live:
+            self._promote_seconds.append(seconds)
+        return {"promoted_blocks": len(live),
+                "promoted_tokens": len(live) * self.block_size,
+                "outcomes": outcomes, "seconds": seconds}
+
+    def _promote_stage(self, node: PrefixNode, t0: float
+                       ) -> Tuple[str, Optional[int], Optional[tuple]]:
+        """Verify + claim for one host->device fill; the caller
+        batch-scatters every staged payload in one op. Returns
+        (outcome, block, payload); block/payload are None unless the
+        outcome is "hit". See ensure_promoted for outcome semantics."""
+        faults = self._tier_faults
+        if faults is not None \
+                and faults.kill_promotion(self._tier_step):  # ptlint: disable=PT-C004
+            return "timeout", None, None    # in-flight promotion cut
+            # short: entry stays resident, the schedule-time retry
+            # picks it up
+        if self.promote_timeout_s is not None \
+                and time.perf_counter() - t0 > self.promote_timeout_s:
+            return "timeout", None, None
+        entry = self.host_tier.get(node.host_id)
+        if entry is None:
+            # the store LRU-dropped the payload between match and fill
+            self._drop_host_subtree(node)
+            return "raced", None, None
+        if self._payload_digest(entry["payload"]) != entry["digest"]:
+            # torn host copy (corrupt_host_block chaos fault, bad DMA):
+            # drop it — the request re-prefills this suffix
+            self._drop_host_subtree(node)
+            return "integrity", None, None
+        try:
+            b = self._take_blocks("_promote", 1)[0]
+        except CacheExhausted:
+            self.alloc_failures -= 1     # not an admission failure
+            return "timeout", None, None    # pool too hot; stays
+            # resident
+        if self.prefix_index.node_of_host(node.host_id) is not node:
+            # _take_blocks recursed into demotion, whose host-store put
+            # LRU-evicted this very entry and unlinked the node — give
+            # the block back and let the suffix re-prefill
+            del self._refcount[b]
+            self._free.append(b)
+            self.blocks_freed += 1
+            return "raced", None, None
+        return "hit", b, entry["payload"]
+
+    def drain_promote_seconds(self) -> List[float]:
+        """Hand accumulated promote-latency samples to the engine's
+        histogram (cleared on read)."""
+        out, self._promote_seconds = self._promote_seconds, []
+        return out
 
     def allocate(self, seq_id, num_tokens: int) -> List[int]:
         """Claim blocks for a new sequence of num_tokens cached tokens
@@ -328,6 +637,8 @@ class PagedKVCache:
         idx = self.prefix_index
         if idx is None:
             return 0
+        if self.host_tier is not None:
+            self.host_tier.clear()
         released: List[int] = []
         for b in idx.clear():
             if self._refcount.get(b, 0) == 0:
@@ -400,20 +711,112 @@ class PagedKVCache:
         return sum(int(a.size) * a.dtype.itemsize
                    for pair in payload for a in pair if a is not None)
 
+    # ------------------------------------------------------- peer fetch
+    def export_prefix(self, tokens) -> Optional[dict]:
+        """Snapshot the longest cached full-block prefix of `tokens`
+        for a peer replica (serving/migration.py fetch_prefix) — the
+        fleet-level twin of export_blocks, walking BOTH tiers: device
+        blocks are gathered out (digest taken now), host entries ship
+        their stored payload after re-verifying the spill digest (a
+        torn entry truncates the export and drops its subtree; the
+        peer prefills the rest). Read-only on the device tier. Returns
+        None when nothing matches, else {"blocks": [(payload, digest),
+        ...] in root-ward order, "tokens": the tokens those blocks
+        cover, "bytes": wire size}."""
+        idx = self.prefix_index
+        if idx is None or len(tokens) < 2:
+            return None
+        toks = [int(t) for t in tokens[:len(tokens) - 1]]
+        dev_path, host_path = idx.match_tiered(toks)
+        blocks: List[tuple] = []
+        total = 0
+        for node in dev_path:
+            b = node.block
+            payload = tuple((np.array(kp[b]), np.array(vp[b]))
+                            for kp, vp in self.pools)
+            blocks.append((payload, self._payload_digest(payload)))
+        for node in host_path:
+            entry = self.host_tier.get(node.host_id) \
+                if self.host_tier is not None else None
+            if entry is None:
+                self._drop_host_subtree(node)
+                break
+            if self._payload_digest(entry["payload"]) != entry["digest"]:
+                self._drop_host_subtree(node)
+                break
+            blocks.append((entry["payload"], entry["digest"]))
+        if not blocks:
+            return None
+        for payload, _ in blocks:
+            total += sum(k.nbytes + v.nbytes for k, v in payload)
+        return {"blocks": blocks,
+                "tokens": toks[:len(blocks) * self.block_size],
+                "bytes": total}
+
+    def admit_prefix(self, tokens, blocks) -> int:
+        """Install a peer's export_prefix snapshot into THIS pool's
+        trie as device-resident cached blocks (refcount 0, evictable)
+        so the next admission of `tokens` hits locally. Atomic-abort
+        semantics mirror admit_migrated: every digest is verified
+        BEFORE any block is claimed (ValueError on mismatch, nothing
+        mutated), and CacheExhausted propagates with no side effects.
+        First-wins insert dedupes against blocks cached meanwhile; a
+        snapshot block the trie didn't take is returned to the free
+        list immediately. Returns the number of newly indexed blocks."""
+        idx = self.prefix_index
+        if idx is None:
+            raise ValueError("admit_prefix needs the prefix cache enabled")
+        blocks = list(blocks)
+        if not blocks:
+            return 0
+        for i, (payload, digest) in enumerate(blocks):
+            if self._payload_digest(payload) != digest:
+                raise ValueError(
+                    f"peer prefix block {i} failed integrity check")
+        ids = self._take_blocks("_peer_fetch", len(blocks))
+        stacked = tuple(
+            (jnp.asarray(np.stack([p[layer][0] for p, _ in blocks])),
+             jnp.asarray(np.stack([p[layer][1] for p, _ in blocks])))
+            for layer in range(self.num_layers))
+        at = jnp.asarray(ids, jnp.int32)
+        self.pools = tuple(
+            (kp.at[at].set(pk), vp.at[at].set(pv))
+            for (kp, vp), (pk, pv) in zip(self.pools, stacked))
+        toks = [int(t) for t in tokens[:len(blocks) * self.block_size]]
+        added = idx.insert(toks, ids,
+                           skip=lambda b: b in self._tainted)
+        for b in ids:
+            if idx.node_of(b) is None:
+                # first-wins dedupe kept an existing block instead
+                del self._refcount[b]
+                self._free.append(b)
+                self.blocks_freed += 1
+            else:
+                self._refcount[b] = 0    # trie-cached, unreferenced
+        return added
+
     def _distrust(self, b: int, to_scrub: List[int]) -> None:
         """Scrub-path hygiene for block b's trie entry: remove its
         whole subtree from the index (a removed parent orphans its
         children, and content downstream of a distrusted block must
         not be re-matched). Subtree blocks nobody references are
         released scrubbed; still-referenced ones are tainted — their
-        final free scrubs them. b itself is left to the caller."""
+        final free scrubs them. HOST-resident descendants are POISONED:
+        the spilled copy is dropped from the store immediately, never
+        promoted (the satellite taint-across-tiers contract). b itself
+        is left to the caller."""
         idx = self.prefix_index
         if idx is None:
             return
         node = idx.node_of(b)
         if node is None:
             return
-        for blk in idx.remove_subtree(node):
+        for n in idx.remove_subtree(node):
+            if n.tier == "host":
+                if self.host_tier is not None:
+                    self.host_tier.poison(n.host_id)
+                continue
+            blk = n.block
             if blk == b:
                 continue
             if self._refcount.get(blk, 0) == 0:
@@ -510,6 +913,20 @@ class PagedKVCache:
             "stale_tainted": len(self._tainted - owned),
             "trie_defects": idx.audit() if idx is not None else 0,
         }
+        # cross-tier keys: every trie host node must point at a live
+        # store entry (orphan = promoted-from-under-us bug) and every
+        # store entry must be reachable from the trie (leaked = host-
+        # side block leak). Payload digests are deliberately NOT
+        # re-verified here — a corrupted-but-never-promoted entry is
+        # harmless until a fill checks it (that is the fill's job).
+        if self.host_tier is not None and idx is not None:
+            trie_hids = set(idx.host_ids())
+            store_hids = set(self.host_tier.ids())
+            report["host_orphans"] = len(trie_hids - store_hids)
+            report["host_leaked"] = len(store_hids - trie_hids)
+        else:
+            report["host_orphans"] = 0
+            report["host_leaked"] = 0
         if any(report.values()):
             # flight recorder (obs/reqtrace.py): an integrity violation
             # is a postmortem trigger — when armed, ship the full ring
@@ -560,13 +977,19 @@ class PagedKVCache:
                     "hits": 0, "misses": 0, "evictions": 0,
                     "cow_forks": 0, "inserted_blocks": 0,
                     "cached_tokens_total": 0, "prompt_tokens_total": 0,
-                    "cached_tokens_ratio": 0.0, "attached_blocks": 0}
+                    "cached_tokens_ratio": 0.0, "attached_blocks": 0,
+                    "host_blocks": 0, "tier_demotions": 0,
+                    "promote_hit": 0, "promote_timeout": 0,
+                    "promote_integrity": 0, "promote_raced": 0}
         out = {"enabled": True}
         out.update(idx.stats())
         out["shared_blocks"] = sum(
             1 for rc in self._refcount.values() if rc >= 2)
         out["evictable_blocks"] = self.num_evictable()
         out["attached_blocks"] = self.blocks_attached
+        out["tier_demotions"] = self.tier_demotions
+        for k, v in self.tier_promotions.items():
+            out[f"promote_{k}"] = v
         return out
 
     def stats(self) -> dict:

@@ -28,6 +28,16 @@ Invariants (audited by PagedKVCache.check_integrity):
   through their parents), so LRU leaf eviction never strands a
   recently-used descendant.
 
+Tiering (docs/serving.md "Hierarchical KV-cache tiering"): nodes carry
+a tier tag. A "device" node owns a physical block (`block >= 0`, in
+`_by_block`); a "host" node's payload was demoted to the owning
+cache's HostTierStore (`block == -1`, `host_id` in `_by_host`). Along
+any root-to-leaf path the tiers read device* host* — demotion works
+leaf-ward (only frontier nodes whose children are all host demote),
+promotion works root-ward, and `insert` stops at a host child — so a
+match is always a device prefix followed by a contiguous promotable
+host run (`match_tiered`).
+
 Host-side only: the index never touches device arrays. See
 docs/serving.md "Prefix caching".
 """
@@ -41,9 +51,12 @@ __all__ = ["PrefixCacheIndex", "PrefixNode"]
 class PrefixNode:
     """One cached block: `key` is the tuple of block_size token ids the
     block holds, `block` the physical block id, `last_touch` the
-    index's logical clock at the last match through this node."""
+    index's logical clock at the last match through this node. `tier`
+    is "device" (owns `block`) or "host" (`block == -1`; `host_id`
+    names the spilled payload in the cache's HostTierStore)."""
 
-    __slots__ = ("key", "block", "parent", "children", "last_touch")
+    __slots__ = ("key", "block", "parent", "children", "last_touch",
+                 "tier", "host_id")
 
     def __init__(self, key: Optional[tuple], block: int,
                  parent: Optional["PrefixNode"], touch: int = 0):
@@ -52,9 +65,11 @@ class PrefixNode:
         self.parent = parent
         self.children: Dict[tuple, "PrefixNode"] = {}
         self.last_touch = touch
+        self.tier = "device"
+        self.host_id: Optional[int] = None
 
     def __repr__(self):                      # debugging aid only
-        return (f"PrefixNode(block={self.block}, "
+        return (f"PrefixNode(block={self.block}, tier={self.tier}, "
                 f"children={len(self.children)})")
 
 
@@ -72,6 +87,7 @@ class PrefixCacheIndex:
         self.block_size = block_size
         self.root = PrefixNode(None, -1, None)
         self._by_block: Dict[int, PrefixNode] = {}
+        self._by_host: Dict[int, PrefixNode] = {}
         self._clock = 0
         # ----------------------------------------- lifetime counters
         self.hits = 0                 # admissions with cached_len > 0
@@ -93,6 +109,13 @@ class PrefixCacheIndex:
     def node_of(self, block: int) -> Optional[PrefixNode]:
         return self._by_block.get(block)
 
+    def node_of_host(self, host_id: int) -> Optional[PrefixNode]:
+        return self._by_host.get(host_id)
+
+    def host_ids(self):
+        """View of every host-resident node's store id."""
+        return self._by_host.keys()
+
     # ------------------------------------------------------- matching
     def match(self, tokens: List[int], touch: bool = True
               ) -> Tuple[List[PrefixNode],
@@ -103,7 +126,11 @@ class PrefixCacheIndex:
         NEXT block agree with a cached child — the copy-on-write
         candidate. `touch=False` is the scheduler's pricing probe (no
         LRU side effects); the real attach touches the matched path so
-        eviction age reflects use."""
+        eviction age reflects use.
+
+        Device-resident only: the walk stops at a host-tier child and
+        the partial scan skips host children (a COW donor must own a
+        physical block). `match_tiered` sees the host run."""
         bs = self.block_size
         if touch:
             self._clock += 1
@@ -111,7 +138,7 @@ class PrefixCacheIndex:
         i = 0
         while i + bs <= len(tokens):
             child = node.children.get(tuple(tokens[i:i + bs]))
-            if child is None:
+            if child is None or child.tier != "device":
                 break
             if touch:
                 child.last_touch = self._clock
@@ -123,6 +150,8 @@ class PrefixCacheIndex:
         best: Optional[Tuple[PrefixNode, int]] = None
         if rest:
             for key, child in node.children.items():
+                if child.tier != "device":
+                    continue
                 m = 0
                 for a, b in zip(rest, key):
                     if a != b:
@@ -134,6 +163,33 @@ class PrefixCacheIndex:
                 best[0].last_touch = self._clock
         return path, best
 
+    def match_tiered(self, tokens: List[int]
+                     ) -> Tuple[List[PrefixNode], List[PrefixNode]]:
+        """Tier-aware probe, no LRU side effects: the device-resident
+        full-block path plus the contiguous HOST-resident run extending
+        it (the promotable suffix — tiers along a path are always
+        device* host*). The scheduler prices a prompt from both halves;
+        `PagedKVCache.ensure_promoted` fills the host run back in."""
+        bs = self.block_size
+        node, dev = self.root, []
+        i = 0
+        while i + bs <= len(tokens):
+            child = node.children.get(tuple(tokens[i:i + bs]))
+            if child is None or child.tier != "device":
+                break
+            dev.append(child)
+            node = child
+            i += bs
+        host: List[PrefixNode] = []
+        while i + bs <= len(tokens):
+            child = node.children.get(tuple(tokens[i:i + bs]))
+            if child is None or child.tier != "host":
+                break
+            host.append(child)
+            node = child
+            i += bs
+        return dev, host
+
     # ------------------------------------------------------ insertion
     def insert(self, tokens: List[int], blocks: List[int],
                skip: Optional[Callable[[int], bool]] = None) -> int:
@@ -144,7 +200,12 @@ class PrefixCacheIndex:
         vetoes individual blocks (tainted content must never be
         re-matched); a vetoed or already-indexed block STOPS the walk —
         a deeper insertion would orphan its children. Returns the
-        number of newly indexed blocks."""
+        number of newly indexed blocks.
+
+        A HOST-tier child also stops the walk: indexing a device block
+        beneath it would break the device*-host* path invariant, and
+        the host copy already holds this content — the next match
+        promotes it instead."""
         bs = self.block_size
         self._clock += 1
         node, added = self.root, 0
@@ -152,6 +213,8 @@ class PrefixCacheIndex:
             key = tuple(tokens[i * bs:(i + 1) * bs])
             child = node.children.get(key)
             if child is not None:
+                if child.tier != "device":
+                    break
                 child.last_touch = self._clock
                 node = child
                 continue
@@ -165,6 +228,42 @@ class PrefixCacheIndex:
         self.inserted_blocks += added
         return added
 
+    # ------------------------------------------------ tier transitions
+    def demote(self, node: PrefixNode, host_id: int) -> None:
+        """Retag a device node host-resident: its payload now lives in
+        the host store under `host_id` and the physical block is the
+        caller's to free. Only frontier nodes (no device children) may
+        demote — the path stays device* host*."""
+        if node.tier != "device":
+            raise ValueError(f"node for host id {node.host_id} is "
+                             "already host-resident")
+        if any(c.tier == "device" for c in node.children.values()):
+            raise ValueError(f"cannot demote block {node.block}: it "
+                             "still has device-resident children")
+        del self._by_block[node.block]
+        node.block = -1
+        node.tier = "host"
+        node.host_id = host_id
+        self._by_host[host_id] = node
+
+    def promote(self, node: PrefixNode, block: int) -> None:
+        """Retag a host node device-resident in `block` (the caller
+        filled it from the store payload). Fresh last_touch: a just-
+        promoted prefix must not be the next demotion victim."""
+        if node.tier != "host":
+            raise ValueError(f"node for block {node.block} is already "
+                             "device-resident")
+        if node.parent is not None and node.parent.key is not None \
+                and node.parent.tier != "device":
+            raise ValueError("cannot promote below a host-resident "
+                             "parent (promotion works root-ward)")
+        del self._by_host[node.host_id]
+        node.host_id = None
+        node.tier = "device"
+        node.block = block
+        node.last_touch = self._clock
+        self._by_block[block] = node
+
     # ------------------------------------------------------- eviction
     def remove(self, node: PrefixNode) -> None:
         """Unlink one LEAF node (raises on internal nodes — removing
@@ -174,22 +273,29 @@ class PrefixCacheIndex:
                 f"cannot remove internal prefix node for block "
                 f"{node.block} ({len(node.children)} children)")
         del node.parent.children[node.key]
-        del self._by_block[node.block]
+        if node.tier == "device":
+            del self._by_block[node.block]
+        else:
+            del self._by_host[node.host_id]
         node.parent = None
 
-    def remove_subtree(self, node: PrefixNode) -> List[int]:
-        """Unlink `node` and its whole subtree (distrust on scrub:
-        tainted content must not be re-matched, and a removed parent
-        would orphan its children anyway). Returns the removed block
-        ids, node first."""
+    def remove_subtree(self, node: PrefixNode) -> List[PrefixNode]:
+        """Unlink `node` and its whole subtree (distrust on scrub,
+        host-entry loss: the content must not be re-matched, and a
+        removed parent would orphan its children anyway). Returns the
+        removed nodes, `node` first — the cache reconciles each by
+        tier (free/taint the device block, drop the host entry)."""
         del node.parent.children[node.key]
         node.parent = None
-        removed: List[int] = []
+        removed: List[PrefixNode] = []
         stack = [node]
         while stack:
             n = stack.pop()
-            removed.append(n.block)
-            del self._by_block[n.block]
+            removed.append(n)
+            if n.tier == "device":
+                del self._by_block[n.block]
+            else:
+                del self._by_host[n.host_id]
             stack.extend(n.children.values())
             n.children.clear()
         return removed
@@ -210,21 +316,52 @@ class PrefixCacheIndex:
             self.remove(best)
         return best
 
+    def lru_demotable(self, evictable: Callable[[int], bool],
+                      skip=frozenset(), pending=frozenset()
+                      ) -> Optional[PrefixNode]:
+        """The least-recently-touched node on the DEMOTION FRONTIER —
+        a device node with no device-resident children whose block
+        satisfies `evictable` — or None. Unlike pop_lru_leaf the node
+        is NOT unlinked: the caller spills its payload and calls
+        `demote`. `skip` excludes nodes on a promotion path in
+        progress (demoting a node's parent mid-promotion would break
+        the device*-host* invariant). `pending` holds nodes the caller
+        has SELECTED but not yet spilled (batched demotion): they are
+        not re-selected, and they count as demoted for their parent's
+        frontier eligibility — the selection sequence matches the
+        one-at-a-time loop exactly."""
+        best: Optional[PrefixNode] = None
+        for node in self._by_block.values():
+            if node in skip or node in pending:
+                continue
+            if any(c.tier == "device" and c not in pending
+                   for c in node.children.values()):
+                continue
+            if not evictable(node.block):
+                continue
+            if best is None or node.last_touch < best.last_touch:
+                best = node
+        return best
+
     def clear(self) -> List[int]:
-        """Drop the entire index; returns every block id it held (the
-        cache reconciles them back to the free list / tables)."""
+        """Drop the entire index; returns every DEVICE block id it held
+        (the cache reconciles them back to the free list / tables and
+        clears its host store separately)."""
         blocks = list(self._by_block)
         self._by_block.clear()
+        self._by_host.clear()
         self.root.children.clear()
         return blocks
 
     # --------------------------------------------------------- audits
     def audit(self) -> int:
         """Structural self-check, returns the number of violations:
-        key widths, parent/child links, by-block map coverage and
-        block uniqueness (one trie slot per physical block)."""
+        key widths, parent/child links, by-block/by-host map coverage,
+        block uniqueness (one trie slot per physical block) and tier
+        layering (no device node beneath a host node)."""
         bad = 0
         seen: Dict[int, int] = {}
+        seen_host: Dict[int, int] = {}
         stack = [self.root]
         while stack:
             node = stack.pop()
@@ -233,18 +370,33 @@ class PrefixCacheIndex:
                     bad += 1
                 if child.parent is not node:
                     bad += 1
-                if self._by_block.get(child.block) is not child:
-                    bad += 1
-                seen[child.block] = seen.get(child.block, 0) + 1
+                if child.tier == "device":
+                    if self._by_block.get(child.block) is not child:
+                        bad += 1
+                    if child.host_id is not None:
+                        bad += 1
+                    if node.key is not None and node.tier != "device":
+                        bad += 1    # device below host: unreachable
+                    seen[child.block] = seen.get(child.block, 0) + 1
+                else:
+                    if self._by_host.get(child.host_id) is not child:
+                        bad += 1
+                    if child.block != -1:
+                        bad += 1
+                    seen_host[child.host_id] = \
+                        seen_host.get(child.host_id, 0) + 1
                 stack.append(child)
         bad += sum(c - 1 for c in seen.values() if c > 1)
         bad += len(set(self._by_block) - set(seen))
+        bad += sum(c - 1 for c in seen_host.values() if c > 1)
+        bad += len(set(self._by_host) - set(seen_host))
         return bad
 
     def stats(self) -> dict:
         total = self.prompt_tokens_total
         return {
             "cached_blocks": len(self._by_block),
+            "host_blocks": len(self._by_host),
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,

@@ -358,6 +358,32 @@ class EngineReplica:
         with self._lock:
             return self.engine.release_waiting(request_id)
 
+    # ------------------------------------------------------- prefix tier
+    # Peer prefix-fetch pass-throughs (docs/serving.md "Hierarchical
+    # KV-cache tiering"). Same discipline as the migration block above:
+    # the BlockMigration coordinator touches ONE replica's lock at a
+    # time, so a fetch in each direction between two replicas can never
+    # deadlock. A slot with no engine probes 0 / exports None — a dead
+    # peer simply holds no prefix.
+
+    def prefix_probe(self, prompt_ids) -> int:
+        with self._lock:
+            if self.engine is None:
+                return 0
+            return self.engine.prefix_probe(prompt_ids)
+
+    def export_prefix(self, prompt_ids):
+        with self._lock:
+            if self.engine is None:
+                return None
+            return self.engine.export_prefix(prompt_ids)
+
+    def admit_prefix(self, prompt_ids, blocks) -> int:
+        with self._lock:
+            if self.engine is None:
+                return 0
+            return self.engine.admit_prefix(prompt_ids, blocks)
+
     # ------------------------------------------------------------ draining
     def drain(self) -> None:
         with self._lock:

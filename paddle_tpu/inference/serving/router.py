@@ -127,6 +127,16 @@ class RouterConfig:
     # every request that completes prefill off to the decode tier via
     # live KV-block migration (serving/migration.py)
     roles: Optional[tuple] = None
+    # peer prefix fetch (docs/serving.md "Hierarchical KV-cache
+    # tiering"): at dispatch, if a PEER replica holds at least one more
+    # full block of the prompt's prefix than the chosen home, pull the
+    # blocks over (BlockMigration.fetch_prefix — transactional, abort
+    # leaves the destination untouched) before the request prefills.
+    # Off by default: with balance="prefix_affinity" requests already
+    # land where their prefix lives; this flag pays under round_robin /
+    # free_blocks routing and after failovers scatter a template's
+    # working set
+    peer_prefix_fetch: bool = False
     obs_label: Optional[str] = None
 
 
@@ -358,6 +368,7 @@ class ReplicaSet:
                     policy=self.config.balance,
                     headroom=info["free_blocks"] - info["block_demand"],
                     waiting=info["waiting"])
+                self._maybe_peer_fetch(rep, request_id, trace_id, ids)
                 return request_id
             # every up replica refused at ITS bound: surface overload
             # with the strongest hint we have
@@ -557,6 +568,35 @@ class ReplicaSet:
         dt = time.perf_counter() - t0
         self._step_ewma = 0.8 * self._step_ewma + 0.2 * dt
         return outs
+
+    # --------------------------------------------------------- peer fetch
+    @holds_lock("_lock")
+    def _maybe_peer_fetch(self, rep: EngineReplica, request_id: str,
+                          trace_id: str, prompt_ids) -> None:
+        """After dispatching to `rep`: if a serving peer holds at least
+        one more FULL block of this prompt's prefix (device- or
+        host-resident) than `rep` does, pull those blocks over
+        (BlockMigration.fetch_prefix) before the request schedules. An
+        aborted pull costs nothing — the request re-prefills exactly as
+        if the peer had held nothing."""
+        if not self.config.peer_prefix_fetch:
+            return
+        eng = next((r.engine for r in self.replicas
+                    if r.engine is not None), None)
+        if eng is None:
+            return
+        local = rep.prefix_probe(prompt_ids)
+        best, best_len = None, local
+        for peer in self.replicas:
+            if peer is rep or not peer.is_serving():
+                continue
+            n = peer.prefix_probe(prompt_ids)
+            if n > best_len:
+                best, best_len = peer, n
+        if best is None or best_len - local < eng.cache.block_size:
+            return                        # nothing a full block better
+        self.migrator.fetch_prefix(best, rep, request_id, trace_id,
+                                   prompt_ids, router_step=self._steps)
 
     # ---------------------------------------------------------- migration
     @holds_lock("_lock")
@@ -817,6 +857,10 @@ class ReplicaSet:
                 from_replica=rec.prev_replica, arrival=rec.arrival,
                 resume=len(rec.tokens), requeues=rec.requeues,
                 batch=batch_id)
+            # the dead replica's prefix working set may survive on a
+            # peer — pull it before the re-prefill recomputes it
+            self._maybe_peer_fetch(target, rec.request_id,
+                                   rec.trace_id, rec.prompt_ids)
         self._orphans[:] = remaining
 
     @holds_lock("_lock")

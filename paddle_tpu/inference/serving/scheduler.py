@@ -55,9 +55,35 @@ from ...obs import reqtrace
 from .paged_cache import CacheExhausted, PagedKVCache
 
 __all__ = ["EngineOverloaded", "SamplingParams", "Request", "RequestState",
-           "Scheduler", "SchedulerConfig", "ScheduledBatch"]
+           "Scheduler", "SchedulerConfig", "ScheduledBatch",
+           "record_promotion_events"]
 
 ADMISSION_POLICIES = ("reject", "shed_oldest")
+
+
+def record_promotion_events(tid: str, request_id: str,
+                            promo: Optional[dict]) -> None:
+    """Translate one `PagedKVCache.ensure_promoted` result into reqtrace
+    events (shared by the engine's enqueue-time prefetch and the
+    scheduler's admission-time retry). A partial promotion emits BOTH a
+    `promote` (for the blocks that landed) and a `promote_abort` (for
+    the failure that stopped the run); `promo is None` means the host
+    run vanished between probe and promotion — raced, nothing landed.
+    The causality checker requires every tiered prefix_match to be
+    resolved by one of these before the request may emit."""
+    if promo is None:
+        reqtrace.record("promote_abort", tid, request_id,
+                        outcome="raced", promoted=0)
+        return
+    if promo["promoted_blocks"]:
+        reqtrace.record("promote", tid, request_id,
+                        blocks=promo["promoted_blocks"],
+                        tokens=promo["promoted_tokens"],
+                        seconds=round(promo["seconds"], 6))
+    if promo["outcomes"] and promo["outcomes"][-1] != "hit":
+        reqtrace.record("promote_abort", tid, request_id,
+                        outcome=promo["outcomes"][-1],
+                        promoted=promo["promoted_blocks"])
 
 
 class EngineOverloaded(RuntimeError):
@@ -622,12 +648,26 @@ class Scheduler:
             # on the uncached tokens only: a fully-templated prompt
             # admits at near-zero cost
             cached_probe = self.cache.match_len(tokens)
+            # tier-aware pricing: a host-resident run behind the device
+            # match is promotable before prefill — promote it NOW (the
+            # admission-time retry of the engine's enqueue prefetch;
+            # covers entries a timed-out promotion left behind) and
+            # re-probe so the price reflects what actually landed
+            host_probe = self.cache.host_match_len(tokens)
+            if host_probe:
+                reqtrace.record(
+                    "prefix_match", req.tid, req.request_id,
+                    cached_tokens=cached_probe, host_tokens=host_probe,
+                    probe=cached_probe)
+                promo = self.cache.ensure_promoted(tokens)
+                record_promotion_events(req.tid, req.request_id, promo)
+                cached_probe = self.cache.match_len(tokens)
             uncached = len(tokens) - cached_probe
             # chunked prefill: a long prompt is admitted with an empty
             # table and fed to the fused decode scan k tokens per step —
             # it is priced (and block-checked) per chunk, not per prompt
             chunked = (thr is not None and len(tokens) > thr) \
-                or cached_probe > 0
+                or cached_probe > 0 or host_probe > 0
             eff = min(chunk, uncached) if chunked else len(tokens)
             # ptlint: disable=PT-C004  admission cost model (see backlog())
             price = cost_model.cost(eff) if cost_model else eff
@@ -648,6 +688,7 @@ class Scheduler:
             if chunked:
                 remaining = max(0, req.params.max_tokens
                                 - len(req.output_ids))
+                d0 = self.cache.tier_demotions
                 try:
                     got = self.cache.allocate_with_prefix(
                         req.request_id, tokens)
@@ -658,6 +699,10 @@ class Scheduler:
                     if self.cache.has_seq(req.request_id):
                         self.cache.free(req.request_id)
                     break                    # never preempt to admit
+                dd = self.cache.tier_demotions - d0
+                if dd:
+                    reqtrace.record("demote", req.tid, req.request_id,
+                                    blocks=dd)
                 req.pf_target = len(tokens)
                 req.prefill_pos = got
                 self.waiting.popleft()
@@ -678,10 +723,15 @@ class Scheduler:
                     arrival=req.arrival, cached=got,
                     target=req.pf_target)
             else:
+                d0 = self.cache.tier_demotions
                 try:
                     self.cache.allocate(req.request_id, len(tokens))
                 except CacheExhausted:
                     break                    # never preempt to admit
+                dd = self.cache.tier_demotions - d0
+                if dd:
+                    reqtrace.record("demote", req.tid, req.request_id,
+                                    blocks=dd)
                 self.cache.note_prefix_miss(len(tokens))
                 self.waiting.popleft()
                 req.state = RequestState.RUNNING

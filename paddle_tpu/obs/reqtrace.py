@@ -61,6 +61,10 @@ EVENT_KINDS = (
     "readmit",        # re-admitted on a survivor (new replica, resume len)
     "migrate_out",    # KV blocks left this replica (dst, blocks, bytes)
     "migrate_in",     # KV blocks landed here (src, resume position)
+    "demote",         # prefix blocks spilled device -> host tier
+    "promote",        # host-resident prefix filled back to device
+    "promote_abort",  # promotion degraded (timeout|integrity|raced)
+    "peer_fetch",     # prefix blocks pulled from a peer replica
     "finish",         # terminal: stop|length|cancelled|timeout|shed|error
 )
 _KIND_SET = frozenset(EVENT_KINDS)
@@ -420,7 +424,15 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
     5. every migration hop likewise: a ``migrate_in`` must follow a
        ``migrate_out`` in its trace and name the replica the blocks
        came from, and no decode emission may land between the two (the
-       request has no engine while its KV is in flight).
+       request has no engine while its KV is in flight);
+    6. tiering: no token emission while a request's matched blocks are
+       still host-resident — a ``prefix_match`` reporting
+       ``host_tokens > 0`` must be resolved by a ``promote`` or
+       ``promote_abort`` before any ``first_token``/``decode_chunk``
+       (re-admission resets the latch: the new admission re-probes);
+    7. every ``promote_abort`` is followed by re-prefill progress
+       (``prefill``/``prefill_chunk``) or a terminal — a degraded
+       promotion must never leave the request wedged.
     """
     complete = bool(dump.get("complete", True))
     violations: List[str] = []
@@ -488,6 +500,8 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
         last_failover_replica = None
         pending_migration = None
         ticket = None
+        host_pending = False    # matched blocks still host-resident
+        abort_open = False      # promote_abort awaiting re-prefill
         for e in evts:
             kind = e["kind"]
             a = e.get("attrs") or {}
@@ -502,15 +516,30 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
                     ticket = a["arrival"]
             if kind in ("engine_admit", "preempt", "requeue"):
                 prefilled = False
+                host_pending = False    # re-admission re-probes tiers
+            elif kind == "prefix_match":
+                if a.get("host_tokens", 0) > 0:
+                    host_pending = True
+            elif kind in ("promote", "promote_abort"):
+                host_pending = False
+                if kind == "promote_abort":
+                    abort_open = True
             elif kind == "prefill":
                 prefilled = True
+                abort_open = False
             elif kind == "prefill_chunk":
+                abort_open = False
                 if a.get("pos", 0) >= a.get("target", float("inf")):
                     prefilled = True
             elif kind in ("first_token", "decode_chunk"):
                 if not prefilled:
                     violations.append(
                         f"{tid}: {kind} before prefill completed")
+                if host_pending:
+                    violations.append(
+                        f"{tid}: {kind} while matched blocks were still "
+                        f"host-resident (no promote/promote_abort since "
+                        f"the tiered prefix_match)")
             elif kind == "failover":
                 last_failover_replica = a.get("replica")
             elif kind == "migrate_out":
@@ -530,6 +559,7 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
                         f"{a.get('from_replica')} but the migrate_out "
                         f"was on replica {pending_migration}")
                 pending_migration = None
+                host_pending = False    # the payload moved device-side
                 # the event says whether the payload already covers the
                 # whole prompt; a mid-prefill migration stays unprefilled
                 # until destination prefill_chunk events catch up
@@ -545,10 +575,15 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
                         f"on replica {last_failover_replica}")
             elif kind == "finish":
                 finishes += 1
+                abort_open = False      # terminal resolves the abort
                 if a.get("reason") not in TERMINAL_REASONS:
                     violations.append(
                         f"{tid}: finish with unknown reason "
                         f"{a.get('reason')!r}")
+        if abort_open and complete:
+            violations.append(
+                f"{tid}: promote_abort never followed by re-prefill or "
+                f"a terminal — request wedged by a degraded promotion")
         if finishes > 1:
             violations.append(
                 f"{tid}: {finishes} terminal events (expected exactly "

@@ -36,7 +36,8 @@ SERVE_SPEC_ENV = "PADDLE_TPU_SERVE_FAULTS"
 
 KINDS = ("kill", "nan", "stall", "corrupt")
 SERVE_KINDS = ("nan_logits", "stall", "cache_corrupt", "burst",
-               "kill_replica", "wedge_replica", "kill_migration")
+               "kill_replica", "wedge_replica", "kill_migration",
+               "kill_promotion", "kill_demotion", "corrupt_host_block")
 KILL_EXIT_CODE = 37  # distinctive, so supervisors/tests can assert on it
 
 
@@ -216,6 +217,19 @@ class ServingFaultInjector:
                               and the router fails the source over
                               (kill_replica can never land there: the
                               replica's own step claims it first)
+        kill_promotion@4      cut the next host→device prefix promotion
+                              short at/after step 4 — the entry stays
+                              host-resident (retryable) and the request
+                              degrades to re-prefill of the suffix
+        kill_demotion@6       cut the next device→host spill short —
+                              nothing reaches the host tier half-written;
+                              the victim block is plainly evicted instead
+        corrupt_host_block@8  flip one value in the LRU-oldest host-tier
+                              entry WITHOUT updating its sha256 — models
+                              torn host RAM; caught by the digest check
+                              on the next promotion/export (outcome
+                              "integrity" → re-prefill). Slides while
+                              the host tier is empty
 
     Each fault fires ONCE per injector instance, at the first
     opportunity AT OR AFTER its step (a fault armed for a step where its
@@ -349,6 +363,41 @@ class ServingFaultInjector:
         if not self.enabled:
             return False
         return self._claim_targeted("kill_migration", step, replica)
+
+    def kill_promotion(self, step: int) -> bool:
+        """Cache hook, inside `PagedKVCache._promote_node`: True exactly
+        once when a kill_promotion fault is due — the in-flight
+        host→device fill stops before touching the device pool, the
+        promotion reports outcome "timeout" (entry stays host-resident,
+        retryable) and the request re-prefills the missing suffix."""
+        if not self.enabled:
+            return False
+        return self._claim("kill_promotion", step) is not None
+
+    def kill_demotion(self, step: int) -> bool:
+        """Cache hook, in `PagedKVCache._evict_cached`'s victim
+        selection: True exactly once when a kill_demotion fault is due
+        — the spill aborts before anything of the victim's is read or
+        reaches the host store (no half-written entry) and the victim
+        block falls back to plain eviction."""
+        if not self.enabled:
+            return False
+        return self._claim("kill_demotion", step) is not None
+
+    def corrupt_host_block(self, step: int, cache) -> None:
+        """Engine hook, top of step: flip one value in the LRU-oldest
+        host-tier entry without updating its digest (HostTierStore.
+        corrupt_oldest) — torn host RAM, detected by the sha256 check on
+        the next fill. Slides to a later step while the cache has no
+        host tier or it is empty."""
+        if not self.enabled:
+            return
+        host = getattr(cache, "host_tier", None)
+        if host is None or len(host) == 0:
+            return
+        if self._claim("corrupt_host_block", step) is None:
+            return
+        host.corrupt_oldest()
 
     def burst(self, step: int) -> int:
         """Harness hook: number of extra arrivals due now (0 if none) —

@@ -208,10 +208,16 @@ def instrument_obs(witness: LockWitness, registry=None, ring=None
 
 
 def instrument_engine(engine, witness: LockWitness) -> None:
-    """Trace one LLMEngine's lock and its scheduler's."""
+    """Trace one LLMEngine's lock, its scheduler's, and — when the
+    paged pool carries a host KV tier — the HostTierStore's leaf
+    lock."""
     _swap(engine, "_lock", "LLMEngine._lock", witness)
     if getattr(engine, "scheduler", None) is not None:
         _swap(engine.scheduler, "_lock", "Scheduler._lock", witness)
+    cache = getattr(engine, "cache", None)
+    if cache is not None and getattr(cache, "host_tier", None) \
+            is not None:
+        _swap(cache.host_tier, "_lock", "HostTierStore._lock", witness)
 
 
 def instrument_fleet(rs, witness: LockWitness, obs_too: bool = True

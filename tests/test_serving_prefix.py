@@ -333,8 +333,8 @@ def test_prefix_index_remove_subtree():
     idx.insert([1, 2, 3, 4, 8, 8], [7, 8, 5])
     node = idx.node_of(8)
     gone = idx.remove_subtree(node)
-    assert sorted(gone) == [5, 8, 9]                # node first
-    assert gone[0] == 8
+    assert sorted(n.block for n in gone) == [5, 8, 9]
+    assert gone[0].block == 8                       # node first
     path, _ = idx.match([1, 2, 3, 4, 5, 6])
     assert [n.block for n in path] == [7]
     assert idx.audit() == 0

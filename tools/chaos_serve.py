@@ -54,6 +54,20 @@ predicted.
 
     JAX_PLATFORMS=cpu python tools/chaos_serve.py --disagg --seed 0
 
+`--tiering` switches to the hierarchical KV-tiering harness
+(`run_chaos_tiering`): templated traffic against a device pool far
+smaller than the prefix working set, a host-RAM tier behind the trie
+(docs/serving.md "Hierarchical KV-cache tiering"), and tier-targeted
+faults — `kill_demotion@step` (die mid-spill), `kill_promotion@step`
+(die mid-fill) and `corrupt_host_block@step` (flip bytes in a spilled
+block; the next promotion must fail sha256 verification and re-prefill
+instead). Gates: zero lost requests, zero leaked blocks on BOTH tiers
+(cross-tier check_integrity + drain-to-empty), bitwise survivors vs
+the unfaulted tiering-on run, non-vacuous demote/promote churn, and a
+clean lock witness including the HostTierStore leaf lock.
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --tiering --seed 0
+
 `--prefix-cache` reruns either harness on TEMPLATED prompts with
 radix-trie block sharing enabled (docs/serving.md "Prefix caching") —
 multi-replica mode additionally routes by prefix affinity so the
@@ -272,6 +286,190 @@ def run_chaos(seed: int = 0, n_requests: int = 16,
         f"survivor token divergence vs unfaulted run: {mismatched}"
     # 4. lock-order witness: cycle-free, and every witnessed edge was
     #    statically predicted (docs/static_analysis.md, PT-C002)
+    _audit_witness(witness, predicted, report,
+                   spans_path=witness_out)
+    return report
+
+
+DEFAULT_TIERING_FAULTS = \
+    "kill_demotion@4,kill_promotion@8,corrupt_host_block@12"
+
+
+def run_chaos_tiering(seed: int = 0, n_requests: int = 20,
+                      faults: str = DEFAULT_TIERING_FAULTS,
+                      max_steps: int = 600, cancel_every: int = 0,
+                      witness_out: str = "") -> dict:
+    """One seeded hierarchical-tiering chaos run (docs/serving.md
+    "Hierarchical KV-cache tiering"): templated traffic against a
+    device pool far smaller than the prefix working set, with a host
+    KV tier behind the trie, while tier-targeted faults kill demotions
+    mid-spill (`kill_demotion`), kill promotions mid-fill
+    (`kill_promotion`) and silently flip bytes in a spilled host block
+    (`corrupt_host_block`). The audit gates:
+
+    - zero lost requests: every id terminal — a failed demotion simply
+      frees the block, a failed/corrupted promotion degrades to
+      ordinary re-prefill of the missing suffix;
+    - zero leaked blocks on BOTH tiers: cross-tier check_integrity
+      clean (host_orphans/host_leaked included), and after
+      clear_prefix_cache the run asserts blocks_allocated ==
+      blocks_freed AND an empty host store;
+    - bitwise survivors: completed requests match the unfaulted
+      tiering-on run token-for-token (a promoted prefix restores the
+      exact spilled bytes; anything less fails digest verification and
+      re-prefills);
+    - non-vacuous: the run must demote, attempt promotions, and fire
+      every scheduled tier fault;
+    - lock-order witness (HostTierStore leaf lock included):
+      cycle-free, statically predicted."""
+    from paddle_tpu.inference.serving import (EngineConfig, LLMEngine,
+                                              SamplingParams)
+    from paddle_tpu.testing.faults import ServingFaultInjector
+    from paddle_tpu.testing.locktrace import (instrument_engine,
+                                              instrument_obs)
+
+    witness, predicted = _lock_witness()
+    instrument_obs(witness)
+    model, cfg = _build_model()
+    rng = np.random.RandomState(seed)
+    # 4 templates x 16 tokens = 16 full trie blocks of working set
+    # against a 32-block device pool that also holds 4 live requests'
+    # tables. Phased revisit order: seed templates 0/1, churn on 2/3
+    # long enough that pool pressure demotes 0/1 to the host tier,
+    # then revisit 0/1 — their blocks must come back via promotion
+    # (n_requests=20 is tuned to make both phases non-vacuous)
+    tpls = [rng.randint(0, cfg.vocab_size, (16,), dtype=np.int32)
+            for _ in range(4)]
+    order = ([0, 0, 1, 1]
+             + [2, 3] * max((n_requests - 8) // 2, 1)
+             + [0, 1, 0, 1])
+    order = (order + [i % 4 for i in range(n_requests)])[:n_requests]
+    specs = [(np.concatenate(
+                [tpls[order[i]],
+                 rng.randint(0, cfg.vocab_size,
+                             (int(rng.randint(2, 6)),),
+                             dtype=np.int32)]),
+              int(rng.randint(4, 10))) for i in range(n_requests)]
+    ecfg = EngineConfig(block_size=4, num_blocks=32, max_num_seqs=4,
+                        max_waiting=n_requests,
+                        admission_policy="shed_oldest",
+                        cache_high_watermark=0.9,
+                        enable_prefix_cache=True,
+                        host_tier_blocks=64)
+
+    def drive(injector, do_cancel):
+        eng = LLMEngine.from_model(model, ecfg, faults=injector)
+        instrument_engine(eng, witness)
+        crng = np.random.RandomState(seed + 1)
+        pending = list(enumerate(specs))
+        rids = {}
+        cancelled = set()
+        for i, (p, mt) in pending[:ecfg.max_num_seqs]:
+            rids[i] = eng.add_request(p, SamplingParams(max_tokens=mt))
+        pending = pending[ecfg.max_num_seqs:]
+        steps = 0
+        while eng.has_unfinished() or pending:
+            eng.step()
+            steps += 1
+            assert steps <= max_steps, \
+                f"engine failed to drain within {max_steps} steps"
+            if steps % 2 == 0 and pending:      # staggered arrivals
+                i, (p, mt) = pending.pop(0)
+                rids[i] = eng.add_request(p, SamplingParams(max_tokens=mt))
+            if do_cancel and cancel_every and steps % cancel_every == 0:
+                live = [i for i, r in rids.items()
+                        if not eng.get_request(r).finished
+                        and i not in cancelled]
+                if live:
+                    victim = live[int(crng.randint(len(live)))]
+                    eng.cancel(rids[victim])
+                    cancelled.add(victim)
+        return eng, rids, cancelled
+
+    # reference pass: same workload, tiering ON, no faults — survivors
+    # compare against healthy demote/promote cycles, so the comparison
+    # also pins promotion bitwise-invariance
+    ref_eng, ref_rids, _ = drive(ServingFaultInjector(""),
+                                 do_cancel=False)
+    ref_eng.cache.check_integrity()
+    ref_ps = ref_eng.cache.prefix_stats()
+    assert ref_ps["tier_demotions"] > 0, \
+        "tiering reference run never demoted — device pool too large " \
+        "for the working set (vacuous)"
+    ref_tokens = {i: list(ref_eng.get_request(r).output_ids)
+                  for i, r in ref_rids.items()}
+
+    injector = ServingFaultInjector(faults)
+    scheduled = {k for k, _s, _a in injector.faults}
+    eng, rids, cancelled = drive(injector, do_cancel=True)
+
+    d = eng.stats.as_dict()
+    unserved = d["shed"] + d["errors"] + d["timeouts"] + d["expired"]
+    p99 = eng.stats.ttft_quantile(0.99)
+    ps = eng.cache.prefix_stats()
+    promotes = {k: ps[f"promote_{k}"]
+                for k in ("hit", "timeout", "integrity", "raced")}
+    pp99 = eng.stats.promote_quantile(0.99)
+    report = {
+        "seed": seed, "requests": n_requests, "faults": faults,
+        "fired": list(injector.fired_log),
+        "stats": {k: v for k, v in d.items()
+                  if isinstance(v, int) and v},
+        "cache": eng.cache.stats(),
+        "host_tier": eng.cache.host_tier.stats(),
+        "prefix": {k: ps[k] for k in
+                   ("hits", "misses", "evictions", "cow_forks",
+                    "host_blocks", "tier_demotions")},
+        "promotions": promotes,
+        "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
+                "promote_p99_s": None if math.isnan(pp99)
+                else round(pp99, 4),
+                "reject_rate": round(unserved / max(n_requests, 1), 4)},
+    }
+    # 1. no lost requests: every id terminal — a misbehaving cache
+    #    tier must degrade to re-prefill, never wedge a request
+    lost = [i for i, r in rids.items()
+            if not eng.get_request(r).finished]
+    assert not lost, f"non-terminal requests after drain: {lost}"
+    # 2. cross-tier zero-leak: device audit + host_orphans/host_leaked
+    report["integrity"] = eng.cache.check_integrity()
+    # 3. bitwise survivors vs the unfaulted tiering-on run
+    mismatched, survivors = [], 0
+    for i, r in rids.items():
+        req = eng.get_request(r)
+        if req.state not in ("finished_stopped", "finished_length") \
+                or i in cancelled:
+            continue
+        survivors += 1
+        if list(req.output_ids) != ref_tokens[i]:
+            mismatched.append({"request": i, "trace_id": req.tid})
+    report["survivors"] = survivors
+    assert not mismatched, \
+        f"survivor token divergence vs unfaulted run: {mismatched}"
+    # 4. non-vacuous: tier churn happened and every scheduled tier
+    #    fault actually fired (a corrupt_host_block that never found a
+    #    resident host block, or a kill_promotion that never saw a
+    #    promotion, tested nothing)
+    assert ps["tier_demotions"] > 0, \
+        "faulted tiering run never demoted — vacuous"
+    assert sum(promotes.values()) > 0, \
+        "faulted tiering run never attempted a promotion — vacuous"
+    fired_kinds = {k for k, _s in injector.fired_log}
+    missing = scheduled - fired_kinds
+    assert not missing, \
+        f"scheduled tier faults never fired: {sorted(missing)}"
+    # 5. both tiers drain to empty: the trie releases every cached
+    #    device block, the host store every spilled payload, and the
+    #    free-list crossing counters must balance exactly
+    eng.cache.clear_prefix_cache()
+    assert eng.cache.blocks_allocated == eng.cache.blocks_freed, \
+        f"device-tier leak after drain+clear: allocated " \
+        f"{eng.cache.blocks_allocated} != freed {eng.cache.blocks_freed}"
+    assert len(eng.cache.host_tier) == 0, \
+        f"host-tier leak after clear: {len(eng.cache.host_tier)} " \
+        f"entries still resident"
+    # 6. lock-order witness (HostTierStore._lock rides as a leaf under
+    #    the engine/scheduler frame): cycle-free, statically predicted
     _audit_witness(witness, predicted, report,
                    spans_path=witness_out)
     return report
@@ -642,6 +840,12 @@ def main(argv=None) -> int:
                          "kill-mid-migration coverage (default faults "
                          f"{DEFAULT_DISAGG_FAULTS!r}; --replicas "
                          "defaults to 3)")
+    ap.add_argument("--tiering", action="store_true",
+                    help="hierarchical KV-tiering harness: host-RAM "
+                         "tier behind the prefix trie, device pool "
+                         "sized below the working set, tier-targeted "
+                         "faults (default "
+                         f"{DEFAULT_TIERING_FAULTS!r})")
     ap.add_argument("--faults", default=None,
                     help="ServingFaultInjector spec (see testing/faults.py)")
     ap.add_argument("--cancel-every", type=int, default=0,
@@ -685,7 +889,15 @@ def main(argv=None) -> int:
     obs.reqtrace.arm(flight_dir, max_dumps=4)
     flight_path = os.path.join(flight_dir, "flightrec-exit.json")
     try:
-        if args.disagg:
+        if args.tiering:
+            report = run_chaos_tiering(
+                seed=args.seed, n_requests=args.requests,
+                faults=(args.faults if args.faults is not None
+                        else DEFAULT_TIERING_FAULTS),
+                max_steps=max(args.max_steps, 600),
+                cancel_every=args.cancel_every,
+                witness_out=args.witness_out)
+        elif args.disagg:
             report = run_chaos_disagg(
                 seed=args.seed, n_requests=args.requests,
                 replicas=(args.replicas if args.replicas > 0 else 3),

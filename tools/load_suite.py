@@ -70,6 +70,19 @@ Scenarios (docs/observability.md "Load suite"):
                  `balance="prefix_affinity"` must retain >= 80% of the
                  single-replica hit rate.
 
+- tiered_prefix — templated traffic whose prefix working set is far
+                 larger than the device pool, with the host-RAM KV
+                 tier behind the trie (docs/serving.md "Hierarchical
+                 KV-cache tiering"): cold templates demote to host
+                 instead of being freed and promote back on revisit.
+                 Runs the SAME workload tiering-on and tiering-off
+                 (reported as `no_tiering_baseline` — evictions there
+                 FREE the blocks, so revisits re-prefill in full) and
+                 gates hit rate, promotion count, promote-latency p99
+                 and the TTFT-p50 speedup; a 3-replica round-robin
+                 pass with `peer_prefix_fetch=True` must commit at
+                 least one transactional peer prefix pull.
+
 - disagg       — the mixed_prefill_decode traffic on a 4-replica
                  budget, run 2-prefill+2-decode (live KV-block handoff
                  at prefill completion, docs/serving.md "Disaggregated
@@ -113,7 +126,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
              "decode_heavy", "replica_kill", "mixed_prefill_decode",
-             "prefix_heavy", "disagg")
+             "prefix_heavy", "tiered_prefix", "disagg")
 
 #: per-scenario SLOs. Latency bounds are generous (CPU-smoke friendly)
 #: — the point is catching regressions in KIND (rejects where none are
@@ -179,6 +192,19 @@ SLOS = {
                      "max_reject_rate": 0.0, "min_hit_rate": 0.5,
                      "min_ttft_speedup": 2.0,
                      "min_affinity_retention": 0.8},
+    # hierarchical KV tiering's contract (docs/serving.md "Hierarchical
+    # KV-cache tiering"): with the working set ≫ device pool, evicted
+    # templates spill to host RAM and promote back on revisit, so the
+    # revisit phase still HITS; with tiering off the same evictions
+    # freed the blocks and every revisit re-prefills its full template
+    # against the tight prefill budget. ttft_speedup (off-p50 / on-p50)
+    # measures exactly that avoided re-prefill; promotions must be
+    # non-vacuous, and the 3-replica round-robin pass must commit at
+    # least one transactional peer prefix pull (peer_prefix_fetch)
+    "tiered_prefix": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 8.0,
+                      "max_reject_rate": 0.0, "min_hit_rate": 0.3,
+                      "min_ttft_speedup": 0.8, "min_promotions": 1,
+                      "min_peer_fetches": 1},
     # disaggregated tiers (docs/serving.md "Disaggregated serving and
     # block migration"): the PR 10 mixed prefill+decode traffic on a
     # 4-replica budget, 2-prefill+2-decode with live KV-block handoff.
@@ -348,6 +374,43 @@ def _arrivals(name: str, n: int, vocab: int, seed: int):
             arr.append((8 + 2 * (i // 6),
                         np.concatenate([templates[i % 3], prompt(2, 6)]),
                         int(rng.randint(4, 8))))
+    elif name == "tiered_prefix":
+        # working set ≫ device pool: 5 templates x 80 tokens = 50 full
+        # trie blocks (block_size 8) against a 60-block device pool of
+        # which 4 live requests' tables (~11 blocks each) claim ~44 —
+        # about two templates stay resident. Phase 1 visits the
+        # templates in order (triples, so the repeat visits exercise
+        # the device hit path); by the time template k prefills,
+        # template k-2 has demoted to host — demote-instead-of-free
+        # with tiering on, plain free with it off. Phase 2 revisits
+        # ALL templates in one burst that lands while phase 1's tail
+        # still drains: with tiering, each revisit batch-promotes its
+        # chain (cost ~constant in template length — one scatter per
+        # pool tensor) and is priced at its suffix; without, cold
+        # revisits re-prefill a full ~84-token template against the
+        # tight 64-token/step budget, serialising admissions. The
+        # ttft_speedup SLO gates that tail difference at p99
+        ecfg.enable_prefix_cache = True
+        ecfg.host_tier_blocks = 256
+        ecfg.block_size = 8
+        ecfg.max_num_seqs = 4
+        ecfg.max_prefill_tokens = 64
+        ecfg.num_blocks = 60
+        ecfg.decode_chunk_size = 4
+        n = max(n, 20)
+        n_t = 5
+        templates = [rng.randint(1, vocab, (80,), dtype=np.int32)
+                     for _ in range(n_t)]
+        for i in range(n - n_t):         # phase 1: t0,t0,t0,t1,...
+            arr.append((2 * i,
+                        np.concatenate([templates[(i // 3) % n_t],
+                                        prompt(2, 6)]),
+                        int(rng.randint(8, 12))))
+        base = 2 * (n - n_t) - 4         # phase 2 overlaps the tail
+        for t in range(n_t):
+            arr.append((base,
+                        np.concatenate([templates[t], prompt(2, 6)]),
+                        int(rng.randint(4, 8))))
     else:
         raise ValueError(f"unknown scenario {name!r}; "
                          f"choose from {SCENARIOS}")
@@ -396,7 +459,8 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
                   faults: str = "", max_steps=6000,
                   balance: str = "free_blocks",
                   obs_label: str = "load-replica-kill",
-                  roles=None, witness=None):
+                  roles=None, witness=None,
+                  peer_prefix_fetch: bool = False):
     """replica_kill / prefix_heavy / disagg fleet driver: the same
     arrival clock as _drive, but the workload flows through a
     ReplicaSet (for replica_kill the fault schedule targets whole
@@ -411,7 +475,8 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
     rc = RouterConfig(num_replicas=replicas, heartbeat_timeout_s=0.02,
                       backoff_base=0.01, backoff_max=0.05,
                       backoff_jitter=0.0, balance=balance,
-                      roles=roles, obs_label=obs_label)
+                      roles=roles, obs_label=obs_label,
+                      peer_prefix_fetch=peer_prefix_fetch)
     rs = ReplicaSet.from_model(model, rc, engine_config=ecfg,
                                faults=ServingFaultInjector(faults))
     if witness is not None:
@@ -583,6 +648,20 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
         if ret is None or ret < ret_min:
             viol.append(f"affinity retention {ret} < {ret_min} "
                         "(3-replica vs single-replica hit rate)")
+    pro_min = slo.get("min_promotions")
+    if pro_min is not None:
+        got = metrics["tiering"]["promotions"]["hit"]
+        if got < pro_min:
+            viol.append(f"promotions hit={got} < {pro_min} "
+                        "(host tier never filled a device miss — "
+                        "tiering was vacuous)")
+    pf_min = slo.get("min_peer_fetches")
+    if pf_min is not None:
+        got = metrics["peer_fetch"]["fetches"]
+        if got < pf_min:
+            viol.append(f"peer prefix fetches {got} < {pf_min} "
+                        "(fleet pass never pulled a prefix from a "
+                        "peer — peer fetch was vacuous)")
     mig_min = slo.get("min_migrations")
     if mig_min is not None:
         got = metrics["migrations"]["migrations"]
@@ -816,6 +895,94 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
                 round(fps["cached_tokens_ratio"], 4),
             "retention": round(fleet_rate / hit_rate, 4)
             if hit_rate else None,
+            "lost": sum(1 for r in rids
+                        if not rs.get_request(r).finished),
+        }
+        m["lockgraph"] = _lockgraph_report(witness, predicted)
+        return _slo_verdict(name, m)
+    if name == "tiered_prefix":
+        import dataclasses
+        # hierarchical KV tiering under a working set the device pool
+        # cannot hold (docs/serving.md "Hierarchical KV-cache
+        # tiering"): the churn phase demotes the leaders' templates to
+        # host RAM, the revisit phase promotes them back. Runs under
+        # the lock witness — ensure_promoted nests
+        # Scheduler._lock -> HostTierStore._lock, the deepest new edge
+        # this PR adds
+        witness, predicted = _lock_witness()
+        # tiering ON (the SLO-gated default)
+        _drive(model, ecfg, arr, witness=witness)
+        eng, submitted, rejected, wall = _drive(model, ecfg, arr,
+                                                witness=witness)
+        m = _metrics(eng, submitted, rejected, wall)
+        ps = eng.cache.prefix_stats()
+        lookups = ps["hits"] + ps["misses"]
+        m["prefix"] = {
+            "hits": ps["hits"], "misses": ps["misses"],
+            "hit_rate": round(ps["hits"] / lookups, 4)
+            if lookups else 0.0,
+            "cached_tokens_ratio": round(ps["cached_tokens_ratio"], 4),
+            "evictions": ps["evictions"],
+        }
+        pp99 = eng.stats.promote_quantile(0.99)
+        m["tiering"] = {
+            "demotions": ps["tier_demotions"],
+            "promotions": {o: ps[f"promote_{o}"]
+                           for o in ("hit", "timeout",
+                                     "integrity", "raced")},
+            "promote_p99_s": None if math.isnan(pp99)
+            else round(pp99, 4),
+            "host_blocks": ps["host_blocks"],
+        }
+        # tiering OFF: same workload, same device pool, eviction
+        # frees instead of demoting — every revisit past the pool's
+        # capacity re-prefills its full template
+        ocfg = dataclasses.replace(ecfg, host_tier_blocks=0,
+                                   obs_label=f"load-{name}-notier")
+        _drive(model, ocfg, arr, witness=witness)
+        oeng, osub, orej, owall = _drive(model, ocfg, arr,
+                                         witness=witness)
+        om = _metrics(oeng, osub, orej, owall)
+        ops = oeng.cache.prefix_stats()
+        olook = ops["hits"] + ops["misses"]
+        m["no_tiering_baseline"] = {
+            "tokens_per_sec": om["tokens_per_sec"],
+            "ttft_p50": om["ttft_p50"],
+            "ttft_p99": om["ttft_p99"],
+            "hit_rate": round(ops["hits"] / olook, 4)
+            if olook else 0.0,
+        }
+        # the gate is NON-REGRESSION (>= 0.8), not a 2x-style win:
+        # promotion pays real per-block spill/fill work that this
+        # CPU harness prices at dispatch overhead rather than DMA
+        # bandwidth, so the honest claim is that extending reuse
+        # beyond the device pool must not materially cost median
+        # TTFT (0.8 is the CPU-smoke wall-clock noise band; the
+        # deterministic demote/promote/peer-fetch counts above are
+        # the exact gates) — the
+        # absolute p50/p99 of both runs ride into BENCH_FULL where
+        # the trend is tracked
+        on50, off50 = m["ttft_p50"], om["ttft_p50"]
+        m["ttft_speedup"] = round(off50 / on50, 2) \
+            if on50 and off50 else None
+        # 3-replica fleet, round-robin on purpose: templates land on
+        # whichever replica is next, so a revisit routed to a replica
+        # that never saw the template must pull the prefix from the
+        # peer that holds it (transactional peer fetch) before falling
+        # back to re-prefill
+        _drive_router(model, ecfg, arr, balance="round_robin",
+                      obs_label=f"load-{name}-fleet", witness=witness,
+                      peer_prefix_fetch=True)
+        rs, rids, rsub, rrej, rwall = _drive_router(
+            model, ecfg, arr, balance="round_robin",
+            obs_label=f"load-{name}-fleet", witness=witness,
+            peer_prefix_fetch=True)
+        ms = rs.migrator.stats()
+        m["peer_fetch"] = {
+            "replicas": REPLICA_COUNT,
+            "fetches": ms["prefix_fetches"],
+            "aborted": ms["prefix_aborted"],
+            "bytes": ms["prefix_bytes"],
             "lost": sum(1 for r in rids
                         if not rs.get_request(r).finished),
         }
