@@ -37,6 +37,16 @@ PAPERS.md arxiv 2604.15464). Four cooperating modules:
                 (zero-lost-request requeue to survivors), draining,
                 prefill/decode tiering with live handoff, and
                 router-level backpressure.
+- tenancy:      TenantRegistry / TenantConfig — tenants as first-class
+                objects: priority class + weight (WFQ fair share),
+                sliding-window token quotas (TenantQuotaExceeded),
+                TTFT/deadline SLOs, weighted prefix-cache shares
+                (docs/serving.md "Multi-tenant scheduling and
+                autoscaling").
+- autoscaler:   Autoscaler / AutoscalerPolicy — telemetry-driven
+                role-aware fleet sizing: shrink via evacuating drain,
+                grow via warmup-probe rejoin, prefill:decode balance
+                from the measured phase split.
 
 See docs/serving.md for architecture and tuning.
 """
@@ -55,6 +65,10 @@ from .replica import (EngineReplica, ReplicaCrashed,  # noqa: F401
 from .migration import (BlockMigration,  # noqa: F401
                         MIGRATION_REASONS)
 from .router import ReplicaSet, RouterConfig, RouterRequest  # noqa: F401
+from .tenancy import (TenantConfig, TenantQuotaExceeded,  # noqa: F401
+                      TenantRegistry)
+from .autoscaler import (Autoscaler, AutoscalerConfig,  # noqa: F401
+                         AutoscalerPolicy)
 
 __all__ = [
     "PagedKVCache", "CacheExhausted", "EngineOverloaded",
@@ -67,4 +81,6 @@ __all__ = [
     "EngineReplica", "ReplicaCrashed", "ReplicaState",
     "BlockMigration", "MIGRATION_REASONS",
     "ReplicaSet", "RouterConfig", "RouterRequest",
+    "TenantConfig", "TenantRegistry", "TenantQuotaExceeded",
+    "Autoscaler", "AutoscalerConfig", "AutoscalerPolicy",
 ]

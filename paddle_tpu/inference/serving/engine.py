@@ -138,6 +138,13 @@ class EngineConfig:
     # final label is ALWAYS uniquified per instance (prefix-N) so two
     # engines can never merge their metric series
     obs_label: Optional[str] = None
+    # multi-tenant serving (serving/tenancy.TenantRegistry): shared
+    # fleet-wide by REFERENCE (dataclasses.replace keeps it), so quota
+    # windows and fair shares are fleet-level facts. Enables WFQ
+    # admission, sliding-window quota enforcement, deadline-aware early
+    # reject and share-weighted trie eviction. None (default) keeps the
+    # historical single-tenant FCFS stack bit-for-bit.
+    tenants: Optional[object] = None
 
 
 @dataclass
@@ -301,10 +308,16 @@ class EngineStats:
         g_pfx = obs.gauge(
             "serving_prefix_cache_blocks",
             "prefix-cache block census: kind=cached (trie-indexed) | "
-            "shared (refcount >= 2)",
-            labels=("engine", "kind"), unit="blocks")
-        self._g_prefix_cached = g_pfx.labels(kind="cached", **lbl)
-        self._g_prefix_shared = g_pfx.labels(kind="shared", **lbl)
+            "shared (refcount >= 2); tenant='*' is the all-tenants "
+            "total, per-tenant children carry kind=cached only "
+            "(cardinality bounded by the TenantRegistry)",
+            labels=("engine", "kind", "tenant"), unit="blocks")
+        self._f_prefix_blocks = g_pfx
+        self._g_prefix_cached = g_pfx.labels(kind="cached", tenant="*",
+                                             **lbl)
+        self._g_prefix_shared = g_pfx.labels(kind="shared", tenant="*",
+                                             **lbl)
+        self._g_prefix_tenant: Dict[str, object] = {}
         # hierarchical tiering (docs/serving.md "Hierarchical KV-cache
         # tiering"): per-tier residency, demote/promote lifecycle
         # counters and the promotion-latency histogram
@@ -398,6 +411,16 @@ class EngineStats:
         self._g_prefix_ratio.set(ps["cached_tokens_ratio"])
         self._g_prefix_cached.set(ps["cached_blocks"])
         self._g_prefix_shared.set(ps["shared_blocks"])
+        # per-tenant cached-block census (multi-tenant stacks): children
+        # are created lazily but never retired — a tenant that drops to
+        # zero blocks must REPORT zero, not go silently stale
+        tb = ps.get("tenant_blocks") or {}
+        for t in tb:
+            if t not in self._g_prefix_tenant:
+                self._g_prefix_tenant[t] = self._f_prefix_blocks.labels(
+                    kind="cached", tenant=t, engine=self.label)
+        for t, child in self._g_prefix_tenant.items():
+            child.set(tb.get(t, 0))
         delta = ps["tier_demotions"] - self._c_demotions.value
         if delta > 0:
             self._c_demotions.inc(delta)
@@ -413,6 +436,12 @@ class EngineStats:
         'evictions') — tests pin these against the cache's own
         counters."""
         return int(self._prefix_counters[kind].value)
+
+    def prefix_tenant_blocks(self, tenant: str) -> int:
+        """Published per-tenant cached-block gauge (reconciliation tests
+        pin this against the trie's lifetime counters)."""
+        child = self._g_prefix_tenant.get(tenant)
+        return int(child.value) if child is not None else 0
 
     def observe_promote(self, dt: float) -> None:
         self._promote_hist.observe(dt)
@@ -553,7 +582,8 @@ class LLMEngine:
                 admission_policy=config.admission_policy,
                 cache_high_watermark=config.cache_high_watermark,
                 prefill_cost_model=cost_model,
-                prefill_chunk_threshold=config.prefill_chunk_threshold),
+                prefill_chunk_threshold=config.prefill_chunk_threshold,
+                tenants=config.tenants),
             self.cache)
         # RLock: step() holds it across the whole iteration and the
         # helpers it calls re-enter (e.g. _emit under _recover)
@@ -621,6 +651,11 @@ class LLMEngine:
             raise ValueError(
                 f"prompt {ids.size} + max_tokens {sampling.max_tokens} "
                 f"exceeds max_seq_len {S}")
+        tenants = self.config.tenants
+        if tenants is not None:
+            # unknown tenant ids are caller bugs, refused loudly before
+            # any engine state is touched
+            tenants.resolve(sampling.tenant)
         with self._lock:
             if request_id is None:
                 request_id = f"req-{self._next_id}"
@@ -644,6 +679,11 @@ class LLMEngine:
                 trace_id = f"tr-{self.stats.label}-{self._next_trace}"
                 self._next_trace += 1
             req.trace_id = trace_id
+            if tenants is not None:
+                # bind the tenant to the trace so EVERY subsequent event
+                # on this timeline auto-carries the tag (ring-level map;
+                # single-tenant stacks without a registry stay untagged)
+                obs.reqtrace.bind_tenant(req.tid, sampling.tenant)
             if resume_tokens is not None and len(resume_tokens):
                 req.output_ids = [int(t) for t in resume_tokens]
                 # TTFT was already observed on the replica that emitted
@@ -651,6 +691,27 @@ class LLMEngine:
                 # token gaps (from now) for the resumed stream
                 req.first_token_time = req.arrival_time
                 req.last_token_time = now
+            charged = 0
+            if tenants is not None and not readmit:
+                # sliding-window token quota, charged for the WORST CASE
+                # (prompt + max_tokens) before any engine state commits;
+                # readmissions never re-charge — failover must not burn
+                # quota twice for one request
+                try:
+                    # ptlint: disable=PT-C004  TenantRegistry sits
+                    # BELOW LLMEngine in lockgraph.json; charge() takes
+                    # only the registry lock, no re-entry
+                    tenants.charge(sampling.tenant,
+                                   ids.size + sampling.max_tokens)
+                except EngineOverloaded as e:
+                    self.stats.rejected += 1
+                    obs.reqtrace.record(
+                        "rejected", req.tid, request_id, reason="quota",
+                        tenant=sampling.tenant, spent=e.depth,
+                        quota=e.limit, retry_after_s=e.retry_after_s)
+                    e.request_id = request_id
+                    raise
+                charged = ids.size + sampling.max_tokens
             try:
                 if readmit:
                     self.scheduler.readmit(req)
@@ -658,7 +719,16 @@ class LLMEngine:
                 else:
                     shed = self.scheduler.add(req)  # validates pool fit
             except EngineOverloaded:
+                if charged:
+                    # ptlint: disable=PT-C004  registry call below the
+                    # engine lock in lockgraph.json (see charge above)
+                    tenants.refund(sampling.tenant, charged)
                 self.stats.rejected += 1
+                raise
+            except ValueError:
+                if charged:
+                    # ptlint: disable=PT-C004  same as refund above
+                    tenants.refund(sampling.tenant, charged)
                 raise
             for victim in shed:
                 victim.finish_time = time.perf_counter()
@@ -763,6 +833,10 @@ class LLMEngine:
             info["free_blocks"] = self.cache.num_free()
             info["running"] = self.scheduler.num_running()
             return info
+
+    def waiting_by_tenant(self) -> dict:
+        """Per-tenant queue depth (autoscaler pressure signal)."""
+        return self.scheduler.waiting_by_tenant()
 
     # ------------------------------------------- block migration surface
     # (serving/migration.py; docs/serving.md "Disaggregated serving and
@@ -1263,7 +1337,11 @@ class LLMEngine:
                             "recoveries": self.stats.recoveries}
         # per-step telemetry: all host values already in hand (scheduler
         # counters, cache free lists) — recording adds no device work
-        self.stats.observe_step(time.perf_counter() - self._step_start)
+        step_dt = time.perf_counter() - self._step_start
+        self.stats.observe_step(step_dt)
+        # feed the measured service rate to the scheduler's deadline
+        # early-reject estimator (inert without a tenant registry)
+        self.scheduler.note_step_seconds(step_dt)
         self.stats.set_prefill_spend(prefill_spend)
         if self.stats.generated_tokens:
             self.stats.set_syncs_per_token(

@@ -151,6 +151,12 @@ class PagedKVCache:
         self.blocks_attached = 0             # shared-prefix attaches
         self.alloc_failures = 0
         self.high_water = 0
+        # multi-tenant accounting (serving/tenancy.py; inert until the
+        # scheduler feeds it): seq_id -> tenant so register_prefix can
+        # stamp trie nodes, and the prefix-share weights arbitrating
+        # weighted eviction (None = historical global LRU)
+        self._seq_tenant: Dict[object, str] = {}
+        self._tenant_weights: Optional[Dict[str, float]] = None
 
     def arm_tier_faults(self, faults: "ServingFaultInjector",
                         step: int) -> None:
@@ -158,6 +164,41 @@ class PagedKVCache:
         kill_promotion) at the engine's injector for this step."""
         self._tier_faults = faults
         self._tier_step = step
+
+    # -------------------------------------------------- tenant plumbing
+    def note_seq_tenant(self, seq_id, tenant: str) -> None:
+        """Tag the tenant whose fair share seq_id spends; the tag rides
+        into the trie when the sequence's prefix registers and is
+        dropped with the sequence's table."""
+        self._seq_tenant[seq_id] = tenant
+
+    def set_tenant_weights(self, weights: Optional[Dict[str, float]]
+                           ) -> None:
+        """Install the prefix-share weights (TenantRegistry snapshot;
+        the scheduler refreshes on registry-version change). None
+        restores the historical unweighted global-LRU eviction."""
+        self._tenant_weights = dict(weights) if weights else None
+
+    def _over_share_tenants(self) -> Optional[set]:
+        """Tenants holding MORE device-resident cached blocks than
+        their prefix_share-weighted proportion of the current cached
+        pool — the victims weighted eviction charges first. None when
+        weighting cannot discriminate (no weights installed, or zero/
+        one tenant holding blocks): the caller falls back to the
+        historical global LRU sweep, which keeps single-tenant stacks
+        on the exact pre-tenancy path."""
+        w = self._tenant_weights
+        idx = self.prefix_index
+        if not w or idx is None:
+            return None
+        census = idx.tenant_device_blocks()
+        if len(census) <= 1:
+            return None
+        total = sum(census.values())
+        total_w = sum(w.get(t, 1.0) for t in census)
+        over = {t for t, n in census.items()
+                if n > total * w.get(t, 1.0) / total_w}
+        return over or None
 
     # ------------------------------------------------------------ queries
     def num_free(self) -> int:
@@ -232,10 +273,17 @@ class PagedKVCache:
             pending: List[PrefixNode] = []
             pset: set = set()
             faults = self._tier_faults
+            # share-weighted victim selection: tenants over their
+            # prefix_share go first; once they are drained back under
+            # share (among exhausts) the sweep widens to the global LRU
+            among = self._over_share_tenants()
             while evicted < n:
                 node = idx.lru_demotable(
                     lambda b: self._refcount.get(b, 0) == 0,
-                    skip=self._promote_guard, pending=pset)
+                    skip=self._promote_guard, pending=pset, among=among)
+                if node is None and among is not None:
+                    among = None
+                    continue
                 if node is None:
                     break
                 evicted += 1
@@ -250,9 +298,13 @@ class PagedKVCache:
                 pset.add(node)
             self._flush_demotions(pending)
             return evicted
+        among = self._over_share_tenants()
         while evicted < n:
             node = idx.pop_lru_leaf(
-                lambda b: self._refcount.get(b, 0) == 0)
+                lambda b: self._refcount.get(b, 0) == 0, among=among)
+            if node is None and among is not None:
+                among = None                 # widen to the global LRU
+                continue
             if node is None:
                 break
             del self._refcount[node.block]
@@ -625,7 +677,8 @@ class PagedKVCache:
         if full <= 0:
             return 0
         return idx.insert(toks, table[:full],
-                          skip=lambda b: b in self._tainted)
+                          skip=lambda b: b in self._tainted,
+                          tenant=self._seq_tenant.get(seq_id, "default"))
 
     def clear_prefix_cache(self) -> int:
         """Drop the entire trie, returning unreferenced cached blocks
@@ -850,6 +903,7 @@ class PagedKVCache:
             self.register_prefix(seq_id, cache_tokens)
         ids = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
+        self._seq_tenant.pop(seq_id, None)
         to_scrub: List[int] = []
         for b in reversed(ids):
             self._refcount[b] -= 1
@@ -927,6 +981,20 @@ class PagedKVCache:
         else:
             report["host_orphans"] = 0
             report["host_leaked"] = 0
+        # per-tenant reconciliation (multi-tenant accounting): each
+        # tenant's lifetime inserted − removed counters must equal its
+        # live trie census (both tiers) — a drift means a removal path
+        # skipped attribution and the per-tenant gauges are lying
+        if idx is not None:
+            census = idx.tenant_census()
+            names = set(idx.tenant_inserted) | set(idx.tenant_removed) \
+                | set(census)
+            report["tenant_drift"] = sum(
+                abs(idx.tenant_inserted.get(t, 0)
+                    - idx.tenant_removed.get(t, 0) - census.get(t, 0))
+                for t in names)
+        else:
+            report["tenant_drift"] = 0
         if any(report.values()):
             # flight recorder (obs/reqtrace.py): an integrity violation
             # is a postmortem trigger — when armed, ship the full ring
@@ -980,9 +1048,11 @@ class PagedKVCache:
                     "cached_tokens_ratio": 0.0, "attached_blocks": 0,
                     "host_blocks": 0, "tier_demotions": 0,
                     "promote_hit": 0, "promote_timeout": 0,
-                    "promote_integrity": 0, "promote_raced": 0}
+                    "promote_integrity": 0, "promote_raced": 0,
+                    "tenant_blocks": {}}
         out = {"enabled": True}
         out.update(idx.stats())
+        out["tenant_blocks"] = idx.tenant_census()
         out["shared_blocks"] = sum(
             1 for rc in self._refcount.values() if rc >= 2)
         out["evictable_blocks"] = self.num_evictable()

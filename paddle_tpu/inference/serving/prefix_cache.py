@@ -56,10 +56,11 @@ class PrefixNode:
     names the spilled payload in the cache's HostTierStore)."""
 
     __slots__ = ("key", "block", "parent", "children", "last_touch",
-                 "tier", "host_id")
+                 "tier", "host_id", "tenant")
 
     def __init__(self, key: Optional[tuple], block: int,
-                 parent: Optional["PrefixNode"], touch: int = 0):
+                 parent: Optional["PrefixNode"], touch: int = 0,
+                 tenant: str = "default"):
         self.key = key
         self.block = block
         self.parent = parent
@@ -67,6 +68,9 @@ class PrefixNode:
         self.last_touch = touch
         self.tier = "device"
         self.host_id: Optional[int] = None
+        # tenant whose sequence WROTE this block (first-wins, like the
+        # block content itself) — the unit of share-weighted eviction
+        self.tenant = tenant
 
     def __repr__(self):                      # debugging aid only
         return (f"PrefixNode(block={self.block}, tier={self.tier}, "
@@ -97,6 +101,13 @@ class PrefixCacheIndex:
         self.inserted_blocks = 0      # trie insertions (first-wins)
         self.cached_tokens_total = 0  # prompt tokens served from cache
         self.prompt_tokens_total = 0  # prompt tokens seen at admission
+        # per-tenant lifetime node counters: every insertion and every
+        # unlink is attributed, so for each tenant
+        #   tenant_inserted - tenant_removed == live census
+        # (both tiers; demote/promote retag without creating/removing).
+        # check_integrity pins this reconciliation under churn.
+        self.tenant_inserted: Dict[str, int] = {}
+        self.tenant_removed: Dict[str, int] = {}
 
     # -------------------------------------------------------- queries
     def __len__(self) -> int:
@@ -192,7 +203,8 @@ class PrefixCacheIndex:
 
     # ------------------------------------------------------ insertion
     def insert(self, tokens: List[int], blocks: List[int],
-               skip: Optional[Callable[[int], bool]] = None) -> int:
+               skip: Optional[Callable[[int], bool]] = None,
+               tenant: str = "default") -> int:
         """Register `blocks` (block i holding tokens[i*bs:(i+1)*bs]) as
         cached prefixes. First-wins dedupe: where a node already exists
         the existing physical block is kept and `blocks[i]` stays a
@@ -220,12 +232,15 @@ class PrefixCacheIndex:
                 continue
             if (skip is not None and skip(b)) or b in self._by_block:
                 break
-            child = PrefixNode(key, b, node, self._clock)
+            child = PrefixNode(key, b, node, self._clock, tenant=tenant)
             node.children[key] = child
             self._by_block[b] = child
             added += 1
             node = child
         self.inserted_blocks += added
+        if added:
+            self.tenant_inserted[tenant] = \
+                self.tenant_inserted.get(tenant, 0) + added
         return added
 
     # ------------------------------------------------ tier transitions
@@ -265,6 +280,13 @@ class PrefixCacheIndex:
         self._by_block[block] = node
 
     # ------------------------------------------------------- eviction
+    def _note_removed(self, node: PrefixNode) -> None:
+        """Attribute one unlinked node to its tenant's removal counter
+        (every removal path funnels through here so the per-tenant
+        inserted/removed/census reconciliation stays exact)."""
+        self.tenant_removed[node.tenant] = \
+            self.tenant_removed.get(node.tenant, 0) + 1
+
     def remove(self, node: PrefixNode) -> None:
         """Unlink one LEAF node (raises on internal nodes — removing
         them would orphan the subtree; use remove_subtree)."""
@@ -278,6 +300,7 @@ class PrefixCacheIndex:
         else:
             del self._by_host[node.host_id]
         node.parent = None
+        self._note_removed(node)
 
     def remove_subtree(self, node: PrefixNode) -> List[PrefixNode]:
         """Unlink `node` and its whole subtree (distrust on scrub,
@@ -296,19 +319,25 @@ class PrefixCacheIndex:
                 del self._by_block[n.block]
             else:
                 del self._by_host[n.host_id]
+            self._note_removed(n)
             stack.extend(n.children.values())
             n.children.clear()
         return removed
 
-    def pop_lru_leaf(self, evictable: Callable[[int], bool]
-                     ) -> Optional[PrefixNode]:
+    def pop_lru_leaf(self, evictable: Callable[[int], bool],
+                     among: Optional[set] = None) -> Optional[PrefixNode]:
         """Remove and return the least-recently-touched leaf whose
         block satisfies `evictable` (the cache passes refcount == 0),
         or None. Clocks are monotone root-ward, so evicting the oldest
-        leaf frees the coldest extremity of the trie first."""
+        leaf frees the coldest extremity of the trie first. `among`
+        restricts candidates to the given TENANTS (share-weighted
+        eviction: the cache first charges tenants over their share,
+        then falls back to the global LRU sweep with among=None)."""
         best: Optional[PrefixNode] = None
         for node in self._by_block.values():
             if node.children or not evictable(node.block):
+                continue
+            if among is not None and node.tenant not in among:
                 continue
             if best is None or node.last_touch < best.last_touch:
                 best = node
@@ -317,7 +346,8 @@ class PrefixCacheIndex:
         return best
 
     def lru_demotable(self, evictable: Callable[[int], bool],
-                      skip=frozenset(), pending=frozenset()
+                      skip=frozenset(), pending=frozenset(),
+                      among: Optional[set] = None
                       ) -> Optional[PrefixNode]:
         """The least-recently-touched node on the DEMOTION FRONTIER —
         a device node with no device-resident children whose block
@@ -329,10 +359,13 @@ class PrefixCacheIndex:
         has SELECTED but not yet spilled (batched demotion): they are
         not re-selected, and they count as demoted for their parent's
         frontier eligibility — the selection sequence matches the
-        one-at-a-time loop exactly."""
+        one-at-a-time loop exactly. `among` restricts candidates to the
+        given tenants (share-weighted eviction, as pop_lru_leaf)."""
         best: Optional[PrefixNode] = None
         for node in self._by_block.values():
             if node in skip or node in pending:
+                continue
+            if among is not None and node.tenant not in among:
                 continue
             if any(c.tier == "device" and c not in pending
                    for c in node.children.values()):
@@ -348,10 +381,33 @@ class PrefixCacheIndex:
         (the cache reconciles them back to the free list / tables and
         clears its host store separately)."""
         blocks = list(self._by_block)
+        for node in self._by_block.values():
+            self._note_removed(node)
+        for node in self._by_host.values():
+            self._note_removed(node)
         self._by_block.clear()
         self._by_host.clear()
         self.root.children.clear()
         return blocks
+
+    def tenant_census(self) -> Dict[str, int]:
+        """Live trie nodes per tenant, BOTH tiers (demotion keeps the
+        node) — the reconciliation counterpart of tenant_inserted/
+        tenant_removed and the per-tenant block gauge source."""
+        out: Dict[str, int] = {}
+        for node in self._by_block.values():
+            out[node.tenant] = out.get(node.tenant, 0) + 1
+        for node in self._by_host.values():
+            out[node.tenant] = out.get(node.tenant, 0) + 1
+        return out
+
+    def tenant_device_blocks(self) -> Dict[str, int]:
+        """Device-resident blocks per tenant (the share the weighted
+        eviction arbitrates — host payloads hold no HBM)."""
+        out: Dict[str, int] = {}
+        for node in self._by_block.values():
+            out[node.tenant] = out.get(node.tenant, 0) + 1
+        return out
 
     # --------------------------------------------------------- audits
     def audit(self) -> int:

@@ -77,6 +77,7 @@ class EngineReplica:
         "history": "_lock",
         "failed_at": "_lock",
         "probe_tokens": "_lock",
+        "_probe_seq": "_lock",
     }
 
     ROLES = ("prefill", "decode", "mixed")
@@ -115,6 +116,7 @@ class EngineReplica:
         self.failed_at: Optional[float] = None  # quarantine timestamp
         self.history: List[tuple] = []    # [(incarnation, reason)]
         self.probe_tokens = 0             # warmup tokens spent (telemetry)
+        self._probe_seq = 0               # probes run on THIS incarnation
 
     # ------------------------------------------------------------ queries
     def is_serving(self) -> bool:
@@ -298,10 +300,15 @@ class EngineReplica:
         terminal fails the probe; the probe request never reaches the
         router's tables."""
         eng = self.engine
+        # the -p sequence keeps probe ids unique when one incarnation is
+        # probed more than once (restart probe, then autoscaler rejoin
+        # probes after each park) — engines reject duplicate request ids
+        self._probe_seq += 1
         rid = eng.add_request(
             self.probe_prompt,
             SamplingParams(max_tokens=1, temperature=0.0),
-            request_id=f"warmup-probe-r{self.index}-i{self.restarts}")
+            request_id=(f"warmup-probe-r{self.index}-i{self.restarts}"
+                        f"-p{self._probe_seq}"))
         for _ in range(self.probe_timeout_steps):
             # ptlint: disable=PT-C003  warmup probe of a PRIVATE engine
             # not yet published to dispatch(); nothing else can contend
@@ -403,3 +410,30 @@ class EngineReplica:
         with self._lock:
             if self.state in (ReplicaState.DRAINING, ReplicaState.DRAINED):
                 self.state = ReplicaState.UP
+
+    def probe_rejoin(self) -> bool:
+        """Warmup-probe rejoin for a PARKED replica (autoscaler grow
+        path, docs/serving.md): a DRAINED slot has been idle for an
+        unbounded time, so before it takes traffic again it must prove
+        the warm engine still serves — the same 1-token greedy probe
+        that gates rejoin after a restart. Only DRAINED slots qualify:
+        the probe loop steps the engine and discards outputs, which
+        would eat live requests' tokens on any serving state. A probe
+        failure quarantines the incarnation (the slot just proved it
+        went bad while parked), handing recovery to the normal
+        restart/backoff machinery. Returns True when the replica is
+        back UP."""
+        with self._lock:
+            if self.state != ReplicaState.DRAINED:
+                return False
+            if self.engine is None or self.engine.has_unfinished():
+                return False
+            try:
+                self._probe()
+            except Exception as e:          # noqa: BLE001 — a failed
+                # rejoin probe is a failed incarnation, not a crash
+                self.quarantine(f"rejoin probe failed: {e}")
+                return False
+            self.state = ReplicaState.UP
+            self.last_beat = time.monotonic()
+            return True

@@ -86,6 +86,7 @@ from ...distributed.elastic import BackoffPolicy
 from .migration import BlockMigration
 from .replica import EngineReplica, ReplicaCrashed, ReplicaState
 from .scheduler import EngineOverloaded, SamplingParams
+from .tenancy import TenantQuotaExceeded
 from .engine import RequestOutput
 
 __all__ = ["BALANCE_POLICIES", "ReplicaSet", "RouterConfig",
@@ -350,6 +351,12 @@ class ReplicaSet:
                     arrival, arrival_time = rep.dispatch(
                         prompt_ids, sampling, request_id,
                         trace_id=trace_id)
+                except TenantQuotaExceeded:
+                    # the quota verdict is TENANT-global, not a property
+                    # of this replica — every peer shares the registry
+                    # and would refuse identically, so surface it now
+                    # with its own retry_after_s (window expiry)
+                    raise
                 except EngineOverloaded as e:
                     last_exc = e          # per-replica bound; try next
                     continue
@@ -371,11 +378,16 @@ class ReplicaSet:
                 self._maybe_peer_fetch(rep, request_id, trace_id, ids)
                 return request_id
             # every up replica refused at ITS bound: surface overload
-            # with the strongest hint we have
+            # with the strongest hint we have — a replica-supplied
+            # retry_after_s (deadline early-reject estimate) beats the
+            # router's drain-rate guess
+            hint = last_exc.retry_after_s if last_exc is not None \
+                and last_exc.retry_after_s is not None \
+                else self._retry_after()
             raise EngineOverloaded(
                 request_id, last_exc.depth if last_exc else 0,
                 last_exc.limit if last_exc else 0,
-                retry_after_s=self._retry_after())
+                retry_after_s=hint)
 
     def cancel(self, request_id: str) -> bool:
         with self._lock:
@@ -944,6 +956,20 @@ class ReplicaSet:
         with self._lock:
             self.replicas[index].undrain()
             self._set_up_gauge(self.replicas[index])
+
+    def probe_grow(self, index: int) -> bool:
+        """Return a PARKED (DRAINED) replica to rotation through a
+        warmup-probe rejoin (autoscaler grow path, docs/serving.md):
+        unlike undrain(), which trusts the warm engine blindly, the
+        slot must serve a 1-token greedy probe end-to-end before real
+        traffic routes there — the same gate a restarted incarnation
+        passes. A failed probe quarantines the slot (normal
+        restart/backoff machinery takes over) and returns False."""
+        with self._lock:
+            rep = self.replicas[index]
+            ok = rep.probe_rejoin()
+            self._set_up_gauge(rep)
+            return ok
 
     # ------------------------------------------------------------- audits
     def check_integrity(self) -> dict:

@@ -117,6 +117,9 @@ class SamplingParams:
     queue_ttl_s: how long the request may sit in the waiting queue before
         it expires unserved (finish_reason='timeout'); unlike deadline_s
         it only guards queueing, so an admitted request never re-arms it.
+    tenant: which tenant's fair share this request spends (serving/
+        tenancy.py). Resolved against the TenantRegistry when the stack
+        is built with one; ignored (and left at "default") otherwise.
     """
     max_tokens: int = 16
     temperature: float = 0.0
@@ -126,6 +129,7 @@ class SamplingParams:
     seed: int = 0
     deadline_s: Optional[float] = None
     queue_ttl_s: Optional[float] = None
+    tenant: str = "default"
 
 
 class RequestState:
@@ -233,6 +237,15 @@ class SchedulerConfig:
     # chunks are inherently rate-limited at k tokens/step). None
     # disables chunking (every prompt takes the dense prefill path).
     prefill_chunk_threshold: Optional[int] = None
+    # multi-tenant WFQ (serving/tenancy.TenantRegistry). When set, the
+    # admission head is chosen by weighted fair queuing over per-tenant
+    # virtual finish times priced in jaxplan FLOPs (full prompt cost, so
+    # one 8k prompt charges its quadratic cost against its tenant's
+    # share), with strict FCFS inside each tenant; deadline-aware early
+    # reject also arms. None (the default) keeps the historical global
+    # FCFS path untouched, and a single active tenant degenerates WFQ to
+    # exactly that path (pinned by tests/test_tenancy.py).
+    tenants: Optional[object] = None
 
 
 @dataclass
@@ -257,6 +270,12 @@ class Scheduler:
         "running": "_lock",
         "num_preemptions": "_lock",
         "watermark_holds": "_lock",
+        "_vtime": "_lock",
+        "_vfinish": "_lock",
+        "_wfq_weights": "_lock",
+        "_weights_version": "_lock",
+        "_step_ewma": "_lock",
+        "deadline_rejects": "_lock",
     }
 
     def __init__(self, config: SchedulerConfig, cache: PagedKVCache):
@@ -275,6 +294,22 @@ class Scheduler:
         self.running: List[Request] = []
         self.num_preemptions = 0
         self.watermark_holds = 0             # admissions paused by watermark
+        # multi-tenant WFQ state (inert when config.tenants is None):
+        # start-time fair queuing over per-tenant virtual finish times.
+        # _vtime is the system virtual clock (last admission's virtual
+        # start); _vfinish[t] the tenant's last virtual finish. Prices
+        # are jaxplan FLOPs of the FULL prompt (quadratic), weights the
+        # registry's effective WFQ weights, snapshotted by version.
+        self.tenants = config.tenants
+        self._vtime = 0.0
+        self._vfinish: dict = {}
+        self._wfq_weights: dict = {}
+        self._weights_version = -1
+        # measured service rate for deadline-aware early reject: EWMA of
+        # engine step wall seconds (note_step_seconds). 0.0 until the
+        # first step — the estimator abstains rather than guess.
+        self._step_ewma = 0.0
+        self.deadline_rejects = 0            # statically-hopeless refusals
 
     # ------------------------------------------------------------- intake
     def add(self, req: Request) -> List[Request]:
@@ -292,6 +327,12 @@ class Scheduler:
                 f"{self.cache.num_blocks}; grow num_blocks or shrink the"
                 f" request")
         with self._lock:
+            # deadline-aware early reject (multi-tenant stacks only):
+            # refuse a request that statically cannot meet its deadline
+            # at the measured service rate BEFORE it burns prefill —
+            # checked ahead of shed_oldest so a doomed arrival never
+            # evicts viable queued work to make room for itself
+            self._deadline_early_reject(req)
             shed: List[Request] = []
             limit = self.config.max_waiting
             if limit is not None:
@@ -306,6 +347,7 @@ class Scheduler:
                         shed.append(victim)
             req.state = RequestState.WAITING
             self.waiting.append(req)
+            self._note_tenant(req)
             return shed
 
     def readmit(self, req: Request):
@@ -327,6 +369,7 @@ class Scheduler:
                 f"{self.cache.num_blocks}")
         with self._lock:
             self._requeue(req)
+            self._note_tenant(req)
 
     # ----------------------------------------------------- block migration
     def adopt_running(self, req: Request):
@@ -353,6 +396,7 @@ class Scheduler:
             req.slot = None
             req.state = RequestState.RUNNING
             self.running.append(req)
+            self._note_tenant(req)
 
     def release_running(self, req: Request):
         """Migration release (source side): detach a RUNNING request
@@ -586,6 +630,148 @@ class Scheduler:
                             reason="recovery", arrival=req.arrival,
                             tokens_kept=len(req.output_ids))
 
+    # ------------------------------------------------- multi-tenant WFQ
+    def note_step_seconds(self, dt: float) -> None:
+        """Engine step-time feed for the deadline early-reject service
+        rate (EWMA; alpha favours recency so the estimate tracks load
+        shifts within a few steps)."""
+        with self._lock:
+            self._step_ewma = dt if self._step_ewma == 0.0 \
+                else 0.8 * self._step_ewma + 0.2 * dt
+
+    def waiting_by_tenant(self) -> dict:
+        """Queue depth per tenant (autoscaler pressure signal)."""
+        with self._lock:
+            out: dict = {}
+            for req in self.waiting:
+                t = req.params.tenant
+                out[t] = out.get(t, 0) + 1
+            return out
+
+    @holds_lock("_lock")
+    def _note_tenant(self, req: Request) -> None:
+        """Tenant bookkeeping on intake (inert without a registry):
+        refresh the weight snapshots and tag the sequence's tenant into
+        the cache so prefix registration stamps trie nodes."""
+        if self.tenants is None:
+            return
+        self._refresh_weights()
+        self.cache.note_seq_tenant(req.request_id, req.params.tenant)
+
+    @holds_lock("_lock")
+    def _refresh_weights(self) -> None:
+        """Re-snapshot registry weights when its version moved; also
+        pushes prefix-share weights into the cache's weighted-eviction
+        view so both stay coherent with one registry version."""
+        reg = self.tenants
+        if reg is None or reg.version == self._weights_version:
+            return
+        # ptlint: disable=PT-C004  TenantRegistry sits BELOW Scheduler
+        # in lockgraph.json; wfq_weights() is a locked read, no re-entry
+        self._wfq_weights = reg.wfq_weights()
+        self._weights_version = reg.version
+        # ptlint: disable=PT-C004  same registry read as above
+        self.cache.set_tenant_weights(reg.prefix_shares())
+
+    @holds_lock("_lock")
+    def _full_price(self, req: Request) -> float:
+        """WFQ price of a request: jaxplan FLOPs of its FULL effective
+        prompt (quadratic — an 8k prompt charges its attention cost, not
+        one ticket), flat tokens without a cost model. Deliberately NOT
+        the per-step admission price (which sees only the first chunk /
+        uncached suffix): fairness is about total work commanded."""
+        n = len(req.prompt_ids) + len(req.output_ids)
+        cost_model = self.config.prefill_cost_model
+        # ptlint: disable=PT-C004  admission cost model (see backlog())
+        return float(cost_model.cost(n)) if cost_model else float(n)
+
+    @holds_lock("_lock")
+    def _deadline_early_reject(self, req: Request) -> None:
+        """Static admission check: at the measured service rate, can
+        this request's prefill even START before its deadline? The bound
+        is optimistic (queue-ahead cost at full budget throughput, zero
+        decode time), so a rejection is a certainty, not a guess; raises
+        EngineOverloaded with a retry_after_s hint sized to the excess.
+        Abstains entirely when there is no registry (single-tenant
+        stacks keep their historical semantics: overdue work is expired
+        by TTL, not refused at the door) or no measured rate yet."""
+        if self.tenants is None or self._step_ewma <= 0.0:
+            return
+        deadline = req.params.deadline_s
+        if deadline is None:
+            # ptlint: disable=PT-C004  TenantRegistry sits BELOW
+            # Scheduler in lockgraph.json; resolve() is a locked read
+            cfg = self.tenants.resolve(req.params.tenant)
+            deadline = cfg.deadline_slo_s
+        if deadline is None:
+            return
+        cost_model = self.config.prefill_cost_model
+        # ptlint: disable=PT-C004  admission cost model (see backlog())
+        budget = cost_model.budget(self.config.max_prefill_tokens) \
+            if cost_model else float(self.config.max_prefill_tokens)
+        ahead = sum(self._full_price(w) for w in self.waiting)
+        own = self._full_price(req)
+        steps = max(1.0, (ahead + own) / max(budget, 1.0))
+        est = steps * self._step_ewma
+        if est <= deadline:
+            return
+        self.deadline_rejects += 1
+        retry = round(est - deadline + self._step_ewma, 3)
+        reqtrace.record("rejected", req.tid, req.request_id,
+                        reason="deadline", deadline_s=deadline,
+                        estimate_s=round(est, 3),
+                        tenant=req.params.tenant)
+        raise EngineOverloaded(req.request_id, len(self.waiting),
+                               self.config.max_waiting or 0,
+                               retry_after_s=retry)
+
+    @holds_lock("_lock")
+    def _select_waiting(self) -> Request:
+        """WFQ head selection: per-tenant FCFS heads (first waiting
+        request of each tenant, in arrival order — intra-tenant order is
+        inviolable), ranked by virtual finish time F = max(vtime,
+        vfinish[tenant]) + price/weight, ties broken by arrival ticket.
+        With zero or one active tenant this returns self.waiting[0]
+        unconditionally — the exact object the historical FCFS path
+        would take, so single-tenant scheduling stays bitwise-identical."""
+        heads: dict = {}
+        for req in self.waiting:
+            t = req.params.tenant
+            if t not in heads:
+                heads[t] = req
+        if len(heads) <= 1:
+            return self.waiting[0]
+        self._refresh_weights()
+        best = None
+        best_key = None
+        for t, req in heads.items():
+            w = max(self._wfq_weights.get(t, 1.0), 1e-9)
+            start = max(self._vtime, self._vfinish.get(t, 0.0))
+            key = (start + self._full_price(req) / w, req.arrival)
+            if best is None or key < best_key:
+                best, best_key = req, key
+        return best
+
+    @holds_lock("_lock")
+    def _dequeue(self, req: Request) -> None:
+        """Remove the admitted request from the waiting queue (the WFQ
+        head need not be the deque head) and advance the virtual clock:
+        the tenant's vfinish absorbs the full price over its weight, and
+        vtime moves to the admission's virtual start so idle tenants
+        re-enter at the current clock instead of a stale past."""
+        if self.waiting and self.waiting[0] is req:
+            self.waiting.popleft()
+        else:
+            self.waiting.remove(req)
+        if self.tenants is None:
+            return
+        self._refresh_weights()
+        t = req.params.tenant
+        w = max(self._wfq_weights.get(t, 1.0), 1e-9)
+        start = max(self._vtime, self._vfinish.get(t, 0.0))
+        self._vfinish[t] = start + self._full_price(req) / w
+        self._vtime = start
+
     def schedule(self) -> ScheduledBatch:
         with self._lock:
             return self._schedule_locked()
@@ -638,7 +824,8 @@ class Scheduler:
         admitted = 0
         while self.waiting and len(self.running) \
                 < self.config.max_num_seqs:
-            req = self.waiting[0]
+            req = self.waiting[0] if self.tenants is None \
+                else self._select_waiting()
             tokens = req.all_token_ids()
             # prefix caching: probe the longest cached prefix first —
             # a hit is admitted CHUNKED regardless of length (the
@@ -705,7 +892,7 @@ class Scheduler:
                                     blocks=dd)
                 req.pf_target = len(tokens)
                 req.prefill_pos = got
-                self.waiting.popleft()
+                self._dequeue(req)
                 req.state = RequestState.RUNNING
                 self.running.append(req)
                 # rides THIS step's fused decode dispatch: first chunk
@@ -733,7 +920,7 @@ class Scheduler:
                     reqtrace.record("demote", req.tid, req.request_id,
                                     blocks=dd)
                 self.cache.note_prefix_miss(len(tokens))
-                self.waiting.popleft()
+                self._dequeue(req)
                 req.state = RequestState.RUNNING
                 self.running.append(req)
                 batch.prefill.append(req)

@@ -1,0 +1,242 @@
+"""Multi-tenant serving: tenants as first-class scheduling objects.
+
+ROADMAP direction 4 ("heavy traffic from millions of users") needs more
+than one anonymous FCFS queue: different callers have different latency
+contracts, different traffic shapes, and different willingness to pay
+for prefill FLOPs. This module gives the serving stack the registry
+half of that story; scheduler.py consumes it for weighted-fair-queuing
+admission, paged_cache.py for share-weighted prefix-trie eviction, and
+autoscaler.py for per-tenant pressure signals.
+
+A `TenantConfig` carries everything admission needs to price one
+tenant's work:
+
+- `priority` class (PRIORITY_CLASSES) and `weight`: the tenant's fair
+  share of admission FLOPs. The WFQ share is `weight` scaled by the
+  class multiplier, and "FLOPs" means jaxplan-priced prefill cost
+  (analysis/jaxplan.PrefillCostModel) — one 8k prompt charges its
+  quadratic attention cost against the share, not "one request".
+- `quota_tokens` per `quota_window_s`: a sliding-window token budget
+  (prompt + max_tokens, charged at admission, refunded if admission
+  ultimately refuses). Exhaustion rejects with a `retry_after_s` hint
+  computed from the window — the same backpressure shape as
+  EngineOverloaded, and in fact raised AS one (TenantQuotaExceeded)
+  so router retry plumbing needs no new except arms.
+- `ttft_slo_s` / `deadline_slo_s`: the tenant's latency contract. The
+  scheduler uses the deadline for static early reject (a request that
+  provably cannot meet it at the measured service rate is refused at
+  admission, never after burning prefill); the autoscaler gates fleet
+  growth on the TTFT SLO.
+- `prefix_share`: the tenant's weight in prefix-cache eviction — one
+  tenant's templates cannot evict everyone else's cached blocks beyond
+  this share (paged_cache._evict_cached).
+
+The registry is shared fleet-wide: one TenantRegistry instance rides
+`EngineConfig.tenants` into every replica's engine (dataclasses.replace
+copies the reference), so quota and fairness are fleet-level facts, not
+per-replica ones.
+
+Thread contract (ptlint PT-C001 via _GUARDED_BY): the registry is read
+at every admission from intake threads and engine step loops; all
+mutable state lives under self._lock. Lock order (lockgraph.json):
+TenantRegistry._lock is acquired under Scheduler._lock (admission
+consults shares) and LLMEngine._lock (quota charge) and takes nothing
+itself, so it slots after Scheduler._lock in the declared order.
+
+Single-tenant neutrality: a stack built WITHOUT a registry (the
+default) never touches this module, and a registry holding only the
+default tenant degenerates WFQ to FCFS — both pinned bitwise-identical
+to the historical scheduler by tests/test_tenancy.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from .scheduler import EngineOverloaded
+
+__all__ = ["DEFAULT_TENANT", "PRIORITY_CLASSES", "TenantConfig",
+           "TenantQuotaExceeded", "TenantRegistry"]
+
+DEFAULT_TENANT = "default"
+
+# priority class -> WFQ weight multiplier. Classes are coarse knobs on
+# top of the per-tenant weight: `batch` tenants cede admission FLOPs to
+# `standard`, which cedes to `latency`.
+PRIORITY_CLASSES = {"batch": 0.25, "standard": 1.0, "latency": 4.0}
+
+
+class TenantQuotaExceeded(EngineOverloaded):
+    """A tenant's sliding-window token quota is spent. Subclasses
+    EngineOverloaded so every existing backpressure path (router
+    retry loop, client retry_after_s plumbing, stats.rejected) handles
+    it unchanged; `depth`/`limit` carry window spend / quota."""
+
+    def __init__(self, request_id, tenant: str, spent: int, quota: int,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(request_id, spent, quota,
+                         retry_after_s=retry_after_s)
+        self.tenant = tenant
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's scheduling contract (module docstring)."""
+    name: str
+    priority: str = "standard"           # PRIORITY_CLASSES key
+    weight: float = 1.0                  # WFQ share within the class
+    quota_tokens: Optional[int] = None   # tokens per window (None = ∞)
+    quota_window_s: float = 60.0
+    ttft_slo_s: Optional[float] = None   # autoscaler growth gate
+    deadline_slo_s: Optional[float] = None  # static early-reject bound
+    prefix_share: float = 1.0            # trie-eviction share weight
+
+    def __post_init__(self):
+        if self.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"tenant {self.name!r}: priority {self.priority!r} not "
+                f"in {tuple(PRIORITY_CLASSES)}")
+        if self.weight <= 0 or self.prefix_share <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight and prefix_share must "
+                f"be positive")
+        if self.quota_tokens is not None and self.quota_tokens <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: quota_tokens must be positive "
+                f"or None")
+        if self.quota_window_s <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: quota_window_s must be positive")
+
+    @property
+    def wfq_weight(self) -> float:
+        """Effective fair-share weight: class multiplier × weight."""
+        return PRIORITY_CLASSES[self.priority] * self.weight
+
+
+class TenantRegistry:
+    """Fleet-wide tenant table + sliding-window quota accounting.
+
+    `version` increments on every registration so consumers (the
+    scheduler's weight snapshot, the cache's eviction shares) can cache
+    derived views and refresh only on change.
+    """
+
+    _GUARDED_BY = {
+        "_tenants": "_lock",
+        "_spend": "_lock",
+        "version": "_lock",
+    }
+
+    def __init__(self, tenants=()):
+        self._lock = threading.RLock()
+        self._tenants: Dict[str, TenantConfig] = {
+            DEFAULT_TENANT: TenantConfig(DEFAULT_TENANT)}
+        # tenant -> deque[(monotonic_ts, tokens)] inside the window
+        self._spend: Dict[str, deque] = {}
+        self.version = 1
+        for cfg in tenants:
+            self.register(cfg)
+
+    # ------------------------------------------------------------ table
+    def register(self, cfg: TenantConfig) -> TenantConfig:
+        """Add or replace one tenant's config."""
+        if not isinstance(cfg, TenantConfig):
+            raise TypeError(f"expected TenantConfig, got {type(cfg)}")
+        with self._lock:
+            self._tenants[cfg.name] = cfg
+            self.version += 1
+            return cfg
+
+    def resolve(self, name: str) -> TenantConfig:
+        """Admission-time lookup; unknown tenants are refused loudly —
+        an unregistered id is a caller bug, not a new tenant."""
+        with self._lock:
+            cfg = self._tenants.get(name)
+            if cfg is None:
+                raise ValueError(
+                    f"unknown tenant {name!r}; registered: "
+                    f"{sorted(self._tenants)}")
+            return cfg
+
+    def names(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._tenants))
+
+    def wfq_weights(self) -> Dict[str, float]:
+        """Snapshot of effective WFQ weights (scheduler refresh)."""
+        with self._lock:
+            return {n: c.wfq_weight for n, c in self._tenants.items()}
+
+    def prefix_shares(self) -> Dict[str, float]:
+        """Snapshot of trie-eviction shares (cache refresh)."""
+        with self._lock:
+            return {n: c.prefix_share for n, c in self._tenants.items()}
+
+    # ------------------------------------------------------------ quota
+    def charge(self, name: str, tokens: int,
+               now: Optional[float] = None) -> None:
+        """Charge `tokens` against the tenant's sliding window; raises
+        TenantQuotaExceeded (with a retry_after_s hint — when the
+        oldest window entry expires) once the window is spent. The
+        caller refunds on a downstream admission refusal so a rejected
+        request never burns quota."""
+        with self._lock:
+            cfg = self._tenants.get(name)
+            if cfg is None:
+                raise ValueError(f"unknown tenant {name!r}")
+            if cfg.quota_tokens is None:
+                return
+            now = time.monotonic() if now is None else now
+            window = self._spend.setdefault(name, deque())
+            horizon = now - cfg.quota_window_s
+            while window and window[0][0] <= horizon:
+                window.popleft()
+            spent = sum(t for _, t in window)
+            if spent + tokens > cfg.quota_tokens:
+                retry = round(window[0][0] - horizon, 3) if window \
+                    else round(cfg.quota_window_s, 3)
+                raise TenantQuotaExceeded(
+                    None, name, spent + tokens, cfg.quota_tokens,
+                    retry_after_s=max(retry, 0.001))
+            window.append((now, int(tokens)))
+
+    def refund(self, name: str, tokens: int) -> None:
+        """Return a charge whose admission was refused downstream (the
+        scheduler's queue bound or deadline early-reject fired after
+        quota accepted). Removes the most recent matching charge."""
+        with self._lock:
+            window = self._spend.get(name)
+            if not window:
+                return
+            for i in range(len(window) - 1, -1, -1):
+                if window[i][1] == tokens:
+                    del window[i]
+                    return
+            window.pop()
+
+    def window_spend(self, name: str,
+                     now: Optional[float] = None) -> int:
+        """Tokens charged inside the tenant's current window."""
+        with self._lock:
+            cfg = self._tenants.get(name)
+            window = self._spend.get(name)
+            if cfg is None or not window:
+                return 0
+            now = time.monotonic() if now is None else now
+            horizon = now - cfg.quota_window_s
+            return sum(t for ts, t in window if ts > horizon)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {n: {"priority": c.priority, "weight": c.weight,
+                        "wfq_weight": c.wfq_weight,
+                        "quota_tokens": c.quota_tokens,
+                        "quota_window_s": c.quota_window_s,
+                        "ttft_slo_s": c.ttft_slo_s,
+                        "deadline_slo_s": c.deadline_slo_s,
+                        "prefix_share": c.prefix_share}
+                    for n, c in sorted(self._tenants.items())}

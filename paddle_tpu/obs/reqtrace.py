@@ -39,8 +39,9 @@ __all__ = [
     "EVENT_KINDS", "TERMINAL_REASONS", "TraceEvent", "ReqTraceRing",
     "RING", "record", "events", "traces", "clear", "enable", "disable",
     "is_enabled", "arm", "disarm", "flight_dump", "maybe_flight",
-    "dump_payload", "group_traces", "ttft_components",
-    "ttft_decomposition", "check_causality",
+    "dump_payload", "bind_tenant", "group_traces", "ttft_components",
+    "ttft_decomposition", "ttft_by_tenant", "trace_tenants",
+    "check_causality",
 ]
 
 # Catalog of event kinds; ``record`` rejects anything else so the dump
@@ -65,6 +66,9 @@ EVENT_KINDS = (
     "promote",        # host-resident prefix filled back to device
     "promote_abort",  # promotion degraded (timeout|integrity|raced)
     "peer_fetch",     # prefix blocks pulled from a peer replica
+    "rejected",       # admission refused: quota | deadline (terminal
+                      # for the refused attempt; a router retry may
+                      # still admit the trace elsewhere)
     "finish",         # terminal: stop|length|cancelled|timeout|shed|error
 )
 _KIND_SET = frozenset(EVENT_KINDS)
@@ -113,6 +117,7 @@ class ReqTraceRing:
         "_flight_limit": "_lock",
         "_flight_count": "_lock",
         "_dumps": "_lock",
+        "_tenants": "_lock",
     }
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
@@ -125,6 +130,12 @@ class ReqTraceRing:
         self._flight_limit = 0
         self._flight_count = 0
         self._dumps: List[str] = []
+        # trace_id -> tenant: bound once at admission so EVERY event on
+        # the timeline auto-carries the tag without threading a tenant
+        # kwarg through ~30 record sites. Insertion-ordered dict, capped
+        # at 2x ring capacity (oldest bindings dropped with their
+        # long-rotated-out events).
+        self._tenants: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     # recording / reading
@@ -137,10 +148,29 @@ class ReqTraceRing:
             raise ValueError(f"unknown reqtrace event kind: {kind!r}")
         ts = time.perf_counter()
         with self._lock:
+            # auto-attach the bound tenant tag (explicit kwarg wins)
+            if "tenant" not in attrs:
+                t = self._tenants.get(str(trace_id))
+                if t is not None:
+                    attrs["tenant"] = t
             self._seq += 1
             self._events.append(TraceEvent(
                 self._seq, ts, str(trace_id), request_id, kind,
                 attrs or None))
+
+    def bind_tenant(self, trace_id: str, tenant: str) -> None:
+        """Bind a tenant to a trace id: every later event on the trace
+        auto-carries ``tenant`` in its attrs (multi-tenant stacks bind
+        at admission; single-tenant stacks never call this and their
+        events stay untagged, byte-identical to the pre-tenancy dump
+        schema)."""
+        if tenant is None:
+            return
+        with self._lock:
+            self._tenants[str(trace_id)] = str(tenant)
+            cap = 2 * self.capacity
+            while len(self._tenants) > cap:
+                self._tenants.pop(next(iter(self._tenants)))
 
     def events(self, trace_id: Optional[str] = None,
                prefix: Optional[str] = None) -> List[TraceEvent]:
@@ -165,6 +195,7 @@ class ReqTraceRing:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._tenants.clear()
 
     def __len__(self) -> int:
         with self._lock:
@@ -276,6 +307,10 @@ RING = ReqTraceRing()
 def record(kind: str, trace_id: str, request_id: Optional[str] = None,
            **attrs) -> None:
     RING.record(kind, trace_id, request_id=request_id, **attrs)
+
+
+def bind_tenant(trace_id: str, tenant: str) -> None:
+    RING.bind_tenant(trace_id, tenant)
 
 
 def events(trace_id: Optional[str] = None,
@@ -408,14 +443,51 @@ def ttft_decomposition(event_dicts: Iterable[Dict[str, Any]]
             "first_gap_s": med("first_gap_s"), "ttft_s": med("ttft_s")}
 
 
+def trace_tenants(event_dicts: Iterable[Dict[str, Any]]
+                  ) -> Dict[str, Optional[str]]:
+    """trace_id → tenant tag (first ``tenant`` attr seen on the trace;
+    None for untagged single-tenant traces)."""
+    out: Dict[str, Optional[str]] = {}
+    for e in event_dicts:
+        tid = e["trace_id"]
+        if out.get(tid) is None:
+            out.setdefault(tid, None)
+            t = (e.get("attrs") or {}).get("tenant")
+            if t is not None:
+                out[tid] = t
+    return out
+
+
+def ttft_by_tenant(event_dicts: Iterable[Dict[str, Any]]
+                   ) -> Dict[str, Dict[str, float]]:
+    """Per-tenant median TTFT decomposition (the fairness debugger):
+    traces are bucketed by their tenant tag (untagged → "default") and
+    each bucket gets its own :func:`ttft_decomposition` aggregate."""
+    events = list(event_dicts)
+    tenant_of = trace_tenants(events)
+    buckets: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        t = tenant_of.get(e["trace_id"]) or "default"
+        buckets.setdefault(t, []).append(e)
+    out: Dict[str, Dict[str, float]] = {}
+    for t, evts in sorted(buckets.items()):
+        decomp = ttft_decomposition(evts)
+        if decomp:
+            out[t] = decomp
+    return out
+
+
 def check_causality(dump: Dict[str, Any]) -> List[str]:
     """Machine-check the causal invariants over a dump. Returns a list
     of violation strings (empty == pass).
 
     1. no token emission before (re-)prefill completes;
     2. requeue preserves the FCFS arrival ticket, per-engine admission
-       stays FCFS among simultaneously-waiting requests, and failover
-       re-admission batches stay arrival-ordered;
+       stays FCFS among simultaneously-waiting requests OF THE SAME
+       TENANT (events carry tenant tags on multi-tenant stacks; WFQ
+       may legally reorder across tenants, never within one — untagged
+       single-tenant dumps collapse to the historical per-engine
+       check), and failover re-admission batches stay arrival-ordered;
     3. exactly one terminal event per trace (at most one for in-flight
        dumps marked ``complete: false``);
     4. every failover hop references a real predecessor: a ``readmit``
@@ -438,9 +510,13 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
     violations: List[str] = []
     by_trace = group_traces(dump.get("events", []))
 
-    # per-engine FCFS state: engine label -> {trace_id: arrival}
-    waiting: Dict[str, Dict[str, float]] = {}
+    # FCFS simulation keyed by (engine, tenant): WFQ reorders ACROSS
+    # tenants legally, so each tenant's queue is checked independently.
+    # Untagged (pre-tenancy / single-tenant) dumps have tenant None
+    # everywhere, collapsing to the historical per-engine global check.
+    waiting: Dict[Any, Dict[str, float]] = {}
     engine_of: Dict[str, str] = {}
+    tenant_of: Dict[str, Optional[str]] = {}
     all_events = sorted((e for e in dump.get("events", [])),
                         key=lambda e: e["seq"])
     readmit_batches: Dict[Any, List[Dict[str, Any]]] = {}
@@ -448,37 +524,45 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
     for e in all_events:
         tid, kind = e["trace_id"], e["kind"]
         a = e.get("attrs") or {}
+        if "tenant" in a and tenant_of.get(tid) is None:
+            tenant_of[tid] = a["tenant"]
         if kind == "engine_admit":
             eng = a.get("engine", "?")
             engine_of[tid] = eng
             if "arrival" in a:
-                waiting.setdefault(eng, {})[tid] = a["arrival"]
+                key = (eng, tenant_of.get(tid))
+                waiting.setdefault(key, {})[tid] = a["arrival"]
         elif kind in ("preempt", "requeue"):
             eng = engine_of.get(tid)
             if eng is not None and "arrival" in a:
-                waiting.setdefault(eng, {})[tid] = a["arrival"]
+                key = (eng, tenant_of.get(tid))
+                waiting.setdefault(key, {})[tid] = a["arrival"]
         elif kind == "scheduled":
             eng = engine_of.get(tid)
             if eng is not None:
-                mine = waiting.get(eng, {}).pop(tid, None)
+                key = (eng, tenant_of.get(tid))
+                mine = waiting.get(key, {}).pop(tid, None)
                 if mine is not None:
                     ahead = [(w, arr) for w, arr
-                             in waiting.get(eng, {}).items()
+                             in waiting.get(key, {}).items()
                              if arr < mine]
                     if ahead:
                         w, arr = min(ahead, key=lambda p: p[1])
+                        tenant = tenant_of.get(tid)
+                        scope = f"tenant {tenant!r} on {eng}" \
+                            if tenant is not None else f"{eng}"
                         violations.append(
                             f"{tid}: scheduled (ticket {mine}) while "
                             f"{w} (ticket {arr}) was still waiting on "
-                            f"{eng} — FCFS order broken")
-        elif kind in ("finish", "failover", "migrate_out"):
+                            f"{scope} — FCFS order broken")
+        elif kind in ("finish", "failover", "migrate_out", "rejected"):
             # migrate_out leaves the per-engine FCFS simulation the same
             # way failover does: the request is gone from this engine
             # (a drained WAITING request re-enters it via the
             # engine_admit its re-dispatch emits on the new engine)
             eng = engine_of.get(tid)
             if eng is not None:
-                waiting.get(eng, {}).pop(tid, None)
+                waiting.get((eng, tenant_of.get(tid)), {}).pop(tid, None)
         elif kind == "migrate_in" and "engine" in a:
             # adopted straight into RUNNING: re-home the trace without a
             # waiting entry — migrated requests never queue again
@@ -497,6 +581,7 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
     for tid, evts in sorted(by_trace.items()):
         prefilled = False
         finishes = 0
+        rejected = False
         last_failover_replica = None
         pending_migration = None
         ticket = None
@@ -573,6 +658,11 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
                         f"{tid}: readmit claims predecessor replica "
                         f"{a.get('from_replica')} but the failover was "
                         f"on replica {last_failover_replica}")
+            elif kind == "rejected":
+                # terminal for the refused ATTEMPT: a router retry may
+                # still admit the trace elsewhere, so this only waives
+                # the finish requirement when nothing else happened
+                rejected = True
             elif kind == "finish":
                 finishes += 1
                 abort_open = False      # terminal resolves the abort
@@ -588,7 +678,7 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
             violations.append(
                 f"{tid}: {finishes} terminal events (expected exactly "
                 f"one)")
-        elif finishes == 0 and complete:
+        elif finishes == 0 and complete and not rejected:
             violations.append(
                 f"{tid}: no terminal event in a complete dump")
     return violations

@@ -208,12 +208,17 @@ def instrument_obs(witness: LockWitness, registry=None, ring=None
 
 
 def instrument_engine(engine, witness: LockWitness) -> None:
-    """Trace one LLMEngine's lock, its scheduler's, and — when the
-    paged pool carries a host KV tier — the HostTierStore's leaf
-    lock."""
+    """Trace one LLMEngine's lock, its scheduler's, the shared
+    TenantRegistry's (multi-tenant stacks only; the registry threads
+    ONE lock through every engine that shares it, so the swap is
+    idempotent), and — when the paged pool carries a host KV tier —
+    the HostTierStore's leaf lock."""
     _swap(engine, "_lock", "LLMEngine._lock", witness)
     if getattr(engine, "scheduler", None) is not None:
         _swap(engine.scheduler, "_lock", "Scheduler._lock", witness)
+    tenants = getattr(getattr(engine, "config", None), "tenants", None)
+    if tenants is not None:
+        _swap(tenants, "_lock", "TenantRegistry._lock", witness)
     cache = getattr(engine, "cache", None)
     if cache is not None and getattr(cache, "host_tier", None) \
             is not None:
@@ -242,4 +247,14 @@ def instrument_fleet(rs, witness: LockWitness, obs_too: bool = True
             rep._factory = traced_factory
     if obs_too:
         instrument_obs(witness)
+    return witness
+
+
+def instrument_autoscaler(asc, witness: LockWitness) -> LockWitness:
+    """Trace an Autoscaler and the fleet it manages. The autoscaler's
+    lock is the OUTERMOST serving lock (lockgraph.json), so every
+    control action it enacts witnesses the full
+    Autoscaler -> ReplicaSet -> ... nesting."""
+    _swap(asc, "_lock", "Autoscaler._lock", witness)
+    instrument_fleet(asc.rs, witness)
     return witness

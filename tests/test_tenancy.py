@@ -1,0 +1,385 @@
+"""Multi-tenant SLO-aware scheduling + the role-aware autoscaler
+(paddle_tpu/inference/serving/{tenancy,autoscaler}.py and the WFQ
+admission path in scheduler.py).
+
+The load-bearing pins:
+- single-tenant serving is BITWISE-identical to the historical FCFS
+  path (greedy AND seeded-stochastic): a stack with no registry and a
+  stack with only the default tenant emit the same tokens in the same
+  finish order;
+- WFQ may reorder ACROSS tenants (latency-class work overtakes batch
+  backlog) but NEVER within one — intra-tenant order is FCFS, and the
+  reqtrace causality checker catches a synthetic violation;
+- sliding-window token quotas charge worst-case at admission, refund
+  on downstream rejection, and refuse with an actionable retry_after_s;
+- per-tenant prefix-cache accounting reconciles exactly: lifetime
+  tenant_inserted - tenant_removed == live trie census == the
+  serving_prefix_cache_blocks{tenant} gauge, through 200 requests of
+  two-tenant eviction churn;
+- the autoscaler policy is a pure function of its signal snapshot, and
+  the Autoscaler's enactments ride the PR-15 lossless lifecycle:
+  shrink = evacuating drain, grow = warmup-probe rejoin.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu import obs
+from paddle_tpu.inference.serving import (
+    Autoscaler, AutoscalerConfig, AutoscalerPolicy, EngineConfig,
+    EngineOverloaded, LLMEngine, ReplicaSet, RouterConfig,
+    SamplingParams, TenantConfig, TenantQuotaExceeded, TenantRegistry)
+
+VOCAB = 97
+
+
+def _model(max_seq=48):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=max_seq)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_num_seqs", 4)
+    # Same shapes as test_serving_disagg's engines, so in a full-suite
+    # session the compiled step functions are already warm.
+    kw.setdefault("decode_chunk_size", 2)
+    return LLMEngine.from_model(model, EngineConfig(**kw))
+
+
+def _drain(eng, max_steps=600):
+    finish_order = []
+    steps = 0
+    while eng.has_unfinished():
+        for out in eng.step():
+            if out.finished:
+                finish_order.append(out.request_id)
+        steps += 1
+        assert steps <= max_steps, "engine failed to drain"
+    return finish_order
+
+
+# ------------------------------------------------------------- tenancy
+def test_tenant_config_validation_and_weights():
+    assert TenantConfig("t", priority="latency", weight=2.0) \
+        .wfq_weight == pytest.approx(8.0)
+    assert TenantConfig("t", priority="batch").wfq_weight \
+        == pytest.approx(0.25)
+    with pytest.raises(ValueError):
+        TenantConfig("t", priority="realtime")
+    with pytest.raises(ValueError):
+        TenantConfig("t", weight=0.0)
+    with pytest.raises(ValueError):
+        TenantConfig("t", quota_tokens=0)
+
+
+def test_registry_quota_charge_refund_and_retry_hint():
+    reg = TenantRegistry([TenantConfig("q", quota_tokens=30,
+                                       quota_window_s=300.0)])
+    reg.charge("q", 20)
+    with pytest.raises(TenantQuotaExceeded) as ei:
+        reg.charge("q", 20)
+    assert ei.value.tenant == "q"
+    assert ei.value.retry_after_s is not None \
+        and ei.value.retry_after_s > 0
+    assert reg.window_spend("q") == 20
+    # refund (downstream rejection) reopens the window
+    reg.refund("q", 20)
+    reg.charge("q", 20)
+    # the default tenant is always resolvable and unmetered
+    assert reg.resolve("default").quota_tokens is None
+    with pytest.raises(ValueError):
+        reg.resolve("unregistered")
+
+
+# ----------------------------------------- single-tenant bitwise pins
+@pytest.mark.parametrize("sampling_kw", [
+    {},                                                        # greedy
+    {"temperature": 0.9, "top_k": 9, "top_p": 0.8},     # stochastic
+], ids=["greedy", "stochastic"])
+def test_single_tenant_bitwise_identical_to_fcfs(sampling_kw):
+    """A registry holding only the default tenant must change NOTHING:
+    same tokens, same finish order as the registry-free FCFS path."""
+    model = _model()
+    rng = np.random.RandomState(3)
+    specs = [(rng.randint(0, VOCAB, (int(rng.randint(3, 9)),),
+                          dtype=np.int32), int(rng.randint(4, 9)))
+             for _ in range(8)]
+
+    def run(tenants):
+        eng = _engine(model, max_num_seqs=2, tenants=tenants)
+        rids = [eng.add_request(p, SamplingParams(
+                    max_tokens=mt, seed=i, **sampling_kw))
+                for i, (p, mt) in enumerate(specs)]
+        order = _drain(eng)
+        return order, [list(eng.get_request(r).output_ids)
+                       for r in rids]
+
+    ref_order, ref_tokens = run(None)
+    wfq_order, wfq_tokens = run(TenantRegistry())
+    assert wfq_order == ref_order
+    assert wfq_tokens == ref_tokens
+
+
+# ------------------------------------------------------ WFQ admission
+def test_wfq_reorders_across_tenants_never_within():
+    """Saturate one slot with batch-class backlog, then submit
+    latency-class work: WFQ schedules the latency requests ahead of the
+    remaining batch queue, while each tenant's own requests finish in
+    their arrival order."""
+    model = _model()
+    reg = TenantRegistry([TenantConfig("bulk", priority="batch"),
+                          TenantConfig("fast", priority="latency")])
+    eng = _engine(model, max_num_seqs=1, tenants=reg)
+    rng = np.random.RandomState(0)
+    bulk = [eng.add_request(
+                rng.randint(0, VOCAB, (8,), dtype=np.int32),
+                SamplingParams(max_tokens=4, tenant="bulk"))
+            for _ in range(4)]
+    fast = [eng.add_request(
+                rng.randint(0, VOCAB, (4,), dtype=np.int32),
+                SamplingParams(max_tokens=4, tenant="fast"))
+            for _ in range(2)]
+    order = _drain(eng)
+    # intra-tenant FCFS is inviolable
+    assert [r for r in order if r in set(bulk)] == bulk
+    assert [r for r in order if r in set(fast)] == fast
+    # cross-tenant: the 4x-weight tenant overtakes queued batch work
+    # (bulk[0] may already hold the slot, but not the whole backlog)
+    assert order.index(fast[0]) < order.index(bulk[-1])
+
+
+def test_deadline_early_reject_is_certain_and_hinted():
+    model = _model()
+    reg = TenantRegistry([TenantConfig("dl", deadline_slo_s=0.001),
+                          TenantConfig("bg")])
+    eng = _engine(model, max_num_seqs=1, tenants=reg)
+    rng = np.random.RandomState(1)
+    for _ in range(4):
+        eng.add_request(rng.randint(0, VOCAB, (8,), dtype=np.int32),
+                        SamplingParams(max_tokens=4, tenant="bg"))
+    # no measured service rate yet -> the check abstains
+    ok = eng.add_request(rng.randint(0, VOCAB, (4,), dtype=np.int32),
+                         SamplingParams(max_tokens=2, tenant="dl"))
+    # with a measured rate, the optimistic bound says the deadline
+    # cannot be met -> refused at the door with a sized retry hint
+    eng.scheduler.note_step_seconds(0.5)
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.add_request(rng.randint(0, VOCAB, (4,), dtype=np.int32),
+                        SamplingParams(max_tokens=2, tenant="dl"))
+    assert not isinstance(ei.value, TenantQuotaExceeded)
+    assert ei.value.retry_after_s is not None \
+        and ei.value.retry_after_s > 0
+    assert eng.scheduler.deadline_rejects == 1
+    eng.cancel(ok)
+    _drain(eng)
+
+
+def test_engine_quota_charge_and_refund_on_downstream_reject():
+    """Admission charges worst-case (prompt + max_tokens) BEFORE the
+    scheduler can refuse; a downstream rejection must refund, so a
+    bounced request never burns its tenant's window."""
+    model = _model()
+    reg = TenantRegistry([TenantConfig("q", quota_tokens=40,
+                                       quota_window_s=300.0)])
+    eng = _engine(model, max_num_seqs=1, max_waiting=1,
+                  admission_policy="reject", tenants=reg)
+    rng = np.random.RandomState(2)
+    p = rng.randint(0, VOCAB, (6,), dtype=np.int32)
+    eng.add_request(p, SamplingParams(max_tokens=4, tenant="q"))  # 10
+    eng.step()            # move it WAITING -> RUNNING to free the queue
+    eng.add_request(p, SamplingParams(max_tokens=4, tenant="q"))  # 20
+    # queue-bound rejection: the 10-token charge must be refunded
+    with pytest.raises(EngineOverloaded):
+        eng.add_request(p, SamplingParams(max_tokens=4, tenant="q"))
+    assert reg.window_spend("q") == 20
+    # quota-bound rejection is typed, hinted, and charges nothing
+    with pytest.raises(TenantQuotaExceeded) as ei:
+        eng.add_request(p, SamplingParams(max_tokens=25, tenant="q"))
+    assert ei.value.retry_after_s is not None
+    assert reg.window_spend("q") == 20
+    _drain(eng)
+
+
+# ------------------------------------- per-tenant cache reconciliation
+def test_two_tenant_churn_reconciles_census_counters_and_gauge():
+    """200 requests of two-tenant templated churn through a pool small
+    enough to force weighted eviction: lifetime counters, live trie
+    census, and the per-tenant block gauge must agree exactly."""
+    model = _model()
+    reg = TenantRegistry([TenantConfig("a", prefix_share=3.0),
+                          TenantConfig("b", prefix_share=1.0)])
+    eng = _engine(model, num_blocks=24, max_num_seqs=4,
+                  enable_prefix_cache=True, tenants=reg)
+    rng = np.random.RandomState(4)
+    tpls = {t: rng.randint(0, VOCAB, (8,), dtype=np.int32)
+            for t in ("a", "b")}
+    live = 0
+    for i in range(200):
+        t = "a" if i % 2 == 0 else "b"
+        sfx = rng.randint(0, VOCAB, (int(rng.randint(2, 5)),),
+                          dtype=np.int32)
+        eng.add_request(np.concatenate([tpls[t], sfx]),
+                        SamplingParams(max_tokens=3, tenant=t))
+        live += 1
+        if live >= 4:
+            eng.step()
+            live = sum(1 for _ in [None] if eng.has_unfinished())
+            live = 0
+    _drain(eng, max_steps=2000)
+    audit = eng.cache.check_integrity()
+    assert audit["tenant_drift"] == 0
+    idx = eng.cache.prefix_index
+    census = idx.tenant_census()
+    for t in set(census) | set(idx.tenant_inserted):
+        assert idx.tenant_inserted.get(t, 0) \
+            - idx.tenant_removed.get(t, 0) == census.get(t, 0)
+    # the gauge the obs layer exports is the same census
+    stats = eng.cache.prefix_stats()
+    assert stats["tenant_blocks"] == idx.tenant_device_blocks()
+    for t, n in stats["tenant_blocks"].items():
+        assert eng.stats.prefix_tenant_blocks(t) == n
+    assert stats["evictions"] > 0, "churn never evicted: vacuous test"
+
+
+# ----------------------------------------------- reqtrace FCFS checker
+def _evt(seq, tid, kind, **attrs):
+    return {"seq": seq, "ts": float(seq), "trace_id": tid,
+            "request_id": tid, "kind": kind, "attrs": attrs}
+
+
+def test_check_causality_intra_tenant_fcfs_fixture():
+    """Synthetic dump: cross-tenant overtaking is legal, intra-tenant
+    overtaking is flagged."""
+    legal = {"complete": True, "events": [
+        _evt(1, "A1", "engine_admit", engine="e0", arrival=1.0,
+             tenant="a"),
+        _evt(2, "B1", "engine_admit", engine="e0", arrival=2.0,
+             tenant="b"),
+        _evt(3, "B1", "scheduled"),          # overtakes tenant a: legal
+        _evt(4, "A1", "scheduled"),
+        _evt(5, "A1", "finish", reason="stop"),
+        _evt(6, "B1", "finish", reason="stop"),
+    ]}
+    assert obs.reqtrace.check_causality(legal) == []
+    violation = {"complete": True, "events": [
+        _evt(1, "A1", "engine_admit", engine="e0", arrival=1.0,
+             tenant="a"),
+        _evt(2, "A2", "engine_admit", engine="e0", arrival=2.0,
+             tenant="a"),
+        _evt(3, "A2", "scheduled"),          # same tenant: FCFS broken
+        _evt(4, "A1", "scheduled"),
+        _evt(5, "A1", "finish", reason="stop"),
+        _evt(6, "A2", "finish", reason="stop"),
+    ]}
+    out = obs.reqtrace.check_causality(violation)
+    assert any("FCFS" in v and "tenant 'a'" in v for v in out)
+
+
+def test_check_causality_rejected_is_terminal():
+    """A quota/deadline refusal ends the attempt: a complete dump with
+    a rejected-only trace must not be flagged as unfinished."""
+    dump = {"complete": True, "events": [
+        _evt(1, "R1", "rejected", reason="quota", tenant="q"),
+    ]}
+    assert obs.reqtrace.check_causality(dump) == []
+
+
+# ----------------------------------------------------------- autoscaler
+def _signals(**kw):
+    base = {"up": 2, "parked": 1, "waiting_total": 0, "free_frac": 1.0,
+            "ttft_p99": 0.0, "prefill_frac": 0.5,
+            "waiting_by_tenant": {}}
+    base.update(kw)
+    return base
+
+
+def test_autoscaler_policy_is_pure_and_role_aware():
+    pol = AutoscalerPolicy(AutoscalerConfig(
+        min_replicas=1, target_waiting_per_replica=4.0,
+        low_waiting_per_replica=1.0, min_headroom_frac=0.1,
+        ttft_p99_slo_s=0.5))
+    d = pol.decide(_signals(waiting_total=20))
+    assert (d["action"], d["reason"]) == ("grow", "queue_pressure")
+    d = pol.decide(_signals(free_frac=0.05))
+    assert (d["action"], d["reason"]) == ("grow", "block_headroom")
+    d = pol.decide(_signals(ttft_p99=0.9))
+    assert (d["action"], d["reason"]) == ("grow", "ttft_slo")
+    assert pol.decide(_signals(up=0))["reason"] == "below_min"
+    # parked slots exhausted -> pressure holds instead of growing
+    assert pol.decide(_signals(parked=0, waiting_total=20))["action"] \
+        == "hold"
+    # idle -> shrink, shedding the role OPPOSITE the measured bottleneck
+    d = pol.decide(_signals(waiting_total=0, prefill_frac=0.9))
+    assert (d["action"], d["role_pref"]) == ("shrink", "decode")
+    d = pol.decide(_signals(waiting_total=0, prefill_frac=0.1))
+    assert (d["action"], d["role_pref"]) == ("shrink", "prefill")
+    # idle but SLO-breached grows (latency debt beats idle capacity)
+    d = pol.decide(_signals(waiting_total=0, ttft_p99=0.9))
+    assert (d["action"], d["reason"]) == ("grow", "ttft_slo")
+
+
+def test_autoscaler_shrink_grow_on_live_fleet():
+    """Closed loop on a real 3-replica fleet: idle parks replicas down
+    to min through the evacuating drain; queue pressure probe-rejoins a
+    parked slot; cooldown spaces the actions; nothing is lost."""
+    model = _model()
+    rs = ReplicaSet.from_model(
+        model, RouterConfig(num_replicas=3),
+        engine_config=EngineConfig(block_size=4, num_blocks=32,
+                                   max_num_seqs=2, decode_chunk_size=2))
+    asc = Autoscaler(rs, AutoscalerConfig(
+        min_replicas=1, max_replicas=3, target_waiting_per_replica=2.0,
+        low_waiting_per_replica=1.0, cooldown_steps=2))
+    d = asc.step()
+    assert d["action"] == "shrink" and d["enacted"]
+    assert rs.num_up() == 2
+    rs.step()                # housekeeping parks the empty DRAINING slot
+    assert str(rs.states()[d["replica"]]) == "drained"
+    # cooldown holds the next two ticks
+    assert asc.step()["reason"] == "cooldown"
+    assert asc.step()["reason"] == "cooldown"
+    asc.step()                                   # second shrink -> min
+    rs.step()
+    assert rs.num_up() == 1 and asc.shrink_events == 2
+    asc.cooldown = 0
+    assert asc.step()["action"] == "hold"        # never below min
+    # pressure: flood the surviving slot, the autoscaler grows back
+    rng = np.random.RandomState(5)
+    rids = [rs.add_request(rng.randint(0, VOCAB, (4,), dtype=np.int32),
+                           SamplingParams(max_tokens=4))
+            for _ in range(8)]
+    asc.cooldown = 0
+    d = asc.step()
+    assert d["action"] == "grow" and d["enacted"]
+    assert rs.num_up() == 2 and asc.grow_events == 1
+    steps = 0
+    while rs.has_unfinished():
+        rs.step()
+        steps += 1
+        assert steps <= 600
+    for r in rids:
+        assert rs.get_request(r).finish_reason in ("stop", "length")
+
+
+def test_probe_rejoin_only_from_parked_state():
+    model = _model()
+    rs = ReplicaSet.from_model(
+        model, RouterConfig(num_replicas=2),
+        engine_config=EngineConfig(block_size=4, num_blocks=16,
+                                   max_num_seqs=2))
+    assert not rs.probe_grow(0)          # UP slot: nothing to rejoin
+    rs.drain(0, recompute=False)
+    rs.step()                # housekeeping parks the empty DRAINING slot
+    assert str(rs.states()[0]) == "drained"
+    assert rs.probe_grow(0)
+    assert str(rs.states()[0]) == "up"
+    # the rejoin probe left no residue in the slot it probed
+    audit = rs.check_integrity()
+    assert audit[0] is not None and audit[0]["leaked"] == 0

@@ -68,6 +68,25 @@ clean lock witness including the HostTierStore leaf lock.
 
     JAX_PLATFORMS=cpu python tools/chaos_serve.py --tiering --seed 0
 
+`--tenants` switches to the multi-tenant autoscaling harness
+(`run_chaos_tenants`): tenant-tagged traffic (WFQ admission, token
+quotas) flows through a 3-replica fleet with the telemetry-driven
+Autoscaler in the loop. The quiet opening parks one replica through an
+evacuating autoscale shrink; `kill_replica` then lands on a SERVING
+replica while the fleet is in that shrunken state — the one-survivor
+window autoscaling creates — and a quota-exhaustion burst slams the
+'burst' tenant's token window while the failover is still settling.
+Gates: zero lost requests across park/kill/rejoin, zero leaked blocks
+AND zero per-tenant census drift on every live pool
+(check_integrity's tenant reconciliation), intra-tenant FCFS verified
+from the recorded traces (reqtrace check_causality — WFQ may reorder
+ACROSS tenants, never within one), non-vacuous quota rejects, the
+shrink strictly before the kill and a probe-rejoin grow after it, and
+a clean lock witness that actually saw the Autoscaler and
+TenantRegistry locks.
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --tenants --seed 0
+
 `--prefix-cache` reruns either harness on TEMPLATED prompts with
 radix-trie block sharing enabled (docs/serving.md "Prefix caching") —
 multi-replica mode additionally routes by prefix affinity so the
@@ -824,6 +843,221 @@ def run_chaos_disagg(seed: int = 0, n_requests: int = 18,
     return report
 
 
+DEFAULT_TENANT_FAULTS = "kill_replica@26:1"
+
+
+def run_chaos_tenants(seed: int = 0, n_requests: int = 24,
+                      replicas: int = 3,
+                      faults: str = DEFAULT_TENANT_FAULTS,
+                      max_steps: int = 4000,
+                      witness_out: str = "") -> dict:
+    """One seeded multi-tenant autoscaling chaos run (module
+    docstring). The schedule is built so the fault lands in the window
+    the autoscaler itself creates: a quiet opening lets the idle-shrink
+    park replica 0 (evacuating drain), the kill then takes a SERVING
+    replica while the fleet is shrunken, and a quota-exhaustion burst
+    arrives while the failover is still settling — forcing a
+    probe-rejoin grow of the parked slot. Raises AssertionError on a
+    lost request, a leaked block or per-tenant census drift on any live
+    pool, an intra-tenant FCFS violation in the recorded traces, a
+    vacuous run (no shrink / no grow / no quota reject / kill before
+    the shrink), or a lock-order finding that misses the Autoscaler and
+    TenantRegistry locks."""
+    import time
+
+    from paddle_tpu import obs
+    from paddle_tpu.inference.serving import (
+        Autoscaler, AutoscalerConfig, EngineConfig, ReplicaSet,
+        RouterConfig, SamplingParams, TenantConfig, TenantQuotaExceeded,
+        TenantRegistry)
+    from paddle_tpu.testing.faults import ServingFaultInjector
+    from paddle_tpu.testing.locktrace import instrument_autoscaler
+
+    witness, predicted = _lock_witness()
+    model, cfg = _build_model()
+    rng = np.random.RandomState(seed)
+    obs.reqtrace.enable()
+
+    # three contracts: a latency tenant, a batch tenant, and a
+    # quota-bounded tenant whose burst is MEANT to overdraw its window
+    reg = TenantRegistry([
+        TenantConfig("alpha", priority="latency"),
+        TenantConfig("bulk", priority="batch"),
+        TenantConfig("burst", quota_tokens=80, quota_window_s=300.0),
+    ])
+    ecfg = EngineConfig(block_size=4, num_blocks=48, max_num_seqs=4,
+                        decode_chunk_size=2, max_waiting=64,
+                        enable_prefix_cache=True, tenants=reg)
+    rcfg = RouterConfig(num_replicas=replicas,
+                        heartbeat_timeout_s=0.02,
+                        backoff_base=0.01, backoff_max=0.05,
+                        backoff_jitter=0.0)
+
+    # arrival schedule keyed by ROUTER step. Steps 0..3 are silent so
+    # the idle-shrink parks a slot before any work exists; a
+    # latency/batch trickle then keeps the shrunken fleet busy through
+    # the scheduled kill; the burst-tenant flood (templated prompts —
+    # the trie census gates stay non-vacuous) lands two steps after it.
+    tpl = rng.randint(0, cfg.vocab_size, (8,), dtype=np.int32)
+    schedule = {}
+    # trickle arrivals every 2 steps past the kill step, so the fault
+    # hits a replica holding LIVE decodes and the failover is real
+    n_trickle = max(12, n_requests - 10)
+    for j in range(n_trickle):
+        tenant = "alpha" if j % 2 == 0 else "bulk"
+        plen = int(rng.randint(4, 8)) if tenant == "alpha" \
+            else int(rng.randint(10, 15))
+        p = rng.randint(0, cfg.vocab_size, (plen,), dtype=np.int32)
+        schedule.setdefault(4 + 2 * j, []).append(
+            (tenant, p, int(rng.randint(6, 11))))
+    n_burst = 10
+    for j in range(n_burst):
+        sfx = rng.randint(0, cfg.vocab_size,
+                          (int(rng.randint(2, 5)),), dtype=np.int32)
+        schedule.setdefault(28, []).append(
+            ("burst", np.concatenate([tpl, sfx]), 6))
+    last_arrival = max(schedule)
+
+    injector = ServingFaultInjector(faults)
+    kill_targets = sorted({(0 if arg is None or arg != arg else int(arg))
+                           for k, s, arg in injector.faults
+                           if k == "kill_replica"})
+    rs = ReplicaSet.from_model(model, rcfg, engine_config=ecfg,
+                               faults=injector)
+    asc = Autoscaler(rs, AutoscalerConfig(
+        min_replicas=max(1, replicas - 1), max_replicas=replicas,
+        target_waiting_per_replica=3.0, low_waiting_per_replica=1.0,
+        min_headroom_frac=0.05, cooldown_steps=4))
+    instrument_autoscaler(asc, witness)
+
+    rids, quota_rejects, retry_hints = {}, 0, []
+    submitted = 0
+    kill_obs = None
+    fleet_series = [(0, rs.num_up())]
+    step = 0
+    while step <= last_arrival or rs.has_unfinished():
+        for tenant, p, mt in schedule.get(step, ()):
+            submitted += 1
+            try:
+                rid = rs.add_request(
+                    p, SamplingParams(max_tokens=mt, tenant=tenant))
+                rids[(tenant, len(rids))] = rid
+            except TenantQuotaExceeded as e:
+                quota_rejects += 1
+                retry_hints.append(e.retry_after_s)
+        kills_before = sum(1 for k, _s in injector.fired_log
+                           if k == "kill_replica")
+        rs.step()
+        if sum(1 for k, _s in injector.fired_log
+               if k == "kill_replica") > kills_before:
+            kill_obs = {
+                "step": step,
+                "parked_at_kill": sum(
+                    1 for r in rs.replicas
+                    if str(rs.states()[r.index]) == "drained"),
+                "shrinks_before_kill": asc.shrink_events,
+            }
+        decision = asc.step()
+        if decision["enacted"]:
+            fleet_series.append((step, rs.num_up()))
+        step += 1
+        assert step <= max_steps, \
+            f"router failed to drain within {max_steps} steps"
+        if not any(r.has_unfinished() for r in rs.replicas) \
+                and rs.has_unfinished():
+            time.sleep(0.002)               # restart backoff pending
+    # the killed replica must restart and rejoin within the run: keep
+    # the housekeeping loop (and the autoscaler) ticking until it does
+    for idx in kill_targets:
+        while str(rs.states()[idx]) not in ("up", "drained"):
+            rs.step()
+            asc.step()
+            step += 1
+            assert step <= max_steps, \
+                f"killed replica {idx} failed to rejoin in " \
+                f"{max_steps} steps (state {rs.states()[idx]})"
+            time.sleep(0.002)
+
+    st = rs.router_stats()
+    p99 = rs.ttft_quantile(0.99)
+    unserved = sum(v for k, v in st["finish_reasons"].items()
+                   if k not in ("stop", "length"))
+    report = {
+        "seed": seed, "requests": submitted, "replicas": replicas,
+        "faults": faults, "fired": list(injector.fired_log),
+        "tenants": sorted(reg.names()),
+        "quota_rejects": quota_rejects,
+        "retry_after_hints": [round(h, 4) for h in retry_hints
+                              if h is not None],
+        "autoscaler": {"grow_events": asc.grow_events,
+                       "shrink_events": asc.shrink_events,
+                       "final_active": rs.num_up(),
+                       "fleet_series": fleet_series},
+        "kill": kill_obs,
+        "requeues": st["requeues"],
+        "finish_reasons": st["finish_reasons"],
+        "replica_states": {k: str(v)
+                           for k, v in st["replica_states"].items()},
+        "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
+                "reject_rate": round((unserved + quota_rejects)
+                                     / max(submitted, 1), 4)},
+    }
+    # 1. zero lost: every ADMITTED request is terminal and served —
+    #    across the autoscale park, the kill's failover, and the rejoin
+    lost = [k for k, r in rids.items()
+            if rs.get_request(r).finish_reason not in ("stop", "length")]
+    assert not lost, f"admitted requests not served after drain: {lost}"
+    # 2. zero leaked blocks AND zero per-tenant census drift on every
+    #    pool that is still live (parked slots keep their engine warm;
+    #    the killed slot's fresh incarnation audits clean by gate 5)
+    report["integrity"] = rs.check_integrity()
+    for idx, audit in report["integrity"].items():
+        assert audit is not None, \
+            f"replica {idx} ended the run without a live engine"
+        assert not audit.get("tenant_drift"), \
+            f"replica {idx}: per-tenant census drift {audit['tenant_drift']}"
+    # 3. quota enforcement was non-vacuous and actionable: the burst
+    #    tenant overdrew its window, every refusal carried a retry hint
+    assert quota_rejects > 0, \
+        "quota chaos run was vacuous: burst tenant never hit its window"
+    assert len(report["retry_after_hints"]) == quota_rejects, \
+        "quota refusal without a retry_after_s hint"
+    # 4. the autoscaler actually exercised both directions, and the kill
+    #    landed while the fleet was in the autoscale-shrunken state
+    assert asc.shrink_events >= 1, "no autoscale shrink happened"
+    assert asc.grow_events >= 1, \
+        "no probe-rejoin grow happened (burst should have forced one)"
+    assert kill_obs is not None, "kill_replica fault never fired"
+    assert kill_obs["shrinks_before_kill"] >= 1 \
+        and kill_obs["parked_at_kill"] >= 1, \
+        f"kill missed the shrunken-fleet window: {kill_obs}"
+    # 5. the killed replica rejoined
+    for idx in kill_targets:
+        assert str(rs.states()[idx]) in ("up", "drained"), \
+            f"killed replica {idx} did not rejoin " \
+            f"(state {rs.states()[idx]})"
+    # 6. intra-tenant FCFS, machine-checked over the recorded traces:
+    #    WFQ + failover may reorder ACROSS tenants, never within one
+    dump = {"reason": "tenants_chaos", "complete": True,
+            "events": [e.as_dict() for e in obs.reqtrace.events(
+                prefix=f"tr-{rs.label}-")]}
+    assert dump["events"], "reqtrace recorded nothing for this router"
+    violations = obs.reqtrace.check_causality(dump)
+    assert not violations, \
+        f"causality violations (incl. intra-tenant FCFS): {violations}"
+    report["causality_events"] = len(dump["events"])
+    # 7. lock-order witness — and it must have actually SEEN the two
+    #    locks this PR added to the order (a witness that never touched
+    #    them would vacuously pass)
+    _audit_witness(witness, predicted, report, spans_path=witness_out)
+    seen = " ".join(report["lockgraph"]["witnessed_edges"])
+    assert "Autoscaler._lock" in seen, \
+        "witness never saw Autoscaler._lock"
+    assert "TenantRegistry._lock" in seen, \
+        "witness never saw TenantRegistry._lock"
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -846,6 +1080,14 @@ def main(argv=None) -> int:
                          "sized below the working set, tier-targeted "
                          "faults (default "
                          f"{DEFAULT_TIERING_FAULTS!r})")
+    ap.add_argument("--tenants", action="store_true",
+                    help="multi-tenant autoscaling harness: WFQ-"
+                         "admitted tenant traffic, the autoscaler in "
+                         "the loop, a replica kill landing in the "
+                         "autoscale-shrunken window and a quota-"
+                         "exhaustion burst (default faults "
+                         f"{DEFAULT_TENANT_FAULTS!r}; --replicas "
+                         "defaults to 3)")
     ap.add_argument("--faults", default=None,
                     help="ServingFaultInjector spec (see testing/faults.py)")
     ap.add_argument("--cancel-every", type=int, default=0,
@@ -903,6 +1145,14 @@ def main(argv=None) -> int:
                 replicas=(args.replicas if args.replicas > 0 else 3),
                 faults=(args.faults if args.faults is not None
                         else DEFAULT_DISAGG_FAULTS),
+                max_steps=args.max_steps,
+                witness_out=args.witness_out)
+        elif args.tenants:
+            report = run_chaos_tenants(
+                seed=args.seed, n_requests=args.requests,
+                replicas=(args.replicas if args.replicas > 0 else 3),
+                faults=(args.faults if args.faults is not None
+                        else DEFAULT_TENANT_FAULTS),
                 max_steps=args.max_steps,
                 witness_out=args.witness_out)
         elif args.replicas > 0:

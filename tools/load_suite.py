@@ -83,6 +83,20 @@ Scenarios (docs/observability.md "Load suite"):
                  pass with `peer_prefix_fetch=True` must commit at
                  least one transactional peer prefix pull.
 
+- multi_tenant — three tenants against one FLOPs-priced WFQ engine
+                 (docs/serving.md "Multi-tenant scheduling and
+                 autoscaling"): 'bulk' floods long prompts at t=0,
+                 'latency' trickles small prompts in behind the flood,
+                 'burst' slams a templated burst into a token quota.
+                 Reports per-tenant tokens/TTFT and gates fairness
+                 (latency p50 <= bulk p50 despite arriving later) and
+                 non-vacuous quota rejects, zero lost.
+- autoscale_diurnal — trickle -> burst -> trickle arrivals against a
+                 4-replica fleet with the Autoscaler in the loop: the
+                 quiet phase must park capacity (evacuating drain), the
+                 burst must probe-rejoin it, nothing may be lost, and
+                 the witnessed lock graph (Autoscaler outermost) must
+                 stay clean.
 - disagg       — the mixed_prefill_decode traffic on a 4-replica
                  budget, run 2-prefill+2-decode (live KV-block handoff
                  at prefill completion, docs/serving.md "Disaggregated
@@ -126,7 +140,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
              "decode_heavy", "replica_kill", "mixed_prefill_decode",
-             "prefix_heavy", "tiered_prefix", "disagg")
+             "prefix_heavy", "tiered_prefix", "disagg",
+             "multi_tenant", "autoscale_diurnal")
 
 #: per-scenario SLOs. Latency bounds are generous (CPU-smoke friendly)
 #: — the point is catching regressions in KIND (rejects where none are
@@ -216,6 +231,33 @@ SLOS = {
     "disagg": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 10.0,
                "max_reject_rate": 0.0, "max_token_gap_p99_s": 4.0,
                "max_lost": 0, "min_migrations": 1},
+    # multi-tenant fairness (docs/serving.md "Multi-tenant scheduling
+    # and autoscaling"): three tenants share one FLOPs-priced WFQ
+    # engine — 'bulk' (priority batch) floods long prompts at t=0,
+    # 'latency' (priority latency) trickles small prompts in behind
+    # the flood, 'burst' slams a templated burst against a token
+    # quota. The fairness gate: the latency tenant's TTFT p50 must
+    # not exceed the bulk tenant's even though every latency request
+    # arrived AFTER the flood (under plain FCFS it necessarily
+    # would); the quota gate requires the burst tenant's overflow to
+    # be refused at admission (non-vacuous quotas), and nothing
+    # admitted may be lost
+    "multi_tenant": {"min_tokens_per_sec": 1.0, "max_ttft_p99_s": 8.0,
+                     "max_reject_rate": 0.35, "max_lost": 0,
+                     "max_tenant_p50_ratio": 1.0,
+                     "min_quota_rejects": 1},
+    # diurnal-ramp autoscaling: a 4-replica fleet under a trickle ->
+    # burst -> trickle arrival curve, the Autoscaler in the loop.
+    # The fleet must TRACK the load — at least one evacuating-drain
+    # shrink during the quiet phase and one probe-rejoin grow when
+    # the burst lands — with zero lost requests across the parks and
+    # rejoins, and the witnessed lock graph (Autoscaler outermost)
+    # clean
+    "autoscale_diurnal": {"min_tokens_per_sec": 1.0,
+                          "max_ttft_p99_s": 10.0,
+                          "max_reject_rate": 0.2, "max_lost": 0,
+                          "min_grow_events": 1,
+                          "min_shrink_events": 1},
 }
 
 CHAOS_FAULTS = "nan_logits@6,stall@9:0.05,cache_corrupt@12"
@@ -513,6 +555,152 @@ def _drive_router(model, ecfg, arrivals, replicas=REPLICA_COUNT,
     return rs, rids, submitted, rejected, wall
 
 
+def _tenant_workload(n: int, vocab: int, seed: int):
+    """multi_tenant spec: (ecfg-sans-registry, arrivals, mk_registry).
+    Arrivals are (step, prompt_ids, max_tokens, tenant); mk_registry
+    builds a FRESH TenantRegistry per pass so the warmup pass's quota
+    spend can't bleed into the measured pass's window."""
+    from paddle_tpu.inference.serving import (EngineConfig, TenantConfig,
+                                              TenantRegistry)
+    rng = np.random.RandomState(seed)
+    n = max(n, 16)
+    nb, nl = n // 2, n // 3
+    nq = n - nb - nl
+
+    def prompt(lo, hi):
+        return rng.randint(1, vocab, (int(rng.randint(lo, hi)),),
+                           dtype=np.int32)
+
+    # tight per-step prefill budget + FLOPs pricing: the bulk flood
+    # takes many steps to admit, which is exactly the window the
+    # latency tenant's WFQ weight must cut through
+    ecfg = EngineConfig(block_size=4, num_blocks=128, max_num_seqs=4,
+                        max_prefill_tokens=64, max_waiting=n,
+                        prefill_cost_model="auto",
+                        obs_label="load-multi-tenant")
+    arr = []
+    for _ in range(nb):                  # bulk: long-prompt flood at t=0
+        arr.append((0, prompt(40, 56), int(rng.randint(4, 7)), "bulk"))
+    for i in range(nl):                  # latency: trickle BEHIND it
+        arr.append((1 + 2 * i, prompt(4, 9),
+                    int(rng.randint(4, 7)), "latency"))
+    template = rng.randint(1, vocab, (24,), dtype=np.int32)
+    for _ in range(nq):                  # burst: templated, quota-bound
+        arr.append((2, np.concatenate([template, prompt(2, 5)]),
+                    int(rng.randint(4, 7)), "burst"))
+
+    def mk_registry():
+        reg = TenantRegistry()
+        reg.register(TenantConfig(name="latency", priority="latency"))
+        reg.register(TenantConfig(name="bulk", priority="batch"))
+        # ~2 burst admissions' worth of window: each request charges
+        # prompt (~27) + max_tokens (~5) up front, so the tail of the
+        # burst MUST be refused at the door (min_quota_rejects gate)
+        reg.register(TenantConfig(name="burst", quota_tokens=70,
+                                  quota_window_s=300.0))
+        return reg
+
+    return ecfg, arr, mk_registry
+
+
+def _drive_tenants(model, ecfg, arrivals, max_steps=4000, witness=None):
+    """multi_tenant driver: _drive's clock with tenant-tagged
+    submissions. Returns (engine, submitted, rejected, quota_rejects,
+    rids_by_tenant, wall_seconds)."""
+    from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                              TenantQuotaExceeded)
+    from paddle_tpu.inference.serving.scheduler import EngineOverloaded
+
+    eng = LLMEngine.from_model(model, ecfg)
+    if witness is not None:
+        from paddle_tpu.testing.locktrace import instrument_engine
+        instrument_engine(eng, witness)
+    queue = sorted(arrivals, key=lambda a: a[0])
+    i = submitted = rejected = quota_rejects = 0
+    rids_by_tenant = {}
+    step = 0
+    t0 = time.perf_counter()
+    while i < len(queue) or eng.has_unfinished():
+        while i < len(queue) and queue[i][0] <= step:
+            _, p, mt, tenant = queue[i]
+            i += 1
+            submitted += 1
+            try:
+                rid = eng.add_request(
+                    p, SamplingParams(max_tokens=mt, tenant=tenant))
+            except TenantQuotaExceeded:
+                quota_rejects += 1
+                rejected += 1
+            except EngineOverloaded:
+                rejected += 1
+            else:
+                rids_by_tenant.setdefault(tenant, []).append(rid)
+        if eng.has_unfinished():
+            eng.step()
+        step += 1
+        if step > max_steps:
+            raise RuntimeError(
+                f"scenario failed to drain within {max_steps} steps")
+    wall = time.perf_counter() - t0
+    eng.cache.check_integrity()          # zero-leak + tenant-drift audit
+    return eng, submitted, rejected, quota_rejects, rids_by_tenant, wall
+
+
+def _drive_autoscaled(model, ecfg, arrivals, witness=None,
+                      max_steps=6000, obs_label="load-autoscale"):
+    """autoscale_diurnal driver: a 4-replica fleet with the Autoscaler
+    ticking once per router step. Returns (router, autoscaler, rids,
+    submitted, rejected, wall_seconds, fleet_series) where
+    fleet_series samples (step, active_replicas) at every change."""
+    from paddle_tpu.inference.serving import (Autoscaler,
+                                              AutoscalerConfig,
+                                              ReplicaSet, RouterConfig,
+                                              SamplingParams)
+    from paddle_tpu.inference.serving.scheduler import EngineOverloaded
+
+    rc = RouterConfig(num_replicas=4, backoff_base=0.01,
+                      backoff_max=0.05, backoff_jitter=0.0,
+                      obs_label=obs_label)
+    rs = ReplicaSet.from_model(model, rc, engine_config=ecfg)
+    asc = Autoscaler(rs, AutoscalerConfig(
+        min_replicas=1, max_replicas=4,
+        target_waiting_per_replica=2.0, low_waiting_per_replica=1.0,
+        min_headroom_frac=0.05, cooldown_steps=3))
+    if witness is not None:
+        from paddle_tpu.testing.locktrace import instrument_autoscaler
+        instrument_autoscaler(asc, witness)
+    queue = sorted(arrivals, key=lambda a: a[0])
+    i = submitted = rejected = 0
+    step = 0
+    rids = []
+    series = [(0, rs.num_up())]
+    t0 = time.perf_counter()
+    while i < len(queue) or rs.has_unfinished():
+        while i < len(queue) and queue[i][0] <= step:
+            _, p, mt = queue[i]
+            i += 1
+            submitted += 1
+            try:
+                rids.append(rs.add_request(
+                    p, SamplingParams(max_tokens=mt)))
+            except EngineOverloaded:
+                rejected += 1
+        if rs.has_unfinished():
+            rs.step()
+        asc.step()
+        up = rs.num_up()
+        if up != series[-1][1]:
+            series.append((step, up))
+        step += 1
+        if step > max_steps:
+            raise RuntimeError(
+                f"scenario failed to drain within {max_steps} steps")
+    wall = time.perf_counter() - t0
+    for audit in rs.check_integrity().values():
+        assert audit is None or audit["leaked"] == 0
+    return rs, asc, rids, submitted, rejected, wall, series
+
+
 def _ttft_decomposition(label) -> dict:
     """Trace-derived TTFT decomposition for one engine/router instance
     (obs/reqtrace.py): median queue / admission / prefill /
@@ -668,6 +856,30 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
         if got < mig_min:
             viol.append(f"migrations {got} < {mig_min} "
                         "(prefill->decode handoff tiering was vacuous)")
+    ratio_max = slo.get("max_tenant_p50_ratio")
+    if ratio_max is not None:
+        ratio = metrics["tenant_fairness"]["p50_ratio"]
+        if ratio is None or ratio > ratio_max:
+            viol.append(
+                f"latency/bulk TTFT p50 ratio {ratio} > {ratio_max} "
+                "(WFQ failed to pull the latency tenant ahead of the "
+                "bulk flood)")
+    qr_min = slo.get("min_quota_rejects")
+    if qr_min is not None and metrics["quota_rejects"] < qr_min:
+        viol.append(f"quota_rejects {metrics['quota_rejects']} < "
+                    f"{qr_min} (token quota was vacuous)")
+    g_min = slo.get("min_grow_events")
+    if g_min is not None \
+            and metrics["autoscaler"]["grow_events"] < g_min:
+        viol.append(f"autoscaler grow_events "
+                    f"{metrics['autoscaler']['grow_events']} < {g_min} "
+                    "(burst never triggered a probe-rejoin)")
+    s_min = slo.get("min_shrink_events")
+    if s_min is not None \
+            and metrics["autoscaler"]["shrink_events"] < s_min:
+        viol.append(f"autoscaler shrink_events "
+                    f"{metrics['autoscaler']['shrink_events']} < "
+                    f"{s_min} (quiet phase never parked capacity)")
     lg = metrics.get("lockgraph")
     if lg is not None:
         # lock-order witness gate (docs/static_analysis.md "Runtime
@@ -755,6 +967,85 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
         model, cfg = _build_model()
     if n is None:
         n = 8 if fast else 24
+    if name == "multi_tenant":
+        import dataclasses
+        from paddle_tpu import obs as _obs
+        # three tenants, one WFQ engine: warmup compiles every bucket
+        # on a throwaway registry; the measured pass gets a fresh one
+        # (fresh quota window) and an instance-unique obs label
+        ecfg0, tarr, mk_registry = _tenant_workload(n, cfg.vocab_size,
+                                                    seed)
+        witness, predicted = _lock_witness()
+        _drive_tenants(model,
+                       dataclasses.replace(ecfg0,
+                                           tenants=mk_registry()),
+                       tarr, witness=witness)
+        mcfg = dataclasses.replace(ecfg0, tenants=mk_registry(),
+                                   obs_label="load-multi-tenant-meas")
+        eng, submitted, rejected, quota_rejects, by_tenant, wall = \
+            _drive_tenants(model, mcfg, tarr, witness=witness)
+        m = _metrics(eng, submitted, rejected, wall)
+        m["quota_rejects"] = quota_rejects
+        m["lost"] = sum(1 for rids in by_tenant.values() for r in rids
+                        if not eng.get_request(r).finished)
+        evts = [e.as_dict() for e in _obs.reqtrace.events(
+            prefix=f"tr-{eng.stats.label}-")]
+        ttfts = _obs.reqtrace.ttft_by_tenant(evts)
+        m["tenants"] = {}
+        for t, rids in sorted(by_tenant.items()):
+            m["tenants"][t] = {
+                "submitted": sum(1 for a in tarr if a[3] == t),
+                "admitted": len(rids),
+                "generated_tokens": sum(
+                    len(eng.get_request(r).output_ids) for r in rids),
+                "ttft_p50": round(ttfts[t]["ttft_s"], 4)
+                if t in ttfts else None,
+            }
+        lat = (ttfts.get("latency") or {}).get("ttft_s")
+        blk = (ttfts.get("bulk") or {}).get("ttft_s")
+        m["tenant_fairness"] = {
+            "latency_p50": round(lat, 4) if lat else None,
+            "bulk_p50": round(blk, 4) if blk else None,
+            "p50_ratio": round(lat / blk, 4) if lat and blk else None,
+        }
+        m["lockgraph"] = _lockgraph_report(witness, predicted)
+        return _slo_verdict(name, m)
+    if name == "autoscale_diurnal":
+        # diurnal curve: quiet trickle (the fleet must shed), one
+        # sharp burst (it must rejoin), quiet tail. Warmup runs the
+        # same curve so the probe-prompt prefill bucket and every
+        # workload bucket compile unmeasured
+        rng = np.random.RandomState(seed)
+        ecfg, _ = _arrivals("steady", n, cfg.vocab_size, seed)
+        ecfg.obs_label = "load-autoscale"
+        ecfg.decode_chunk_size = 2
+        ecfg.num_blocks = 48
+
+        def prompt(lo, hi):
+            return rng.randint(1, cfg.vocab_size,
+                               (int(rng.randint(lo, hi)),),
+                               dtype=np.int32)
+        darr = []
+        for i in range(6):               # quiet morning: trickle
+            darr.append((3 * i, prompt(4, 10), int(rng.randint(4, 8))))
+        for _ in range(max(n, 12)):      # noon burst, all at once
+            darr.append((30, prompt(4, 10), int(rng.randint(6, 10))))
+        for i in range(3):               # quiet tail
+            darr.append((55 + 3 * i, prompt(4, 10),
+                         int(rng.randint(4, 8))))
+        witness, predicted = _lock_witness()
+        _drive_autoscaled(model, ecfg, darr, witness=witness)
+        rs, asc, rids, submitted, rejected, wall, series = \
+            _drive_autoscaled(model, ecfg, darr, witness=witness)
+        m = _metrics_router(rs, rids, submitted, rejected, wall)
+        m["autoscaler"] = {
+            "grow_events": asc.grow_events,
+            "shrink_events": asc.shrink_events,
+            "final_active": rs.num_up(),
+            "fleet_series": series,
+        }
+        m["lockgraph"] = _lockgraph_report(witness, predicted)
+        return _slo_verdict(name, m)
     faults = CHAOS_FAULTS if name == "chaos_kill" else ""
     ecfg, arr = _arrivals(name, n, cfg.vocab_size, seed)
     if name == "replica_kill":

@@ -4,6 +4,9 @@
     python tools/reqtrace.py DUMP.json                 summary table
     python tools/reqtrace.py DUMP.json --timeline TID  one causal timeline
     python tools/reqtrace.py DUMP.json --ttft          TTFT decomposition
+                                                       (+ per-tenant p50
+                                                       rows when events
+                                                       carry tenant tags)
     python tools/reqtrace.py DUMP.json --check         causality invariants
     python tools/reqtrace.py DUMP.json --chrome OUT    per-request tracks
                             [--merge EXISTING.json]    ...appended to an
@@ -26,7 +29,10 @@ span IS the causal story).
 
 --check machine-verifies the causal invariants (no token emission
 before prefill completes, requeue preserves the FCFS arrival ticket
-and admission order, exactly-one terminal event per trace, every
+and admission order — per (engine, tenant) when events carry tenant
+tags, so WFQ's cross-tenant reordering is legal while intra-tenant
+FCFS stays machine-checked —, exactly-one terminal event per trace
+(a quota/deadline 'rejected' attempt waives that), every
 failover hop references a real predecessor replica, every migrate_in
 references the replica its migrate_out named and no decode emission
 lands between them) and exits 0/1 —
@@ -111,6 +117,16 @@ def print_ttft(dump: dict) -> None:
     if agg:
         print(f"{'p50':>12s}  " + "  ".join(
             f"{agg[k]:12.6f}" for k in hdr[1:]))
+    # per-tenant p50 rows, only when the dump carries tenant tags (a
+    # single-tenant stack never binds them, so its output is unchanged)
+    by_tenant = _rt.ttft_by_tenant(dump["events"])
+    if len(by_tenant) > 1 or (by_tenant
+                              and "default" not in by_tenant):
+        for tenant in sorted(by_tenant):
+            agg_t = by_tenant[tenant]
+            label = f"p50[{tenant}]"
+            print(f"{label:>12s}  " + "  ".join(
+                f"{agg_t[k]:12.6f}" for k in hdr[1:]))
 
 
 def _span_event(name, t0s, t1s, base, pid, tid):
