@@ -78,8 +78,15 @@ class AutoCheckpointManager:
     def __init__(self, save_dir: str, models=(), optimizers=(),
                  lr_schedulers=(), max_keep: int = 3,
                  save_interval_epochs: int = 1, async_save: bool = False,
-                 save_every_n_steps: Optional[int] = None):
+                 save_every_n_steps: Optional[int] = None,
+                 require_manifest: bool = False):
         self.save_dir = save_dir
+        # strict-manifest mode (serving/deploy.py publishes revisions
+        # through this): a snapshot with no checksums.json is treated as
+        # corrupt instead of tolerated — a deploy must never load
+        # weights it cannot verify. Default False keeps pre-manifest
+        # snapshots restorable for ordinary training resume.
+        self.require_manifest = bool(require_manifest)
         self.models = list(models)
         self.optimizers = list(optimizers)
         self.lr_schedulers = list(lr_schedulers)
@@ -326,12 +333,20 @@ class AutoCheckpointManager:
 
     def _verify_checksums(self, kind: str, idx: int, path: str):
         """Recompute every array digest of a snapshot and compare against
-        its checksums.json. Raises on any mismatch (missing manifest is
-        tolerated: pre-manifest snapshots stay restorable). The data is
+        its checksums.json. Raises on any mismatch. A missing manifest
+        is tolerated by default (pre-manifest snapshots stay restorable)
+        but is a hard error under require_manifest=True — the
+        strict-manifest mode published revisions (serving/deploy.py)
+        restore with, so unverifiable weights never deploy. The data is
         re-loaded with return_numpy=True so digests see exactly the bytes
         the manifest hashed at save time."""
         manifest_path = os.path.join(os.path.dirname(path), CHECKSUM_FILE)
         if not os.path.exists(manifest_path):
+            if self.require_manifest:
+                raise IOError(
+                    f"snapshot {kind}_{idx} has no {CHECKSUM_FILE} "
+                    f"manifest (require_manifest=True refuses "
+                    f"unverifiable weights)")
             return
         with open(manifest_path) as f:
             want = json.load(f)

@@ -47,6 +47,14 @@ PAPERS.md arxiv 2604.15464). Four cooperating modules:
                 role-aware fleet sizing: shrink via evacuating drain,
                 grow via warmup-probe rejoin, prefill:decode balance
                 from the measured phase split.
+- deploy:       ModelRegistry / DeployController — multi-model replica
+                pools over sha256-manifest checkpoint revisions, and
+                chaos-gated zero-downtime rolling weight deploys
+                (evacuating drain → swap → canary parity gate →
+                probe rejoin, with instant warm rollback and
+                revision-keyed KV so stale cache never serves new
+                weights; docs/serving.md "Multi-model serving and
+                rolling deploys").
 
 See docs/serving.md for architecture and tuning.
 """
@@ -69,6 +77,8 @@ from .tenancy import (TenantConfig, TenantQuotaExceeded,  # noqa: F401
                       TenantRegistry)
 from .autoscaler import (Autoscaler, AutoscalerConfig,  # noqa: F401
                          AutoscalerPolicy)
+from .deploy import (DeployConfig, DeployController,  # noqa: F401
+                     ModelRegistry, Revision)
 
 __all__ = [
     "PagedKVCache", "CacheExhausted", "EngineOverloaded",
@@ -83,4 +93,5 @@ __all__ = [
     "ReplicaSet", "RouterConfig", "RouterRequest",
     "TenantConfig", "TenantRegistry", "TenantQuotaExceeded",
     "Autoscaler", "AutoscalerConfig", "AutoscalerPolicy",
+    "DeployConfig", "DeployController", "ModelRegistry", "Revision",
 ]

@@ -40,6 +40,13 @@ role to grow or shrink: when prefill dominates, grow prefill-capable
 slots first and shrink decode slots first; when decode dominates, the
 reverse. Mixed slots are always eligible on both sides.
 
+Model-awareness (multi-model fleets, PR 18 / serving/deploy.py): the
+signal snapshot carries a per-model breakdown, growth lands in the
+HOTTEST model's pool (highest waiting per serving replica, among
+models with a parked slot), shrink drains the COLDEST — and never a
+model's last serving replica, so no pool ever scales to zero while
+registered. Single-model fleets see identical decisions to before.
+
 Thread contract (ptlint PT-C001 via _GUARDED_BY): `Autoscaler._lock`
 is the OUTERMOST lock in the serving stack — step() holds it while
 calling into ReplicaSet control surfaces, which take the router lock
@@ -202,17 +209,23 @@ class Autoscaler:
         total = 0
         t_prefill = 0.0
         t_decode = 0.0
+        by_model: Dict[str, Dict[str, int]] = {}
         for rep in rs.replicas:
+            ent = by_model.setdefault(
+                rep.model, {"up": 0, "parked": 0, "waiting": 0})
             if rep.state == ReplicaState.DRAINED:
                 parked += 1
+                ent["parked"] += 1
             if not rep.accepts_admissions():
                 continue
             up += 1
+            ent["up"] += 1
             eng = rep.engine
             if eng is None:
                 continue
             info = rep.load_info()
             waiting_total += info["waiting"]
+            ent["waiting"] += info["waiting"]
             free += info["free_blocks"]
             total += eng.cache.num_blocks
             for t, n in eng.waiting_by_tenant().items():
@@ -230,6 +243,9 @@ class Autoscaler:
             # in lockgraph.json; a lock-free histogram read besides
             "ttft_p99": rs.ttft_quantile(0.99),
             "prefill_frac": t_prefill / busy if busy else 0.5,
+            # per-model pool pressure (multi-model fleets): which pool
+            # growth should land in / shrink should drain from
+            "by_model": by_model,
         }
 
     # -------------------------------------------------------------- step
@@ -255,7 +271,8 @@ class Autoscaler:
             out["enacted"] = False
             out["replica"] = None
             if verdict["action"] == "grow":
-                idx = self._pick_grow(verdict["role_pref"])
+                idx = self._pick_grow(verdict["role_pref"],
+                                      model=self._hot_model(signals))
                 # ptlint: disable=PT-C004  Autoscaler._lock is the
                 # OUTERMOST serving lock (lockgraph.json); control
                 # surfaces below never call back up into the autoscaler
@@ -267,7 +284,8 @@ class Autoscaler:
                     out["enacted"] = True
                     out["replica"] = idx
             elif verdict["action"] == "shrink":
-                idx = self._pick_shrink(verdict["role_pref"])
+                idx = self._pick_shrink(verdict["role_pref"],
+                                        model=self._cold_model(signals))
                 if idx is not None:
                     # evacuating drain: live blocks migrate, queued
                     # work re-dispatches — nothing recomputes or drops
@@ -288,12 +306,45 @@ class Autoscaler:
 
     # --------------------------------------------------------- selection
     @holds_lock("_lock")
-    def _pick_grow(self, role_pref: str) -> Optional[int]:
-        """Parked slot to rejoin: preferred role first, then mixed,
-        then whatever is parked — availability beats tiering, same rule
-        the router's admission fallback uses."""
+    def _hot_model(self, signals: dict) -> Optional[str]:
+        """The model pool growth should land in: highest waiting per
+        serving replica among models that HAVE a parked slot to give
+        back. None in single-model fleets (no preference)."""
+        by = signals.get("by_model") or {}
+        if len(by) < 2:
+            return None
+        cands = {m: e for m, e in by.items() if e["parked"] > 0}
+        if not cands:
+            return None
+        return max(sorted(cands),
+                   key=lambda m: cands[m]["waiting"]
+                   / max(cands[m]["up"], 1))
+
+    @holds_lock("_lock")
+    def _cold_model(self, signals: dict) -> Optional[str]:
+        """The model pool shrink should drain from: lowest waiting per
+        serving replica among models that keep >= 1 serving replica
+        after the drain. None in single-model fleets."""
+        by = signals.get("by_model") or {}
+        if len(by) < 2:
+            return None
+        cands = {m: e for m, e in by.items() if e["up"] > 1}
+        if not cands:
+            return None
+        return min(sorted(cands),
+                   key=lambda m: cands[m]["waiting"] / cands[m]["up"])
+
+    @holds_lock("_lock")
+    def _pick_grow(self, role_pref: str, model: str = None
+                   ) -> Optional[int]:
+        """Parked slot to rejoin: preferred model pool first (hottest —
+        multi-model fleets), then preferred role, then mixed, then
+        whatever is parked — availability beats tiering, same rule the
+        router's admission fallback uses."""
         parked = [r for r in self.rs.replicas
                   if r.state == ReplicaState.DRAINED]
+        if model is not None:
+            parked = [r for r in parked if r.model == model] or parked
         for want in (role_pref, "mixed"):
             for rep in parked:
                 if rep.role == want:
@@ -301,15 +352,30 @@ class Autoscaler:
         return parked[0].index if parked else None
 
     @holds_lock("_lock")
-    def _pick_shrink(self, role_pref: str) -> Optional[int]:
+    def _pick_shrink(self, role_pref: str, model: str = None
+                     ) -> Optional[int]:
         """Active slot to park: among UP replicas (never touch DRAINING
-        — one evacuation at a time), prefer the shed role, then mixed;
-        within a role, drain the emptiest slot (cheapest evacuation).
-        Refuses to take the active set below min_replicas."""
+        — one evacuation at a time), prefer the cold model's pool, then
+        the shed role, then mixed; within a role, drain the emptiest
+        slot (cheapest evacuation). Refuses to take the active set
+        below min_replicas, and never parks a model's LAST serving
+        replica (a registered pool must stay routable)."""
         ups = [r for r in self.rs.replicas
                if r.state == ReplicaState.UP]
         if len(ups) <= self.config.min_replicas:
             return None
+        serving_by_model: Dict[str, int] = {}
+        for r in self.rs.replicas:
+            if r.accepts_admissions():
+                serving_by_model[r.model] = \
+                    serving_by_model.get(r.model, 0) + 1
+        if len(serving_by_model) > 1:
+            ups = [r for r in ups
+                   if serving_by_model.get(r.model, 0) > 1]
+            if not ups:
+                return None
+        if model is not None:
+            ups = [r for r in ups if r.model == model] or ups
         def emptiest(reps: List) -> Optional[int]:
             best, best_load = None, None
             for rep in reps:

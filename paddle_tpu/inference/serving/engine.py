@@ -145,6 +145,15 @@ class EngineConfig:
     # reject and share-weighted trie eviction. None (default) keeps the
     # historical single-tenant FCFS stack bit-for-bit.
     tenants: Optional[object] = None
+    # multi-model fleets (serving/deploy.py): which model's weights this
+    # engine serves and which published revision of them. The pair keys
+    # every KV payload that leaves the engine (export_request,
+    # export_prefix) and every admit path refuses a payload keyed for a
+    # different (model, revision) — stale KV can never cross a weight
+    # rollout. The defaults keep single-model stacks untagged and their
+    # reqtrace dumps byte-identical to the pre-deploy schema.
+    model: str = "default"
+    revision: str = "r0"
 
 
 @dataclass
@@ -589,6 +598,15 @@ class LLMEngine:
         # helpers it calls re-enter (e.g. _emit under _recover)
         self._lock = threading.RLock()
         self.stats = EngineStats(config.obs_label)
+        # (model, revision) event tag (serving/deploy.py): emission and
+        # terminal events carry the serving revision so the causality
+        # checker can prove no token was emitted by a revision other
+        # than the one the request was admitted under (invariant 8).
+        # Default-keyed engines stay untagged — pre-deploy dump schema.
+        self._rev_tag: Optional[Dict[str, str]] = None
+        if (config.model, config.revision) != ("default", "r0"):
+            self._rev_tag = {"model": config.model,
+                             "revision": config.revision}
         self._requests: Dict[str, Request] = {}
         self._rngs: Dict[str, np.random.RandomState] = {}
         self._next_id = 0
@@ -702,7 +720,8 @@ class LLMEngine:
                     # BELOW LLMEngine in lockgraph.json; charge() takes
                     # only the registry lock, no re-entry
                     tenants.charge(sampling.tenant,
-                                   ids.size + sampling.max_tokens)
+                                   ids.size + sampling.max_tokens,
+                                   model=self.config.model)
                 except EngineOverloaded as e:
                     self.stats.rejected += 1
                     obs.reqtrace.record(
@@ -722,13 +741,15 @@ class LLMEngine:
                 if charged:
                     # ptlint: disable=PT-C004  registry call below the
                     # engine lock in lockgraph.json (see charge above)
-                    tenants.refund(sampling.tenant, charged)
+                    tenants.refund(sampling.tenant, charged,
+                                   model=self.config.model)
                 self.stats.rejected += 1
                 raise
             except ValueError:
                 if charged:
                     # ptlint: disable=PT-C004  same as refund above
-                    tenants.refund(sampling.tenant, charged)
+                    tenants.refund(sampling.tenant, charged,
+                                   model=self.config.model)
                 raise
             for victim in shed:
                 victim.finish_time = time.perf_counter()
@@ -737,7 +758,8 @@ class LLMEngine:
                     victim.request_id, None, list(victim.output_ids),
                     True, "shed"))
                 obs.reqtrace.record("finish", victim.tid,
-                                    victim.request_id, reason="shed")
+                                    victim.request_id, reason="shed",
+                                    **(self._rev_tag or {}))
             self._requests[request_id] = req
             self._rngs[request_id] = np.random.RandomState(
                 sampling.seed & 0x7FFFFFFF)
@@ -745,7 +767,8 @@ class LLMEngine:
                 "engine_admit", req.tid, request_id,
                 engine=self.stats.label, arrival=req.arrival,
                 readmit=bool(readmit), resume=len(req.output_ids),
-                waiting=self.scheduler.num_waiting())
+                waiting=self.scheduler.num_waiting(),
+                **(self._rev_tag or {}))
             if self.cache.host_tier is not None:
                 # enqueue-time prefetch: promote the host-resident
                 # prefix while the request queues, overlapping the fill
@@ -892,6 +915,12 @@ class LLMEngine:
                     f"clean step boundary")
             return {
                 "request_id": request_id,
+                # (model, revision) key: the destination's
+                # admit_migrated refuses a payload keyed for different
+                # weights — KV is only valid under the parameters that
+                # wrote it, so it must never cross a rollout boundary
+                "model": self.config.model,
+                "revision": self.config.revision,
                 "prompt_ids": np.array(req.prompt_ids, np.int32),
                 "params": req.params,
                 "arrival": req.arrival,
@@ -918,6 +947,18 @@ class LLMEngine:
         hold the table (the coordinator aborts; the request keeps
         running at the source)."""
         rid = snap["request_id"]
+        key = (snap.get("model", self.config.model),
+               snap.get("revision", self.config.revision))
+        if key != (self.config.model, self.config.revision):
+            # cross-revision refusal (serving/deploy.py): KV written by
+            # other weights is garbage under these — raised BEFORE any
+            # state is touched, so the coordinator aborts cleanly and
+            # the request keeps running at its source
+            raise ValueError(
+                f"admit_migrated: {rid!r} payload is keyed "
+                f"{key} but this engine serves "
+                f"{(self.config.model, self.config.revision)} — "
+                f"cross-revision KV refused")
         with self._lock:
             old = self._requests.get(rid)
             if old is not None and not old.finished:
@@ -1022,16 +1063,31 @@ class LLMEngine:
     def export_prefix(self, prompt_ids) -> Optional[dict]:
         """Donor half of a peer prefix fetch: snapshot the longest
         cached full-block prefix of `prompt_ids` (both tiers, digests
-        included). Read-only; None when nothing matches."""
+        included), keyed by this engine's (model, revision). Read-only;
+        None when nothing matches."""
         with self._lock:
-            return self.cache.export_prefix(
+            snap = self.cache.export_prefix(
                 np.asarray(prompt_ids, np.int32).reshape(-1))
+            if snap is not None:
+                snap["model"] = self.config.model
+                snap["revision"] = self.config.revision
+            return snap
 
-    def admit_prefix(self, prompt_ids, blocks) -> int:
+    def admit_prefix(self, prompt_ids, blocks, model: str = None,
+                     revision: str = None) -> int:
         """Receiver half: verify and install a peer's prefix snapshot
         as locally cached (evictable) blocks. Raises ValueError on an
-        integrity mismatch and CacheExhausted when the pool cannot hold
-        it — both with atomic-abort semantics (nothing mutated)."""
+        integrity mismatch OR on a payload keyed for a different
+        (model, revision) — stale prefix KV must never serve another
+        revision's requests — and CacheExhausted when the pool cannot
+        hold it; all with atomic-abort semantics (nothing mutated)."""
+        key = (self.config.model if model is None else model,
+               self.config.revision if revision is None else revision)
+        if key != (self.config.model, self.config.revision):
+            raise ValueError(
+                f"admit_prefix: payload keyed {key} but this engine "
+                f"serves {(self.config.model, self.config.revision)} — "
+                f"cross-revision prefix refused")
         with self._lock:
             return self.cache.admit_prefix(
                 np.asarray(prompt_ids, np.int32).reshape(-1), blocks)
@@ -1068,7 +1124,8 @@ class LLMEngine:
             # ttft_sum below stays the completed-only accumulator
             self.stats.observe_ttft(now - req.arrival_time)
             obs.reqtrace.record("first_token", req.tid, req.request_id,
-                                ttft_s=now - req.arrival_time)
+                                ttft_s=now - req.arrival_time,
+                                **(self._rev_tag or {}))
         else:
             # per-token latency: gap since this request's previous token
             self.stats.observe_token_gap(now - req.last_token_time)
@@ -1092,7 +1149,8 @@ class LLMEngine:
             self.stats.observe_latency(now - req.arrival_time)
             obs.reqtrace.record("finish", req.tid, req.request_id,
                                 reason=reason,
-                                tokens=len(req.output_ids))
+                                tokens=len(req.output_ids),
+                                **(self._rev_tag or {}))
         outs.append(RequestOutput(req.request_id, tok,
                                   list(req.output_ids), finished, reason))
 
@@ -1109,7 +1167,8 @@ class LLMEngine:
         outs.append(RequestOutput(req.request_id, None,
                                   list(req.output_ids), True, reason))
         obs.reqtrace.record("finish", req.tid, req.request_id,
-                            reason=reason, tokens=len(req.output_ids))
+                            reason=reason, tokens=len(req.output_ids),
+                            **(self._rev_tag or {}))
 
     @holds_lock("_lock")
     def _expire_and_abort(self, outs: List[RequestOutput]):
@@ -1124,7 +1183,8 @@ class LLMEngine:
                                       list(req.output_ids), True,
                                       "timeout"))
             obs.reqtrace.record("finish", req.tid, req.request_id,
-                                reason="timeout")
+                                reason="timeout",
+                                **(self._rev_tag or {}))
         for req in self.scheduler.overdue_running(now):
             self.stats.timeouts += 1
             self._finish_abnormal(req, RequestState.FINISHED_TIMEOUT,
@@ -1329,7 +1389,8 @@ class LLMEngine:
                                     "decode_chunk", req.tid,
                                     req.request_id, n=n_emit,
                                     total=len(req.output_ids),
-                                    finished=req.finished)
+                                    finished=req.finished,
+                                    **(self._rev_tag or {}))
             step_ev.args = {"step": step_no, "outputs": len(outs),
                             "errors": self.stats.errors,
                             "expired": self.stats.expired,

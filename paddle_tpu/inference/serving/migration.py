@@ -81,6 +81,7 @@ class BlockMigration:
         "migrations": "_lock",
         "aborted": "_lock",
         "rolled_back": "_lock",
+        "revision_refused": "_lock",
         "bytes_moved": "_lock",
         "prefix_fetches": "_lock",
         "prefix_aborted": "_lock",
@@ -93,6 +94,7 @@ class BlockMigration:
         self.migrations = 0               # committed
         self.aborted = 0                  # destination pool full
         self.rolled_back = 0              # source died mid-migration
+        self.revision_refused = 0         # cross-(model,revision) blocked
         self.bytes_moved = 0
         self.prefix_fetches = 0           # committed peer prefix pulls
         self.prefix_aborted = 0           # dst full / digest mismatch
@@ -116,6 +118,11 @@ class BlockMigration:
             "peer prefix pulls by outcome (hit|aborted); an abort "
             "leaves the destination pool untouched and the request "
             "re-prefills", labels=("router", "outcome"))
+        self._c_rev_refused = obs.counter(
+            "serving_revision_refusals_total",
+            "KV transfers refused because source and destination serve "
+            "different (model, revision) keys — stale KV never crosses "
+            "a weight rollout (serving/deploy.py)", labels=("router",))
 
     def migrate(self, src: EngineReplica, dst: EngineReplica,
                 request_id: str, reason: str, router_step: int = 0,
@@ -144,6 +151,15 @@ class BlockMigration:
                         request_id: str, reason: str,
                         router_step: int, faults) -> Optional[dict]:
         t0 = time.perf_counter()
+        if src.revision_key() != dst.revision_key():
+            # cross-revision refusal (serving/deploy.py): KV written by
+            # one revision's weights must never serve another's
+            # requests. Clean abort before any copy — the request keeps
+            # running at the source; the router routes the drain/
+            # rebalance to a same-revision destination instead.
+            self.revision_refused += 1
+            self._c_rev_refused.labels(router=self.label).inc()
+            return None
         snap = src.export_request(request_id)
         try:
             dst_engine = dst.admit_migrated(snap)
@@ -221,12 +237,21 @@ class BlockMigration:
                              trace_id: str, prompt_ids,
                              router_step: int) -> Optional[dict]:
         t0 = time.perf_counter()
+        if src.revision_key() != dst.revision_key():
+            # same refusal as _migrate_locked: a peer serving different
+            # weights holds no prefix worth pulling — its KV is garbage
+            # under this revision's parameters
+            self.revision_refused += 1
+            self._c_rev_refused.labels(router=self.label).inc()
+            return None
         snap = src.export_prefix(prompt_ids)
         if snap is None:
             return None                   # peer held nothing after all
         tid = trace_id or request_id
         try:
-            added = dst.admit_prefix(prompt_ids, snap["blocks"])
+            added = dst.admit_prefix(prompt_ids, snap["blocks"],
+                                     model=snap.get("model"),
+                                     revision=snap.get("revision"))
         except (CacheExhausted, ValueError):
             # atomic abort: admit_prefix verifies all digests BEFORE
             # claiming blocks and CacheExhausted claims nothing — the
@@ -261,6 +286,7 @@ class BlockMigration:
             return {"migrations": self.migrations,
                     "aborted": self.aborted,
                     "rolled_back": self.rolled_back,
+                    "revision_refused": self.revision_refused,
                     "bytes_moved": self.bytes_moved,
                     "prefix_fetches": self.prefix_fetches,
                     "prefix_aborted": self.prefix_aborted,

@@ -78,6 +78,11 @@ class EngineReplica:
         "failed_at": "_lock",
         "probe_tokens": "_lock",
         "_probe_seq": "_lock",
+        "_factory": "_lock",
+        "model": "_lock",
+        "revision": "_lock",
+        "_prev_engine": "_lock",
+        "_prev_factory": "_lock",
     }
 
     ROLES = ("prefill", "decode", "mixed")
@@ -117,8 +122,33 @@ class EngineReplica:
         self.history: List[tuple] = []    # [(incarnation, reason)]
         self.probe_tokens = 0             # warmup tokens spent (telemetry)
         self._probe_seq = 0               # probes run on THIS incarnation
+        # (model, revision) identity (serving/deploy.py): cached OFF the
+        # engine so routing/autoscaling can still group a quarantined
+        # slot (engine None) with its pool. Updated whenever an engine
+        # is (re)built; swap_revision changes it, quarantine keeps it.
+        with self._lock:
+            self.model, self.revision = self._engine_key()
+        # warm standby for instant rollback: the previous revision's
+        # engine + factory, held from swap_revision until the deploy
+        # commits (commit_revision) or rolls back (restore_revision)
+        self._prev_engine = None
+        self._prev_factory: Optional[Callable] = None
+
+    @holds_lock("_lock")
+    def _engine_key(self) -> tuple:
+        """(model, revision) the current engine serves (engine configs
+        default to ("default", "r0") on single-model stacks)."""
+        cfg = self.engine.config
+        return (getattr(cfg, "model", "default"),
+                getattr(cfg, "revision", "r0"))
 
     # ------------------------------------------------------------ queries
+    def revision_key(self) -> tuple:
+        """(model, revision) this slot serves — the key every KV payload
+        carries and every admit path checks (cross-revision refusal)."""
+        with self._lock:
+            return (self.model, self.revision)
+
     def is_serving(self) -> bool:
         with self._lock:
             return self.state in ReplicaState.SERVING
@@ -284,6 +314,7 @@ class EngineReplica:
                 # visible to dispatch() is worse than a slow factory (the
                 # router tolerates a slow restart; it routes around DOWN)
                 self.engine = self._factory(self.index, self.restarts)
+                self.model, self.revision = self._engine_key()
                 self._probe()
             except Exception as e:          # noqa: BLE001 — any probe
                 # failure is a failed incarnation, not a router crash
@@ -385,11 +416,14 @@ class EngineReplica:
                 return None
             return self.engine.export_prefix(prompt_ids)
 
-    def admit_prefix(self, prompt_ids, blocks) -> int:
+    def admit_prefix(self, prompt_ids, blocks, model: str = None,
+                     revision: str = None) -> int:
         with self._lock:
             if self.engine is None:
                 return 0
-            return self.engine.admit_prefix(prompt_ids, blocks)
+            return self.engine.admit_prefix(prompt_ids, blocks,
+                                            model=model,
+                                            revision=revision)
 
     # ------------------------------------------------------------ draining
     def drain(self) -> None:
@@ -437,3 +471,124 @@ class EngineReplica:
             self.state = ReplicaState.UP
             self.last_beat = time.monotonic()
             return True
+
+    # ------------------------------------------------- revision rollout
+    # The DeployController's per-replica primitives (serving/deploy.py).
+    # A rollout touches one PARKED (DRAINED, evacuated-empty) slot at a
+    # time: swap_revision installs the new revision's engine and runs
+    # the warmup probe, canary_outputs drives the parity gate, and the
+    # slot only rejoins rotation via the normal probe_rejoin. The OLD
+    # engine + factory are kept warm until the whole deploy commits
+    # (commit_revision) so restore_revision is an instant, re-prefill-
+    # free rollback — the drained old pool is empty, nothing is stale.
+
+    def swap_revision(self, engine_factory: Callable) -> bool:
+        """Replace a PARKED slot's engine with a new revision's, probe
+        it, and park again (the canary gate and probe_rejoin stand
+        between the swap and real traffic). A factory/probe failure
+        reinstates the old incarnation and returns False — the slot is
+        exactly as before the call."""
+        with self._lock:
+            if self.state != ReplicaState.DRAINED:
+                raise ValueError(
+                    f"swap_revision: replica {self.index} is "
+                    f"{self.state!r}, not drained")
+            if self.engine.has_unfinished():
+                raise ValueError(
+                    f"swap_revision: replica {self.index} still holds "
+                    f"unfinished work")
+            self._prev_engine = self.engine
+            self._prev_factory = self._factory
+            self._factory = engine_factory
+            self.state = ReplicaState.STARTING
+            try:
+                # ptlint: disable=PT-C004  same contract as restart():
+                # the swap must be atomic under the replica lock — a
+                # half-built engine visible to dispatch() would serve
+                # unverified weights
+                self.engine = engine_factory(self.index, self.restarts)
+                self.model, self.revision = self._engine_key()
+                self._probe()
+            except Exception:               # noqa: BLE001 — a failed
+                # swap is a failed CANDIDATE, not a failed slot: the
+                # old incarnation comes straight back
+                self._factory = self._prev_factory
+                self.engine = self._prev_engine
+                self._prev_engine = None
+                self._prev_factory = None
+                self.model, self.revision = self._engine_key()
+                self.state = ReplicaState.DRAINED
+                return False
+            self.state = ReplicaState.DRAINED
+            return True
+
+    def restore_revision(self) -> bool:
+        """Instant rollback: reinstate the warm previous-revision engine
+        and factory saved by swap_revision. Works whether the swapped
+        incarnation is still parked or was quarantined mid-deploy (the
+        chaos window) — the slot parks DRAINED either way and rejoins
+        via probe_rejoin. Returns False when there is nothing to
+        restore."""
+        with self._lock:
+            if self._prev_factory is None:
+                return False
+            self._factory = self._prev_factory
+            old, self._prev_engine = self._prev_engine, None
+            self._prev_factory = None
+            if old is None:                  # pragma: no cover - the
+                # warm engine is only dropped by commit_revision, which
+                # also clears the factory; restart() rebuilds old weights
+                return False
+            self.engine = old
+            self.model, self.revision = self._engine_key()
+            self._wedged = False
+            self.restart_at = None
+            self.state = ReplicaState.DRAINED
+            return True
+
+    def commit_revision(self) -> None:
+        """Release the warm standby once the deploy commits — rollback
+        past this point is a fresh deploy of the old revision."""
+        with self._lock:
+            self._prev_engine = None
+            self._prev_factory = None
+
+    def canary_outputs(self, prompts, max_tokens: int = 8,
+                       max_steps_each: int = 256) -> List[List[int]]:
+        """Greedy decode of the pinned canary prompt set on a PARKED
+        slot's engine — the deploy parity gate's measurement half. Runs
+        each prompt end-to-end (prefill → decode → 'length' terminal)
+        and returns the emitted token lists; any raise or an unfinished
+        canary fails the gate. Only DRAINED slots qualify, for the same
+        reason as probe_rejoin: the loop steps the engine and a serving
+        state would lose live requests' tokens."""
+        with self._lock:
+            if self.state != ReplicaState.DRAINED:
+                raise ValueError(
+                    f"canary_outputs: replica {self.index} is "
+                    f"{self.state!r}, not drained")
+            eng = self.engine
+            outs: List[List[int]] = []
+            for prompt in prompts:
+                self._probe_seq += 1
+                rid = eng.add_request(
+                    list(prompt),
+                    SamplingParams(max_tokens=max_tokens,
+                                   temperature=0.0),
+                    request_id=(f"canary-r{self.index}-i{self.restarts}"
+                                f"-p{self._probe_seq}"))
+                for _ in range(max_steps_each):
+                    # ptlint: disable=PT-C003  canary probe of a PARKED
+                    # engine not reachable from dispatch(); same
+                    # contention-free contract as _probe
+                    eng.step()
+                    if eng.get_request(rid).finished:
+                        break
+                req = eng.get_request(rid)
+                if req.state != "finished_length":
+                    raise RuntimeError(
+                        f"canary {rid!r} ended {req.state!r} instead of "
+                        f"serving its tokens")
+                self.probe_tokens += len(req.output_ids)
+                outs.append([int(t) for t in req.output_ids])
+            return outs

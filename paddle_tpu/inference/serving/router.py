@@ -50,6 +50,17 @@ engine-level crash recovery of PR 3 to REPLICA-LEVEL failover:
   router's step-time EWMA, or the earliest pending restart), policy
   'shed_oldest' sheds the GLOBALLY-oldest waiting request from
   whichever replica holds it.
+- MULTI-MODEL (PR 18, serving/deploy.py): with `config.models` set to
+  a ModelRegistry, each replica belongs to ONE model's pool (its
+  engine config's `model`) and `SamplingParams.model` picks the pool —
+  admission, failover re-admission and migration never cross pools,
+  and per-model revision route weights (set_route_weights) split a
+  pool's traffic across checkpoint revisions for A/B and rolling
+  deploys. Every request is PINNED to the revision that admitted it
+  (invariant 8 in obs/reqtrace.py): migrated KV only moves between
+  replicas sharing the (model, revision) key, and the only legal
+  revision crossing is a full re-dispatch/re-prefill, which records a
+  fresh `admitted` event re-pinning the trace.
 
 Observability (docs/observability.md): `serving_replica_up{router,
 replica}` gauge, `serving_failovers_total{router,replica,reason}`,
@@ -138,6 +149,14 @@ class RouterConfig:
     # free_blocks routing and after failovers scatter a template's
     # working set
     peer_prefix_fetch: bool = False
+    # multi-model fleet (serving/deploy.py): a ModelRegistry resolving
+    # SamplingParams.model to its published revisions. When set, each
+    # replica belongs to ONE model's pool (its engine config's `model`),
+    # admission/routing/failover stay inside that pool, and per-model
+    # revision weights (set_route_weights / DeployController) split
+    # traffic across revisions for A/B and rolling deploys. None keeps
+    # the single-model fleet untagged and bit-identical.
+    models: Optional[object] = None
     obs_label: Optional[str] = None
 
 
@@ -163,6 +182,12 @@ class RouterRequest:
     # the re-admission event names its predecessor with it
     trace_id: str = ""
     prev_replica: Optional[int] = None
+    # multi-model fleets (serving/deploy.py): the model pool this
+    # request belongs to and the revision it is currently PINNED to
+    # (the revision of the replica that admitted it — tokens may only
+    # come from that revision; a re-pin records a fresh `admitted`)
+    model: str = "default"
+    revision: Optional[str] = None
 
 
 class ReplicaSet:
@@ -181,6 +206,7 @@ class ReplicaSet:
         "_steps": "_lock",
         "_step_ewma": "_lock",
         "recovery_times": "_lock",
+        "_route_weights": "_lock",
     }
 
     def __init__(self, engine_factory, config: RouterConfig = None,
@@ -248,6 +274,11 @@ class ReplicaSet:
         self.migrator = BlockMigration(self.label)
         self._lock = threading.RLock()
         self._requests: Dict[str, RouterRequest] = {}
+        # per-model revision routing weights (A/B splits and canary
+        # ramps, serving/deploy.py). Empty → every model routes to its
+        # registry-active revision. Only consulted when config.models
+        # is set; single-model fleets never look here.
+        self._route_weights: Dict[str, Dict[str, float]] = {}
         self._next_id = 0
         self._next_trace = 0              # trace-id mint (reqtrace)
         self._readmit_seq = 0             # failover re-admission batches
@@ -310,6 +341,34 @@ class ReplicaSet:
 
         return cls(factory, config, faults=faults)
 
+    @classmethod
+    def from_registry(cls, registry, assignments, config: RouterConfig
+                      = None, faults=None):
+        """Build a multi-model fleet over a ModelRegistry
+        (serving/deploy.py): `assignments[i]` names the model replica i
+        serves, each pinned to the model's revision ACTIVE AT BUILD
+        TIME (a restart rebuilds the same revision bit-for-bit; only a
+        DeployController swap moves a slot forward). The registry rides
+        on config.models so admission, failover and migration stay
+        inside each model's pool."""
+        import dataclasses
+        config = config or RouterConfig()
+        if len(assignments) != config.num_replicas:
+            raise ValueError(
+                f"assignments names {len(assignments)} replicas but "
+                f"num_replicas={config.num_replicas}")
+        # one pinned factory per slot, resolved NOW: a later restart
+        # (which runs the factory under EngineReplica._lock) rebuilds
+        # the same revision without re-entering the registry
+        pinned = tuple(registry.engine_factory(m, registry.active(m))
+                       for m in assignments)
+        config = dataclasses.replace(config, models=registry)
+
+        def factory(index, incarnation):
+            return pinned[index](index, incarnation)
+
+        return cls(factory, config, faults=faults)
+
     # ------------------------------------------------------------ intake
     def add_request(self, prompt_ids, sampling: SamplingParams = None,
                     request_id: str = None) -> str:
@@ -325,7 +384,17 @@ class ReplicaSet:
                 self._next_id += 1
             if request_id in self._requests:
                 raise ValueError(f"duplicate request_id {request_id!r}")
-            ups = self._admission_candidates()
+            model = sampling.model
+            registry = self.config.models
+            # ptlint: disable=PT-C004  ModelRegistry sits BELOW
+            # ReplicaSet in the declared order; pure locked reads
+            if registry is not None and not registry.has_model(model):
+                # a caller bug, not an overload: unknown models never
+                # become routable by waiting
+                raise ValueError(
+                    f"unknown model {model!r}; registry serves "
+                    f"{sorted(registry.models())}")  # ptlint: disable=PT-C004  registry read down the order
+            ups = self._admission_candidates(model=model)
             if not ups:
                 raise EngineOverloaded(
                     request_id, 0, 0,
@@ -342,6 +411,13 @@ class ReplicaSet:
             ids = np.asarray(prompt_ids, np.int32).reshape(-1)
             trace_id = f"tr-{self.label}-{self._next_trace}"
             self._next_trace += 1
+            # revision steering (A/B weights / canary ramp): prefer the
+            # picked revision's replicas, but availability beats the
+            # split — the admitted event records the revision the
+            # request actually LANDED on, which is what pins it
+            want_rev = self._pick_revision(model, request_id)
+            if want_rev is not None:
+                ups = [r for r in ups if r.revision == want_rev] or ups
             last_exc = None
             for rep in self._rank(ups, prompt_ids=ids,
                                   demand=self._worst_demand(
@@ -365,16 +441,23 @@ class ReplicaSet:
                     request_id=request_id, prompt_ids=ids,
                     params=sampling, arrival_time=arrival_time,
                     arrival=arrival, replica=rep.index,
-                    trace_id=trace_id)
+                    trace_id=trace_id, model=model,
+                    revision=rep.revision)
                 # balance decision, recorded with the chosen replica's
-                # post-dispatch headroom (host-side load snapshot)
+                # post-dispatch headroom (host-side load snapshot).
+                # Multi-model fleets stamp the resolved (model,
+                # revision) — invariant 8 pins every later token to it;
+                # single-model fleets stay untagged (byte-identical
+                # dumps).
                 info = rep.load_info()
+                rev_tag = {} if registry is None else {
+                    "model": model, "revision": rep.revision}
                 obs.reqtrace.record(
                     "admitted", trace_id, request_id,
                     router=self.label, replica=rep.index,
                     policy=self.config.balance,
                     headroom=info["free_blocks"] - info["block_demand"],
-                    waiting=info["waiting"])
+                    waiting=info["waiting"], **rev_tag)
                 self._maybe_peer_fetch(rep, request_id, trace_id, ids)
                 return request_id
             # every up replica refused at ITS bound: surface overload
@@ -417,15 +500,62 @@ class ReplicaSet:
 
     # ------------------------------------------------------------ routing
     @holds_lock("_lock")
-    def _admission_candidates(self) -> List[EngineReplica]:
+    def _admission_candidates(self, model: str = None
+                              ) -> List[EngineReplica]:
         """New prompts (and failover re-prefills) are prefill work:
         they admit to the prefill/mixed tier. Falls back to EVERY
         accepting replica when that whole tier is down — availability
         beats tiering, and a decode replica can still prefill, just not
-        at its sized-for roofline."""
+        at its sized-for roofline. In a multi-model fleet the request's
+        model pool is a HARD filter applied first — a request never
+        lands on another model's weights, whatever is down."""
         ups = [r for r in self.replicas if r.accepts_admissions()]
+        if model is not None and self.config.models is not None:
+            ups = [r for r in ups if r.model == model]
         tier = [r for r in ups if r.role in ("prefill", "mixed")]
         return tier or ups
+
+    @holds_lock("_lock")
+    def _pick_revision(self, model: str, seed: str) -> Optional[str]:
+        """Deterministic weighted revision choice for one request:
+        hash (model, request_id) onto the model's route weights —
+        stateless, replayable, and a 90/10 split is 90/10 for any
+        request population. No weights → the registry's active
+        revision; no registry → None (single-model fleet, no
+        steering)."""
+        weights = self._route_weights.get(model)
+        if not weights:
+            reg = self.config.models
+            # ptlint: disable=PT-C004  registry read down the order
+            return reg.active(model) if reg is not None else None
+        total = sum(weights.values())
+        h = int.from_bytes(hashlib.sha256(
+            f"{model}/{seed}".encode()).digest()[:8], "big")
+        x = (h / 2.0 ** 64) * total
+        for rev in sorted(weights):
+            x -= weights[rev]
+            if x < 0:
+                return rev
+        return sorted(weights)[-1]
+
+    @holds_lock("_lock")
+    def _repin(self, rec: RouterRequest, rep: EngineReplica) -> None:
+        """Re-pin a re-dispatched request to its new home's revision.
+        Crossing revisions is legal ONLY because re-dispatch re-prefills
+        from the router's token log (migrated KV never crosses — the
+        migrator refuses); the fresh `admitted` event re-pins the trace
+        so invariant 8 holds the request's FUTURE tokens to the new
+        revision."""
+        if self.config.models is None:
+            rec.revision = rep.revision
+            return
+        if rec.revision == rep.revision:
+            return
+        rec.revision = rep.revision
+        obs.reqtrace.record(
+            "admitted", rec.trace_id or rec.request_id,
+            rec.request_id, router=self.label, replica=rep.index,
+            policy="repin", model=rec.model, revision=rep.revision)
 
     @holds_lock("_lock")
     def _rank(self, candidates: List[EngineReplica],
@@ -600,7 +730,10 @@ class ReplicaSet:
         local = rep.prefix_probe(prompt_ids)
         best, best_len = None, local
         for peer in self.replicas:
-            if peer is rep or not peer.is_serving():
+            # prefix KV is revision-keyed: a peer on other weights
+            # holds nothing this replica may serve
+            if peer is rep or not peer.is_serving() \
+                    or peer.revision_key() != rep.revision_key():
                 continue
             n = peer.prefix_probe(prompt_ids)
             if n > best_len:
@@ -620,9 +753,14 @@ class ReplicaSet:
         (that is what the tier is sized for, and the router's tier
         filter keeps prompts off it), then descending effective
         headroom, mid-prefill migrations prefer prefill/mixed instead
-        (their remaining chunks are prefill work)."""
+        (their remaining chunks are prefill work). Candidates must
+        share the source's (model, revision) key — KV blocks never
+        cross a weight rollout (the migrator refuses anyway; filtering
+        here avoids burning export attempts on guaranteed refusals)."""
+        key = exclude.revision_key()
         cands = [r for r in self.replicas
-                 if r is not exclude and r.accepts_admissions()]
+                 if r is not exclude and r.accepts_admissions()
+                 and r.revision_key() == key]
         if decode_phase:
             cands = [r for r in cands if r.role != "prefill"] \
                 or cands
@@ -711,9 +849,17 @@ class ReplicaSet:
              if rec.replica == rep.index and not rec.finished),
             key=lambda rec: rec.arrival)
         for rec in queued:
-            ups = self._admission_candidates()   # excludes DRAINING rep
+            # excludes the DRAINING rep; stays in the model pool, and
+            # prefers the revision the request is pinned to (crossing
+            # is legal for queued work — it never prefilled — but a
+            # same-revision home keeps old-revision traffic bitwise on
+            # old weights through a rolling deploy)
+            ups = self._admission_candidates(model=rec.model)
             if not ups:
                 break
+            if rec.revision is not None:
+                ups = [r for r in ups
+                       if r.revision == rec.revision] or ups
             if rep.release_waiting(rec.request_id) is None:
                 continue      # running but unmovable: finishes here
             target = self._rank(
@@ -741,6 +887,7 @@ class ReplicaSet:
                 outs.append(self._pending.pop())
                 continue
             rec.replica = target.index
+            self._repin(rec, target)
             moved += 1
         return moved
 
@@ -837,10 +984,19 @@ class ReplicaSet:
         self._readmit_seq += 1
         batch_id = self._readmit_seq
         for rec in self._orphans:
-            ups = self._admission_candidates()
+            ups = self._admission_candidates(model=rec.model)
             if not ups:
                 remaining.append(rec)
                 continue
+            # same-revision survivors first: a failover mid-deploy must
+            # not silently promote old-revision requests onto new
+            # weights while an old-revision home exists (re-admission
+            # DOES cross revisions as a last resort — it re-prefills
+            # from the token log, and _repin records the fresh
+            # `admitted` that makes it legal under invariant 8)
+            if rec.revision is not None:
+                ups = [r for r in ups
+                       if r.revision == rec.revision] or ups
             # affinity-aware re-admission: the rendezvous key re-ranks
             # over the SURVIVOR set, so a dead replica's template
             # traffic converges on one deterministic survivor and
@@ -869,6 +1025,7 @@ class ReplicaSet:
                 from_replica=rec.prev_replica, arrival=rec.arrival,
                 resume=len(rec.tokens), requeues=rec.requeues,
                 batch=batch_id)
+            self._repin(rec, target)
             # the dead replica's prefix working set may survive on a
             # peer — pull it before the re-prefill recomputes it
             self._maybe_peer_fetch(target, rec.request_id,
@@ -957,6 +1114,55 @@ class ReplicaSet:
             self.replicas[index].undrain()
             self._set_up_gauge(self.replicas[index])
 
+    def evict(self, index: int, reason: str = "evict",
+              detail: str = "") -> int:
+        """Forced failover of one replica through the exact machinery a
+        crash takes: quarantine, requeue every non-terminal request in
+        original arrival order, re-admit to survivors immediately. The
+        deploy controller uses this on rollback to clear a swapped
+        slot's live work before restoring the previous revision's warm
+        engine — restore_revision replaces the engine object, so any
+        request still decoding there would otherwise be silently
+        stranded. Terminal outputs synthesized during re-admission (no
+        survivor fits) are delivered by the next step(). Returns the
+        number of requeued requests."""
+        with self._lock:
+            rep = self.replicas[index]
+            victims = sum(1 for rec in self._requests.values()
+                          if not rec.finished and rec.replica == index)
+            outs: List[RequestOutput] = []
+            self._failover(rep, reason, detail, outs)
+            self._pending.extend(outs)
+            return victims
+
+    def set_route_weights(self, model: str,
+                          weights: Dict[str, float] = None) -> None:
+        """Set (or with None/empty: clear) the revision traffic split
+        for `model` — {"sha256:abc...": 0.9, "sha256:def...": 0.1}.
+        Cleared → requests route to the registry-active revision.
+        DeployController drives this to shift traffic onto swapped
+        replicas mid-rollout and to snap it back on rollback."""
+        if weights:
+            if any(w < 0 for w in weights.values()) \
+                    or sum(weights.values()) <= 0:
+                raise ValueError(
+                    f"route weights must be non-negative with a "
+                    f"positive sum, got {weights}")
+        with self._lock:
+            if weights:
+                self._route_weights[model] = dict(weights)
+            else:
+                self._route_weights.pop(model, None)
+
+    def route_weights(self, model: str) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._route_weights.get(model, {}))
+
+    def pool(self, model: str) -> List[int]:
+        """Replica indices currently serving `model` (any revision)."""
+        with self._lock:
+            return [r.index for r in self.replicas if r.model == model]
+
     def probe_grow(self, index: int) -> bool:
         """Return a PARKED (DRAINED) replica to rotation through a
         warmup-probe rejoin (autoscaler grow path, docs/serving.md):
@@ -1021,6 +1227,10 @@ class ReplicaSet:
                 if rec.finished:
                     key = rec.finish_reason or "unknown"
                     by_reason[key] = by_reason.get(key, 0) + 1
+            pools: Dict[str, Dict[str, List[int]]] = {}
+            for r in self.replicas:
+                pools.setdefault(r.model, {}).setdefault(
+                    r.revision, []).append(r.index)
             return {
                 "steps": self._steps,
                 "requests": len(recs),
@@ -1031,6 +1241,9 @@ class ReplicaSet:
                 "finish_reasons": by_reason,
                 "replica_states": {r.index: r.state
                                    for r in self.replicas},
+                "pools": pools,
+                "route_weights": {m: dict(w) for m, w
+                                  in self._route_weights.items()},
                 "recovery_times_s": [round(t, 4)
                                      for t in self.recovery_times],
             }

@@ -120,6 +120,11 @@ class SamplingParams:
     tenant: which tenant's fair share this request spends (serving/
         tenancy.py). Resolved against the TenantRegistry when the stack
         is built with one; ignored (and left at "default") otherwise.
+    model: which model this request wants (serving/deploy.ModelRegistry).
+        Resolved by the ReplicaSet front-end against the registry when
+        the fleet is built with one — the request is admitted to a
+        replica pool serving that model's currently-routed revision.
+        Ignored (and left at "default") on single-model stacks.
     """
     max_tokens: int = 16
     temperature: float = 0.0
@@ -130,6 +135,7 @@ class SamplingParams:
     deadline_s: Optional[float] = None
     queue_ttl_s: Optional[float] = None
     tenant: str = "default"
+    model: str = "default"
 
 
 class RequestState:

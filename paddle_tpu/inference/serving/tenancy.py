@@ -128,6 +128,7 @@ class TenantRegistry:
     _GUARDED_BY = {
         "_tenants": "_lock",
         "_spend": "_lock",
+        "_model_spend": "_lock",
         "version": "_lock",
     }
 
@@ -137,6 +138,11 @@ class TenantRegistry:
             DEFAULT_TENANT: TenantConfig(DEFAULT_TENANT)}
         # tenant -> deque[(monotonic_ts, tokens)] inside the window
         self._spend: Dict[str, deque] = {}
+        # (tenant, model) -> net tokens charged, cumulative — the
+        # multi-model billing breakdown (serving/deploy.py). Quota
+        # itself stays per-tenant across models: one budget, however
+        # the tenant splits it.
+        self._model_spend: Dict[Tuple[str, str], int] = {}
         self.version = 1
         for cfg in tenants:
             self.register(cfg)
@@ -178,16 +184,23 @@ class TenantRegistry:
 
     # ------------------------------------------------------------ quota
     def charge(self, name: str, tokens: int,
-               now: Optional[float] = None) -> None:
+               now: Optional[float] = None,
+               model: Optional[str] = None) -> None:
         """Charge `tokens` against the tenant's sliding window; raises
         TenantQuotaExceeded (with a retry_after_s hint — when the
         oldest window entry expires) once the window is spent. The
         caller refunds on a downstream admission refusal so a rejected
-        request never burns quota."""
+        request never burns quota. `model` tags the charge for the
+        per-model billing breakdown (model_spend); quota enforcement
+        is model-blind."""
         with self._lock:
             cfg = self._tenants.get(name)
             if cfg is None:
                 raise ValueError(f"unknown tenant {name!r}")
+            if model is not None:
+                key = (name, model)
+                self._model_spend[key] = \
+                    self._model_spend.get(key, 0) + int(tokens)
             if cfg.quota_tokens is None:
                 return
             now = time.monotonic() if now is None else now
@@ -199,16 +212,26 @@ class TenantRegistry:
             if spent + tokens > cfg.quota_tokens:
                 retry = round(window[0][0] - horizon, 3) if window \
                     else round(cfg.quota_window_s, 3)
+                if model is not None:
+                    # refused before commit: the breakdown must not
+                    # show tokens the tenant never got to spend
+                    self._model_spend[(name, model)] -= int(tokens)
                 raise TenantQuotaExceeded(
                     None, name, spent + tokens, cfg.quota_tokens,
                     retry_after_s=max(retry, 0.001))
             window.append((now, int(tokens)))
 
-    def refund(self, name: str, tokens: int) -> None:
+    def refund(self, name: str, tokens: int,
+               model: Optional[str] = None) -> None:
         """Return a charge whose admission was refused downstream (the
         scheduler's queue bound or deadline early-reject fired after
         quota accepted). Removes the most recent matching charge."""
         with self._lock:
+            if model is not None:
+                key = (name, model)
+                if key in self._model_spend:
+                    self._model_spend[key] = max(
+                        self._model_spend[key] - int(tokens), 0)
             window = self._spend.get(name)
             if not window:
                 return
@@ -217,6 +240,17 @@ class TenantRegistry:
                     del window[i]
                     return
             window.pop()
+
+    def model_spend(self) -> Dict[str, Dict[str, int]]:
+        """Cumulative net tokens charged, per tenant per model — the
+        billing view a multi-model fleet reports (load_suite /
+        router_stats consumers). Empty until a charge carries a model
+        tag."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for (tenant, model), tok in sorted(self._model_spend.items()):
+                out.setdefault(tenant, {})[model] = tok
+            return out
 
     def window_spend(self, name: str,
                      now: Optional[float] = None) -> int:

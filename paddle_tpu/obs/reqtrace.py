@@ -36,7 +36,8 @@ from collections import deque
 from typing import Any, Dict, Iterable, List, Optional
 
 __all__ = [
-    "EVENT_KINDS", "TERMINAL_REASONS", "TraceEvent", "ReqTraceRing",
+    "EVENT_KINDS", "DEPLOY_KINDS", "TERMINAL_REASONS", "TraceEvent",
+    "ReqTraceRing",
     "RING", "record", "events", "traces", "clear", "enable", "disable",
     "is_enabled", "arm", "disarm", "flight_dump", "maybe_flight",
     "dump_payload", "bind_tenant", "group_traces", "ttft_components",
@@ -70,8 +71,23 @@ EVENT_KINDS = (
                       # for the refused attempt; a router retry may
                       # still admit the trace elsewhere)
     "finish",         # terminal: stop|length|cancelled|timeout|shed|error
+    # -- deploy control plane (serving/deploy.py): these live on their
+    #    own per-deploy timeline (trace_id "deploy-<model>-N"), not on
+    #    request traces, and are exempt from the request invariants
+    "deploy_start",   # rollout began: model, from/to revision, replicas
+    "replica_swap",   # one slot swapped to the new revision (post-probe)
+    "canary",         # parity gate verdict on one slot: pass|fail
+    "rollback",       # deploy rolled back: reason, slots restored
+    "deploy_commit",  # rollout committed: new revision serving
 )
 _KIND_SET = frozenset(EVENT_KINDS)
+
+# control-plane kinds: a trace made ONLY of these is a deploy timeline,
+# checked by its own terminal rule (commit XOR rollback) instead of the
+# per-request invariants
+DEPLOY_KINDS = frozenset((
+    "deploy_start", "replica_swap", "canary", "rollback",
+    "deploy_commit"))
 
 TERMINAL_REASONS = ("stop", "length", "cancelled", "timeout", "shed",
                     "error")
@@ -504,7 +520,22 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
        (re-admission resets the latch: the new admission re-probes);
     7. every ``promote_abort`` is followed by re-prefill progress
        (``prefill``/``prefill_chunk``) or a terminal — a degraded
-       promotion must never leave the request wedged.
+       promotion must never leave the request wedged;
+    8. revision pinning (serving/deploy.py): no token is emitted — and
+       no terminal recorded — by a revision other than the one the
+       request was last admitted under. ``admitted`` carries the
+       resolved ``revision`` tag on multi-model stacks and the engine
+       stamps its own serving revision on ``first_token`` /
+       ``decode_chunk`` / ``finish``; a mismatch means stale routing
+       served a request across a weight rollout. A failover
+       re-admission records a fresh ``admitted`` (re-prefill from the
+       token log is revision-legal; migrated KV is not), which re-pins
+       the trace. Untagged (single-model) dumps are vacuously clean.
+
+    Deploy control-plane traces (every event in ``DEPLOY_KINDS``) skip
+    the request invariants; instead a complete dump requires each
+    started deploy to end in exactly one of ``deploy_commit`` /
+    ``rollback``.
     """
     complete = bool(dump.get("complete", True))
     violations: List[str] = []
@@ -579,6 +610,18 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
                 f"{arrivals} is not arrival-ordered")
 
     for tid, evts in sorted(by_trace.items()):
+        if all(e["kind"] in DEPLOY_KINDS for e in evts):
+            # control-plane timeline: its terminal rule is commit XOR
+            # rollback, and the request invariants don't apply
+            started = sum(1 for e in evts
+                          if e["kind"] == "deploy_start")
+            ended = sum(1 for e in evts
+                        if e["kind"] in ("deploy_commit", "rollback"))
+            if started and complete and ended != 1:
+                violations.append(
+                    f"{tid}: deploy ended {ended} times (expected "
+                    f"exactly one deploy_commit or rollback)")
+            continue
         prefilled = False
         finishes = 0
         rejected = False
@@ -587,9 +630,20 @@ def check_causality(dump: Dict[str, Any]) -> List[str]:
         ticket = None
         host_pending = False    # matched blocks still host-resident
         abort_open = False      # promote_abort awaiting re-prefill
+        admitted_rev = None     # latest admitted revision (invariant 8)
         for e in evts:
             kind = e["kind"]
             a = e.get("attrs") or {}
+            if kind == "admitted" and a.get("revision") is not None:
+                admitted_rev = a["revision"]
+            elif kind in ("first_token", "decode_chunk", "finish") \
+                    and a.get("revision") is not None \
+                    and admitted_rev is not None \
+                    and a["revision"] != admitted_rev:
+                violations.append(
+                    f"{tid}: {kind} from revision {a['revision']!r} "
+                    f"for a request admitted under revision "
+                    f"{admitted_rev!r} — revision pinning broken")
             if "arrival" in a:
                 if ticket is None:
                     ticket = a["arrival"]

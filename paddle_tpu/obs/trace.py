@@ -30,7 +30,7 @@ __all__ = ["Span", "SpanEvent", "CATEGORIES", "enable", "disable",
 #: span categories used by instrument sites (docs/observability.md);
 #: free-form strings are allowed, these are the cataloged ones
 CATEGORIES = ("serving", "schedule", "prefill", "decode", "checkpoint",
-              "restart", "train", "op")
+              "restart", "train", "op", "deploy")
 
 
 class SpanEvent:

@@ -37,7 +37,8 @@ SERVE_SPEC_ENV = "PADDLE_TPU_SERVE_FAULTS"
 KINDS = ("kill", "nan", "stall", "corrupt")
 SERVE_KINDS = ("nan_logits", "stall", "cache_corrupt", "burst",
                "kill_replica", "wedge_replica", "kill_migration",
-               "kill_promotion", "kill_demotion", "corrupt_host_block")
+               "kill_promotion", "kill_demotion", "corrupt_host_block",
+               "kill_deploy")
 KILL_EXIT_CODE = 37  # distinctive, so supervisors/tests can assert on it
 
 
@@ -230,6 +231,13 @@ class ServingFaultInjector:
                               on the next promotion/export (outcome
                               "integrity" → re-prefill). Slides while
                               the host tier is empty
+        kill_deploy@5[:r]     replica `r` dies INSIDE a rolling weight
+                              deploy, in the window after its new
+                              revision swapped in but before the canary
+                              parity gate ran — the narrowest rollout
+                              window (serving/deploy.py); the controller
+                              quarantines the slot and rolls the whole
+                              deploy back to the old revision
 
     Each fault fires ONCE per injector instance, at the first
     opportunity AT OR AFTER its step (a fault armed for a step where its
@@ -363,6 +371,16 @@ class ServingFaultInjector:
         if not self.enabled:
             return False
         return self._claim_targeted("kill_migration", step, replica)
+
+    def kill_deploy(self, step: int, replica: int) -> bool:
+        """DeployController hook, between swap_revision and the canary
+        gate on replica `replica`: True exactly once when a kill_deploy
+        fault targeting it is due at or after deploy tick `step` — the
+        freshly-swapped (never-served) incarnation dies, the controller
+        quarantines the slot and rolls the deploy back."""
+        if not self.enabled:
+            return False
+        return self._claim_targeted("kill_deploy", step, replica)
 
     def kill_promotion(self, step: int) -> bool:
         """Cache hook, inside `PagedKVCache._promote_node`: True exactly

@@ -258,3 +258,19 @@ def instrument_autoscaler(asc, witness: LockWitness) -> LockWitness:
     _swap(asc, "_lock", "Autoscaler._lock", witness)
     instrument_fleet(asc.rs, witness)
     return witness
+
+
+def instrument_deploy(ctl, witness: LockWitness) -> LockWitness:
+    """Trace a DeployController and the fleet it rolls. The
+    controller's lock sits ABOVE Autoscaler at the top of the declared
+    order; the shared ModelRegistry's sits between the replica locks
+    and the engines its factories build (swap_revision calls the
+    registry factory under EngineReplica._lock, and engine construction
+    registers metric families under the registry lock), so a traced
+    rollout witnesses the full DeployController -> ReplicaSet ->
+    EngineReplica -> ModelRegistry -> LLMEngine nesting. Idempotent —
+    a second controller over the same fleet re-traces only itself."""
+    _swap(ctl, "_lock", "DeployController._lock", witness)
+    _swap(ctl.registry, "_lock", "ModelRegistry._lock", witness)
+    instrument_fleet(ctl.rs, witness)
+    return witness

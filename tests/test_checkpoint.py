@@ -249,6 +249,36 @@ def test_bit_flip_detected_by_checksum_manifest(tmp_path):
     _epoch(model2, optim2, X, Y)
 
 
+def test_require_manifest_refuses_manifestless_snapshot(tmp_path):
+    """Strict-manifest mode (require_manifest=True, the mode published
+    model revisions restore with — serving/deploy.py): a snapshot whose
+    checksums.json was DELETED is unverifiable and must be refused like
+    any corrupt snapshot — quarantined with a warning, restore falls
+    back to the newest snapshot that still carries its manifest."""
+    import warnings
+
+    model, optim, sched = _build()
+    mgr = AutoCheckpointManager(str(tmp_path), [model], [optim], [sched],
+                                save_interval_epochs=1, max_keep=3)
+    X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    Y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    for e in range(2):
+        _epoch(model, optim, X, Y)
+        mgr.save(e)
+    os.remove(tmp_path / "epoch_1" / "checksums.json")
+
+    model2, optim2, sched2 = _build(seed=999)
+    mgr2 = AutoCheckpointManager(str(tmp_path), [model2], [optim2],
+                                 [sched2], require_manifest=True)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        got = mgr2.restore_latest()
+    assert got == 0                    # fell back past manifestless epoch_1
+    assert any("no checksums.json" in str(w.message) for w in rec)
+    assert (tmp_path / "epoch_1.corrupt").exists()
+    _epoch(model2, optim2, X, Y)       # fallback state actually loaded
+
+
 def test_missing_manifest_stays_restorable(tmp_path):
     """Pre-manifest snapshots (no checksums.json) must restore without
     complaint — the integrity layer is additive, not a format break."""

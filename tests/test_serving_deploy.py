@@ -1,0 +1,458 @@
+"""Multi-model fleet + chaos-gated rolling weight deploys
+(paddle_tpu/inference/serving/deploy.py, router.py, migration.py).
+
+The load-bearing pins (docs/serving.md "Multi-model serving and
+rolling deploys"):
+
+- publishing is content-addressed by the sha256 checkpoint manifest:
+  identical weights republish as the SAME revision (no new lineage
+  entry), drifted weights as a different one, and an artifact with no
+  `checksums.json` is a hard publish error;
+- a rolling deploy under live traffic commits replica-by-replica with
+  zero lost requests, flips the registry-active revision, and clears
+  the A/B route weights;
+- requests that stay pinned to the OLD revision finish bitwise against
+  a no-deploy run on old weights;
+- a poisoned candidate revision is caught by the canary parity gate at
+  the committed tolerance and rolled back atomically — old revision
+  still active, every replica restored, nothing lost;
+- a kill inside the swap->canary window after an earlier slot already
+  rejoined rolls back the LIVE slot too, through the router's
+  zero-lost eviction;
+- KV never crosses revisions: the migrator refuses both live-request
+  migration and peer prefix pulls between replicas with different
+  (model, revision) keys;
+- reqtrace invariant 8 (no token under a revision other than the
+  admitted one) and the deploy-trace terminal rule (exactly one
+  commit XOR rollback per started deploy) hold on real runs and flag
+  synthetic violations.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.inference.serving import (DeployConfig, DeployController,
+                                          EngineConfig, ModelRegistry,
+                                          ReplicaSet, ReplicaState,
+                                          RouterConfig, SamplingParams)
+from paddle_tpu.obs.reqtrace import ReqTraceRing
+from paddle_tpu.testing.faults import ServingFaultInjector
+
+VOCAB = 97
+
+
+def _gpt(seed):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=24)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _gpt(0)
+
+
+@pytest.fixture(scope="module")
+def model_b():
+    # genuinely different weights: the canary gate sees real greedy
+    # divergence, so a clean deploy must COMMIT its tolerance
+    return _gpt(1)
+
+
+def _ecfg(**kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("decode_chunk_size", 2)   # keep requests in flight
+    return EngineConfig(**kw)
+
+
+def _registry(old, new):
+    reg = ModelRegistry()
+    r0 = reg.publish("m", old, engine_config=_ecfg())
+    r1 = reg.publish("m", new, engine_config=_ecfg())
+    assert r0 != r1
+    return reg, r0, r1
+
+
+def _fleet(reg, n=2, faults=None, **rkw):
+    rkw.setdefault("backoff_base", 0.01)
+    rkw.setdefault("backoff_max", 0.05)
+    rkw.setdefault("backoff_jitter", 0.0)
+    return ReplicaSet.from_registry(
+        reg, ("m",) * n, config=RouterConfig(num_replicas=n, **rkw),
+        faults=faults or ServingFaultInjector(""))
+
+
+def _prompts(n, seed=7, lo=3, hi=8):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, VOCAB, int(rng.randint(lo, hi))).astype(np.int32)
+            for _ in range(n)]
+
+
+def _sp(mt=6):
+    return SamplingParams(max_tokens=mt, model="m")
+
+
+def _assert_no_leaks(rs):
+    for idx, audit in rs.check_integrity().items():
+        assert audit is not None, f"replica {idx} has no live engine"
+        assert audit["leaked"] == 0, (idx, audit)
+
+
+def _assert_all_served(rs, rids):
+    for rid in rids:
+        rec = rs.get_request(rid)
+        assert rec.finished, rid
+        assert rec.finish_reason in ("stop", "length"), \
+            (rid, rec.finish_reason)
+
+
+# ------------------------------------------------------------- registry
+def test_publish_is_content_addressed_and_idempotent(model, model_b):
+    reg = ModelRegistry()
+    r0 = reg.publish("m", model)
+    assert r0.startswith("sha256:") and len(r0) == len("sha256:") + 12
+    # identical weights -> same id, no new lineage entry
+    assert reg.publish("m", model) == r0
+    assert reg.revisions("m") == (r0,)
+    assert reg.active("m") == r0              # first publish activates
+    r1 = reg.publish("m", model_b)
+    assert r1 != r0                           # drifted weights, new id
+    assert reg.revisions("m") == (r0, r1)     # publish-ordered lineage
+    assert reg.active("m") == r0              # later publishes do NOT
+    reg.set_active("m", r1)
+    assert reg.active("m") == r1
+    desc = reg.describe()
+    assert desc["m"] == {"revisions": [r0, r1], "active": r1}
+    with pytest.raises(ValueError, match="unknown model"):
+        reg.active("ghost")
+    with pytest.raises(ValueError, match="no revision"):
+        reg.set_active("m", "sha256:000000000000")
+
+
+def test_publish_artifact_requires_manifest(tmp_path, model):
+    reg = ModelRegistry()
+    art = tmp_path / "artifact"
+    art.mkdir()
+    with pytest.raises(IOError, match="checksums.json"):
+        reg.publish("m", model, artifact_dir=str(art))
+    assert not reg.has_model("m")             # nothing half-published
+    (art / "checksums.json").write_text("[]")
+    with pytest.raises(IOError, match="empty or malformed"):
+        reg.publish("m", model, artifact_dir=str(art))
+    manifest = {"layer0/w": "ab" * 32, "layer0/b": "cd" * 32}
+    (art / "checksums.json").write_text(json.dumps(manifest))
+    rid = reg.publish("m", model, artifact_dir=str(art))
+    assert rid.startswith("sha256:")
+    assert reg.manifest("m", rid) == manifest
+
+
+def test_registry_engines_are_revision_stamped(model, model_b):
+    reg, r0, r1 = _registry(model, model_b)
+    eng = reg.build_engine("m", None, 0, 0)   # None -> active revision
+    assert (eng.config.model, eng.config.revision) == ("m", r0)
+    # the pinned factory builds the requested revision, not the active
+    eng2 = reg.engine_factory("m", r1)(3, 0)
+    assert (eng2.config.model, eng2.config.revision) == ("m", r1)
+    with pytest.raises(ValueError, match="no revision"):
+        reg.build_engine("m", "sha256:000000000000", 0, 0)
+    with pytest.raises(ValueError, match="unknown model"):
+        reg.engine_factory("ghost", r0)
+
+
+def test_controller_preconditions(model, model_b):
+    reg, r0, r1 = _registry(model, model_b)
+    plain = ReplicaSet.from_model(
+        model, RouterConfig(num_replicas=1), engine_config=_ecfg())
+    with pytest.raises(ValueError, match="ModelRegistry"):
+        DeployController(plain, "m", r1)
+    rs = _fleet(reg, n=1)
+    with pytest.raises(ValueError, match="already at"):
+        DeployController(rs, "m", r0)         # no-op deploy
+    with pytest.raises(ValueError, match="no revision"):
+        DeployController(rs, "m", "sha256:000000000000")
+
+
+# -------------------------------------------------------------- commits
+def test_rolling_deploy_commits_under_live_traffic(model, model_b):
+    reg, r0, r1 = _registry(model, model_b)
+    rs = _fleet(reg, n=2)
+    rids = [rs.add_request(p, _sp()) for p in _prompts(6)]
+    # the candidate genuinely diverges on every canary prompt; the
+    # committed tolerance covering the full set is what lets it ship
+    ctl = DeployController(rs, "m", r1,
+                           config=DeployConfig(canary_tolerance=3))
+    ctl.start()
+    while not ctl.done():
+        rs.step()
+        ctl.tick()
+    rs.run(max_steps=2000)
+
+    st = ctl.status()
+    assert st["outcome"] == "committed", st
+    assert st["error"] is None
+    assert st["swapped"] == [0, 1]
+    assert reg.active("m") == r1              # registry flipped
+    for rep in rs.replicas:
+        assert rep.revision == r1
+        assert rep.is_serving()
+    assert rs.route_weights("m") == {}        # A/B split cleared
+    _assert_all_served(rs, rids)              # zero lost
+    _assert_no_leaks(rs)
+
+    # post-commit traffic is admitted under the new revision
+    rid = rs.add_request(np.arange(1, 5, dtype=np.int32), _sp(mt=3))
+    assert rs.get_request(rid).revision == r1
+    rs.run(max_steps=500)
+    _assert_no_leaks(rs)
+
+    # the deploy trace on the closed catalog: start, one swap + canary
+    # per slot, exactly one commit — and the merged request + deploy
+    # timeline passes the checker (invariant 8 included)
+    dep = [e.kind for e in obs.reqtrace.events(trace_id=ctl.deploy_id)]
+    assert dep[0] == "deploy_start"
+    assert dep.count("replica_swap") == 2
+    assert dep.count("canary") == 2
+    assert dep[-1] == "deploy_commit"
+    ids = sorted(obs.reqtrace.traces(prefix=ctl.deploy_id))
+    ids += sorted(obs.reqtrace.traces(prefix=f"tr-{rs.label}-"))
+    dump = obs.reqtrace.dump_payload("deploy-commit-test",
+                                     trace_ids=ids, complete=True)
+    assert obs.reqtrace.check_causality(dump) == []
+
+
+def test_old_revision_requests_finish_bitwise(model, model_b):
+    prompts = _prompts(5, seed=11)
+    # reference: the same prompts on an old-revision fleet, no deploy
+    ref = _fleet(_registry(model, model_b)[0], n=2)
+    ref_rids = [ref.add_request(p, _sp()) for p in prompts]
+    ref.run(max_steps=2000)
+    want = [list(ref.get_request(r).tokens) for r in ref_rids]
+    _assert_no_leaks(ref)
+
+    reg, r0, r1 = _registry(model, model_b)
+    rs = _fleet(reg, n=2)
+    rids = [rs.add_request(p, _sp()) for p in prompts]
+    for _ in range(3):                        # work underway pre-deploy
+        rs.step()
+    DeployController(rs, "m", r1,
+                     config=DeployConfig(canary_tolerance=3)).run()
+    rs.run(max_steps=2000)
+    _assert_all_served(rs, rids)
+    # every request that FINISHED pinned to the old revision matched
+    # the no-deploy run token-for-token (re-pinned ones re-prefilled
+    # on new weights and legitimately drifted)
+    checked = 0
+    for i, rid in enumerate(rids):
+        rec = rs.get_request(rid)
+        if rec.revision == r0:
+            assert list(rec.tokens) == want[i], i
+            checked += 1
+    assert checked >= 1                       # the gate was not vacuous
+    _assert_no_leaks(rs)
+
+
+# ------------------------------------------------------------ rollbacks
+def test_poisoned_revision_rolls_back(model, model_b):
+    reg, r0, r_bad = _registry(model, model_b)
+    rs = _fleet(reg, n=2)
+    rids = [rs.add_request(p, _sp(mt=5)) for p in _prompts(4)]
+    # strict default tolerance 0: the divergent candidate must abort
+    ctl = DeployController(rs, "m", r_bad)
+    st = ctl.run()
+    rs.run(max_steps=2000)
+
+    assert st["outcome"] == "rolled_back", st
+    assert "canary" in st["error"] and "diverged" in st["error"]
+    assert reg.active("m") == r0              # old revision still live
+    for rep in rs.replicas:
+        assert rep.revision == r0             # warm engines restored
+        assert rep.is_serving()
+    assert rs.route_weights("m") == {}
+    _assert_all_served(rs, rids)              # in-flight work survived
+    _assert_no_leaks(rs)
+    # rollback released the warm standby path: the slot still restarts
+    rid = rs.add_request(np.arange(1, 6, dtype=np.int32), _sp(mt=3))
+    assert rs.get_request(rid).revision == r0
+    rs.run(max_steps=500)
+    _assert_no_leaks(rs)
+
+
+def test_rollback_unwinds_live_swapped_slot(model, model_b):
+    # kill_deploy fires on slot 1 inside its swap->canary window, AFTER
+    # slot 0 already swapped, passed canary and rejoined rotation —
+    # the rollback must evict slot 0's live new-revision work through
+    # the zero-lost failover before restoring its warm old engine
+    reg, r0, r1 = _registry(model, model_b)
+    faults = ServingFaultInjector("kill_deploy@1:1")
+    rs = _fleet(reg, n=3, faults=faults)
+    ctl = DeployController(rs, "m", r1,
+                           config=DeployConfig(canary_tolerance=3))
+    ctl.start()
+    rids, k = [], 0
+    while not ctl.done():
+        if k < 12:                            # traffic during rollout
+            rids.append(rs.add_request(_prompts(1, seed=100 + k)[0],
+                                       _sp()))
+            k += 1
+        rs.step()
+        ctl.tick()
+    st = ctl.status()
+    assert st["outcome"] == "rolled_back", st
+    assert "killed in the swap->canary window" in st["error"]
+    assert st["swapped"] == [0, 1]
+    rs.run(max_steps=3000)
+
+    assert reg.active("m") == r0
+    for rep in rs.replicas:
+        assert rep.revision == r0
+        assert rep.is_serving()
+    assert rs.route_weights("m") == {}
+    _assert_all_served(rs, rids)              # evicted work re-served
+    _assert_no_leaks(rs)
+    ids = sorted(obs.reqtrace.traces(prefix=ctl.deploy_id))
+    ids += sorted(obs.reqtrace.traces(prefix=f"tr-{rs.label}-"))
+    dump = obs.reqtrace.dump_payload("deploy-rollback-test",
+                                     trace_ids=ids, complete=True)
+    assert obs.reqtrace.check_causality(dump) == []
+    dep = [e.kind for e in obs.reqtrace.events(trace_id=ctl.deploy_id)]
+    assert dep.count("rollback") == 1 and "deploy_commit" not in dep
+
+
+# -------------------------------------------------- cross-revision KV
+def test_cross_revision_kv_is_refused(model, model_b):
+    reg, r0, r1 = _registry(model, model_b)
+    rs = _fleet(reg, n=2)
+    # park slot 1 and move it to the new revision by hand (mid-deploy
+    # shape: a mixed-revision pool)
+    rs.drain(1, recompute=False)
+    for _ in range(50):
+        if rs.replicas[1].state == ReplicaState.DRAINED:
+            break
+        rs.step()
+    assert rs.replicas[1].state == ReplicaState.DRAINED
+    assert rs.replicas[1].swap_revision(reg.engine_factory("m", r1))
+    assert rs.probe_grow(1)
+    assert rs.replicas[0].revision_key() == ("m", r0)
+    assert rs.replicas[1].revision_key() == ("m", r1)
+
+    # a live decode on the old-revision slot refuses to migrate across
+    # no route weights: steering prefers the registry-active revision,
+    # so the request homes on the old-revision slot
+    rid = rs.add_request(_prompts(1, seed=21)[0], _sp(mt=8))
+    assert rs.get_request(rid).replica == 0
+    for _ in range(200):
+        if rs.replicas[0].migratable_requests():
+            break
+        rs.step()
+    cand = rs.replicas[0].migratable_requests()
+    assert cand, "no decode-phase request to migrate"
+    before = rs.migrator.stats()["revision_refused"]
+    out = rs.migrator.migrate(rs.replicas[0], rs.replicas[1], cand[0],
+                              "rebalance")
+    assert out is None                        # clean abort, not a raise
+    # …and a peer prefix pull across revisions is refused the same way
+    rec = rs.get_request(rid)
+    pull = rs.migrator.fetch_prefix(rs.replicas[0], rs.replicas[1],
+                                    rid, rec.trace_id,
+                                    list(rec.prompt_ids))
+    assert pull is None
+    assert rs.migrator.stats()["revision_refused"] == before + 2
+    rs.run(max_steps=1000)
+    assert rs.get_request(rid).finished       # kept running at source
+    assert rs.get_request(rid).revision == r0
+    _assert_no_leaks(rs)
+
+
+# --------------------------------------------------------- A/B routing
+def test_route_weight_validation_and_steering(model, model_b):
+    reg, r0, r1 = _registry(model, model_b)
+    rs = _fleet(reg, n=2)
+    with pytest.raises(ValueError, match="non-negative"):
+        rs.set_route_weights("m", {r0: -1.0})
+    with pytest.raises(ValueError, match="positive sum"):
+        rs.set_route_weights("m", {r0: 0.0})
+    rs.set_route_weights("m", {r0: 1.0, r1: 3.0})
+    assert rs.route_weights("m") == {r0: 1.0, r1: 3.0}
+    # all weight on a revision no replica serves: availability beats
+    # the split — the request admits anyway, pinned to its real home
+    rs.set_route_weights("m", {r1: 1.0})
+    rid = rs.add_request(_prompts(1, seed=31)[0], _sp(mt=2))
+    assert rs.get_request(rid).revision == r0
+    rs.set_route_weights("m", None)
+    assert rs.route_weights("m") == {}
+    rs.run(max_steps=300)
+    _assert_no_leaks(rs)
+
+
+# ------------------------------------------------- invariant 8 (checker)
+def _payload(ring, complete=True):
+    return {"version": 1, "reason": "test", "complete": complete,
+            "events": [e.as_dict() for e in ring.events()]}
+
+
+def test_invariant8_synthetic_legal_and_violation():
+    r = ReqTraceRing()
+    # legal: tokens under the admitted revision; the re-dispatch
+    # records a fresh `admitted` that re-pins the trace
+    r.record("admitted", "t8", router="r0", replica=0, model="m",
+             revision="sha256:aaa")
+    r.record("engine_admit", "t8", engine="m-r0", arrival=0)
+    r.record("scheduled", "t8", arrival=0)
+    r.record("prefill", "t8")
+    r.record("first_token", "t8", revision="sha256:aaa")
+    r.record("requeue", "t8", arrival=0)
+    r.record("admitted", "t8", router="r0", replica=1, policy="repin",
+             model="m", revision="sha256:bbb")
+    r.record("engine_admit", "t8", engine="m-r1", arrival=0)
+    r.record("scheduled", "t8", arrival=0)
+    r.record("prefill", "t8")
+    r.record("decode_chunk", "t8", revision="sha256:bbb")
+    r.record("finish", "t8", reason="stop", revision="sha256:bbb")
+    assert obs.reqtrace.check_causality(_payload(r)) == []
+
+    # violation: a token from a revision the trace was never re-pinned
+    # to — the exact hole a buggy rollout would open
+    r.clear()
+    r.record("admitted", "t9", router="r0", replica=0, model="m",
+             revision="sha256:aaa")
+    r.record("engine_admit", "t9", engine="m-r0", arrival=0)
+    r.record("scheduled", "t9", arrival=0)
+    r.record("prefill", "t9")
+    r.record("first_token", "t9", revision="sha256:bbb")
+    r.record("finish", "t9", reason="stop", revision="sha256:bbb")
+    msgs = obs.reqtrace.check_causality(_payload(r))
+    assert any("revision pinning broken" in v for v in msgs), msgs
+
+
+def test_deploy_trace_terminal_rule():
+    r = ReqTraceRing()
+    r.record("deploy_start", "dep-t", router="r0", model="m",
+             from_revision="sha256:aaa", to_revision="sha256:bbb",
+             replicas=2)
+    r.record("replica_swap", "dep-t", router="r0", replica=0,
+             model="m", revision="sha256:bbb")
+    r.record("canary", "dep-t", router="r0", replica=0, mismatches=0,
+             passed=True)
+    # an in-flight deploy is fine on a partial dump…
+    assert obs.reqtrace.check_causality(_payload(r, complete=False)) \
+        == []
+    # …but a COMPLETE dump demands exactly one terminal
+    msgs = obs.reqtrace.check_causality(_payload(r))
+    assert any("deploy ended 0 times" in v for v in msgs), msgs
+    r.record("deploy_commit", "dep-t", router="r0", model="m",
+             revision="sha256:bbb", replicas=1)
+    assert obs.reqtrace.check_causality(_payload(r)) == []
+    # commit AND rollback on one deploy is a bug wherever it comes from
+    r.record("rollback", "dep-t", router="r0", model="m", reason="x",
+             restored=0, revision="sha256:aaa")
+    msgs = obs.reqtrace.check_causality(_payload(r))
+    assert any("deploy ended 2 times" in v for v in msgs), msgs

@@ -87,6 +87,28 @@ TenantRegistry locks.
 
     JAX_PLATFORMS=cpu python tools/chaos_serve.py --tenants --seed 0
 
+`--deploy` switches to the rolling-deploy harness
+(`run_chaos_deploy`): a 3-replica single-model fleet built over a
+ModelRegistry runs TWO rollouts of a genuinely-different candidate
+revision while traffic keeps flowing (docs/serving.md "Multi-model
+serving and rolling deploys"). `kill_deploy@tick:r` kills replica r in
+the one window plain kill_replica can't isolate — after the new
+engine swapped in but BEFORE the canary parity gate ran — and it is
+scheduled to land after another slot already swapped AND rejoined, so
+the rollback must unwind a live serving slot (evict its new-revision
+requests through the zero-lost failover, restore the warm old-weight
+engine) and not just the corpse. The second rollout runs with the
+fault budget exhausted and must commit. Gates: both deploys reach
+their required terminal, the registry stays on the old revision after
+the rollback and lands on the new one after the commit, zero lost
+requests, zero leaked blocks, non-vacuous evacuating-drain KV
+migrations, reqtrace causality clean (incl. the revision-pinning
+invariant: no token from a revision the request was not admitted
+under), and a lock witness that actually saw the DeployController and
+ModelRegistry locks.
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --deploy --seed 0
+
 `--prefix-cache` reruns either harness on TEMPLATED prompts with
 radix-trie block sharing enabled (docs/serving.md "Prefix caching") —
 multi-replica mode additionally routes by prefix affinity so the
@@ -1058,6 +1080,200 @@ def run_chaos_tenants(seed: int = 0, n_requests: int = 24,
     return report
 
 
+DEFAULT_DEPLOY_FAULTS = "kill_deploy@1:1"
+
+
+def run_chaos_deploy(seed: int = 0, n_requests: int = 24,
+                     replicas: int = 3,
+                     faults: str = DEFAULT_DEPLOY_FAULTS,
+                     max_steps: int = 4000,
+                     witness_out: str = "") -> dict:
+    """One seeded rolling-deploy chaos run (module docstring). Two
+    rollouts of the same candidate revision under continuous traffic:
+    the first is killed in the swap->canary window (`kill_deploy` —
+    replica 1 dies AFTER replica 0 already swapped and rejoined, so
+    the rollback has a live rejoined slot to unwind) and must roll
+    back atomically; the second runs with the fault budget exhausted
+    and must commit. Raises AssertionError on a lost request, a leaked
+    block on any live pool, a deploy missing its required terminal,
+    the registry activating the candidate after the rollback, a
+    vacuous run (kill never fired / nothing swapped before the kill /
+    zero mid-rollout KV migrations) or a lock-order finding that never
+    saw the DeployController and ModelRegistry locks."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu import obs
+    from paddle_tpu.inference.serving import (
+        DeployConfig, DeployController, EngineConfig, ModelRegistry,
+        ReplicaSet, RouterConfig, SamplingParams)
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.testing.faults import ServingFaultInjector
+    from paddle_tpu.testing.locktrace import instrument_deploy
+
+    witness, predicted = _lock_witness()
+    rng = np.random.RandomState(seed)
+    obs.reqtrace.enable()
+
+    # two GENUINELY different revisions of one architecture (different
+    # init seeds -> different weights -> different sha256 manifests;
+    # identical weights would publish idempotently as ONE revision).
+    # The canary tolerance is opened to the full prompt set because the
+    # candidate is MEANT to diverge: this harness gates the kill
+    # window and the rollback machinery, while the parity gate's
+    # poisoned-revision rejection has its own coverage
+    # (tools/load_suite.py rolling_deploy, pass 2).
+    gcfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                     num_heads=4, max_seq_len=48)
+
+    def _rev_model(init_seed):
+        paddle.seed(init_seed)
+        m = GPT(gcfg)
+        m.eval()
+        return m
+
+    ecfg = EngineConfig(block_size=4, num_blocks=48, max_num_seqs=4,
+                        decode_chunk_size=2, max_waiting=64,
+                        enable_prefix_cache=True)
+    reg = ModelRegistry()
+    rev_old = reg.publish("m", _rev_model(0), engine_config=ecfg)
+    rev_new = reg.publish("m", _rev_model(1), engine_config=ecfg)
+    assert rev_new != rev_old, "seeded revisions collided"
+
+    injector = ServingFaultInjector(faults)
+    rcfg = RouterConfig(num_replicas=replicas,
+                        heartbeat_timeout_s=0.02,
+                        backoff_base=0.01, backoff_max=0.05,
+                        backoff_jitter=0.0)
+    rs = ReplicaSet.from_registry(reg, ("m",) * replicas, config=rcfg,
+                                  faults=injector)
+    dcfg = DeployConfig(canary_tolerance=3)   # = len(canary_prompts)
+
+    rids = []
+    submitted = 0
+    step = 0
+    ctl = None
+    done_deploys = []
+    kill_obs = None
+    next_deploy_at = 3                 # traffic in flight before it
+    while (submitted < n_requests or rs.has_unfinished()
+           or len(done_deploys) < 2):
+        if submitted < n_requests and step % 2 == 0:
+            plen = int(rng.randint(4, 10))
+            p = rng.randint(0, gcfg.vocab_size, (plen,), dtype=np.int32)
+            rids.append(rs.add_request(
+                p, SamplingParams(max_tokens=int(rng.randint(6, 11)),
+                                  model="m")))
+            submitted += 1
+        rs.step()
+        if ctl is not None:
+            kills_before = sum(1 for k, _s in injector.fired_log
+                               if k == "kill_deploy")
+            ctl.tick()
+            if sum(1 for k, _s in injector.fired_log
+                   if k == "kill_deploy") > kills_before:
+                kill_obs = {
+                    "step": step, "tick": ctl.status()["ticks"],
+                    "swapped_before_kill":
+                        len(ctl.status()["swapped"]) - 1,
+                }
+            if ctl.done():
+                done_deploys.append(ctl.status())
+                next_deploy_at = step + 2
+                ctl = None
+        elif len(done_deploys) < 2 and step >= next_deploy_at:
+            ctl = DeployController(rs, "m", rev_new, config=dcfg,
+                                   faults=injector)
+            instrument_deploy(ctl, witness)
+            ctl.start()
+        step += 1
+        assert step <= max_steps, \
+            f"run incomplete after {max_steps} steps " \
+            f"(deploys {len(done_deploys)}/2, " \
+            f"unfinished {rs.has_unfinished()})"
+        if not any(r.has_unfinished() for r in rs.replicas) \
+                and rs.has_unfinished():
+            time.sleep(0.002)           # restart backoff pending
+
+    st = rs.router_stats()
+    p99 = rs.ttft_quantile(0.99)
+    unserved = sum(v for k, v in st["finish_reasons"].items()
+                   if k not in ("stop", "length"))
+    report = {
+        "seed": seed, "requests": submitted, "replicas": replicas,
+        "faults": faults, "fired": list(injector.fired_log),
+        "revisions": {"old": rev_old, "new": rev_new},
+        "deploys": done_deploys,
+        "kill": kill_obs,
+        "requeues": st["requeues"],
+        "migrations": st["migrations"],
+        "finish_reasons": st["finish_reasons"],
+        "pools": st["pools"],
+        "replica_states": {k: str(v)
+                           for k, v in st["replica_states"].items()},
+        "slo": {"ttft_p99_s": None if math.isnan(p99) else round(p99, 4),
+                "reject_rate": round(unserved / max(submitted, 1), 4)},
+    }
+    # 1. deploy #1 rolled back (kill in the swap->canary window) and
+    #    left the registry on the old revision; deploy #2 committed
+    assert len(done_deploys) == 2, f"deploys: {done_deploys}"
+    assert done_deploys[0]["outcome"] == "rolled_back", \
+        f"killed deploy did not roll back: {done_deploys[0]}"
+    assert done_deploys[1]["outcome"] == "committed", \
+        f"clean deploy did not commit: {done_deploys[1]}"
+    assert reg.active("m") == rev_new, \
+        "registry not on the new revision after the committed deploy"
+    # 2. the kill was non-vacuous AND landed after a real swap — the
+    #    rollback had a rejoined new-revision slot to unwind, not just
+    #    the freshly-killed one
+    assert kill_obs is not None, "kill_deploy fault never fired"
+    assert kill_obs["swapped_before_kill"] >= 1, \
+        f"kill landed before any other slot swapped: {kill_obs}"
+    # 3. zero lost: every admitted request is terminal and served,
+    #    across the rollout drains, the kill, the rollback eviction and
+    #    the second rollout
+    lost = [r for r in rids
+            if rs.get_request(r).finish_reason not in ("stop", "length")]
+    assert not lost, f"requests not served: {lost}"
+    # 4. the fleet converged: every slot is back in rotation on the
+    #    committed revision, and every live pool audits zero leaks
+    for idx, state in rs.states().items():
+        assert str(state) == "up", \
+            f"replica {idx} did not converge (state {state})"
+    report["integrity"] = rs.check_integrity()
+    for idx, audit in report["integrity"].items():
+        assert audit is not None, \
+            f"replica {idx} ended the run without a live engine"
+    # 5. the rollout drains actually MOVED live KV (evacuating drain —
+    #    a run where every request finished before its replica drained
+    #    never exercised migration)
+    assert st["migrations"]["migrations"] > 0, \
+        "no KV migrations during the rollout drains (vacuous run)"
+    # 6. per-request causality (incl. invariant 8: no token from a
+    #    revision the request was not admitted under) and the deploy
+    #    lifecycle invariant (every started deploy ends in exactly one
+    #    commit XOR rollback), machine-checked over the recorded traces
+    evs = [e.as_dict() for e in obs.reqtrace.events(
+        prefix=f"tr-{rs.label}-")]
+    evs += [e.as_dict() for e in obs.reqtrace.events(prefix="deploy-")]
+    evs.sort(key=lambda d: d["seq"])
+    dump = {"reason": "deploy_chaos", "complete": True, "events": evs}
+    assert dump["events"], "reqtrace recorded nothing for this router"
+    violations = obs.reqtrace.check_causality(dump)
+    assert not violations, \
+        f"causality violations (incl. revision pinning): {violations}"
+    report["causality_events"] = len(dump["events"])
+    # 7. lock-order witness — and it must have actually SEEN the two
+    #    locks this PR added to the declared order
+    _audit_witness(witness, predicted, report, spans_path=witness_out)
+    seen = " ".join(report["lockgraph"]["witnessed_edges"])
+    assert "DeployController._lock" in seen, \
+        "witness never saw DeployController._lock"
+    assert "ModelRegistry._lock" in seen, \
+        "witness never saw ModelRegistry._lock"
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -1088,6 +1304,14 @@ def main(argv=None) -> int:
                          "exhaustion burst (default faults "
                          f"{DEFAULT_TENANT_FAULTS!r}; --replicas "
                          "defaults to 3)")
+    ap.add_argument("--deploy", action="store_true",
+                    help="rolling-deploy harness: two weight rollouts "
+                         "under continuous traffic — the first killed "
+                         "in the swap->canary window (kill_deploy) "
+                         "must roll back atomically with zero lost "
+                         "requests, the second must commit (default "
+                         f"faults {DEFAULT_DEPLOY_FAULTS!r}; "
+                         "--replicas defaults to 3)")
     ap.add_argument("--faults", default=None,
                     help="ServingFaultInjector spec (see testing/faults.py)")
     ap.add_argument("--cancel-every", type=int, default=0,
@@ -1146,6 +1370,14 @@ def main(argv=None) -> int:
                 faults=(args.faults if args.faults is not None
                         else DEFAULT_DISAGG_FAULTS),
                 max_steps=args.max_steps,
+                witness_out=args.witness_out)
+        elif args.deploy:
+            report = run_chaos_deploy(
+                seed=args.seed, n_requests=args.requests,
+                replicas=(args.replicas if args.replicas > 0 else 3),
+                faults=(args.faults if args.faults is not None
+                        else DEFAULT_DEPLOY_FAULTS),
+                max_steps=max(args.max_steps, 600),
                 witness_out=args.witness_out)
         elif args.tenants:
             report = run_chaos_tenants(

@@ -108,6 +108,20 @@ Scenarios (docs/observability.md "Load suite"):
                  disaggregation exists to protect), zero lost requests
                  and non-vacuous handoffs, with the mixed baseline
                  riding along on the same gap bound for attribution.
+- rolling_deploy — chaos-gated zero-downtime weight rollout
+                 (docs/serving.md "Multi-model serving and rolling
+                 deploys"): a 3-replica registry-built pool rolls to a
+                 new revision replica-by-replica WHILE the arrival
+                 clock keeps submitting — evacuating drain with live
+                 KV-block migration, weight swap, canary parity gate,
+                 probe rejoin. Gates zero lost requests, TTFT p99 held
+                 through the rollout, non-vacuous migrations, and the
+                 bitwise contract: every request that finished pinned
+                 to the OLD revision must match a no-deploy reference
+                 run on old weights token-for-token. A second pass
+                 deploys a poisoned revision under the strict default
+                 canary tolerance — the parity gate must reject it and
+                 roll back with the old revision still active.
 
 Each scenario runs its full workload once unmeasured (compiles every
 prefill/decode bucket — TTFT must not include XLA compile time), then
@@ -141,7 +155,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 SCENARIOS = ("steady", "bursty", "long_prompt", "chaos_kill",
              "decode_heavy", "replica_kill", "mixed_prefill_decode",
              "prefix_heavy", "tiered_prefix", "disagg",
-             "multi_tenant", "autoscale_diurnal")
+             "multi_tenant", "autoscale_diurnal", "rolling_deploy")
 
 #: per-scenario SLOs. Latency bounds are generous (CPU-smoke friendly)
 #: — the point is catching regressions in KIND (rejects where none are
@@ -258,6 +272,24 @@ SLOS = {
                           "max_reject_rate": 0.2, "max_lost": 0,
                           "min_grow_events": 1,
                           "min_shrink_events": 1},
+    # rolling weight deploy (docs/serving.md "Multi-model serving and
+    # rolling deploys"): a 3-replica registry-built pool rolls to a
+    # new revision under continuous traffic. min_migrations pins that
+    # the rollout moved LIVE work (drain with KV-block handoff, not an
+    # idle fleet); max_lost 0 and the held TTFT p99 are the
+    # zero-downtime claim; max_divergent_old_rev 0 is the bitwise
+    # contract — requests that finished pinned to the old revision
+    # must match a no-deploy reference run on old weights
+    # token-for-token. min_commits gates the clean pass's terminal;
+    # min_rollbacks gates the second, poisoned pass: under the strict
+    # default canary tolerance the parity gate must refuse the
+    # candidate and restore the old revision with nothing lost
+    "rolling_deploy": {"min_tokens_per_sec": 1.0,
+                       "max_ttft_p99_s": 10.0,
+                       "max_reject_rate": 0.2, "max_lost": 0,
+                       "min_migrations": 1, "min_commits": 1,
+                       "min_rollbacks": 1,
+                       "max_divergent_old_rev": 0},
 }
 
 CHAOS_FAULTS = "nan_logits@6,stall@9:0.05,cache_corrupt@12"
@@ -701,6 +733,73 @@ def _drive_autoscaled(model, ecfg, arrivals, witness=None,
     return rs, asc, rids, submitted, rejected, wall, series
 
 
+def _drive_deploy(registry, model_id, rev_to, arrivals, dcfg,
+                  witness=None, obs_label="load-deploy",
+                  deploy_at=3, max_steps=6000):
+    """rolling_deploy driver: a 3-replica single-model pool built from
+    a ModelRegistry, with a DeployController rolling it to `rev_to`
+    WHILE the arrival clock keeps submitting. The controller starts
+    once traffic is in flight (`deploy_at`) and ticks once per router
+    step to its terminal; the loop then keeps stepping until the fleet
+    drains. Returns (router, terminal deploy status, {rid: arrival
+    index}, submitted, rejected, wall_seconds)."""
+    from paddle_tpu.inference.serving import (DeployController,
+                                              ReplicaSet, RouterConfig,
+                                              SamplingParams)
+    from paddle_tpu.inference.serving.scheduler import EngineOverloaded
+
+    rc = RouterConfig(num_replicas=3, heartbeat_timeout_s=0.02,
+                      backoff_base=0.01, backoff_max=0.05,
+                      backoff_jitter=0.0, obs_label=obs_label)
+    rs = ReplicaSet.from_registry(registry, (model_id,) * 3, config=rc)
+    if witness is not None:
+        from paddle_tpu.testing.locktrace import instrument_fleet
+        instrument_fleet(rs, witness)
+    queue = sorted(arrivals, key=lambda a: a[0])
+    i = submitted = rejected = 0
+    step = 0
+    ctl = None
+    status = None
+    rid_index = {}
+    t0 = time.perf_counter()
+    while i < len(queue) or rs.has_unfinished() or status is None:
+        while i < len(queue) and queue[i][0] <= step:
+            _, p, mt = queue[i]
+            idx = i
+            i += 1
+            submitted += 1
+            try:
+                rid_index[rs.add_request(
+                    p, SamplingParams(max_tokens=mt,
+                                      model=model_id))] = idx
+            except EngineOverloaded:
+                rejected += 1
+        if rs.has_unfinished() or status is None:
+            rs.step()
+            if not any(r.has_unfinished() for r in rs.replicas) \
+                    and rs.has_unfinished():
+                time.sleep(0.002)    # restart/rejoin backoff pending
+        if ctl is not None and status is None:
+            ctl.tick()
+            if ctl.done():
+                status = ctl.status()
+        elif status is None and step >= deploy_at:
+            ctl = DeployController(rs, model_id, rev_to, config=dcfg)
+            if witness is not None:
+                from paddle_tpu.testing.locktrace import \
+                    instrument_deploy
+                instrument_deploy(ctl, witness)
+            ctl.start()
+        step += 1
+        if step > max_steps:
+            raise RuntimeError(
+                f"scenario failed to drain within {max_steps} steps")
+    wall = time.perf_counter() - t0
+    for audit in rs.check_integrity().values():
+        assert audit is None or audit["leaked"] == 0
+    return rs, status, rid_index, submitted, rejected, wall
+
+
 def _ttft_decomposition(label) -> dict:
     """Trace-derived TTFT decomposition for one engine/router instance
     (obs/reqtrace.py): median queue / admission / prefill /
@@ -855,7 +954,31 @@ def _check_slo(metrics: dict, slo: dict) -> dict:
         got = metrics["migrations"]["migrations"]
         if got < mig_min:
             viol.append(f"migrations {got} < {mig_min} "
-                        "(prefill->decode handoff tiering was vacuous)")
+                        "(no live KV-block handoff — the tier split / "
+                        "rollout drain was vacuous)")
+    c_min = slo.get("min_commits")
+    if c_min is not None and metrics["deploy"]["commits"] < c_min:
+        viol.append(f"deploy commits {metrics['deploy']['commits']} < "
+                    f"{c_min} (the clean rollout did not commit: "
+                    f"{metrics['deploy']['commit_pass']})")
+    rb_min = slo.get("min_rollbacks")
+    if rb_min is not None and metrics["deploy"]["rollbacks"] < rb_min:
+        viol.append(f"deploy rollbacks "
+                    f"{metrics['deploy']['rollbacks']} < {rb_min} "
+                    "(the canary parity gate did not reject the "
+                    "poisoned revision: "
+                    f"{metrics['deploy']['poisoned_pass']})")
+    dv_max = slo.get("max_divergent_old_rev")
+    if dv_max is not None:
+        bw = metrics["bitwise_old_rev"]
+        if bw["checked"] < 1:
+            viol.append("bitwise_old_rev checked 0 requests (no "
+                        "old-revision request finished during the "
+                        "deploy pass — the bitwise gate was vacuous)")
+        elif bw["divergent"] > dv_max:
+            viol.append(f"bitwise_old_rev divergent {bw['divergent']} "
+                        f"> {dv_max} (old-revision requests did not "
+                        "finish bitwise on old weights)")
     ratio_max = slo.get("max_tenant_p50_ratio")
     if ratio_max is not None:
         ratio = metrics["tenant_fairness"]["p50_ratio"]
@@ -1043,6 +1166,131 @@ def run_scenario(name: str, model=None, cfg=None, n: int = None,
             "shrink_events": asc.shrink_events,
             "final_active": rs.num_up(),
             "fleet_series": series,
+        }
+        m["lockgraph"] = _lockgraph_report(witness, predicted)
+        return _slo_verdict(name, m)
+    if name == "rolling_deploy":
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.serving import (DeployConfig,
+                                                  EngineConfig,
+                                                  ModelRegistry)
+        from paddle_tpu.models.gpt import GPT
+
+        # enough in-flight work that the first drained slot has live
+        # requests to migrate (min_migrations must be non-vacuous)
+        n = max(n, 12)
+        rng = np.random.RandomState(seed)
+
+        def prompt(lo, hi):
+            return rng.randint(1, cfg.vocab_size,
+                               (int(rng.randint(lo, hi)),),
+                               dtype=np.int32)
+        darr = [(2 * j, prompt(4, 10), int(rng.randint(6, 11)))
+                for j in range(n)]
+        ecfg = EngineConfig(block_size=4, num_blocks=48,
+                            max_num_seqs=4, decode_chunk_size=2,
+                            max_waiting=n, enable_prefix_cache=True)
+
+        # candidate revisions are GENUINELY different weights
+        # (different init seeds -> different sha256 manifests;
+        # identical weights publish idempotently as ONE revision)
+        def _rev_model(init_seed):
+            paddle.seed(init_seed)
+            m2 = GPT(cfg)
+            m2.eval()
+            return m2
+        new_model = _rev_model(1)
+        bad_model = _rev_model(2)
+
+        # fresh registry per pass: a committed deploy flips the
+        # registry's active revision, which would change what the NEXT
+        # pass's pool boots as
+        def mk_registry(candidate):
+            reg = ModelRegistry()
+            r_old = reg.publish("m", model, engine_config=ecfg)
+            r_new = reg.publish("m", candidate, engine_config=ecfg)
+            assert r_new != r_old, "seeded revisions collided"
+            return reg, r_old, r_new
+
+        witness, predicted = _lock_witness()
+        # the clean pass's candidate is MEANT to diverge (retrained
+        # weights), so its committed tolerance covers the full canary
+        # set; the poisoned pass below runs the strict default (0)
+        dcfg_commit = DeployConfig(canary_tolerance=3)
+        # warmup: one full rollout, unmeasured — compiles both
+        # revisions' engine buckets plus the canary/probe prompts
+        wreg, _, w_new = mk_registry(new_model)
+        _drive_deploy(wreg, "m", w_new, darr, dcfg_commit,
+                      witness=witness, obs_label="load-deploy-warm")
+        # measured pass 1: rollout under traffic must COMMIT
+        reg1, rev_old, rev_new = mk_registry(new_model)
+        rs, st1, rid_index, submitted, rejected, wall = _drive_deploy(
+            reg1, "m", rev_new, darr, dcfg_commit, witness=witness,
+            obs_label="load-deploy")
+        m = _metrics_router(rs, list(rid_index), submitted, rejected,
+                            wall)
+        m["migrations"] = rs.migrator.stats()
+        if st1["outcome"] == "committed":
+            assert reg1.active("m") == rev_new, \
+                "committed deploy left the registry on the old revision"
+        # bitwise reference: the SAME workload on a plain old-weights
+        # fleet with no deploy. Greedy decode + the stack's bitwise
+        # replay/migration invariants make per-request tokens a pure
+        # function of (weights, prompt), so any deploy-pass request
+        # that finished pinned to the OLD revision must match its
+        # reference twin token-for-token
+        brs, brids, bsub, _brej, _bwall = _drive_router(
+            model, ecfg, darr, obs_label="load-deploy-ref",
+            witness=witness)
+        assert len(brids) == bsub, \
+            "reference pass rejected requests; bitwise map broken"
+        base_tokens = {}
+        for j, r in enumerate(brids):
+            rec = brs.get_request(r)
+            if rec.finished and rec.finish_reason in ("stop", "length"):
+                base_tokens[j] = list(rec.tokens)
+        checked = divergent = on_new = 0
+        for rid, j in rid_index.items():
+            rec = rs.get_request(rid)
+            if not rec.finished \
+                    or rec.finish_reason not in ("stop", "length"):
+                continue
+            if rec.revision != rev_old:
+                on_new += 1          # served by the new revision
+                continue
+            if j in base_tokens:
+                checked += 1
+                if list(rec.tokens) != base_tokens[j]:
+                    divergent += 1
+        m["bitwise_old_rev"] = {"checked": checked,
+                                "divergent": divergent,
+                                "finished_on_new": on_new}
+        # pass 2: poisoned candidate under the strict default canary
+        # tolerance — the parity gate must refuse it, the rollback
+        # must restore the old revision, and nothing may be lost
+        reg2, rev_old2, rev_bad = mk_registry(bad_model)
+        prs, st2, prid_index, psub, _prej, _pwall = _drive_deploy(
+            reg2, "m", rev_bad, darr, DeployConfig(), witness=witness,
+            obs_label="load-deploy-poison")
+        plost = sum(1 for r in prid_index
+                    if not prs.get_request(r).finished)
+        assert reg2.active("m") == rev_old2, \
+            "poisoned revision went active despite the canary gate"
+        m["lost"] += plost
+        m["deploy"] = {
+            "commits": 1 if st1["outcome"] == "committed" else 0,
+            "rollbacks": 1 if st2["outcome"] == "rolled_back" else 0,
+            "commit_pass": {
+                "outcome": st1["outcome"], "error": st1["error"],
+                "from": st1["from_revision"],
+                "to": st1["to_revision"],
+                "ticks": st1["ticks"], "swapped": st1["swapped"],
+            },
+            "poisoned_pass": {
+                "outcome": st2["outcome"], "error": st2["error"],
+                "submitted": psub, "lost": plost,
+                "old_rev_still_active": reg2.active("m") == rev_old2,
+            },
         }
         m["lockgraph"] = _lockgraph_report(witness, predicted)
         return _slo_verdict(name, m)

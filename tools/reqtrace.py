@@ -35,7 +35,11 @@ FCFS stays machine-checked —, exactly-one terminal event per trace
 (a quota/deadline 'rejected' attempt waives that), every
 failover hop references a real predecessor replica, every migrate_in
 references the replica its migrate_out named and no decode emission
-lands between them) and exits 0/1 —
+lands between them, and no token is emitted under a model revision
+other than the one the trace's latest `admitted` event pinned — the
+rolling-deploy isolation invariant; deploy control-plane traces
+(deploy_start/replica_swap/canary/rollback/deploy_commit) are checked
+for exactly one terminal per started deploy instead) and exits 0/1 —
 the tier-1 suite runs it on a small recorded run. Dumps marked
 `"complete": false` (taken mid-run by an auto trigger) tolerate traces
 that have not reached their terminal event yet.
