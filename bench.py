@@ -743,6 +743,20 @@ def main():
         raise SystemExit(
             f"lockgraph preflight failed (exit {res.returncode})")
 
+    # jaxshard preflight (docs/static_cost.md): the sharding layouts we
+    # are about to bench must match the committed shardplan.json —
+    # coverage both ways, per-axis wire bytes within tolerance, zero
+    # unsuppressed findings. Same discipline as the lockgraph gate.
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "jaxshard.py"), "--plan", "check"],
+        capture_output=True, text=True)
+    if res.returncode != 0:
+        sys.stderr.write(res.stdout + res.stderr)
+        raise SystemExit(
+            f"jaxshard preflight failed (exit {res.returncode})")
+
     import jax
     on_tpu = jax.default_backend() != "cpu"
     tokens_per_sec, mfu = bench_gpt(on_tpu)
@@ -842,6 +856,21 @@ def main():
     if "roofline_tokens_per_sec" in ts:
         ts["measured_vs_roofline"] = round(
             tokens_per_sec / ts["roofline_tokens_per_sec"], 4)
+    # committed per-axis collective wire bytes (shardplan.json, already
+    # checked clean by the preflight above): what the static sharding
+    # model says each program moves per mesh axis, next to what we
+    # measured. stdlib read — the plan is a plain JSON artifact.
+    try:
+        _sp = json.load(open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "shardplan.json")))
+        _STATIC_EST["shard_comm"] = {
+            name: {"implicit_axis_bytes": e["implicit_axis_bytes"],
+                   "explicit_axis_bytes": e["explicit_axis_bytes"],
+                   "per_device_peak_bytes": e["per_device_peak_bytes"]}
+            for name, e in _sp["programs"].items()}
+    except (OSError, ValueError, KeyError):
+        pass
     if _STATIC_EST:
         line["static_model"] = _STATIC_EST
     print(json.dumps(line))

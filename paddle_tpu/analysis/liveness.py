@@ -76,10 +76,16 @@ class PeakReport:
 
 
 def peak_live_bytes(jaxpr_like, name: str = "<jaxpr>",
-                    pin_invars: bool = False) -> PeakReport:
+                    pin_invars: bool = False,
+                    bytes_fn=None) -> PeakReport:
     """Peak live-buffer bytes of a (Closed)Jaxpr by linear-scan
     liveness. `pin_invars` keeps every invar live to the end (used for
-    scan/while bodies — loop-carry double residency)."""
+    scan/while bodies — loop-carry double residency). `bytes_fn`
+    overrides the per-var byte charge (default `var_bytes`): jaxshard
+    passes bytes/shard_factor to turn the global peak into a per-device
+    peak without duplicating the scan."""
+    if bytes_fn is None:
+        bytes_fn = var_bytes
     closed = jaxpr_like if hasattr(jaxpr_like, "jaxpr") else None
     raw = closed.jaxpr if closed is not None else jaxpr_like
     eqns = list(raw.eqns)
@@ -102,7 +108,7 @@ def peak_live_bytes(jaxpr_like, name: str = "<jaxpr>",
     live: Dict[object, int] = {}
     entry = 0
     for v in list(raw.constvars) + list(raw.invars):
-        b = var_bytes(v)
+        b = bytes_fn(v)
         entry += b
         if v in last_use and v not in live:
             live[v] = b
@@ -110,13 +116,13 @@ def peak_live_bytes(jaxpr_like, name: str = "<jaxpr>",
 
     peak, where = entry, f"{name}:entry"
     for i, eqn in enumerate(eqns):
-        out_b = sum(var_bytes(v) for v in eqn.outvars)
+        out_b = sum(bytes_fn(v) for v in eqn.outvars)
         inner_extra = 0
         pin = eqn.primitive.name in _PIN_BODY
         for label, sub in _sub_jaxprs(eqn):
             rep = peak_live_bytes(
                 sub, name=f"{name}/{eqn.primitive.name}.{label}",
-                pin_invars=pin)
+                pin_invars=pin, bytes_fn=bytes_fn)
             inner_extra = max(inner_extra,
                               max(0, rep.peak_bytes - rep.entry_bytes))
         cur = live_total + out_b + inner_extra
@@ -125,7 +131,7 @@ def peak_live_bytes(jaxpr_like, name: str = "<jaxpr>",
         for v in eqn.outvars:
             lu = last_use.get(v)
             if lu is not None and lu > i and v not in live:
-                b = var_bytes(v)
+                b = bytes_fn(v)
                 live[v] = b
                 live_total += b
         for v in [u for u, lu in last_use.items()

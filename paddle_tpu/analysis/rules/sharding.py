@@ -1,0 +1,265 @@
+"""Sharding-policy rules: PartitionSpec layouts belong to the shard
+plan, not to call sites.
+
+PR 19 introduced jaxshard (analysis/jaxshard.py): per-program sharding
+layouts are abstract-interpreted, triaged, and committed to
+shardplan.json. A literal `P(...)` handed straight to a sharding
+consumer (`with_sharding_constraint`, `NamedSharding`, `shard_map`
+in/out specs, jit in/out shardings, `device_put`) forks that policy at
+the call site — the plan gate keeps passing while the program lays
+tensors out some other way. And a mesh-axis name that no enclosing
+mesh defines ("tpx" for "tp") silently no-ops: GSPMD treats the dim as
+unsharded and the program replicates. Two rules make both visible:
+
+  PT-S001  literal PartitionSpec at a sharding call site (route the
+           layout through the committed shard plan, or suppress with
+           a reason)
+  PT-S002  mesh-axis name used in a spec but absent from every mesh
+           the enclosing module can build
+
+Taint-style propagation (same discipline as the trace-safety rules):
+`spec = P(None, None, "sp", None)` followed by
+`shard_map(..., in_specs=(spec,))` fires PT-S001 at the ASSIGNMENT —
+the layout decision — so the suppression reason lives where the spec
+is chosen. Bare `P()` (replicated) is exempt: replication is the
+absence of a layout decision. As with PT-T009, the suppression IS the
+workflow: the sanctioned plumbing layers (parallel/mesh.py,
+parallel/api.py, distributed/tp_layers.py) and the jaxshard registry
+itself carry `# ptlint: disable=PT-S001` comments explaining why they
+are the mechanism rather than a policy fork.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..ast_core import Finding, ModuleContext, Rule
+from .trace_safety import _dotted
+
+__all__ = ["ShardingPolicyRule", "SHARDING_RULES"]
+
+SHARDING_RULES = {
+    "PT-S001": ("error",
+                "literal PartitionSpec at a sharding call site (bypass "
+                "of the committed shard plan)"),
+    "PT-S002": ("error",
+                "mesh-axis name used in a PartitionSpec but absent "
+                "from every mesh the module can build"),
+}
+
+#: the canonical mesh vocabulary: parallel/mesh.py build_mesh axes.
+#: Modules that construct no mesh of their own (they run under the
+#: global mesh) are checked against this set.
+_BUILD_MESH_AXES = frozenset({"dp", "pp", "sharding", "sp", "ep", "tp"})
+
+#: callee tails that consume a layout
+_CONSUMER_TAILS = frozenset({
+    "with_sharding_constraint", "NamedSharding", "shard_map",
+    "device_put", "named_sharding",
+})
+#: keywords that consume a layout on ANY call (jit, shard_map, ...)
+_CONSUMER_KWARGS = frozenset({
+    "in_shardings", "out_shardings", "in_specs", "out_specs",
+    "sharding", "shardings", "device",
+})
+
+
+def _is_pspec_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func)
+    if name is None:
+        return False
+    return name.split(".")[-1] in ("P", "PartitionSpec")
+
+
+def _nonempty_pspec(node: ast.Call) -> bool:
+    """Bare P() carries no layout decision; P(None) and friends do
+    (an explicit every-dim-replicated pin is still a decision).
+    `P(*spec)` is exempt too: a starred forward passes on a spec the
+    call site did not choose — the decision lives upstream."""
+    args = [a for a in node.args if not isinstance(a, ast.Starred)]
+    return bool(args or node.keywords)
+
+
+def _spec_axis_names(node: ast.Call) -> Iterable[Tuple[str, ast.AST]]:
+    """String mesh-axis names inside one P(...) literal, with the node
+    carrying each (axes may sit inside per-dim tuples)."""
+    def walk(n):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.value, n
+        elif isinstance(n, (ast.Tuple, ast.List)):
+            for e in n.elts:
+                yield from walk(e)
+
+    for a in node.args:
+        yield from walk(a)
+    for kw in node.keywords:
+        if kw.arg is None:
+            continue
+        yield from walk(kw.value)
+
+
+def _module_mesh_axes(tree: ast.Module) -> Tuple[Set[str], bool]:
+    """(axis names of every mesh this module builds, found_any).
+    Recognizes `Mesh(devs, ("a", "b"))` / `Mesh(..., axis_names=...)`
+    literals and `build_mesh(dp=4, tp=2)` kwarg names."""
+    axes: Set[str] = set()
+    found = False
+
+    def strings(n) -> Iterable[str]:
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.value
+        elif isinstance(n, (ast.Tuple, ast.List)):
+            for e in n.elts:
+                yield from strings(e)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        if tail == "Mesh":
+            cands = list(node.args[1:2]) + [
+                kw.value for kw in node.keywords
+                if kw.arg == "axis_names"]
+            for c in cands:
+                got = set(strings(c))
+                if got:
+                    axes |= got
+                    found = True
+        elif tail == "build_mesh":
+            got = {kw.arg for kw in node.keywords
+                   if kw.arg and kw.arg != "devices"}
+            if got:
+                axes |= got & _BUILD_MESH_AXES
+                found = True
+    return axes, found
+
+
+class ShardingPolicyRule(Rule):
+    """PT-S001 (literal spec at a consumer) + PT-S002 (unknown axis)."""
+
+    ids = tuple(SHARDING_RULES)
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        mesh_axes, found_mesh = _module_mesh_axes(ctx.tree)
+        # a module that builds its own mesh may also still run pieces
+        # under the global build_mesh mesh — the union is the set of
+        # names that can possibly bind
+        known_axes = mesh_axes | _BUILD_MESH_AXES
+
+        # ---- PT-S002: every axis name in every spec literal
+        sev2 = SHARDING_RULES["PT-S002"][0]
+        for node in ast.walk(ctx.tree):
+            if not _is_pspec_call(node):
+                continue
+            for axis, anchor in _spec_axis_names(node):
+                if axis not in known_axes:
+                    where = ("meshes built here define "
+                             f"{sorted(mesh_axes)}" if found_mesh
+                             else "no mesh is built in this module; "
+                                  "build_mesh axes are "
+                                  f"{sorted(_BUILD_MESH_AXES)}")
+                    findings.append(ctx.finding(
+                        "PT-S002", anchor,
+                        f"axis {axis!r} is not a mesh axis any "
+                        f"enclosing mesh defines ({where}) — GSPMD "
+                        f"silently treats the dim as unsharded",
+                        severity=sev2))
+
+        # ---- PT-S001: spec literals consumed by sharding call sites
+        sev1 = SHARDING_RULES["PT-S001"][0]
+        emitted: Set[int] = set()
+
+        def emit(anchor, how: str):
+            if id(anchor) in emitted:
+                return
+            emitted.add(id(anchor))
+            findings.append(ctx.finding(
+                "PT-S001", anchor,
+                f"literal PartitionSpec {how}: layouts are planned "
+                f"and committed (analysis/jaxshard.py -> "
+                f"shardplan.json); consume the plan's layout or "
+                f"suppress with a reason", severity=sev1))
+
+        # taint sources: name = <expr containing a nonempty P literal>,
+        # recorded per enclosing function scope (module counts as one)
+        scopes: List[Tuple[ast.AST, List[ast.stmt]]] = [
+            (ctx.tree, list(ctx.tree.body))]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node, list(node.body)))
+
+        for scope, _body in scopes:
+            tainted: Dict[str, ast.AST] = {}
+            for node in _scope_walk(scope):
+                if isinstance(node, ast.Assign):
+                    lits = [n for n in ast.walk(node.value)
+                            if _is_pspec_call(n) and _nonempty_pspec(n)]
+                    if lits:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                tainted[t.id] = node
+            if not tainted:
+                continue
+            for node in _scope_walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not _is_consumer(node):
+                    continue
+                for arg in _consumed_exprs(node):
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name) and n.id in tainted:
+                            emit(tainted[n.id],
+                                 f"assigned here reaches "
+                                 f"{_callee_label(node)}")
+
+        # direct literals inside a consumer's arguments
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_consumer(node):
+                continue
+            for arg in _consumed_exprs(node):
+                for n in ast.walk(arg):
+                    if _is_pspec_call(n) and _nonempty_pspec(n):
+                        emit(n, f"passed to {_callee_label(node)}")
+        return findings
+
+
+def _scope_walk(scope: ast.AST):
+    """Walk a function scope WITHOUT descending into nested defs (each
+    nested def is its own scope entry)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_consumer(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    tail = name.split(".")[-1] if name else ""
+    if tail in _CONSUMER_TAILS:
+        return True
+    return any(kw.arg in _CONSUMER_KWARGS for kw in call.keywords)
+
+
+def _consumed_exprs(call: ast.Call) -> Iterable[ast.AST]:
+    name = _dotted(call.func)
+    tail = name.split(".")[-1] if name else ""
+    if tail in _CONSUMER_TAILS:
+        yield from call.args
+    for kw in call.keywords:
+        if kw.arg in _CONSUMER_KWARGS or tail in _CONSUMER_TAILS:
+            yield kw.value
+
+
+def _callee_label(call: ast.Call) -> str:
+    name = _dotted(call.func)
+    if name:
+        return f"'{name}(...)'"
+    kws = [kw.arg for kw in call.keywords if kw.arg in _CONSUMER_KWARGS]
+    return f"a call with {'/'.join(kws) or 'sharding'} keywords"

@@ -92,6 +92,10 @@ class DeviceEmbeddingCache:
             client.pull_sparse_state(table_id, ids), np.float32))
         if mesh is not None and axis is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
+            # ptlint: disable=PT-S001  parameter-server row placement:
+            # the embedding table shards over the caller-chosen axis by
+            # construction (PS tables are outside the jaxshard registry
+            # — they never enter a traced training program)
             sh = NamedSharding(mesh, P(axis, None))
             table = jax.device_put(table, sh)
             state = jax.device_put(state, sh)

@@ -186,6 +186,9 @@ class GPTAttention(nn.Layer):
                 "implemented (per-chunk RNG across the rotating ring); "
                 "set dropout=0.0 or context_parallel=False")
         mesh = ensure_global_mesh()
+        # ptlint: disable=PT-S001  the sequence-parallel contract of
+        # ring attention: heads stay local, sequence shards over 'sp' —
+        # jaxshard budgets this exact layout in collective.ring_attention
         spec = P(None, None, "sp", None)
         fn = shard_map(
             lambda q_, k_, v_: ring_attention(q_, k_, v_, "sp",

@@ -1,0 +1,28 @@
+"""PT-S001 true negatives: bare P() (replication is the absence of a
+layout decision), starred forwards (the decision lives upstream),
+plan-sourced shardings, and a spec table that never reaches a
+sharding consumer.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def forwarded(mesh, spec):
+    # the caller chose the layout; this wrapper only plumbs it
+    return NamedSharding(mesh, P(*spec))
+
+
+def planned(fn, plan):
+    return jax.jit(fn, in_shardings=plan.in_shardings,
+                   out_shardings=plan.out_shardings)
+
+
+# a data table of specs is not a call site; the consumer that reads it
+# is where routing through the plan gets checked
+_TABLE = {"wte.weight": P("tp", None)}

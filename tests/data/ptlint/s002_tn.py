@@ -1,0 +1,24 @@
+"""PT-S002 true negatives: axis names resolved by the module's own
+Mesh literal ("rows"/"cols"), by build_mesh kwargs, and by the global
+build_mesh vocabulary (a module running under the global mesh builds
+no mesh of its own).
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import build_mesh
+
+
+def build(devs):
+    return Mesh(np.asarray(devs), ("rows", "cols"))
+
+
+def build_global():
+    return build_mesh(sharding=2, tp=2)
+
+
+LOCAL = P("rows", "cols")
+GLOBAL = P("dp", None)
+TP = P(None, "tp")

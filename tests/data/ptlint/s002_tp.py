@@ -1,0 +1,18 @@
+"""PT-S002 true positives: mesh-axis names in PartitionSpec literals
+that no enclosing mesh defines — the module's own Mesh has axes
+("dp", "mdl"), build_mesh's vocabulary adds pp/sharding/sp/ep/tp, and
+neither contains "tpx" (a typo for "tp") or "seq". GSPMD silently
+treats such dims as unsharded.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def build(devs):
+    return Mesh(np.asarray(devs), ("dp", "mdl"))
+
+
+BAD_TYPO = P("tpx", None)  # expect: PT-S002
+BAD_UNKNOWN = P(None, "seq")  # expect: PT-S002

@@ -92,6 +92,15 @@ def main(argv=None) -> int:
         violations = jaxcost.check_budget(
             args.budget_file, costs,
             require_full_coverage=names is None)
+        # cross-artifact gate: for programs committed in BOTH the
+        # budget and the shard plan (shardplan.json), jaxshard's
+        # explicit per-axis collective bytes must sum to this budget's
+        # comm_bytes — both artifacts price collectives off the same
+        # byte table, so disagreement means one of them is stale
+        from paddle_tpu.analysis import jaxshard
+        with open(args.budget_file) as f:
+            committed = json.load(f)
+        violations += jaxshard.crosscheck_with_budget(committed)
 
     if args.format == "json":
         print(json.dumps({

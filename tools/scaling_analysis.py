@@ -241,6 +241,24 @@ def main(counts):
         else:
             print(f"static roofline child FAILED:\n{out.stderr[-2000:]}",
                   file=sys.stderr)
+        # sharded static model (shardplan.json, committed by
+        # tools/jaxshard.py): per-mesh-axis collective wire bytes and
+        # per-device peak for the fsdp x tp train step, beside the
+        # measured anchor. Plain-JSON read — this parent stays jax-free.
+        try:
+            sp = json.load(open(os.path.join(ROOT, "shardplan.json")))
+            tr = sp["programs"]["train_step.fsdp_tp"]
+            print(json.dumps({
+                "shard_static_model": "train_step.fsdp_tp",
+                "mesh": tr["mesh"],
+                "implicit_axis_bytes": tr["implicit_axis_bytes"],
+                "explicit_axis_bytes": tr["explicit_axis_bytes"],
+                "per_device_peak_bytes": tr["per_device_peak_bytes"],
+                "envelope_ok": tr["envelope_ok"],
+            }), flush=True)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"shard static model unavailable: {e!r}",
+                  file=sys.stderr)
         print(json.dumps({
             "projection_note": "efficiency floor = compute/(compute+"
             "unoverlapped ICI ring all-reduce); anchored to measured "
