@@ -757,6 +757,21 @@ def main():
         raise SystemExit(
             f"jaxshard preflight failed (exit {res.returncode})")
 
+    # jaxnum preflight (docs/static_analysis.md NUM-* rules): the
+    # numerics of the programs we are about to bench must match the
+    # committed numplan.json — per-program error bounds within
+    # tolerance, every finding triaged, and the int8 KV codec's derived
+    # dequant bound still pinned to its declared budget
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "jaxnum.py"), "--plan", "check"],
+        capture_output=True, text=True)
+    if res.returncode != 0:
+        sys.stderr.write(res.stdout + res.stderr)
+        raise SystemExit(
+            f"jaxnum preflight failed (exit {res.returncode})")
+
     import jax
     on_tpu = jax.default_backend() != "cpu"
     tokens_per_sec, mfu = bench_gpt(on_tpu)

@@ -13,7 +13,16 @@ equation (recursing through pjit/scan/cond/while sub-jaxprs) for:
 - unintended dtype downcasts ("downcasts"): convert_element_type from a
   >=32-bit float to a sub-32-bit float. NOTE the package enables
   jax_enable_x64, so f64→f32 converts are everywhere and deliberate —
-  only precision drops BELOW 32 bits are flagged.
+  only precision drops BELOW 32 bits are flagged. The dtype predicate
+  itself lives in analysis/jaxnum.py (`lossy_float_downcast`) — ONE
+  bfloat16-aware lattice shared with the whole-program numerics
+  analyzer;
+- integer narrowing ("int_narrowing", opt-in): convert_element_type to
+  a strictly narrower integer (int64→int32 table/length casts). Not in
+  DEFAULT_CHECKS because gather-index casts (`lab.astype(int32)`) are
+  deliberate and this trace-level check has no value-range analysis to
+  tell them apart — jaxnum's NUM-CAST rule is the range-aware version,
+  and numplan.json is where its findings are triaged and gated.
 
 Entry points: `audit_fn` on any callable, `audit_train_step` on a
 jit.TrainStep, `audit_decode_programs` on the four decode sub-programs
@@ -30,6 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# the shared dtype lattice: jaxnum owns the bfloat16-aware downcast /
+# narrowing predicates (module-level import is cycle-safe — jaxnum's
+# registry imports run lazily inside its builder functions)
+from . import jaxnum as _lattice
+
 __all__ = ["AuditIssue", "JaxprAuditError", "FORBIDDEN_PRIMITIVES",
            "audit_jaxpr", "audit_fn", "audit_train_step",
            "audit_decode_programs", "assert_clean",
@@ -42,6 +56,9 @@ FORBIDDEN_PRIMITIVES = frozenset({
 })
 
 DEFAULT_CHECKS = ("callbacks", "consts", "downcasts")
+#: every check audit_jaxpr knows; "int_narrowing" is opt-in (see the
+#: module docstring for why)
+ALL_CHECKS = ("callbacks", "consts", "downcasts", "int_narrowing")
 #: one closure-captured array bigger than this means someone baked
 #: state into the executable instead of passing it as an argument
 DEFAULT_MAX_CONST_BYTES = 1 << 20
@@ -49,7 +66,7 @@ DEFAULT_MAX_CONST_BYTES = 1 << 20
 
 @dataclass(frozen=True)
 class AuditIssue:
-    kind: str        # "callback" | "const" | "downcast"
+    kind: str        # "callback" | "const" | "downcast" | "int_narrowing"
     where: str       # entry-point name (+ sub-jaxpr path)
     message: str
 
@@ -139,7 +156,8 @@ def audit_jaxpr(jaxpr_like, name: str = "<jaxpr>",
                 "callback", path,
                 f"forbidden primitive '{pname}' — a host round-trip "
                 f"inside the compiled program"))
-        if "downcasts" in checks and pname == "convert_element_type":
+        if pname == "convert_element_type" and (
+                "downcasts" in checks or "int_narrowing" in checks):
             invar = eqn.invars[0]
             if _is_literal(invar):
                 continue  # literal converts are free trace-time consts
@@ -149,18 +167,22 @@ def audit_jaxpr(jaxpr_like, name: str = "<jaxpr>",
                 continue
             src = np.dtype(src)
             dst = np.dtype(dst)
-            # jnp.issubdtype, not np.issubdtype: bfloat16 (ml_dtypes)
-            # sits outside numpy's type lattice and is exactly the
-            # downcast this check exists to catch
-            if (jnp.issubdtype(src, jnp.floating)
-                    and jnp.issubdtype(dst, jnp.floating)
-                    and src.itemsize >= 4 and dst.itemsize < 4):
+            if "downcasts" in checks and \
+                    _lattice.lossy_float_downcast(src, dst):
                 issues.append(AuditIssue(
                     "downcast", path,
                     f"float downcast {src.name} -> {dst.name}: "
                     f"sub-32-bit precision entered the program; if "
                     f"intentional, audit with checks excluding "
                     f"'downcasts'"))
+            if "int_narrowing" in checks and \
+                    _lattice.lossy_int_narrowing(src, dst):
+                issues.append(AuditIssue(
+                    "int_narrowing", path,
+                    f"integer narrowing {src.name} -> {dst.name}: "
+                    f"values past 2^{8 * dst.itemsize - 1} wrap; "
+                    f"jaxnum's NUM-CAST rule proves or refutes the "
+                    f"range — prefer gating via numplan.json"))
     return issues
 
 

@@ -8,18 +8,21 @@ from __future__ import annotations
 
 from .concurrency import CONCURRENCY_RULES, LockDisciplineRule
 from .lockorder import LOCKORDER_RULES, LockOrderRule
+from .numerics import NUMERICS_RULES, NumericsCastRule
 from .policy import POLICY_RULES, PolicyCentralizationRule
 from .sharding import SHARDING_RULES, ShardingPolicyRule
 from .trace_safety import TRACE_RULES, TraceSafetyRule
 
 __all__ = ["RULE_CATALOG", "default_rules", "TraceSafetyRule",
            "LockDisciplineRule", "LockOrderRule",
-           "PolicyCentralizationRule", "ShardingPolicyRule"]
+           "PolicyCentralizationRule", "ShardingPolicyRule",
+           "NumericsCastRule"]
 
 RULE_CATALOG = {**TRACE_RULES, **CONCURRENCY_RULES, **LOCKORDER_RULES,
-                **POLICY_RULES, **SHARDING_RULES}
+                **POLICY_RULES, **SHARDING_RULES, **NUMERICS_RULES}
 
 
 def default_rules():
     return [TraceSafetyRule(), LockDisciplineRule(), LockOrderRule(),
-            PolicyCentralizationRule(), ShardingPolicyRule()]
+            PolicyCentralizationRule(), ShardingPolicyRule(),
+            NumericsCastRule()]

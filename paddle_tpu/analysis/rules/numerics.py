@@ -1,0 +1,164 @@
+"""Numerics-policy rule: lossy storage casts belong to the sanctioned
+cast helpers, not to call sites.
+
+PR 20 introduced jaxnum (analysis/jaxnum.py): per-program numerics are
+abstract-interpreted, error bounds derived, findings triaged, and the
+result committed to numplan.json. A literal sub-32-bit `astype` /
+`dtype=` at an arbitrary call site forks that policy the same way a
+literal PartitionSpec forks the shard plan: the committed precision
+plan keeps passing while some tensor quietly loses mantissa (or wraps)
+outside any analyzed program.
+
+  PT-N001  literal lossy dtype (`float16`/`bfloat16`/`int8`/...)
+           consumed by `.astype(...)` or a `dtype=` keyword outside a
+           sanctioned cast helper (route the cast through amp
+           (amp/auto_cast.py, static/amp.py), the quantization ops
+           (ops/quant_ops.py), or the KV codec
+           (inference/serving/kv_quant.py) — or suppress with a
+           reason)
+
+Taint-style propagation (the PT-S001 discipline): `dt = jnp.bfloat16`
+followed by `x.astype(dt)` fires at the ASSIGNMENT — the precision
+decision — so the suppression reason lives where the dtype is chosen.
+32-bit-and-wider dtypes (`float32`, `int32`, `float64`, ...) are
+exempt: the package runs with jax_enable_x64, so down-to-32 converts
+are the deliberate norm (the same boundary as jaxnum's
+`lossy_float_downcast`). The sanctioned helpers themselves carry
+`# ptlint: disable=PT-N001` comments explaining why they are the
+mechanism rather than a policy fork.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..ast_core import Finding, ModuleContext, Rule
+from .trace_safety import _dotted
+
+__all__ = ["NumericsCastRule", "NUMERICS_RULES"]
+
+NUMERICS_RULES = {
+    "PT-N001": ("error",
+                "literal lossy dtype at an astype/dtype= call site "
+                "(bypass of the committed precision plan)"),
+}
+
+#: sub-32-bit storage names — the same boundary jaxnum's
+#: lossy_float_downcast / lossy_int_narrowing draw (duplicated as
+#: strings because the lint core is stdlib-only and cannot import the
+#: jax-backed lattice)
+_LOSSY_DTYPES = frozenset({
+    "float16", "bfloat16", "half", "int8", "uint8", "int16", "uint16",
+})
+
+
+def _lossy_name(name: str) -> bool:
+    return name in _LOSSY_DTYPES or name.startswith("float8")
+
+
+def _is_lossy_literal(node: ast.AST) -> bool:
+    """A dtype literal that names sub-32-bit storage: a string
+    constant ("bfloat16") or a dotted attribute whose tail is one
+    (jnp.bfloat16, np.float16, ml_dtypes.float8_e4m3fn)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _lossy_name(node.value)
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        name = _dotted(node)
+        if name:
+            return _lossy_name(name.split(".")[-1])
+    return False
+
+
+def _lossy_literals(expr: ast.AST) -> List[ast.AST]:
+    return [n for n in ast.walk(expr) if _is_lossy_literal(n)]
+
+
+def _consumed_exprs(call: ast.Call) -> Iterable[ast.AST]:
+    """The expressions a call consumes as a dtype: every argument of
+    an `.astype(...)` method call, and any `dtype=` keyword."""
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr == "astype":
+        yield from call.args
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            yield kw.value
+
+
+def _callee_label(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr == "astype":
+        return "'.astype(...)'"
+    name = _dotted(call.func)
+    return f"'{name}(dtype=...)'" if name else "a dtype= keyword"
+
+
+class NumericsCastRule(Rule):
+    """PT-N001: literal lossy dtype reaching an astype/dtype= site."""
+
+    ids = tuple(NUMERICS_RULES)
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        sev = NUMERICS_RULES["PT-N001"][0]
+        emitted: Set[int] = set()
+
+        def emit(anchor, how: str):
+            if id(anchor) in emitted:
+                return
+            emitted.add(id(anchor))
+            findings.append(ctx.finding(
+                "PT-N001", anchor,
+                f"literal lossy dtype {how}: sub-32-bit precision is "
+                f"planned and committed (analysis/jaxnum.py -> "
+                f"numplan.json); route the cast through a sanctioned "
+                f"helper (amp, ops/quant_ops.py, kv_quant.py) or "
+                f"suppress with a reason", severity=sev))
+
+        # taint sources: name = <expr containing a lossy dtype
+        # literal>, recorded per enclosing function scope (module
+        # counts as one scope)
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+
+        for scope in scopes:
+            tainted: Dict[str, ast.AST] = {}
+            for node in _scope_walk(scope):
+                if isinstance(node, ast.Assign) and \
+                        _lossy_literals(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tainted[t.id] = node
+            if not tainted:
+                continue
+            for node in _scope_walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                for arg in _consumed_exprs(node):
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name) and n.id in tainted:
+                            emit(tainted[n.id],
+                                 f"assigned here reaches "
+                                 f"{_callee_label(node)}")
+
+        # direct literals inside a consumer's dtype expressions
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for arg in _consumed_exprs(node):
+                for n in _lossy_literals(arg):
+                    emit(n, f"passed to {_callee_label(node)}")
+        return findings
+
+
+def _scope_walk(scope: ast.AST):
+    """Walk a function scope WITHOUT descending into nested defs (each
+    nested def is its own scope entry)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

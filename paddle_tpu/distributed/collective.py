@@ -375,7 +375,7 @@ def all_gather_object(obj_list, obj, group=None):
     bytes)."""
     if _multiproc():
         import pickle
-        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)  # ptlint: disable=PT-N001  reinterprets pickle BYTES for the wire, not a numeric cast
         lens = _host_allgather(np.asarray([blob.size], np.int64))
         width = int(lens.max())
         padded = np.zeros(width, np.uint8)

@@ -354,7 +354,7 @@ def _apply_loss_strategies(loss_fn, strategy: DistributedStrategy):
 
         def amp_fn(model, *args, _fn=fn):
             with auto_cast(level="O2" if cfg.get("use_pure_fp16") else "O1",
-                           dtype=cfg.get("dtype", "bfloat16"),
+                           dtype=cfg.get("dtype", "bfloat16"),  # ptlint: disable=PT-N001  plumbs the user's amp config INTO auto_cast, the sanctioned amp helper
                            custom_white_list=cfg.get("custom_white_list"),
                            custom_black_list=cfg.get("custom_black_list")):
                 return _fn(model, *args)

@@ -513,7 +513,7 @@ def load(out, file_path, load_as_fp16=False):
     arr = next(iter(state.values())) if isinstance(state, dict) else state
     arr = np.asarray(arr)
     if load_as_fp16:
-        arr = arr.astype(np.float16)
+        arr = arr.astype(np.float16)  # ptlint: disable=PT-N001  load_as_fp16 is the caller's explicit request (load_op.cc parity)
     out._value = to_tensor(arr)._value
     return out
 

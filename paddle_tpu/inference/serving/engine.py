@@ -129,6 +129,14 @@ class EngineConfig:
     # (entries stay host-resident, retryable) and the request re-prefills
     # the unpromoted suffix. None = unbounded.
     promote_timeout_s: Optional[float] = None
+    # KV pool storage dtype (docs/serving.md "int8 KV blocks"): "int8"
+    # stores the block pools (and host-tier spills) as int8 codes +
+    # per-(block, head) scales via serving/kv_quant.py, ~4x less
+    # resident KV. Decoded output then tracks the f32 engine within the
+    # dequantization bound jaxnum derives and numplan.json commits
+    # (serving.kv_block_codec). "float32" (default) is the historical
+    # bitwise-exact pool.
+    kv_cache_dtype: str = "float32"
     # ----------------------------- robustness layer (docs/serving.md)
     max_waiting: Optional[int] = None    # bounded waiting queue (None=∞)
     admission_policy: str = "reject"     # 'reject' | 'shed_oldest'
@@ -575,7 +583,8 @@ class LLMEngine:
             L, H, D, config.num_blocks, config.block_size,
             enable_prefix_cache=config.enable_prefix_cache,
             host_tier_blocks=config.host_tier_blocks,
-            promote_timeout_s=config.promote_timeout_s)
+            promote_timeout_s=config.promote_timeout_s,
+            kv_cache_dtype=config.kv_cache_dtype)
         cost_model = config.prefill_cost_model
         if cost_model == "auto":
             # committed-plan admission pricing; a repo without a plan

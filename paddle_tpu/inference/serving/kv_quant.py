@@ -1,0 +1,101 @@
+"""int8 KV-block codec: per-block-per-head symmetric quantization.
+
+Roadmap item 2 (TP serving with int8 KV-cache blocks, per PAPERS.md
+"EQuARX: Efficient Quantized AllReduce in XLA") needs the KV pools and
+the host spill tier to hold int8 codes instead of f32 — but only with
+a *committed* error bound. This module is the codec the paged cache
+consumes (`PagedKVCache(kv_cache_dtype="int8")` pool mode and the
+quantized host-tier spill path), and the first real consumer of the
+jaxnum numerics analyzer (analysis/jaxnum.py): `kv_block_roundtrip`
+is registered as the `serving.kv_block_codec` program, jaxnum derives
+its worst-case dequantization error from the quantize→dequantize
+provenance in the jaxpr, and the derived bound is pinned in
+numplan.json against the declared budget below.
+
+Scheme (symmetric, zero-point-free — KV activations are zero-centered
+and a zero-point would break the "fresh block is all-zero" parity
+contract, since 0.0 must encode exactly):
+
+    scale[b, h] = absmax over block b, head h / 127
+    q           = clip(round(x / scale), -127, 127)  int8
+    x_hat       = q * scale
+
+Worst-case relative error (fullscale of the (block, head) tile):
+|x - x_hat| <= 0.5 * scale = 0.5/127 * absmax — `KV_INT8_REL_ERR`,
+the budget jaxnum checks the derived bound against.
+
+Requantization stability: the pool-mode setter re-encodes the WHOLE
+pool every decode chunk, so unchanged blocks must round-trip
+bit-identically. `requantize_blocks` keeps scales MONOTONE
+(s' = max(s_old, absmax/127)): an unchanged block's dequantized
+values are q*s with |q| <= 127, so absmax/127 <= s_old, the scale
+stays put, and round(q*s/s) recovers q exactly. Only blocks whose
+content actually grew in magnitude re-encode at a larger scale.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KV_INT8_LEVELS", "KV_INT8_REL_ERR", "quantize_blocks",
+           "requantize_blocks", "dequantize_blocks",
+           "kv_block_roundtrip"]
+
+#: symmetric int8 code range: [-127, 127] (-128 unused so the range is
+#: sign-symmetric and |q| * scale never exceeds absmax)
+KV_INT8_LEVELS = 127
+
+#: declared worst-case dequant error, relative to the (block, head)
+#: tile's fullscale (its absmax at quantization time). jaxnum derives
+#: the same 0.5/levels bound from the codec's jaxpr and numplan.json
+#: pins the two against each other.
+KV_INT8_REL_ERR = 0.5 / KV_INT8_LEVELS
+
+
+def _safe(scale):
+    # all-zero tiles have scale 0; dividing by 1 instead keeps q = 0
+    # exactly (jaxnum cannot see this guard relationally — the codec's
+    # finite:div suppression in numplan.json records why it is safe)
+    return jnp.where(scale > 0, scale, 1.0)
+
+
+def _encode(x, scale):
+    s = _safe(scale)[:, None, :, None]
+    q = jnp.clip(jnp.round(x / s), -KV_INT8_LEVELS, KV_INT8_LEVELS)
+    return q.astype(jnp.int8)  # ptlint: disable=PT-N001  THE sanctioned KV codec: bound derived by jaxnum, pinned in numplan.json
+
+
+def _quantize_blocks(x):
+    """Fresh per-(block, head) symmetric encode of `x`
+    [n, block_size, H, D] -> (q int8, scale f32 [n, H])."""
+    absmax = jnp.max(jnp.abs(x), axis=(1, 3))
+    scale = absmax / KV_INT8_LEVELS
+    return _encode(x, scale), scale
+
+
+def _requantize_blocks(x, prev_scale):
+    """Monotone-scale encode for the pool-mode setter: scales never
+    shrink, so a block whose dequantized content is unchanged
+    round-trips bit-identically (see module docstring)."""
+    absmax = jnp.max(jnp.abs(x), axis=(1, 3))
+    scale = jnp.maximum(prev_scale, absmax / KV_INT8_LEVELS)
+    return _encode(x, scale), scale
+
+
+def _dequantize_blocks(q, scale):
+    """Decode (q int8 [n, bs, H, D], scale f32 [n, H]) -> f32."""
+    return q.astype(scale.dtype) * scale[:, None, :, None]
+
+
+quantize_blocks = jax.jit(_quantize_blocks)
+requantize_blocks = jax.jit(_requantize_blocks)
+dequantize_blocks = jax.jit(_dequantize_blocks)
+
+
+def kv_block_roundtrip(x):
+    """quantize→dequantize composition — the `serving.kv_block_codec`
+    jaxnum registry program. Un-jitted on purpose: jaxnum traces it
+    directly and derives the dequant error bound from the round/clip/
+    convert provenance, pinning it against KV_INT8_REL_ERR."""
+    q, scale = _quantize_blocks(x)
+    return _dequantize_blocks(q, scale)

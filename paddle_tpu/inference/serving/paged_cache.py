@@ -38,6 +38,21 @@ suffix. The zero-leak, refcount and scrub-taint invariants span both
 tiers (`check_integrity` cross-tier keys; a distrusted subtree's
 host copies are poisoned, never promoted).
 
+int8 pool mode (docs/serving.md "int8 KV blocks"): with
+`kv_cache_dtype="int8"` the pools are STORED as int8 codes plus
+per-(block, head) f32 scales (serving/kv_quant.py), cutting resident
+KV bytes ~4x. `pools` stays the logical f32 interface — the property
+getter dequantizes, the setter re-encodes with MONOTONE scales so a
+block whose content didn't change round-trips bit-identically — and
+every consumer (attention gather, write_prefill scatter, migration,
+scrub, promotion) is oblivious. The worst-case dequantization error
+is not folklore: analysis/jaxnum.py derives it from the codec's
+jaxpr (`serving.kv_block_codec`) and numplan.json pins it against
+the declared `KV_INT8_REL_ERR` budget. Host-tier spill in this mode
+stores the QUANTIZED payload (codes + scale rows under one sha256),
+so the spill tier gets the same ~4x and the integrity contract is
+unchanged.
+
 Host/device split: block accounting (free list, tables, lengths,
 refcounts, trie, counters) is plain Python — it feeds the scheduler
 and never traces. The pools themselves are jax arrays; `write_prefill`
@@ -54,6 +69,7 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from . import kv_quant
 from .host_tier import HostTierStore
 from .prefix_cache import PrefixCacheIndex, PrefixNode
 
@@ -99,18 +115,37 @@ class PagedKVCache:
                  num_blocks: int, block_size: int, dtype=jnp.float32,
                  enable_prefix_cache: bool = False,
                  host_tier_blocks: int = 0,
-                 promote_timeout_s: Optional[float] = None):
+                 promote_timeout_s: Optional[float] = None,
+                 kv_cache_dtype: str = "float32"):
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
+        if kv_cache_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'float32' or 'int8', got "
+                f"{kv_cache_dtype!r}")
         self.num_layers = num_layers
         self.num_heads = num_heads
         self.head_dim = head_dim
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.kv_cache_dtype = kv_cache_dtype
         shape = (num_blocks, block_size, num_heads, head_dim)
-        self.pools: Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...] = tuple(
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-            for _ in range(num_layers))
+        if kv_cache_dtype == "int8":
+            # quantized pool mode (module docstring): int8 codes +
+            # per-(block, head) scales; the `pools` property is the
+            # dequantized f32 view every consumer reads and writes
+            self._qpools = tuple(
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8))
+                for _ in range(num_layers))
+            self._scales = tuple(
+                (jnp.zeros((num_blocks, num_heads), jnp.float32),
+                 jnp.zeros((num_blocks, num_heads), jnp.float32))
+                for _ in range(num_layers))
+        else:
+            self._qpools = None
+            self._pools: Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...] = \
+                tuple((jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                      for _ in range(num_layers))
         # ----------------------------------------------- host accounting
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._tables: Dict[object, List[int]] = {}
@@ -157,6 +192,51 @@ class PagedKVCache:
         # weighted eviction (None = historical global LRU)
         self._seq_tenant: Dict[object, str] = {}
         self._tenant_weights: Optional[Dict[str, float]] = None
+
+    # ------------------------------------------------ pool storage view
+    @property
+    def pools(self) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]:
+        """L-tuple of (k, v) [num_blocks, block_size, H, D] in the
+        LOGICAL f32 layout — what the attention gather, write_prefill
+        scatter, migration and scrub paths all read and assign. In f32
+        mode this is the storage itself (bit-for-bit the historical
+        attribute). In int8 mode the getter dequantizes the code/scale
+        storage and the setter re-encodes through
+        kv_quant.requantize_blocks, whose monotone scales make an
+        unchanged block's round-trip bit-stable (kv_quant docstring),
+        so repeated decode-chunk rebinds never walk stored values."""
+        if self._qpools is None:
+            return self._pools
+        return tuple(
+            (kv_quant.dequantize_blocks(qk, sk),
+             kv_quant.dequantize_blocks(qv, sv))
+            for (qk, qv), (sk, sv) in zip(self._qpools, self._scales))
+
+    @pools.setter
+    def pools(self, new_pools) -> None:
+        if self._qpools is None:
+            self._pools = tuple(new_pools)
+            return
+        qpools, scales = [], []
+        for (k, v), (sk, sv) in zip(new_pools, self._scales):
+            qk, nsk = kv_quant.requantize_blocks(k, sk)
+            qv, nsv = kv_quant.requantize_blocks(v, sv)
+            qpools.append((qk, qv))
+            scales.append((nsk, nsv))
+        self._qpools = tuple(qpools)
+        self._scales = tuple(scales)
+
+    def _reset_block_scales(self, ids) -> None:
+        """Zero freshly-claimed blocks' scale rows (int8 mode): stale
+        codes dequantize against scale 0 to exact zeros — the
+        fresh-block invariant — and the next write derives its scale
+        from the new content alone. A surviving (larger) scale from the
+        block's previous tenant would inflate the quantization step
+        past the committed relative-error bound (numplan.json)."""
+        at = jnp.asarray(list(ids), jnp.int32)
+        self._scales = tuple(
+            (sk.at[at].set(0.0), sv.at[at].set(0.0))
+            for sk, sv in self._scales)
 
     def arm_tier_faults(self, faults: "ServingFaultInjector",
                         step: int) -> None:
@@ -241,6 +321,8 @@ class PagedKVCache:
         got = [self._free.pop() for _ in range(n)]
         for b in got:
             self._refcount[b] = 1
+        if self._qpools is not None and got:
+            self._reset_block_scales(got)
         self.blocks_allocated += n
         self.high_water = max(self.high_water, self.num_used())
         return got
@@ -325,6 +407,17 @@ class PagedKVCache:
             h.update(np.ascontiguousarray(v).tobytes())
         return h.hexdigest()
 
+    def _dequant_payload(self, payload) -> tuple:
+        """Decode a QUANTIZED spill payload (L int8 code pairs + the
+        trailing (k_scales [L, H], v_scales [L, H]) pair) back to the
+        L-pair f32 shape the scatter/wire paths expect. Only meaningful
+        in int8 mode; called after the stored digest has verified."""
+        ks, vs = payload[self.num_layers]
+        return tuple(
+            (payload[li][0].astype(np.float32) * ks[li][None, :, None],
+             payload[li][1].astype(np.float32) * vs[li][None, :, None])
+            for li in range(self.num_layers))
+
     def _flush_demotions(self, nodes: List[PrefixNode]) -> None:
         """Spill the staged victims' payloads to the host tier and free
         their device blocks (demote-instead-of-free). The payload read
@@ -339,12 +432,27 @@ class PagedKVCache:
         if not nodes:
             return
         ids = jnp.asarray([n.block for n in nodes], dtype=jnp.int32)
-        per_layer = [(np.asarray(kp[ids]), np.asarray(vp[ids]))
-                     for kp, vp in self.pools]
+        if self._qpools is not None:
+            # spill QUANTIZED (module docstring): the codes gather per
+            # pool tensor, plus every layer's scale rows appended as ONE
+            # extra (L+1)-th pair — the pair-iterating digest therefore
+            # covers codes AND scales, and the store's byte accounting /
+            # corrupt_oldest chaos hook work unchanged
+            per_layer = [(np.asarray(qk[ids]), np.asarray(qv[ids]))
+                         for qk, qv in self._qpools]
+            sc = [(np.asarray(sk[ids]), np.asarray(sv[ids]))
+                  for sk, sv in self._scales]
+        else:
+            per_layer = [(np.asarray(kp[ids]), np.asarray(vp[ids]))
+                         for kp, vp in self.pools]
+            sc = None
         for i, node in enumerate(nodes):
             b = node.block
             payload = tuple((np.array(pk[i]), np.array(pv[i]))
                             for pk, pv in per_layer)
+            if sc is not None:
+                payload += ((np.stack([k[i] for k, _ in sc]),
+                             np.stack([v[i] for _, v in sc])),)
             hid, dropped = self.host_tier.put(
                 payload, self._payload_digest(payload))
             self.prefix_index.demote(node, hid)
@@ -532,7 +640,13 @@ class PagedKVCache:
             self._free.append(b)
             self.blocks_freed += 1
             return "raced", None, None
-        return "hit", b, entry["payload"]
+        payload = entry["payload"]
+        if self._qpools is not None:
+            # the batched commit scatters through the f32 `pools` view;
+            # decode the verified quantized payload here so the commit
+            # path is mode-oblivious
+            payload = self._dequant_payload(payload)
+        return "hit", b, payload
 
     def drain_promote_seconds(self) -> List[float]:
         """Hand accumulated promote-latency samples to the engine's
@@ -797,7 +911,14 @@ class PagedKVCache:
             if self._payload_digest(entry["payload"]) != entry["digest"]:
                 self._drop_host_subtree(node)
                 break
-            blocks.append((entry["payload"], entry["digest"]))
+            payload, digest = entry["payload"], entry["digest"]
+            if self._qpools is not None:
+                # peers admit uniform f32 payloads (admit_prefix stacks
+                # per-layer pairs across blocks): decode the verified
+                # quantized spill and digest the decoded wire form fresh
+                payload = self._dequant_payload(payload)
+                digest = self._payload_digest(payload)
+            blocks.append((payload, digest))
         if not blocks:
             return None
         for payload, _ in blocks:
@@ -1066,6 +1187,7 @@ class PagedKVCache:
         return {
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
+            "kv_cache_dtype": self.kv_cache_dtype,
             "free": self.num_free(),
             "used": self.num_used(),
             "utilization": self.utilization(),

@@ -358,10 +358,10 @@ class Layer:
         return self.to(dtype="float32")
 
     def half(self):
-        return self.to(dtype="float16")
+        return self.to(dtype="float16")  # ptlint: disable=PT-N001  .half() IS the user's explicit cast request (Paddle API parity)
 
     def bfloat16(self):
-        return self.to(dtype="bfloat16")
+        return self.to(dtype="bfloat16")  # ptlint: disable=PT-N001  .bfloat16() IS the user's explicit cast request (Paddle API parity)
 
     def astype(self, dtype):
         return self.to(dtype=dtype)

@@ -142,8 +142,14 @@ def fake_quantize_dequantize_moving_average_abs_max(x, scale, bit_length=8,
 @op("quantize_linear", differentiable=False)
 def _quantize_linear(x, scale, zero_point, bit_length):
     bnt = (1 << (bit_length - 1)) - 1
-    return jnp.clip(jnp.round(x / scale + zero_point), -bnt - 1, bnt) \
-        .astype(jnp.int8 if bit_length <= 8 else jnp.int32)
+    # round BEFORE adding the zero point: saturate(round(x/scale) + zp)
+    # per ONNX QuantizeLinear / quantize_linear_op. Folding zp into the
+    # round operand flips round-half-to-even tie parity whenever zp is
+    # odd (x=0.5, scale=1, zp=1: round(0.5)+1 = 1, but the folded
+    # round(1.5) = 2) — a silent one-code divergence on every tie.
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s) + zero_point, -bnt - 1, bnt) \
+        .astype(jnp.int8 if bit_length <= 8 else jnp.int32)  # ptlint: disable=PT-N001  quantize_linear IS a sanctioned quantization helper
 
 
 def quantize_linear(x, scale, zero_point=0.0, bit_length=8, name=None):
@@ -251,7 +257,11 @@ def _fq_range_abs_max(x, in_scale, it, window_size, bit_length):
     # window restart every window_size steps, else running max
     restart = (it % window_size) == 0
     out_scale = jnp.where(restart, cur, jnp.maximum(in_scale, cur))
-    q = jnp.clip(jnp.round(x / out_scale * bound), -bound, bound)
+    # every sibling guards its divisor; on a window-restart step with an
+    # all-zero batch out_scale is exactly 0 and the unguarded divide
+    # poisons q with NaN
+    q = jnp.clip(jnp.round(x / jnp.maximum(out_scale, 1e-9) * bound),
+                 -bound, bound)
     return q, out_scale, it + 1
 
 

@@ -26,7 +26,7 @@ def _synthetic_digits(n, seed, image_size=28, num_classes=10):
         base = np.sin(freq * (np.cos(angle) * xx + np.sin(angle) * yy))
         images[mask] = base[None] * 127.5 + 127.5
     images += rng.randn(n, image_size, image_size) * 8.0
-    return np.clip(images, 0, 255).astype(np.uint8), ys.astype(np.int64)
+    return np.clip(images, 0, 255).astype(np.uint8), ys.astype(np.int64)  # ptlint: disable=PT-N001  uint8 pixel storage after an explicit [0, 255] clip — range-exact
 
 
 class MNIST(Dataset):

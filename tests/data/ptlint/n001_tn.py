@@ -1,0 +1,38 @@
+"""PT-N001 true negatives: 32-bit-and-wider casts (the x64 package's
+deliberate norm), dtype variables plumbed from a caller (the decision
+lives upstream), lossy names outside any cast consumer, and a
+suppressed sanctioned helper.
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax.numpy as jnp
+
+
+def widen(x):
+    return x.astype(jnp.float32)
+
+
+def narrow_to_32(x):
+    # x64 mode: int64 -> int32 index casts are the deliberate norm;
+    # the range-aware version of this check is jaxnum's NUM-CAST
+    return x.astype(jnp.int32)
+
+
+def forwarded(x, dtype):
+    # the caller chose the dtype; this wrapper only plumbs it
+    return x.astype(dtype)
+
+
+def creation(shape):
+    return jnp.zeros(shape, dtype=jnp.float32)
+
+
+# a dtype table is not a call site; the consumer that reads it is
+# where routing through a sanctioned helper gets checked
+_WIDTHS = {"bfloat16": 2, "float16": 2, "float32": 4}
+
+
+def sanctioned(q):
+    # quantization helpers ARE the mechanism; they carry a reasoned
+    # suppression exactly like the shipped codec does
+    return q.astype(jnp.int8)  # ptlint: disable=PT-N001  fixture helper

@@ -1,0 +1,34 @@
+"""PT-N001 true positives: literal sub-32-bit dtypes at astype/dtype=
+call sites — a direct lossy literal handed to `.astype`, a `dtype=`
+keyword, and tainted assignments whose dtype reaches a cast — all
+bypassing the committed precision plan (numplan.json).
+
+Lint fixture — parsed by ptlint, never executed.
+"""
+import jax.numpy as jnp
+
+
+def cast_activation(x):
+    return x.astype(jnp.bfloat16)  # expect: PT-N001
+
+
+def cast_string(x):
+    return x.astype("float16")  # expect: PT-N001
+
+
+def build_buffer(shape):
+    return jnp.zeros(shape, dtype=jnp.int8)  # expect: PT-N001
+
+
+def tainted_cast(x):
+    dt = jnp.bfloat16  # expect: PT-N001
+    return x.astype(dt)
+
+
+def tainted_kwarg(shape):
+    storage = "float16"  # expect: PT-N001
+    return jnp.ones(shape, dtype=storage)
+
+
+def fp8_cast(x):
+    return x.astype(jnp.float8_e4m3fn)  # expect: PT-N001

@@ -1,0 +1,394 @@
+"""jaxnum: the whole-program numerics analyzer and its committed plan.
+
+Covers the ISSUE-20 contract:
+  - dtype-lattice predicates (the one lattice jaxpr_audit delegates to),
+  - bound exactness: hand-computed matmul-chain error in f32 ulps,
+    f32 vs bf16 storage vs bf16 accumulation (NUM-ACC TP and TN),
+  - scan error growth with trip count (exact iteration + linear tail
+    extrapolation past SCAN_EXACT_MAX),
+  - NUM-FINITE true positive AND clamp-provenance true negative for
+    exp and div,
+  - NUM-CAST: lossy roundtrip detection, integer narrowing with
+    range-proven (iota / clamp) true negatives,
+  - int8 KV codec: derived bound == declared budget, and SOUNDNESS —
+    the static bound dominates the measured max dequant error while
+    staying within 4x of it (no vacuous over-bound),
+  - registry/plan coverage in both directions, every committed finding
+    suppressed with a reason,
+  - diff_plans structural + tolerance drift detection,
+  - CLI exit-code semantics (0 clean / 1 violation / 2 usage),
+  - quant_ops regression pins (zero-point tie parity, window-restart
+    divisor guard),
+  - jaxpr_audit "int_narrowing" stays opt-in (outside DEFAULT_CHECKS).
+"""
+import copy
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import jaxnum, jaxpr_audit
+
+pytestmark = pytest.mark.lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+JAXNUM_CLI = REPO / "tools" / "jaxnum.py"
+PLAN_FILE = REPO / "numplan.json"
+
+BF16 = 2 ** 16          # ulps32 of one bfloat16 rounding (23-7 bits)
+F16 = 2 ** 13           # ulps32 of one float16 rounding (23-10 bits)
+
+
+# -------------------------------------------------------------- lattice
+class TestLattice:
+    def test_float_downcast_predicate(self):
+        f64, f32 = np.dtype(np.float64), np.dtype(np.float32)
+        f16, bf16 = np.dtype(np.float16), np.dtype(jnp.bfloat16)
+        assert jaxnum.lossy_float_downcast(f32, f16)
+        assert jaxnum.lossy_float_downcast(f32, bf16)
+        assert jaxnum.lossy_float_downcast(f64, f16)
+        # x64 mode makes f64 inputs routine; f64 -> f32 is the
+        # deliberate repo-wide normalization, not a lossy event
+        assert not jaxnum.lossy_float_downcast(f64, f32)
+        assert not jaxnum.lossy_float_downcast(f16, f32)   # widening
+        assert not jaxnum.lossy_float_downcast(f16, bf16)  # already sub-32
+
+    def test_int_narrowing_predicate(self):
+        i64, i32, i8 = (np.dtype(np.int64), np.dtype(np.int32),
+                        np.dtype(np.int8))
+        assert jaxnum.lossy_int_narrowing(i64, i32)
+        assert jaxnum.lossy_int_narrowing(i32, i8)
+        assert not jaxnum.lossy_int_narrowing(i32, i64)
+        assert not jaxnum.lossy_int_narrowing(i32, np.dtype(np.float32))
+
+    def test_ulps32_scale(self):
+        assert jaxnum.ulps32(np.dtype(np.float32)) == 1.0
+        assert jaxnum.ulps32(np.dtype(jnp.bfloat16)) == BF16
+        assert jaxnum.ulps32(np.dtype(np.float16)) == F16
+        # f64 rounding is far below one f32 ulp
+        assert jaxnum.ulps32(np.dtype(np.float64)) < 1e-8
+
+    def test_opaque_dtypes_tolerated(self):
+        key = jax.random.key(0)
+        # extended dtypes (PRNG keys) must pass through the lattice
+        # without np.dtype explosions
+        assert not jaxnum.is_float(jaxnum._dt(key.dtype))
+        assert not jaxnum.is_int(jaxnum._dt(key.dtype))
+
+
+# ------------------------------------------------------- bound exactness
+class TestBounds:
+    def test_matmul_chain_hand_computed(self):
+        """(a @ b) @ c, all f32: each dot charges n * u(acc) + u(out)
+        = K + 1 ulps on top of the operand errors.
+        a[8,64] @ b[64,16]: 64 + 1 = 65; @ c[16,4]: 65 + 16 + 1 = 82."""
+        a = jnp.zeros((8, 64), jnp.float32)
+        b = jnp.zeros((64, 16), jnp.float32)
+        c = jnp.zeros((16, 4), jnp.float32)
+        rep = jaxnum.analyze_fn(lambda a, b, c: (a @ b) @ c,
+                                a, b, c, name="t.chain")
+        assert rep.max_error_ulps == 82.0
+        assert rep.findings == []
+        assert rep.acc_dtypes == ["float32"]
+
+    def test_bf16_storage_f32_accum(self):
+        """bf16 storage casts cost 2^16 ulps each; the f32-accumulated
+        dot adds 64 + 1: 2*65536 + 65 = 131137. No NUM-ACC — the
+        accumulator is full-width."""
+        a = jnp.zeros((8, 64), jnp.float32)
+        b = jnp.zeros((64, 16), jnp.float32)
+
+        def f(a, b):
+            return jnp.dot(a.astype(jnp.bfloat16),
+                           b.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
+
+        rep = jaxnum.analyze_fn(f, a, b, name="t.chain16")
+        assert rep.max_error_ulps == 2 * BF16 + 65
+        assert not [f for f in rep.findings if f.rule == "NUM-ACC"]
+        assert rep.acc_dtypes == ["float32"]
+
+    def test_bf16_accumulation_num_acc(self):
+        """Accumulating IN bf16 multiplies the n-term by 2^16:
+        65536 * (2 + 64 + 1) — and NUM-ACC must fire (u(acc) > 1,
+        n = 64 >= NUM_ACC_MIN_ELEMS)."""
+        a = jnp.zeros((8, 64), jnp.float32)
+        b = jnp.zeros((64, 16), jnp.float32)
+
+        def f(a, b):
+            return jax.lax.dot_general(
+                a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.bfloat16)
+
+        rep = jaxnum.analyze_fn(f, a, b, name="t.acc16")
+        assert rep.max_error_ulps == BF16 * 67
+        keys = [f.key for f in rep.findings]
+        assert "acc:dot_general:bfloat16" in keys
+
+    def test_scan_error_grows_with_trip_count(self):
+        """An eps-accumulating carry grows linearly in T — exactly
+        iterated to SCAN_EXACT_MAX, linear tail extrapolation past it
+        (T=512 > 256 must note the extrapolation and keep the slope)."""
+        x = jnp.zeros((4,), jnp.float32)
+
+        def make(T):
+            def f(x):
+                def body(c, _):
+                    c = c * 1.000001 + 1.0
+                    return c, c
+                out, _ = jax.lax.scan(body, x, None, length=T)
+                return out
+            return f
+
+        e32 = jaxnum.analyze_fn(make(32), x, name="t.s32")
+        e128 = jaxnum.analyze_fn(make(128), x, name="t.s128")
+        e512 = jaxnum.analyze_fn(make(512), x, name="t.s512")
+        assert e32.max_error_ulps == 64.0      # 2 ulps per trip
+        assert e128.max_error_ulps == 256.0
+        assert e512.max_error_ulps == 1024.0   # extrapolated tail
+        assert any("extrapolat" in n for n in e512.notes)
+
+
+# ------------------------------------------------------------ NUM-FINITE
+class TestFinite:
+    X = jnp.zeros((4,), jnp.float32)
+
+    def test_exp_unbounded_fires(self):
+        rep = jaxnum.analyze_fn(lambda x: jnp.exp(x), self.X, name="t.e")
+        assert "finite:exp" in [f.key for f in rep.findings]
+
+    def test_exp_clamped_is_clean(self):
+        rep = jaxnum.analyze_fn(
+            lambda x: jnp.exp(jnp.clip(x, -10.0, 10.0)), self.X,
+            name="t.ec")
+        assert rep.findings == []
+
+    def test_div_unbounded_denominator_fires(self):
+        rep = jaxnum.analyze_fn(lambda x, y: x / y, self.X, self.X,
+                                name="t.d")
+        assert "finite:div:div" in [f.key for f in rep.findings]
+
+    def test_div_clamped_denominator_is_clean(self):
+        rep = jaxnum.analyze_fn(
+            lambda x, y: x / jnp.clip(y, 1.0, 2.0), self.X, self.X,
+            name="t.dc")
+        assert rep.findings == []
+
+
+# -------------------------------------------------------------- NUM-CAST
+class TestCast:
+    def test_lossy_roundtrip_detected(self):
+        x = jnp.zeros((4,), jnp.float32)
+        rep = jaxnum.analyze_fn(
+            lambda x: x.astype(jnp.bfloat16).astype(jnp.float32), x,
+            name="t.rt")
+        assert [f.key for f in rep.findings] == \
+            ["cast:roundtrip:bfloat16->float32"]
+        # the widening cannot recover the 2^16-ulp storage loss
+        assert rep.max_error_ulps == BF16
+
+    def test_int_narrowing_unproven_fires(self):
+        x = jnp.zeros((4,), jnp.int64)
+        rep = jaxnum.analyze_fn(lambda x: x.astype(jnp.int32), x,
+                                name="t.n")
+        assert "cast:int:int64->int32" in [f.key for f in rep.findings]
+
+    def test_int_narrowing_proven_range_is_clean(self):
+        """Interval provenance refutes the narrowing: iota and clamp
+        both prove the value fits int32 — the range-aware gate that
+        jaxpr_audit's blanket opt-in check can't provide."""
+        x = jnp.zeros((4,), jnp.int64)
+        r1 = jaxnum.analyze_fn(
+            lambda: jnp.arange(10, dtype=jnp.int64).astype(jnp.int32),
+            name="t.ni")
+        r2 = jaxnum.analyze_fn(
+            lambda x: jnp.clip(x, 0, 100).astype(jnp.int32), x,
+            name="t.nc")
+        assert r1.findings == []
+        assert r2.findings == []
+
+
+# ----------------------------------------------------------- int8 codec
+class TestCodec:
+    def test_derived_bound_matches_budget(self):
+        from paddle_tpu.inference.serving import kv_quant
+        x = jnp.zeros((4, 16, 4, 8), jnp.float32)
+        rep = jaxnum.analyze_fn(
+            kv_quant.kv_block_roundtrip, x, name="t.codec",
+            suppress={"finite:div:div": "where-guarded"},
+            quant_budget=kv_quant.KV_INT8_REL_ERR)
+        assert rep.quant is not None
+        assert rep.quant["levels"] == kv_quant.KV_INT8_LEVELS
+        assert rep.quant["derived_rel_err"] == \
+            pytest.approx(0.5 / kv_quant.KV_INT8_LEVELS, rel=1e-4)
+        assert rep.unsuppressed() == []
+
+    def test_static_bound_sound_and_tight(self):
+        """The committed bound must DOMINATE the measured max dequant
+        error (soundness) without being vacuous (<= 4x measured)."""
+        from paddle_tpu.inference.serving import kv_quant
+        bound = jaxnum.committed_codec_bound(str(PLAN_FILE))
+        assert bound is not None
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(8, 16, 4, 16).astype(np.float32))
+        xhat = kv_quant.kv_block_roundtrip(x)
+        absmax = jnp.max(jnp.abs(x), axis=(1, 3), keepdims=True)
+        measured = float(jnp.max(jnp.abs(x - xhat) / absmax))
+        assert measured <= bound * (1 + 1e-6)
+        assert bound <= 4 * measured
+
+    def test_undeclared_budget_fires(self):
+        from paddle_tpu.inference.serving import kv_quant
+        x = jnp.zeros((2, 4, 2, 4), jnp.float32)
+        rep = jaxnum.analyze_fn(kv_quant.kv_block_roundtrip, x,
+                                name="t.nb")
+        assert "quant:undeclared" in [f.key for f in rep.findings]
+
+
+# ------------------------------------------------------ registry / plan
+@pytest.fixture(scope="module")
+def reports():
+    return jaxnum.compute_reports()
+
+
+class TestPlan:
+    def test_registry_coverage_both_directions(self, reports):
+        names = set(jaxnum.registry_names())
+        assert len(names) >= 12
+        assert set(reports) == names
+        plan = jaxnum.load_plan(str(PLAN_FILE))
+        assert plan is not None, "numplan.json must be committed"
+        assert set(plan["programs"]) == names
+
+    def test_committed_plan_is_clean(self, reports):
+        assert jaxnum.check_plan(str(PLAN_FILE), reports=reports) == []
+
+    def test_every_committed_finding_has_a_reason(self):
+        plan = jaxnum.load_plan(str(PLAN_FILE))
+        triaged = 0
+        for name, prog in plan["programs"].items():
+            for key, f in prog.get("findings", {}).items():
+                assert f.get("suppressed"), \
+                    f"{name}: {key} committed without a triage reason"
+                assert len(f["suppressed"]) > 20, \
+                    f"{name}: {key} reason is not a reason"
+                triaged += 1
+        assert triaged >= 10   # the registry is not finding-free
+
+    def test_diff_plans_drift_detection(self, reports):
+        committed = jaxnum.load_plan(str(PLAN_FILE))
+        current = jaxnum._plan_payload(reports)
+        assert jaxnum.diff_plans(committed, current) == []
+
+        drifted = copy.deepcopy(current)
+        codec = drifted["programs"]["serving.kv_block_codec"]
+        codec["max_error_ulps"] *= 2          # > 5% numeric drift
+        v = jaxnum.diff_plans(committed, drifted)
+        assert any("max_error_ulps drifted" in m for m in v)
+
+        missing = copy.deepcopy(current)
+        del missing["programs"]["train_step"]
+        v = jaxnum.diff_plans(committed, missing)
+        assert any("no longer in the registry" in m for m in v)
+        v = jaxnum.diff_plans(missing, current)
+        assert any("missing from the committed plan" in m for m in v)
+
+        unsup = copy.deepcopy(current)
+        fs = unsup["programs"]["train_step"]["findings"]
+        fs[next(iter(fs))]["suppressed"] = None
+        v = jaxnum.diff_plans(committed, unsup)
+        assert any("suppression changed" in m for m in v)
+
+    def test_small_bound_wobble_tolerated(self, reports):
+        committed = jaxnum.load_plan(str(PLAN_FILE))
+        wobbled = copy.deepcopy(jaxnum._plan_payload(reports))
+        entry = wobbled["programs"]["serving.kv_block_codec"]
+        entry["max_error_ulps"] *= 1.02       # inside the 5% tolerance
+        assert jaxnum.diff_plans(committed, wobbled) == []
+
+
+# ----------------------------------------------------------------- CLI
+def _run_cli(*args, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, str(JAXNUM_CLI), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+class TestCLI:
+    def test_check_committed_plan_exits_0(self):
+        res = _run_cli("--plan", "check")
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "0 plan violation" in res.stdout
+
+    def test_seeded_drift_exits_1(self, tmp_path):
+        plan = json.loads(PLAN_FILE.read_text())
+        plan["programs"]["serving.kv_block_codec"]["max_error_ulps"] /= 2
+        drifted = tmp_path / "numplan.json"
+        drifted.write_text(json.dumps(plan))
+        res = _run_cli("--plan", "check", "--plan-file", str(drifted))
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert "PLAN VIOLATION" in res.stdout
+
+    def test_missing_plan_exits_1(self, tmp_path):
+        res = _run_cli("--plan", "check", "--plan-file",
+                       str(tmp_path / "absent.json"))
+        assert res.returncode == 1
+        assert "no committed precision plan" in res.stdout
+
+    def test_usage_errors_exit_2(self):
+        res = _run_cli("--plan", "check", "--programs", "train_step")
+        assert res.returncode == 2
+        res = _run_cli("--programs", "no.such.program")
+        assert res.returncode == 2
+        assert "unknown program" in (res.stdout + res.stderr)
+
+
+# ---------------------------------------------------- quant_ops pins
+class TestQuantOpsRegressions:
+    def test_zero_point_outside_round_tie_parity(self):
+        """saturate(round(x/scale) + zp): x=0.5, scale=1, zp=1 must
+        give round(0.5)+1 = 1 (round-half-to-even), NOT the folded
+        round(1.5) = 2."""
+        from paddle_tpu.ops.quant_ops import quantize_linear
+        q = quantize_linear(jnp.asarray([0.5, 2.5, -0.5]),
+                            jnp.asarray(1.0), zero_point=1.0)
+        assert np.asarray(q._value).tolist() == [1, 3, 1]
+
+    def test_range_abs_max_zero_restart_batch_is_finite(self):
+        """Window-restart step with an all-zero batch: out_scale is
+        exactly 0 and the divide must be guarded, not NaN."""
+        from paddle_tpu.ops.quant_ops import fake_quantize_range_abs_max
+        q, scale, it = fake_quantize_range_abs_max(
+            jnp.zeros((4,), jnp.float32), jnp.asarray(3.0), iter=0,
+            window_size=10)
+        assert np.all(np.isfinite(np.asarray(q._value)))
+        assert np.asarray(q._value).tolist() == [0.0] * 4
+        assert float(scale._value) == 0.0     # the restart semantics
+
+
+# ------------------------------------------------- jaxpr_audit opt-in
+class TestAuditIntNarrowing:
+    def test_opt_in_not_default(self):
+        assert "int_narrowing" not in jaxpr_audit.DEFAULT_CHECKS
+        assert "int_narrowing" in jaxpr_audit.ALL_CHECKS
+
+    def test_narrowing_flagged_only_when_opted_in(self):
+        x = jnp.zeros((4,), jnp.int64)
+
+        def f(x):
+            return x.astype(jnp.int32)
+
+        default = jaxpr_audit.audit_fn(f, x)
+        assert default == []
+        opted = jaxpr_audit.audit_fn(f, x, checks=("int_narrowing",))
+        assert [i.kind for i in opted] == ["int_narrowing"]
+        assert "NUM-CAST" in opted[0].message

@@ -63,8 +63,12 @@ faults — `kill_demotion@step` (die mid-spill), `kill_promotion@step`
 block; the next promotion must fail sha256 verification and re-prefill
 instead). Gates: zero lost requests, zero leaked blocks on BOTH tiers
 (cross-tier check_integrity + drain-to-empty), bitwise survivors vs
-the unfaulted tiering-on run, non-vacuous demote/promote churn, and a
+the unfaulted tiering-on run, non-vacuous demote/promote churn, a
+forced-promotion integrity catch on a corrupted host entry, and a
 clean lock witness including the HostTierStore leaf lock.
+`--kv-cache-dtype int8` reruns all of it over the quantized pool +
+quantized spill (docs/serving.md "int8 KV blocks"), pinning that the
+sha256 digest covers the codes+scales payload too.
 
     JAX_PLATFORMS=cpu python tools/chaos_serve.py --tiering --seed 0
 
@@ -339,7 +343,8 @@ DEFAULT_TIERING_FAULTS = \
 def run_chaos_tiering(seed: int = 0, n_requests: int = 20,
                       faults: str = DEFAULT_TIERING_FAULTS,
                       max_steps: int = 600, cancel_every: int = 0,
-                      witness_out: str = "") -> dict:
+                      witness_out: str = "",
+                      kv_cache_dtype: str = "float32") -> dict:
     """One seeded hierarchical-tiering chaos run (docs/serving.md
     "Hierarchical KV-cache tiering"): templated traffic against a
     device pool far smaller than the prefix working set, with a host
@@ -361,6 +366,14 @@ def run_chaos_tiering(seed: int = 0, n_requests: int = 20,
       re-prefills);
     - non-vacuous: the run must demote, attempt promotions, and fire
       every scheduled tier fault;
+    - a corrupted host payload must be CAUGHT: the in-traffic
+      corrupt_host_block flips the LRU-oldest spill (which this
+      workload may never re-request), so after the drive the harness
+      ALSO corrupts a still-resident host entry and forces promotion
+      of its exact token path — the sha256 check must trip and drop
+      the entry; with kv_cache_dtype="int8" this pins that the
+      QUANTIZED spill payload (codes + scale rows under one digest)
+      still trips the integrity check, not just the f32 layout;
     - lock-order witness (HostTierStore leaf lock included):
       cycle-free, statically predicted."""
     from paddle_tpu.inference.serving import (EngineConfig, LLMEngine,
@@ -396,7 +409,8 @@ def run_chaos_tiering(seed: int = 0, n_requests: int = 20,
                         admission_policy="shed_oldest",
                         cache_high_watermark=0.9,
                         enable_prefix_cache=True,
-                        host_tier_blocks=64)
+                        host_tier_blocks=64,
+                        kv_cache_dtype=kv_cache_dtype)
 
     def drive(injector, do_cancel):
         eng = LLMEngine.from_model(model, ecfg, faults=injector)
@@ -499,6 +513,57 @@ def run_chaos_tiering(seed: int = 0, n_requests: int = 20,
     missing = scheduled - fired_kinds
     assert not missing, \
         f"scheduled tier faults never fired: {sorted(missing)}"
+    # 4b. the corruption contract must be CAUGHT, deterministically:
+    #    the in-traffic fault flips the LRU-oldest spill, which this
+    #    workload may never re-request, so corrupt a still-resident
+    #    host entry (shortest host run, so its promotion is attempted
+    #    first) and force-promote its exact token path — the sha256
+    #    check must trip and drop the entry. Under
+    #    kv_cache_dtype="int8" this pins that the QUANTIZED payload
+    #    (codes + trailing scale rows) is covered by the digest.
+    if "corrupt_host_block" in scheduled:
+        idx = eng.cache.prefix_index
+        best = None
+        for hid in eng.cache.host_tier.ids():
+            node = idx.node_of_host(hid)
+            if node is None:
+                continue
+            path, n = [], node
+            while n is not None and n.key is not None:
+                path.append(n)
+                n = n.parent
+            host_run = 0
+            for n in path:                     # leaf-ward: node first
+                if n.tier == "host":
+                    host_run += 1
+                else:
+                    break
+            if best is None or host_run < best[0]:
+                best = (host_run, hid, list(reversed(path)))
+        assert best is not None, \
+            "corrupt_host_block scheduled but no host entry still " \
+            "resident to pin the integrity contract on"
+        _hr, hid, path = best
+        toks = [t for n in path for t in n.key]
+        k0 = eng.cache.host_tier.get(hid)["payload"][0][0]
+        k0.flat[0] = k0.flat[0] + 1.0          # torn RAM, stale digest
+        pre = eng.cache.tier_promotions["integrity"]
+        # +1 sentinel: ensure_promoted drops the trailing (uncached)
+        # decode token before matching
+        res = eng.cache.ensure_promoted(toks + [0])
+        assert res is not None and "integrity" in res["outcomes"], \
+            f"forced promotion of a corrupted host payload was " \
+            f"silently admitted (outcomes: " \
+            f"{res and res['outcomes']}) — digest does not cover " \
+            f"the {eng.cache.kv_cache_dtype} payload"
+        assert eng.cache.tier_promotions["integrity"] == pre + 1
+        ps = eng.cache.prefix_stats()
+        report["promotions"] = {
+            k: ps[f"promote_{k}"]
+            for k in ("hit", "timeout", "integrity", "raced")}
+        report["forced_integrity_catch"] = {
+            "host_id": hid, "blocks_deep": len(path),
+            "kv_cache_dtype": eng.cache.kv_cache_dtype}
     # 5. both tiers drain to empty: the trie releases every cached
     #    device block, the host store every spilled payload, and the
     #    free-list crossing counters must balance exactly
@@ -1296,6 +1361,13 @@ def main(argv=None) -> int:
                          "sized below the working set, tier-targeted "
                          "faults (default "
                          f"{DEFAULT_TIERING_FAULTS!r})")
+    ap.add_argument("--kv-cache-dtype", choices=("float32", "int8"),
+                    default="float32",
+                    help="--tiering only: KV-block pool storage dtype; "
+                         "'int8' runs the harness over the quantized "
+                         "pool + quantized host-tier spill, pinning "
+                         "that the sha256 integrity contract holds for "
+                         "the codes+scales payload")
     ap.add_argument("--tenants", action="store_true",
                     help="multi-tenant autoscaling harness: WFQ-"
                          "admitted tenant traffic, the autoscaler in "
@@ -1362,7 +1434,8 @@ def main(argv=None) -> int:
                         else DEFAULT_TIERING_FAULTS),
                 max_steps=max(args.max_steps, 600),
                 cancel_every=args.cancel_every,
-                witness_out=args.witness_out)
+                witness_out=args.witness_out,
+                kv_cache_dtype=args.kv_cache_dtype)
         elif args.disagg:
             report = run_chaos_disagg(
                 seed=args.seed, n_requests=args.requests,
